@@ -1,0 +1,55 @@
+"""flink_tpu: a TPU-native stream & batch dataflow framework.
+
+A from-scratch rebuild of the capabilities of Apache Flink (reference:
+JMIsham/flink @ 1.5-SNAPSHOT) designed TPU-first: keyed state lives in
+TPU HBM as key-group-vectorized struct-of-arrays, per-record
+``AggregateFunction.add/merge`` calls are micro-batched into
+``jax.jit``/Pallas kernels, and the keyBy exchange between parallel
+subtasks maps onto XLA collectives over a ``jax.sharding.Mesh``.
+
+Layer map (mirrors SURVEY.md §1):
+
+  core/       config, functions, type serialization, state descriptors,
+              key groups              (ref: flink-core)
+  state/      keyed/operator state backends: heap + TPU-HBM
+              (ref: flink-runtime state SPI + RocksDB backend)
+  ops/        device kernels: hashing, HLL, Count-Min, quantile
+              sketches, segment aggregation (ref: none — the TPU
+              replacement for per-record JVM aggregation)
+  streaming/  StreamElement model, operators, windowing, timers,
+              DataStream API, graph translation
+              (ref: flink-streaming-java)
+  runtime/    jobgraph, local/mini-cluster execution, checkpoint
+              coordination, metrics     (ref: flink-runtime)
+  parallel/   device-mesh sharding of key groups, collective keyBy
+              exchange                  (ref: network stack / §2.8)
+  table/      Table API + SQL slice lowering onto the window operator
+              (ref: flink-libraries/flink-table)
+  cep/        pattern matching          (ref: flink-libraries/flink-cep)
+  connectors/ sources/sinks             (ref: flink-connectors)
+"""
+
+__version__ = "0.1.0"
+
+from flink_tpu.core.config import ConfigOption, ConfigOptions, Configuration
+from flink_tpu.core.functions import (
+    AggregateFunction,
+    FilterFunction,
+    FlatMapFunction,
+    KeySelector,
+    MapFunction,
+    ReduceFunction,
+)
+
+__all__ = [
+    "ConfigOption",
+    "ConfigOptions",
+    "Configuration",
+    "AggregateFunction",
+    "FilterFunction",
+    "FlatMapFunction",
+    "KeySelector",
+    "MapFunction",
+    "ReduceFunction",
+    "__version__",
+]

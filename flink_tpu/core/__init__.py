@@ -1,0 +1,2 @@
+"""Core primitives: config, functions, serialization, state descriptors,
+key groups.  (ref: flink-core — SURVEY.md §2.1)"""

@@ -1,0 +1,332 @@
+"""User-function SPI.
+
+Re-designs the reference's function interfaces (flink-core
+org/apache/flink/api/common/functions/ — MapFunction, FlatMapFunction,
+FilterFunction, ReduceFunction, AggregateFunction.java:127-160,
+RichFunction lifecycle) for Python.  Plain callables are accepted
+everywhere a single-method function is expected; the classes exist for
+rich lifecycle (open/close + runtime context) and for the multi-method
+``AggregateFunction`` contract that the TPU state backend vectorizes.
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import Any, Callable, Generic, Iterable, Optional, TypeVar
+
+IN = TypeVar("IN")
+IN1 = TypeVar("IN1")
+IN2 = TypeVar("IN2")
+OUT = TypeVar("OUT")
+ACC = TypeVar("ACC")
+KEY = TypeVar("KEY")
+
+
+class Function:
+    """Marker base for all user functions (ref: Function.java)."""
+
+
+class RuntimeContext:
+    """Per-subtask runtime context handed to rich functions.
+
+    Exposes subtask metadata, accumulators, and keyed-state access
+    (ref: flink-core/.../functions/RuntimeContext.java; state accessors
+    mirror RuntimeContext.getState/getListState/...).
+    """
+
+    def __init__(
+        self,
+        task_name: str = "task",
+        index_of_subtask: int = 0,
+        parallelism: int = 1,
+        max_parallelism: int = 128,
+        attempt_number: int = 0,
+        metric_group=None,
+        keyed_state_store=None,
+        operator_state_store=None,
+    ):
+        self.task_name = task_name
+        self.index_of_this_subtask = index_of_subtask
+        self.number_of_parallel_subtasks = parallelism
+        self.max_number_of_parallel_subtasks = max_parallelism
+        self.attempt_number = attempt_number
+        self.metric_group = metric_group
+        self._keyed_state_store = keyed_state_store
+        self._operator_state_store = operator_state_store
+        self.accumulators: dict[str, Any] = {}
+
+    # --- keyed state accessors --------------------------------------
+    def _keyed(self):
+        if self._keyed_state_store is None:
+            raise RuntimeError(
+                "Keyed state is only available on a keyed stream "
+                "(call .key_by(...) before the stateful function)")
+        return self._keyed_state_store
+
+    def get_state(self, descriptor):
+        return self._keyed().get_value_state(descriptor)
+
+    def get_list_state(self, descriptor):
+        return self._keyed().get_list_state(descriptor)
+
+    def get_reducing_state(self, descriptor):
+        return self._keyed().get_reducing_state(descriptor)
+
+    def get_aggregating_state(self, descriptor):
+        return self._keyed().get_aggregating_state(descriptor)
+
+    def get_map_state(self, descriptor):
+        return self._keyed().get_map_state(descriptor)
+
+    # --- accumulators ------------------------------------------------
+    def add_accumulator(self, name: str, accumulator) -> None:
+        self.accumulators[name] = accumulator
+
+    def get_accumulator(self, name: str):
+        return self.accumulators.get(name)
+
+
+class RichFunction(Function):
+    """Rich variant with lifecycle + runtime context
+    (ref: RichFunction.java)."""
+
+    def __init__(self):
+        self._runtime_context: Optional[RuntimeContext] = None
+
+    def open(self, configuration) -> None:  # noqa: B027
+        pass
+
+    def close(self) -> None:  # noqa: B027
+        pass
+
+    def set_runtime_context(self, ctx: RuntimeContext) -> None:
+        self._runtime_context = ctx
+
+    def get_runtime_context(self) -> RuntimeContext:
+        if self._runtime_context is None:
+            raise RuntimeError("runtime context not initialized; "
+                               "function not opened yet")
+        return self._runtime_context
+
+
+class MapFunction(Function, Generic[IN, OUT], abc.ABC):
+    """(ref: MapFunction.java)"""
+
+    @abc.abstractmethod
+    def map(self, value: IN) -> OUT:
+        ...
+
+
+class FlatMapFunction(Function, Generic[IN, OUT], abc.ABC):
+    """Returns an iterable of outputs per input (ref: FlatMapFunction.java
+    — the Collector argument becomes a returned iterable)."""
+
+    @abc.abstractmethod
+    def flat_map(self, value: IN) -> Iterable[OUT]:
+        ...
+
+
+class FilterFunction(Function, Generic[IN], abc.ABC):
+    """(ref: FilterFunction.java)"""
+
+    @abc.abstractmethod
+    def filter(self, value: IN) -> bool:
+        ...
+
+
+class ReduceFunction(Function, Generic[IN], abc.ABC):
+    """(ref: ReduceFunction.java)"""
+
+    @abc.abstractmethod
+    def reduce(self, value1: IN, value2: IN) -> IN:
+        ...
+
+
+class FoldFunction(Function, Generic[IN, OUT], abc.ABC):
+    """Deprecated in the reference but part of the API surface
+    (ref: FoldFunction.java)."""
+
+    @abc.abstractmethod
+    def fold(self, accumulator: OUT, value: IN) -> OUT:
+        ...
+
+
+class AggregateFunction(Function, Generic[IN, ACC, OUT], abc.ABC):
+    """Incremental aggregation contract — THE boundary the TPU backend
+    vectorizes (ref: flink-core/.../functions/AggregateFunction.java:127-160).
+
+    Implementations whose accumulator is a fixed-shape array state and
+    whose add/merge are expressible as jnp ops can additionally
+    implement :class:`flink_tpu.ops.device_agg.DeviceAggregateFunction`
+    to run micro-batched on TPU.
+    """
+
+    @abc.abstractmethod
+    def create_accumulator(self) -> ACC:
+        ...
+
+    @abc.abstractmethod
+    def add(self, value: IN, accumulator: ACC) -> ACC:
+        ...
+
+    @abc.abstractmethod
+    def get_result(self, accumulator: ACC) -> OUT:
+        ...
+
+    @abc.abstractmethod
+    def merge(self, a: ACC, b: ACC) -> ACC:
+        ...
+
+
+class KeySelector(Function, Generic[IN, KEY], abc.ABC):
+    """(ref: flink-core/.../functions/KeySelector.java... java/functions)"""
+
+    @abc.abstractmethod
+    def get_key(self, value: IN) -> KEY:
+        ...
+
+
+class CoMapFunction(Function, Generic[IN1, IN2, OUT], abc.ABC):
+    """(ref: flink-streaming-java co functions)"""
+
+    @abc.abstractmethod
+    def map1(self, value: IN1) -> OUT:
+        ...
+
+    @abc.abstractmethod
+    def map2(self, value: IN2) -> OUT:
+        ...
+
+
+class CoFlatMapFunction(Function, Generic[IN1, IN2, OUT], abc.ABC):
+    @abc.abstractmethod
+    def flat_map1(self, value: IN1) -> Iterable[OUT]:
+        ...
+
+    @abc.abstractmethod
+    def flat_map2(self, value: IN2) -> Iterable[OUT]:
+        ...
+
+
+class JoinFunction(Function, Generic[IN1, IN2, OUT], abc.ABC):
+    @abc.abstractmethod
+    def join(self, first: IN1, second: IN2) -> OUT:
+        ...
+
+
+class CoGroupFunction(Function, Generic[IN1, IN2, OUT], abc.ABC):
+    @abc.abstractmethod
+    def co_group(self, first: Iterable[IN1], second: Iterable[IN2]) -> Iterable[OUT]:
+        ...
+
+
+# ---------------------------------------------------------------------
+# Adapters: accept plain callables wherever single-method functions go.
+# ---------------------------------------------------------------------
+
+def as_map_function(fn: "Callable[[IN], OUT] | MapFunction") -> MapFunction:
+    if isinstance(fn, MapFunction):
+        return fn
+    if callable(fn):
+        return _LambdaMap(fn)
+    raise TypeError(f"not a map function: {fn!r}")
+
+
+def as_flat_map_function(fn) -> FlatMapFunction:
+    if isinstance(fn, FlatMapFunction):
+        return fn
+    if callable(fn):
+        return _LambdaFlatMap(fn)
+    raise TypeError(f"not a flat-map function: {fn!r}")
+
+
+def as_filter_function(fn) -> FilterFunction:
+    if isinstance(fn, FilterFunction):
+        return fn
+    if callable(fn):
+        return _LambdaFilter(fn)
+    raise TypeError(f"not a filter function: {fn!r}")
+
+
+def as_reduce_function(fn) -> ReduceFunction:
+    if isinstance(fn, ReduceFunction):
+        return fn
+    if callable(fn):
+        return _LambdaReduce(fn)
+    raise TypeError(f"not a reduce function: {fn!r}")
+
+
+def as_key_selector(fn) -> KeySelector:
+    if isinstance(fn, KeySelector):
+        return fn
+    if callable(fn):
+        return _LambdaKeySelector(fn)
+    if isinstance(fn, (str, int)):
+        return _FieldKeySelector(fn)
+    if isinstance(fn, (tuple, list)) and all(isinstance(f, (str, int)) for f in fn):
+        return _CompositeFieldKeySelector(tuple(fn))
+    raise TypeError(f"not a key selector: {fn!r}")
+
+
+class _LambdaMap(MapFunction):
+    def __init__(self, fn):
+        self._fn = fn
+
+    def map(self, value):
+        return self._fn(value)
+
+
+class _LambdaFlatMap(FlatMapFunction):
+    def __init__(self, fn):
+        self._fn = fn
+
+    def flat_map(self, value):
+        out = self._fn(value)
+        return out if out is not None else ()
+
+
+class _LambdaFilter(FilterFunction):
+    def __init__(self, fn):
+        self._fn = fn
+
+    def filter(self, value):
+        return bool(self._fn(value))
+
+
+class _LambdaReduce(ReduceFunction):
+    def __init__(self, fn):
+        self._fn = fn
+
+    def reduce(self, value1, value2):
+        return self._fn(value1, value2)
+
+
+class _LambdaKeySelector(KeySelector):
+    def __init__(self, fn):
+        self._fn = fn
+
+    def get_key(self, value):
+        return self._fn(value)
+
+
+class _FieldKeySelector(KeySelector):
+    """keyBy("word") / keyBy(0) — positional or named field access
+    (ref: Flink's field-expression keyBy on tuples/POJOs)."""
+
+    def __init__(self, field):
+        self._field = field
+
+    def get_key(self, value):
+        if isinstance(self._field, int):
+            return value[self._field]
+        if isinstance(value, dict):
+            return value[self._field]
+        return getattr(value, self._field)
+
+
+class _CompositeFieldKeySelector(KeySelector):
+    def __init__(self, fields):
+        self._selectors = tuple(_FieldKeySelector(f) for f in fields)
+
+    def get_key(self, value):
+        return tuple(s.get_key(value) for s in self._selectors)

@@ -1,0 +1,7 @@
+"""Device kernels: hashing, sketch aggregates, segment ops.
+
+This layer replaces the reference's per-record JVM aggregation hot path
+(heap StateTable probes / RocksDB JNI get-put,
+RocksDBAggregatingState.java:108-131) with batched, jit-compiled TPU
+kernels operating on key-group-vectorized struct-of-arrays state.
+"""

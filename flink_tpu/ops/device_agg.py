@@ -1,0 +1,268 @@
+"""DeviceAggregateFunction: the vectorized aggregation contract.
+
+The reference funnels every windowed aggregation through
+``AggregateFunction.createAccumulator/add/getResult/merge``
+(flink-core/.../functions/AggregateFunction.java:127-160) invoked once
+per record (heap: HeapAggregatingState.java:80-89; RocksDB:
+RocksDBAggregatingState.java:108-131 — two JNI hops per record).
+
+Here the same contract is re-shaped for TPU execution: accumulators for
+ALL keys of a key-group range live as struct-of-arrays in HBM
+(``state[name][slot, ...]``), and ``add`` is replaced by a batched
+``update(state, slots, values, vh_hi, vh_lo)`` that scatters a whole
+micro-batch in one jit-compiled device dispatch.  Each device aggregate
+is *also* a plain AggregateFunction (scalar numpy accumulators =
+single-slot arrays), so the identical aggregate runs on the heap
+backend for differential testing and on the TPU backend for speed.
+
+Slots are dense indices handed out by the backend's per-window key
+index (flink_tpu/state/tpu_backend.py); duplicate slots within a batch
+are legal and resolved by the scatter combinator (add/max/min).
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import Dict, NamedTuple, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from flink_tpu.core.functions import AggregateFunction
+
+
+class StateSpec(NamedTuple):
+    """Per-slot layout of one state component."""
+    shape: Tuple[int, ...]   # trailing shape per slot (() for scalar)
+    dtype: np.dtype
+    fill: float              # initial/cleared value
+
+
+class DeviceAggregateFunction(AggregateFunction):
+    """Batched aggregation over slot-indexed HBM state.
+
+    Subclasses define per-slot state layout and jnp-traceable
+    update/result/merge; the base class derives the scalar
+    AggregateFunction contract (accumulator = dict of single-slot numpy
+    arrays) so the heap backend runs the same logic per-record.
+    """
+
+    #: update() consumes the `values` array
+    needs_value: bool = False
+    #: update() consumes value-hash lanes (distinct-count style sketches)
+    needs_value_hash: bool = False
+    #: dtype the batcher should coerce values to
+    value_dtype: np.dtype = np.float32
+
+    # ---- device contract -------------------------------------------
+    @abc.abstractmethod
+    def state_specs(self) -> Dict[str, StateSpec]:
+        ...
+
+    def init_state(self, capacity: int) -> Dict[str, jnp.ndarray]:
+        return {
+            name: jnp.full((capacity, *spec.shape), spec.fill, dtype=spec.dtype)
+            for name, spec in self.state_specs().items()
+        }
+
+    def grow_state(self, state: Dict[str, jnp.ndarray], new_capacity: int) -> Dict[str, jnp.ndarray]:
+        out = {}
+        for name, spec in self.state_specs().items():
+            old = state[name]
+            pad = jnp.full((new_capacity - old.shape[0], *spec.shape), spec.fill, dtype=spec.dtype)
+            out[name] = jnp.concatenate([old, pad], axis=0)
+        return out
+
+    @abc.abstractmethod
+    def update(
+        self,
+        state: Dict[str, jnp.ndarray],
+        slots: jnp.ndarray,          # [N] int32 slot per record
+        values: jnp.ndarray,         # [N] value_dtype (dummy if !needs_value)
+        vh_hi: jnp.ndarray,          # [N] uint32 (dummy if !needs_value_hash)
+        vh_lo: jnp.ndarray,          # [N] uint32
+        mask: jnp.ndarray,           # [N] bool — False entries are padding
+    ) -> Dict[str, jnp.ndarray]:
+        ...
+
+    @abc.abstractmethod
+    def result(self, state: Dict[str, jnp.ndarray], slots: jnp.ndarray) -> jnp.ndarray:
+        """Finalize: gather `slots` and compute per-slot results
+        (device twin of AggregateFunction.getResult)."""
+        ...
+
+    def merge_slots(
+        self, state: Dict[str, jnp.ndarray], dst: jnp.ndarray, src: jnp.ndarray
+    ) -> Dict[str, jnp.ndarray]:
+        """state[dst] ⊕= state[src] — session-window namespace merging
+        (device twin of AggregateFunction.merge)."""
+        raise NotImplementedError(f"{type(self).__name__} does not support merging")
+
+    def clear_slots(self, state: Dict[str, jnp.ndarray], slots: jnp.ndarray) -> Dict[str, jnp.ndarray]:
+        out = dict(state)
+        for name, spec in self.state_specs().items():
+            fill = jnp.full((slots.shape[0], *spec.shape), spec.fill, dtype=spec.dtype)
+            out[name] = out[name].at[slots].set(fill)
+        return out
+
+    # ---- scalar AggregateFunction contract (heap-backend twin) ------
+    def create_accumulator(self):
+        return {name: np.full(spec.shape if spec.shape else (1,), spec.fill, dtype=spec.dtype)
+                for name, spec in self.state_specs().items()}
+
+    def add(self, value, accumulator):
+        slot = np.zeros(1, np.int32)
+        state = {k: np.asarray(v)[None] if np.asarray(v).shape == ()
+                 else np.asarray(v).reshape(1, *self.state_specs()[k].shape)
+                 for k, v in accumulator.items()}
+        vals, hi, lo = self._host_record(value)
+        new = jax.tree_util.tree_map(
+            np.asarray,
+            self.update({k: jnp.asarray(v) for k, v in state.items()},
+                        jnp.asarray(slot), jnp.asarray(vals), jnp.asarray(hi),
+                        jnp.asarray(lo), jnp.ones(1, bool)))
+        return {k: np.asarray(v)[0] if self.state_specs()[k].shape == ()
+                else np.asarray(v)[0] for k, v in new.items()}
+
+    def get_result(self, accumulator):
+        state = {k: jnp.asarray(np.asarray(v).reshape(1, *self.state_specs()[k].shape))
+                 for k, v in accumulator.items()}
+        out = np.asarray(self.result(state, jnp.zeros(1, jnp.int32)))[0]
+        return out.item() if np.ndim(out) == 0 else out
+
+    def merge(self, a, b):
+        specs = self.state_specs()
+        stacked = {k: jnp.asarray(np.stack([np.asarray(a[k]).reshape(specs[k].shape),
+                                            np.asarray(b[k]).reshape(specs[k].shape)]))
+                   for k in specs}
+        merged = self.merge_slots(stacked, jnp.array([0], jnp.int32), jnp.array([1], jnp.int32))
+        return {k: np.asarray(v)[0] for k, v in merged.items()}
+
+    def _host_record(self, value):
+        """Turn one scalar value into (values[1], vh_hi[1], vh_lo[1])."""
+        from flink_tpu.core.keygroups import stable_hash64
+        if self.needs_value_hash:
+            h = stable_hash64(value)
+            hi = np.array([h >> 32], np.uint32)
+            lo = np.array([h & 0xFFFFFFFF], np.uint32)
+        else:
+            hi = np.zeros(1, np.uint32)
+            lo = np.zeros(1, np.uint32)
+        if self.needs_value:
+            vals = np.array([value], self.value_dtype)
+        else:
+            vals = np.zeros(1, self.value_dtype)
+        return vals, hi, lo
+
+
+# ---------------------------------------------------------------------
+# Plain arithmetic aggregates (sum/count/min/max/avg) — the TPU twins of
+# the reference's SumAggregator / rolling reduce on numeric fields
+# (flink-streaming-java/.../api/functions/aggregation/).
+# ---------------------------------------------------------------------
+
+class SumAggregate(DeviceAggregateFunction):
+    needs_value = True
+
+    def __init__(self, dtype=np.float32):
+        self._dtype = np.dtype(dtype)
+        self.value_dtype = self._dtype
+
+    def state_specs(self):
+        return {"sum": StateSpec((), self._dtype, 0)}
+
+    def update(self, state, slots, values, vh_hi, vh_lo, mask):
+        vals = jnp.where(mask, values, jnp.zeros((), values.dtype))
+        return {**state, "sum": state["sum"].at[slots].add(vals)}
+
+    def result(self, state, slots):
+        return state["sum"][slots]
+
+    def merge_slots(self, state, dst, src):
+        return {**state, "sum": state["sum"].at[dst].add(state["sum"][src])}
+
+
+class CountAggregate(DeviceAggregateFunction):
+    def state_specs(self):
+        return {"count": StateSpec((), np.dtype(np.int32), 0)}
+
+    def update(self, state, slots, values, vh_hi, vh_lo, mask):
+        return {**state, "count": state["count"].at[slots].add(mask.astype(jnp.int32))}
+
+    def result(self, state, slots):
+        return state["count"][slots]
+
+    def merge_slots(self, state, dst, src):
+        return {**state, "count": state["count"].at[dst].add(state["count"][src])}
+
+
+class MinAggregate(DeviceAggregateFunction):
+    needs_value = True
+
+    def __init__(self, dtype=np.float32):
+        self._dtype = np.dtype(dtype)
+        self.value_dtype = self._dtype
+
+    def state_specs(self):
+        big = np.finfo(self._dtype).max if np.issubdtype(self._dtype, np.floating) \
+            else np.iinfo(self._dtype).max
+        return {"min": StateSpec((), self._dtype, big)}
+
+    def update(self, state, slots, values, vh_hi, vh_lo, mask):
+        fill = self.state_specs()["min"].fill
+        vals = jnp.where(mask, values, jnp.full((), fill, values.dtype))
+        return {**state, "min": state["min"].at[slots].min(vals)}
+
+    def result(self, state, slots):
+        return state["min"][slots]
+
+    def merge_slots(self, state, dst, src):
+        return {**state, "min": state["min"].at[dst].min(state["min"][src])}
+
+
+class MaxAggregate(DeviceAggregateFunction):
+    needs_value = True
+
+    def __init__(self, dtype=np.float32):
+        self._dtype = np.dtype(dtype)
+        self.value_dtype = self._dtype
+
+    def state_specs(self):
+        small = np.finfo(self._dtype).min if np.issubdtype(self._dtype, np.floating) \
+            else np.iinfo(self._dtype).min
+        return {"max": StateSpec((), self._dtype, small)}
+
+    def update(self, state, slots, values, vh_hi, vh_lo, mask):
+        fill = self.state_specs()["max"].fill
+        vals = jnp.where(mask, values, jnp.full((), fill, values.dtype))
+        return {**state, "max": state["max"].at[slots].max(vals)}
+
+    def result(self, state, slots):
+        return state["max"][slots]
+
+    def merge_slots(self, state, dst, src):
+        return {**state, "max": state["max"].at[dst].max(state["max"][src])}
+
+
+class AvgAggregate(DeviceAggregateFunction):
+    needs_value = True
+
+    def state_specs(self):
+        return {"sum": StateSpec((), np.dtype(np.float32), 0),
+                "count": StateSpec((), np.dtype(np.int32), 0)}
+
+    def update(self, state, slots, values, vh_hi, vh_lo, mask):
+        vals = jnp.where(mask, values, jnp.zeros((), values.dtype))
+        return {**state,
+                "sum": state["sum"].at[slots].add(vals),
+                "count": state["count"].at[slots].add(mask.astype(jnp.int32))}
+
+    def result(self, state, slots):
+        cnt = state["count"][slots]
+        return state["sum"][slots] / jnp.maximum(cnt, 1).astype(jnp.float32)
+
+    def merge_slots(self, state, dst, src):
+        return {**state,
+                "sum": state["sum"].at[dst].add(state["sum"][src]),
+                "count": state["count"].at[dst].add(state["count"][src])}

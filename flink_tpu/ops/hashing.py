@@ -1,0 +1,91 @@
+"""Device-side hashing primitives.
+
+All state addressing in the TPU backend is hash-based: the host computes
+a stable 64-bit hash per key (flink_tpu.core.keygroups.stable_hash64 /
+splitmix64_np) and ships it to the device as two uint32 lanes
+(``h_hi``, ``h_lo``).  Device kernels derive everything they need
+(HLL register index + rank, Count-Min row indices, bucket ids) from
+those lanes with exact uint32 bit arithmetic — no float log tricks,
+so host and device agree bit-for-bit.
+
+TPU note: JAX runs with 32-bit types by default and TPUs have no native
+int64, so 64-bit hashes are represented as (hi, lo) uint32 pairs
+throughout.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def fmix32(h: jnp.ndarray) -> jnp.ndarray:
+    """MurmurHash3 32-bit finalizer (device twin of
+    flink_tpu.core.keygroups.murmur_hash)."""
+    h = h.astype(jnp.uint32)
+    h = h ^ (h >> 16)
+    h = h * jnp.uint32(0x85EBCA6B)
+    h = h ^ (h >> 13)
+    h = h * jnp.uint32(0xC2B2AE35)
+    h = h ^ (h >> 16)
+    return h
+
+
+def hash2_32(x: jnp.ndarray, seed: int = 0) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Two independent 32-bit hashes of a 32-bit input — device-side
+    key hashing for fully on-device pipelines (int32 keys)."""
+    x = x.astype(jnp.uint32)
+    h1 = fmix32(x ^ jnp.uint32(seed))
+    h2 = fmix32(x + jnp.uint32(0x9E3779B9) + jnp.uint32(seed))
+    return h1, h2
+
+
+def split_hash64_np(h64: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+    """Host helper: split uint64 hashes into (hi, lo) uint32 lanes."""
+    h64 = h64.astype(np.uint64)
+    hi = (h64 >> np.uint64(32)).astype(np.uint32)
+    lo = (h64 & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+    return hi, lo
+
+
+def popcount32(x: jnp.ndarray) -> jnp.ndarray:
+    """Branchless popcount over uint32 (SWAR)."""
+    x = x.astype(jnp.uint32)
+    x = x - ((x >> 1) & jnp.uint32(0x55555555))
+    x = (x & jnp.uint32(0x33333333)) + ((x >> 2) & jnp.uint32(0x33333333))
+    x = (x + (x >> 4)) & jnp.uint32(0x0F0F0F0F)
+    return ((x * jnp.uint32(0x01010101)) >> 24).astype(jnp.int32)
+
+
+def clz32(x: jnp.ndarray) -> jnp.ndarray:
+    """Count leading zeros of uint32, exact (no float log)."""
+    x = x.astype(jnp.uint32)
+    x = x | (x >> 1)
+    x = x | (x >> 2)
+    x = x | (x >> 4)
+    x = x | (x >> 8)
+    x = x | (x >> 16)
+    return 32 - popcount32(x)
+
+
+def hll_register_and_rank(
+    h_hi: jnp.ndarray, h_lo: jnp.ndarray, precision: int
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """HLL decomposition of a 64-bit hash: register index from the low
+    ``precision`` bits, rank = (leading zeros of the high 32 bits) + 1,
+    capped at 33.  Returns (register[int32], rank[int32])."""
+    m_mask = jnp.uint32((1 << precision) - 1)
+    reg = (h_lo.astype(jnp.uint32) & m_mask).astype(jnp.int32)
+    rank = (clz32(h_hi) + 1).astype(jnp.int32)
+    return reg, rank
+
+
+def countmin_rows(
+    h_hi: jnp.ndarray, h_lo: jnp.ndarray, depth: int, width: int
+) -> jnp.ndarray:
+    """Kirsch–Mitzenmacher double hashing: row r index =
+    (lo + r*hi) mod width.  Returns [depth, N] int32 column indices."""
+    r = jnp.arange(depth, dtype=jnp.uint32)[:, None]
+    idx = (h_lo.astype(jnp.uint32)[None, :]
+           + r * h_hi.astype(jnp.uint32)[None, :]) % jnp.uint32(width)
+    return idx.astype(jnp.int32)

@@ -1,0 +1,210 @@
+"""Sketch kernel accuracy + device/host parity tests.
+
+Models the reference's serializer/operator unit-test tier (SURVEY.md §4
+tier 1): pure-logic accuracy bounds, merge semantics, and the
+scalar-vs-batched twin equivalence that the heap/TPU backend pair
+relies on."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from flink_tpu.core.keygroups import splitmix64_np, stable_hash64
+from flink_tpu.ops.device_agg import (
+    AvgAggregate,
+    CountAggregate,
+    MaxAggregate,
+    MinAggregate,
+    SumAggregate,
+)
+from flink_tpu.ops.hashing import clz32, popcount32, split_hash64_np
+from flink_tpu.ops.sketches import (
+    CountMinSketchAggregate,
+    HyperLogLogAggregate,
+    QuantileSketchAggregate,
+)
+
+
+def _batch(agg, n, values=None, hashes=None, slots=None):
+    slots = np.zeros(n, np.int32) if slots is None else slots
+    values = np.zeros(n, agg.value_dtype) if values is None else values.astype(agg.value_dtype)
+    if hashes is None:
+        hi = np.zeros(n, np.uint32)
+        lo = np.zeros(n, np.uint32)
+    else:
+        hi, lo = split_hash64_np(hashes)
+    mask = np.ones(n, bool)
+    return (jnp.asarray(slots), jnp.asarray(values), jnp.asarray(hi),
+            jnp.asarray(lo), jnp.asarray(mask))
+
+
+class TestBitOps:
+    def test_popcount(self):
+        xs = np.array([0, 1, 0xFFFFFFFF, 0x80000000, 0x0F0F0F0F], np.uint32)
+        expect = [bin(int(x)).count("1") for x in xs]
+        assert list(np.asarray(popcount32(jnp.asarray(xs)))) == expect
+
+    def test_clz(self):
+        xs = np.array([0, 1, 2, 0x80000000, 0x40000000, 0xFFFFFFFF], np.uint32)
+        expect = [32, 31, 30, 0, 1, 0]
+        assert list(np.asarray(clz32(jnp.asarray(xs)))) == expect
+
+
+class TestHLL:
+    @pytest.mark.parametrize("n", [100, 10_000, 200_000])
+    def test_cardinality_bound(self, n):
+        agg = HyperLogLogAggregate(precision=12)
+        state = agg.init_state(4)
+        hashes = splitmix64_np(np.arange(n, dtype=np.uint64))
+        state = agg.update(state, *_batch(agg, n, hashes=hashes))
+        est = float(np.asarray(agg.result(state, jnp.array([0], jnp.int32)))[0])
+        # 1.04/sqrt(4096) ~ 1.6%; allow 5 sigma
+        assert abs(est - n) / n < 0.10, f"est={est} n={n}"
+
+    def test_duplicates_dont_count(self):
+        agg = HyperLogLogAggregate(precision=12)
+        state = agg.init_state(1)
+        hashes = splitmix64_np(np.arange(1000, dtype=np.uint64) % 100)
+        state = agg.update(state, *_batch(agg, 1000, hashes=hashes))
+        est = float(np.asarray(agg.result(state, jnp.array([0], jnp.int32)))[0])
+        assert abs(est - 100) / 100 < 0.15
+
+    def test_merge_is_union(self):
+        agg = HyperLogLogAggregate(precision=12)
+        state = agg.init_state(2)
+        h1 = splitmix64_np(np.arange(0, 5000, dtype=np.uint64))
+        h2 = splitmix64_np(np.arange(2500, 7500, dtype=np.uint64))
+        state = agg.update(state, *_batch(agg, 5000, hashes=h1, slots=np.zeros(5000, np.int32)))
+        state = agg.update(state, *_batch(agg, 5000, hashes=h2, slots=np.ones(5000, np.int32)))
+        state = agg.merge_slots(state, jnp.array([0], jnp.int32), jnp.array([1], jnp.int32))
+        est = float(np.asarray(agg.result(state, jnp.array([0], jnp.int32)))[0])
+        assert abs(est - 7500) / 7500 < 0.10
+
+    def test_multi_slot_isolation(self):
+        agg = HyperLogLogAggregate(precision=10)
+        state = agg.init_state(8)
+        n = 3000
+        slots = (np.arange(n) % 3).astype(np.int32)
+        hashes = splitmix64_np(np.arange(n, dtype=np.uint64))
+        state = agg.update(state, *_batch(agg, n, hashes=hashes, slots=slots))
+        ests = np.asarray(agg.result(state, jnp.arange(8, dtype=jnp.int32)))
+        for s in range(3):
+            assert abs(ests[s] - 1000) / 1000 < 0.15
+        for s in range(3, 8):
+            assert ests[s] == 0  # untouched slots estimate zero
+
+    def test_scalar_twin_matches_batched(self):
+        """Heap-backend scalar path == TPU batched path, bit for bit."""
+        agg = HyperLogLogAggregate(precision=8)
+        acc = agg.create_accumulator()
+        values = [f"item-{i}" for i in range(500)]
+        for v in values:
+            acc = agg.add(v, acc)
+        scalar_est = agg.get_result(acc)
+
+        state = agg.init_state(1)
+        hashes = np.array([stable_hash64(v) for v in values], np.uint64)
+        state = agg.update(state, *_batch(agg, 500, hashes=hashes))
+        batch_est = float(np.asarray(agg.result(state, jnp.array([0], jnp.int32)))[0])
+        assert scalar_est == pytest.approx(batch_est, rel=1e-6)
+
+
+class TestCountMin:
+    def test_point_query_overestimates_bounded(self):
+        agg = CountMinSketchAggregate(depth=4, width=2048)
+        state = agg.init_state(1)
+        rng = np.random.default_rng(0)
+        # zipf-ish: item i appears ~ 1000/(i+1) times
+        items = np.concatenate([np.full(max(1, 1000 // (i + 1)), i) for i in range(200)])
+        rng.shuffle(items)
+        hashes = splitmix64_np(items.astype(np.uint64))
+        n = len(items)
+        state = agg.update(state, *_batch(agg, n, values=np.ones(n), hashes=hashes))
+
+        true_counts = np.bincount(items, minlength=200)
+        q_hashes = splitmix64_np(np.arange(200, dtype=np.uint64))
+        qh, ql = split_hash64_np(q_hashes)
+        est = np.asarray(agg.point_query(
+            state, jnp.zeros(200, jnp.int32), jnp.asarray(qh), jnp.asarray(ql)))
+        assert np.all(est >= true_counts)           # CMS never underestimates
+        eps_bound = 2.72 * n / 2048
+        assert np.all(est - true_counts <= 3 * eps_bound)
+
+    def test_total_and_merge(self):
+        agg = CountMinSketchAggregate(depth=4, width=256)
+        state = agg.init_state(2)
+        h = splitmix64_np(np.arange(50, dtype=np.uint64))
+        state = agg.update(state, *_batch(agg, 50, values=np.ones(50), hashes=h,
+                                          slots=np.zeros(50, np.int32)))
+        state = agg.update(state, *_batch(agg, 50, values=np.ones(50) * 2, hashes=h,
+                                          slots=np.ones(50, np.int32)))
+        state = agg.merge_slots(state, jnp.array([0], jnp.int32), jnp.array([1], jnp.int32))
+        total = np.asarray(agg.result(state, jnp.array([0, 1], jnp.int32)))
+        assert total[0] == 150 and total[1] == 100
+
+
+class TestQuantileSketch:
+    def test_quantiles_relative_error(self):
+        agg = QuantileSketchAggregate(quantiles=(0.5, 0.99), relative_accuracy=0.01)
+        state = agg.init_state(1)
+        rng = np.random.default_rng(42)
+        data = rng.lognormal(mean=3.0, sigma=1.5, size=100_000).astype(np.float32)
+        state = agg.update(state, *_batch(agg, len(data), values=data))
+        out = np.asarray(agg.result(state, jnp.array([0], jnp.int32)))[0]
+        p50, p99 = float(out[0]), float(out[1])
+        t50, t99 = np.quantile(data, [0.5, 0.99])
+        assert abs(p50 - t50) / t50 < 0.05
+        assert abs(p99 - t99) / t99 < 0.05
+
+    def test_merge(self):
+        agg = QuantileSketchAggregate(quantiles=(0.5,), relative_accuracy=0.02)
+        state = agg.init_state(2)
+        lo = np.full(1000, 10.0, np.float32)
+        hi = np.full(1000, 1000.0, np.float32)
+        state = agg.update(state, *_batch(agg, 1000, values=lo, slots=np.zeros(1000, np.int32)))
+        state = agg.update(state, *_batch(agg, 1000, values=hi, slots=np.ones(1000, np.int32)))
+        state = agg.merge_slots(state, jnp.array([0], jnp.int32), jnp.array([1], jnp.int32))
+        med = float(np.asarray(agg.result(state, jnp.array([0], jnp.int32)))[0, 0])
+        # median of {10 x1000, 1000 x1000} sits at one of the two modes
+        assert 9 <= med <= 1030
+
+
+class TestPlainAggregates:
+    def test_sum_count_min_max_avg(self):
+        n = 1000
+        rng = np.random.default_rng(7)
+        vals = rng.normal(50, 10, n).astype(np.float32)
+        slots = (np.arange(n) % 4).astype(np.int32)
+        sl = jnp.arange(4, dtype=jnp.int32)
+        for agg, expect in [
+            (SumAggregate(), [vals[slots == s].sum() for s in range(4)]),
+            (CountAggregate(), [(slots == s).sum() for s in range(4)]),
+            (MinAggregate(), [vals[slots == s].min() for s in range(4)]),
+            (MaxAggregate(), [vals[slots == s].max() for s in range(4)]),
+            (AvgAggregate(), [vals[slots == s].mean() for s in range(4)]),
+        ]:
+            state = agg.init_state(4)
+            state = agg.update(state, *_batch(agg, n, values=vals, slots=slots))
+            out = np.asarray(agg.result(state, sl))
+            np.testing.assert_allclose(out, expect, rtol=1e-4)
+
+    def test_mask_excludes_padding(self):
+        agg = SumAggregate()
+        state = agg.init_state(1)
+        slots = jnp.zeros(4, jnp.int32)
+        values = jnp.array([1.0, 2.0, 100.0, 100.0])
+        mask = jnp.array([True, True, False, False])
+        dummy = jnp.zeros(4, jnp.uint32)
+        state = agg.update(state, slots, values, dummy, dummy, mask)
+        assert float(state["sum"][0]) == 3.0
+
+    def test_scalar_twin(self):
+        agg = AvgAggregate()
+        acc = agg.create_accumulator()
+        for v in [1.0, 2.0, 3.0, 4.0]:
+            acc = agg.add(v, acc)
+        assert agg.get_result(acc) == pytest.approx(2.5)
+        acc2 = agg.create_accumulator()
+        acc2 = agg.add(10.0, acc2)
+        merged = agg.merge(acc, acc2)
+        assert agg.get_result(merged) == pytest.approx(4.0)
