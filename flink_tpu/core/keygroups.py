@@ -12,6 +12,7 @@ bucketing and device probing agree.
 
 from __future__ import annotations
 
+import math
 from typing import Any, Iterator, Tuple
 
 import numpy as np
@@ -59,7 +60,8 @@ def stable_hash64(key: Any) -> int:
             h = splitmix64(h ^ stable_hash64(item))
         return h
     if isinstance(key, float):
-        if key == int(key):
+        # NaN/inf are valid keys; int(key) would raise on them
+        if math.isfinite(key) and key == int(key):
             return splitmix64(int(key))
         return splitmix64(hash(key) & 0xFFFFFFFFFFFFFFFF)
     if key is None:

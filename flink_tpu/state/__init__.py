@@ -1,0 +1,27 @@
+"""Keyed & operator state backends.
+
+Re-designs the reference's state SPI (flink-runtime/.../state/
+AbstractKeyedStateBackend.java:64-453) with two backends behind the
+`state.backend` config switch (ref: StateBackendLoader.java:92-109):
+
+  heap  — host dict tables, per-record semantics (ref:
+          HeapKeyedStateBackend.java:90)
+  tpu   — key-group-vectorized struct-of-arrays in TPU HBM with
+          micro-batched scatter updates (replaces the RocksDB JNI
+          backend, RocksDBKeyedStateBackend.java:134, whose per-record
+          get/put round trips are the cost this design removes)
+"""
+
+from flink_tpu.state.backend import KeyedStateBackend
+from flink_tpu.state.heap_backend import HeapKeyedStateBackend
+from flink_tpu.state.tpu_backend import TpuKeyedStateBackend
+from flink_tpu.state.operator_state import OperatorStateBackend
+from flink_tpu.state.loader import load_state_backend
+
+__all__ = [
+    "KeyedStateBackend",
+    "HeapKeyedStateBackend",
+    "TpuKeyedStateBackend",
+    "OperatorStateBackend",
+    "load_state_backend",
+]

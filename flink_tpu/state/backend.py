@@ -1,0 +1,186 @@
+"""Keyed-state backend contract.
+
+Re-designs flink-runtime/.../state/AbstractKeyedStateBackend.java:64-453:
+per-state-name factories (createValueState :159 … createMapState :229),
+`setCurrentKey` :237 (computes the key group), `getOrCreateKeyedState`
+:319 (binds a descriptor once and caches), namespace addressing
+(window = namespace, WindowOperator.java:387) and snapshot/restore.
+
+Differences from the reference, on purpose:
+- No per-state serializer plumbing on the hot path; Python values go
+  straight into the tables, serialization happens only at snapshot
+  time (and for the TPU backend the hot path is numeric arrays).
+- `snapshot()` returns a `KeyedStateSnapshot` of per-key-group chunks
+  so restore can re-split ranges on rescale
+  (ref: KeyGroupsStateHandle.java, StateAssignmentOperation.java).
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import Any, Dict, Iterable, List, Optional, Tuple
+
+from flink_tpu.core.keygroups import (
+    KeyGroupRange,
+    assign_to_key_group,
+)
+from flink_tpu.core.state import (
+    AggregatingStateDescriptor,
+    FoldingStateDescriptor,
+    ListStateDescriptor,
+    MapStateDescriptor,
+    ReducingStateDescriptor,
+    StateDescriptor,
+    ValueStateDescriptor,
+)
+
+
+#: default namespace used for non-windowed keyed state
+#: (ref: VoidNamespace.java — a singleton namespace)
+VOID_NAMESPACE = ()
+
+
+class KeyedStateSnapshot:
+    """Serialized keyed state, chunked per key group.
+
+    `key_group_bytes[kg]` is an opaque bytes blob for key group `kg`;
+    restore feeds each chunk whose key group falls in the new backend's
+    range (ref: KeyGroupsStateHandle.java + KeyGroupRangeOffsets.java —
+    here chunks are explicit instead of offsets into one stream).
+    """
+
+    __slots__ = ("key_group_bytes", "meta")
+
+    def __init__(self, key_group_bytes: Dict[int, bytes], meta: Optional[dict] = None):
+        self.key_group_bytes = key_group_bytes
+        self.meta = meta or {}
+
+    @property
+    def total_bytes(self) -> int:
+        return sum(len(b) for b in self.key_group_bytes.values())
+
+    def intersect(self, key_group_range: KeyGroupRange) -> "KeyedStateSnapshot":
+        return KeyedStateSnapshot(
+            {kg: b for kg, b in self.key_group_bytes.items()
+             if key_group_range.contains(kg)},
+            dict(self.meta),
+        )
+
+
+class KeyedStateBackend(abc.ABC):
+    """The contract every keyed backend implements
+    (ref: AbstractKeyedStateBackend.java:64)."""
+
+    def __init__(self, key_group_range: KeyGroupRange, max_parallelism: int):
+        self.key_group_range = key_group_range
+        self.max_parallelism = max_parallelism
+        self._current_key: Any = None
+        self._current_key_group: int = -1
+        #: name → bound state object (ref: keyValueStatesByName, :319)
+        self._states: Dict[str, Any] = {}
+        #: name → descriptor it was bound with (compatibility checks)
+        self._descriptors: Dict[str, StateDescriptor] = {}
+        #: queryable-state registrations (ref: :382-389)
+        self.queryable_states: Dict[str, Any] = {}
+
+    # ---- key context (ref: setCurrentKey :237) ----------------------
+    def set_current_key(self, key: Any) -> None:
+        self._current_key = key
+        self._current_key_group = assign_to_key_group(key, self.max_parallelism)
+
+    @property
+    def current_key(self) -> Any:
+        return self._current_key
+
+    @property
+    def current_key_group(self) -> int:
+        return self._current_key_group
+
+    # ---- state binding (ref: getOrCreateKeyedState :319) ------------
+    def get_or_create_keyed_state(self, descriptor: StateDescriptor):
+        state = self._states.get(descriptor.name)
+        if state is None:
+            state = self._create_state(descriptor)
+            self._states[descriptor.name] = state
+            self._descriptors[descriptor.name] = descriptor
+            if descriptor.is_queryable:
+                self.queryable_states[descriptor.queryable_state_name] = state
+        else:
+            bound = self._descriptors[descriptor.name]
+            if bound.TYPE != descriptor.TYPE:
+                # (ref: StateDescriptor compatibility check in
+                # AbstractKeyedStateBackend — same name, different kind
+                # of state is a program error, not a cache hit)
+                raise ValueError(
+                    f"state {descriptor.name!r} already registered as "
+                    f"{bound.TYPE!r}, cannot rebind as {descriptor.TYPE!r}")
+        return state
+
+    def get_partitioned_state(self, namespace, descriptor: StateDescriptor):
+        """Bind + switch namespace in one call
+        (ref: getPartitionedState :403)."""
+        state = self.get_or_create_keyed_state(descriptor)
+        state.set_current_namespace(namespace)
+        return state
+
+    def _create_state(self, descriptor: StateDescriptor):
+        # ordered most-specific-first; isinstance covers subclasses
+        for dtype, factory in [
+            (MapStateDescriptor, self.create_map_state),
+            (AggregatingStateDescriptor, self.create_aggregating_state),
+            (ReducingStateDescriptor, self.create_reducing_state),
+            (FoldingStateDescriptor, self.create_folding_state),
+            (ListStateDescriptor, self.create_list_state),
+            (ValueStateDescriptor, self.create_value_state),
+        ]:
+            if isinstance(descriptor, dtype):
+                return factory(descriptor)
+        raise TypeError(f"unsupported state descriptor {descriptor!r}")
+
+    # ---- factories (ref: createValueState :159 … createMapState :229)
+    @abc.abstractmethod
+    def create_value_state(self, descriptor: ValueStateDescriptor):
+        ...
+
+    @abc.abstractmethod
+    def create_list_state(self, descriptor: ListStateDescriptor):
+        ...
+
+    @abc.abstractmethod
+    def create_reducing_state(self, descriptor: ReducingStateDescriptor):
+        ...
+
+    @abc.abstractmethod
+    def create_aggregating_state(self, descriptor: AggregatingStateDescriptor):
+        ...
+
+    @abc.abstractmethod
+    def create_folding_state(self, descriptor: FoldingStateDescriptor):
+        ...
+
+    @abc.abstractmethod
+    def create_map_state(self, descriptor: MapStateDescriptor):
+        ...
+
+    # ---- introspection ----------------------------------------------
+    @abc.abstractmethod
+    def get_keys(self, state_name: str, namespace) -> Iterable[Any]:
+        """All keys having state under (state_name, namespace)
+        (ref: KeyedStateBackend#getKeys)."""
+
+    def num_registered_states(self) -> int:
+        return len(self._states)
+
+    # ---- snapshot / restore (ref: Snapshotable) ---------------------
+    @abc.abstractmethod
+    def snapshot(self) -> KeyedStateSnapshot:
+        ...
+
+    @abc.abstractmethod
+    def restore(self, snapshots: Iterable[KeyedStateSnapshot]) -> None:
+        """Restore from one or more snapshots' chunks that intersect
+        this backend's key-group range (rescale = pass the snapshots of
+        all old subtasks; chunks outside the range are skipped)."""
+
+    def dispose(self) -> None:
+        self._states.clear()

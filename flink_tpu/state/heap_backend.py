@@ -1,0 +1,363 @@
+"""Heap (host-dict) keyed-state backend — the reference semantics twin.
+
+Re-designs flink-runtime/.../state/heap/HeapKeyedStateBackend.java:90
+and the Heap*State family (HeapValueState, HeapListState,
+HeapAggregatingState.java:80-89 …).  A `StateTable` here is
+``{namespace: {key: value}}`` per registered state; the reference's
+CopyOnWriteStateTable async-snapshot machinery is unnecessary because
+snapshots serialize from a quiesced table (the streaming runtime
+snapshots between micro-batches, under the task's single-owner loop —
+see SURVEY.md §5 race-detection note).
+
+This backend exists for (a) differential testing of the TPU backend,
+(b) states whose values are arbitrary Python objects, and (c) the
+`state.backend: heap` config (ref names `jobmanager`/`filesystem`,
+StateBackendLoader.java:92-109).
+"""
+
+from __future__ import annotations
+
+import pickle
+from collections import defaultdict
+from typing import Any, Dict, Iterable, List, Optional, Tuple
+
+from flink_tpu.core.keygroups import KeyGroupRange, assign_to_key_group
+from flink_tpu.core.state import (
+    AggregatingState,
+    AggregatingStateDescriptor,
+    FoldingState,
+    FoldingStateDescriptor,
+    ListState,
+    ListStateDescriptor,
+    MapState,
+    MapStateDescriptor,
+    ReducingState,
+    ReducingStateDescriptor,
+    StateDescriptor,
+    ValueState,
+    ValueStateDescriptor,
+)
+from flink_tpu.state.backend import (
+    VOID_NAMESPACE,
+    KeyedStateBackend,
+    KeyedStateSnapshot,
+)
+
+
+class StateTable:
+    """{namespace: {key: value}} (ref: heap/StateTable.java)."""
+
+    __slots__ = ("by_namespace",)
+
+    def __init__(self):
+        self.by_namespace: Dict[Any, Dict[Any, Any]] = {}
+
+    def get(self, key, namespace, default=None):
+        ns = self.by_namespace.get(namespace)
+        if ns is None:
+            return default
+        return ns.get(key, default)
+
+    def put(self, key, namespace, value) -> None:
+        self.by_namespace.setdefault(namespace, {})[key] = value
+
+    def remove(self, key, namespace) -> None:
+        ns = self.by_namespace.get(namespace)
+        if ns is not None:
+            ns.pop(key, None)
+            if not ns:
+                del self.by_namespace[namespace]
+
+    def contains(self, key, namespace) -> bool:
+        ns = self.by_namespace.get(namespace)
+        return ns is not None and key in ns
+
+    def keys(self, namespace) -> Iterable[Any]:
+        return self.by_namespace.get(namespace, {}).keys()
+
+    def entries(self) -> Iterable[Tuple[Any, Any, Any]]:
+        for namespace, by_key in self.by_namespace.items():
+            for key, value in by_key.items():
+                yield namespace, key, value
+
+    def is_empty(self) -> bool:
+        return not self.by_namespace
+
+
+class _AbstractHeapState:
+    def __init__(self, backend: "HeapKeyedStateBackend", descriptor: StateDescriptor,
+                 table: StateTable):
+        self._backend = backend
+        self._descriptor = descriptor
+        self._table = table
+        self._namespace = VOID_NAMESPACE
+
+    def set_current_namespace(self, namespace) -> None:
+        self._namespace = namespace
+
+    @property
+    def _key(self):
+        return self._backend.current_key
+
+    def clear(self) -> None:
+        self._table.remove(self._key, self._namespace)
+
+
+class HeapValueState(_AbstractHeapState, ValueState):
+    def value(self):
+        v = self._table.get(self._key, self._namespace)
+        if v is None:
+            return self._descriptor.get_default_value()
+        return v
+
+    def update(self, value) -> None:
+        if value is None:
+            self.clear()
+        else:
+            self._table.put(self._key, self._namespace, value)
+
+
+class HeapListState(_AbstractHeapState, ListState):
+    def get(self):
+        v = self._table.get(self._key, self._namespace)
+        return list(v) if v else None
+
+    def add(self, value) -> None:
+        v = self._table.get(self._key, self._namespace)
+        if v is None:
+            self._table.put(self._key, self._namespace, [value])
+        else:
+            v.append(value)
+
+    def add_all(self, values) -> None:
+        values = list(values)
+        if not values:
+            return
+        v = self._table.get(self._key, self._namespace)
+        if v is None:
+            self._table.put(self._key, self._namespace, values)
+        else:
+            v.extend(values)
+
+    def update(self, values) -> None:
+        values = list(values)
+        if values:
+            self._table.put(self._key, self._namespace, values)
+        else:
+            self.clear()
+
+    def merge_namespaces(self, target, sources) -> None:
+        """(ref: InternalMergingState#mergeNamespaces via
+        HeapListState — concatenation)."""
+        merged = self._table.get(self._key, target) or []
+        for src in sources:
+            v = self._table.get(self._key, src)
+            if v:
+                merged.extend(v)
+            self._table.remove(self._key, src)
+        if merged:
+            self._table.put(self._key, target, merged)
+
+
+class HeapReducingState(_AbstractHeapState, ReducingState):
+    def __init__(self, backend, descriptor: ReducingStateDescriptor, table):
+        super().__init__(backend, descriptor, table)
+        self._reduce = descriptor.reduce_function.reduce
+
+    def get(self):
+        return self._table.get(self._key, self._namespace)
+
+    def add(self, value) -> None:
+        cur = self._table.get(self._key, self._namespace)
+        self._table.put(self._key, self._namespace,
+                        value if cur is None else self._reduce(cur, value))
+
+    def merge_namespaces(self, target, sources) -> None:
+        merged = self._table.get(self._key, target)
+        for src in sources:
+            v = self._table.get(self._key, src)
+            self._table.remove(self._key, src)
+            if v is not None:
+                merged = v if merged is None else self._reduce(merged, v)
+        if merged is not None:
+            self._table.put(self._key, target, merged)
+
+
+class HeapAggregatingState(_AbstractHeapState, AggregatingState):
+    """add → agg.add(value, acc) (ref: HeapAggregatingState.java:80-89)."""
+
+    def __init__(self, backend, descriptor: AggregatingStateDescriptor, table):
+        super().__init__(backend, descriptor, table)
+        self._agg = descriptor.aggregate_function
+
+    def get(self):
+        acc = self._table.get(self._key, self._namespace)
+        if acc is None:
+            return None
+        return self._agg.get_result(acc)
+
+    def get_accumulator(self):
+        return self._table.get(self._key, self._namespace)
+
+    def add(self, value) -> None:
+        acc = self._table.get(self._key, self._namespace)
+        if acc is None:
+            acc = self._agg.create_accumulator()
+        acc = self._agg.add(value, acc)
+        self._table.put(self._key, self._namespace, acc)
+
+    def merge_namespaces(self, target, sources) -> None:
+        merged = self._table.get(self._key, target)
+        for src in sources:
+            v = self._table.get(self._key, src)
+            self._table.remove(self._key, src)
+            if v is not None:
+                merged = v if merged is None else self._agg.merge(merged, v)
+        if merged is not None:
+            self._table.put(self._key, target, merged)
+
+
+class HeapFoldingState(_AbstractHeapState, FoldingState):
+    def __init__(self, backend, descriptor: FoldingStateDescriptor, table):
+        super().__init__(backend, descriptor, table)
+        self._fold = descriptor.fold_function
+
+    def get(self):
+        return self._table.get(self._key, self._namespace)
+
+    def add(self, value) -> None:
+        acc = self._table.get(self._key, self._namespace)
+        if acc is None:
+            acc = self._descriptor.get_default_value()
+        self._table.put(self._key, self._namespace, self._fold(acc, value))
+
+
+class HeapMapState(_AbstractHeapState, MapState):
+    def _map(self, create=False) -> Optional[dict]:
+        m = self._table.get(self._key, self._namespace)
+        if m is None and create:
+            m = {}
+            self._table.put(self._key, self._namespace, m)
+        return m
+
+    def get(self, key):
+        m = self._map()
+        return None if m is None else m.get(key)
+
+    def put(self, key, value) -> None:
+        self._map(create=True)[key] = value
+
+    def put_all(self, mapping: dict) -> None:
+        if mapping:
+            self._map(create=True).update(mapping)
+
+    def remove(self, key) -> None:
+        m = self._map()
+        if m is not None:
+            m.pop(key, None)
+            if not m:
+                self.clear()
+
+    def contains(self, key) -> bool:
+        m = self._map()
+        return m is not None and key in m
+
+    def entries(self):
+        m = self._map()
+        return list(m.items()) if m else []
+
+    def keys(self):
+        m = self._map()
+        return list(m.keys()) if m else []
+
+    def values(self):
+        m = self._map()
+        return list(m.values()) if m else []
+
+    def is_empty(self) -> bool:
+        m = self._map()
+        return not m
+
+
+class HeapKeyedStateBackend(KeyedStateBackend):
+    """All registered states as host dict tables."""
+
+    name = "heap"
+
+    def __init__(self, key_group_range: KeyGroupRange, max_parallelism: int):
+        super().__init__(key_group_range, max_parallelism)
+        self._tables: Dict[str, StateTable] = {}
+
+    def _table(self, name: str) -> StateTable:
+        t = self._tables.get(name)
+        if t is None:
+            t = StateTable()
+            self._tables[name] = t
+        return t
+
+    # ---- factories --------------------------------------------------
+    def create_value_state(self, d: ValueStateDescriptor):
+        return HeapValueState(self, d, self._table(d.name))
+
+    def create_list_state(self, d: ListStateDescriptor):
+        return HeapListState(self, d, self._table(d.name))
+
+    def create_reducing_state(self, d: ReducingStateDescriptor):
+        return HeapReducingState(self, d, self._table(d.name))
+
+    def create_aggregating_state(self, d: AggregatingStateDescriptor):
+        return HeapAggregatingState(self, d, self._table(d.name))
+
+    def create_folding_state(self, d: FoldingStateDescriptor):
+        return HeapFoldingState(self, d, self._table(d.name))
+
+    def create_map_state(self, d: MapStateDescriptor):
+        return HeapMapState(self, d, self._table(d.name))
+
+    # ---- introspection ----------------------------------------------
+    def get_keys(self, state_name: str, namespace) -> Iterable[Any]:
+        t = self._tables.get(state_name)
+        return list(t.keys(namespace)) if t else []
+
+    # ---- snapshot / restore -----------------------------------------
+    def snapshot(self) -> KeyedStateSnapshot:
+        """Serialize every (state, namespace, key, value) entry into
+        its key group's chunk (ref: HeapKeyedStateBackend snapshot
+        :289-420, key-grouped writeStateTable loop)."""
+        per_kg: Dict[int, List[Tuple[str, Any, Any, Any]]] = defaultdict(list)
+        for name, table in self._tables.items():
+            for namespace, key, value in table.entries():
+                kg = assign_to_key_group(key, self.max_parallelism)
+                per_kg[kg].append((name, namespace, key, value))
+        return KeyedStateSnapshot(
+            {kg: pickle.dumps(entries, protocol=pickle.HIGHEST_PROTOCOL)
+             for kg, entries in per_kg.items()},
+            meta={"backend": self.name},
+        )
+
+    def restore(self, snapshots) -> None:
+        # clear in place: bound state objects hold table references
+        for table in self._tables.values():
+            table.by_namespace.clear()
+        for snap in snapshots:
+            for kg, blob in snap.key_group_bytes.items():
+                if not self.key_group_range.contains(kg):
+                    continue
+                chunk = pickle.loads(blob)
+                if isinstance(chunk, dict):
+                    # chunk written by the tpu backend: host entries plus
+                    # device rows, which ARE the scalar-twin accumulator
+                    # format the heap aggregating state operates on
+                    for name, namespace, key, value in chunk["host"]:
+                        self._table(name).put(key, namespace, value)
+                    for name, entries in chunk["device"].items():
+                        table = self._table(name)
+                        for key, namespace, row in entries:
+                            table.put(key, namespace, row)
+                    continue
+                for name, namespace, key, value in chunk:
+                    self._table(name).put(key, namespace, value)
+
+    def dispose(self) -> None:
+        super().dispose()
+        self._tables.clear()

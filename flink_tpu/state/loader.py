@@ -1,0 +1,51 @@
+"""State-backend selection from configuration.
+
+Mirrors flink-runtime/.../state/StateBackendLoader.java:92-109, where
+the `state.backend` config key resolves shortcut names to factories —
+the north-star requirement is that ONLY this switch changes between the
+heap and TPU deployments.  Shortcuts accepted:
+
+  heap | jobmanager | filesystem  → HeapKeyedStateBackend
+  tpu  | rocksdb                  → TpuKeyedStateBackend
+                                    (`rocksdb` maps to the TPU backend
+                                    because it occupies the same role:
+                                    the scalable keyed backend)
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from flink_tpu.core.config import Configuration
+from flink_tpu.core.keygroups import KeyGroupRange
+from flink_tpu.state.backend import KeyedStateBackend
+from flink_tpu.state.heap_backend import HeapKeyedStateBackend
+from flink_tpu.state.tpu_backend import TpuKeyedStateBackend
+
+#: config key (ref: CheckpointingOptions.java:33 `state.backend`)
+STATE_BACKEND_KEY = "state.backend"
+
+_HEAP_NAMES = {"heap", "jobmanager", "filesystem", "memory", "hashmap"}
+_TPU_NAMES = {"tpu", "rocksdb", "device", "hbm"}
+
+
+def load_state_backend(
+    config_or_name,
+    key_group_range: KeyGroupRange,
+    max_parallelism: int,
+    **kwargs,
+) -> KeyedStateBackend:
+    if isinstance(config_or_name, Configuration):
+        name = config_or_name.get_string(STATE_BACKEND_KEY, "heap")
+    elif config_or_name is None:
+        name = "heap"
+    else:
+        name = str(config_or_name)
+    name = name.lower()
+    if name in _HEAP_NAMES:
+        return HeapKeyedStateBackend(key_group_range, max_parallelism)
+    if name in _TPU_NAMES:
+        return TpuKeyedStateBackend(key_group_range, max_parallelism, **kwargs)
+    raise ValueError(
+        f"unknown state backend {name!r}; expected one of "
+        f"{sorted(_HEAP_NAMES | _TPU_NAMES)}")

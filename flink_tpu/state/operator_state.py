@@ -1,0 +1,171 @@
+"""Operator (non-keyed) state backend.
+
+Re-designs flink-runtime/.../state/DefaultOperatorStateBackend.java:
+per-operator named list states (Kafka offsets etc.) with two
+redistribution modes on rescale, plus broadcast state
+(ref: HeapBroadcastState.java).
+
+Redistribution (ref: OperatorStateHandle.Mode):
+  SPLIT_DISTRIBUTE — list items are round-robined across new subtasks
+  UNION            — every subtask gets the full concatenated list
+"""
+
+from __future__ import annotations
+
+import pickle
+from typing import Any, Dict, Iterable, List, Tuple
+
+
+SPLIT_DISTRIBUTE = "split"
+UNION = "union"
+
+
+class OperatorListState:
+    """(ref: PartitionableListState)"""
+
+    def __init__(self, name: str, mode: str = SPLIT_DISTRIBUTE):
+        self.name = name
+        self.mode = mode
+        self._items: List[Any] = []
+
+    def get(self) -> List[Any]:
+        return list(self._items)
+
+    def add(self, value) -> None:
+        self._items.append(value)
+
+    def add_all(self, values: Iterable[Any]) -> None:
+        self._items.extend(values)
+
+    def update(self, values: Iterable[Any]) -> None:
+        self._items = list(values)
+
+    def clear(self) -> None:
+        self._items.clear()
+
+
+class BroadcastState:
+    """Keyed map replicated identically on every subtask
+    (ref: HeapBroadcastState.java)."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self._map: Dict[Any, Any] = {}
+
+    def get(self, key):
+        return self._map.get(key)
+
+    def put(self, key, value) -> None:
+        self._map[key] = value
+
+    def put_all(self, mapping: dict) -> None:
+        self._map.update(mapping)
+
+    def remove(self, key) -> None:
+        self._map.pop(key, None)
+
+    def contains(self, key) -> bool:
+        return key in self._map
+
+    def entries(self):
+        return list(self._map.items())
+
+    def keys(self):
+        return list(self._map.keys())
+
+    def values(self):
+        return list(self._map.values())
+
+    def immutable_entries(self):
+        return tuple(self._map.items())
+
+    def clear(self) -> None:
+        self._map.clear()
+
+
+class OperatorStateSnapshot:
+    __slots__ = ("list_states", "broadcast_states")
+
+    def __init__(self, list_states: Dict[str, Tuple[str, bytes]],
+                 broadcast_states: Dict[str, bytes]):
+        #: name → (mode, pickled items)
+        self.list_states = list_states
+        self.broadcast_states = broadcast_states
+
+    @staticmethod
+    def redistribute(snapshots: List["OperatorStateSnapshot"],
+                     new_parallelism: int) -> List["OperatorStateSnapshot"]:
+        """Re-split all old subtasks' operator state across
+        `new_parallelism` new subtasks (ref:
+        RoundRobinOperatorStateRepartitioner.java)."""
+        all_items: Dict[str, Tuple[str, List[Any]]] = {}
+        bcast: Dict[str, bytes] = {}
+        for snap in snapshots:
+            for name, (mode, blob) in snap.list_states.items():
+                items = pickle.loads(blob)
+                if name not in all_items:
+                    all_items[name] = (mode, [])
+                all_items[name][1].extend(items)
+            for name, blob in snap.broadcast_states.items():
+                bcast[name] = blob  # identical on every subtask
+        outs: List[OperatorStateSnapshot] = []
+        for i in range(new_parallelism):
+            lists: Dict[str, Tuple[str, bytes]] = {}
+            for name, (mode, items) in all_items.items():
+                if mode == UNION:
+                    part = items
+                else:
+                    part = items[i::new_parallelism]
+                lists[name] = (mode, pickle.dumps(part))
+            outs.append(OperatorStateSnapshot(dict(lists), dict(bcast)))
+        return outs
+
+
+class OperatorStateBackend:
+    def __init__(self):
+        self._list_states: Dict[str, OperatorListState] = {}
+        self._broadcast_states: Dict[str, BroadcastState] = {}
+
+    def get_list_state(self, name: str) -> OperatorListState:
+        return self._get_list(name, SPLIT_DISTRIBUTE)
+
+    def get_union_list_state(self, name: str) -> OperatorListState:
+        """(ref: getUnionListState — Kafka consumer offsets use this)"""
+        return self._get_list(name, UNION)
+
+    def _get_list(self, name: str, mode: str) -> OperatorListState:
+        st = self._list_states.get(name)
+        if st is None:
+            st = OperatorListState(name, mode)
+            self._list_states[name] = st
+        elif st.mode != mode:
+            raise ValueError(
+                f"operator state {name!r} already registered with mode {st.mode}")
+        return st
+
+    def get_broadcast_state(self, name: str) -> BroadcastState:
+        st = self._broadcast_states.get(name)
+        if st is None:
+            st = BroadcastState(name)
+            self._broadcast_states[name] = st
+        return st
+
+    def snapshot(self) -> OperatorStateSnapshot:
+        return OperatorStateSnapshot(
+            {name: (st.mode, pickle.dumps(st.get()))
+             for name, st in self._list_states.items()},
+            {name: pickle.dumps(st.entries())
+             for name, st in self._broadcast_states.items()},
+        )
+
+    def restore(self, snapshot: OperatorStateSnapshot) -> None:
+        for name, (mode, blob) in snapshot.list_states.items():
+            self._get_list(name, mode).update(pickle.loads(blob))
+        for name, blob in snapshot.broadcast_states.items():
+            st = self.get_broadcast_state(name)
+            st.clear()
+            st.put_all(dict(pickle.loads(blob)))
+
+    def dispose(self) -> None:
+        self._list_states.clear()
+        self._broadcast_states.clear()

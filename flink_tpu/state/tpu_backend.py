@@ -1,0 +1,482 @@
+"""TPU-HBM keyed-state backend — the replacement for the reference's
+native (RocksDB/JNI) backend.
+
+The reference's RocksDB backend pays two JNI hops per record
+(RocksDBAggregatingState.java:108-131: db.get → deserialize → add →
+serialize → db.put).  Here, aggregation state for ALL keys of this
+subtask's key-group range lives as struct-of-arrays in device HBM
+(`{component: f32/u8/i32 [capacity, ...]}`), a host-side index maps
+(key, namespace) → dense slot, and updates are micro-batched: records
+accumulate in host ring buffers and one `jax.jit` scatter dispatch
+applies the whole batch (donated buffers → in-place HBM update, no
+reallocation).  Reads (window fires) flush pending writes then gather.
+
+States whose values are arbitrary Python objects (ValueState, ListState,
+MapState, reducing/aggregating with non-device functions) are kept in
+host tables exactly like the heap backend — mirroring how RocksDB
+stores opaque bytes for everything while the hot path here is the
+numeric aggregation state (the north-star workload).
+
+Key-group layout: every slot records its key group so snapshots chunk
+per key group (rescale re-splits ranges, ref:
+KeyGroupRangeAssignment.java:47-56, StateAssignmentOperation.java).
+"""
+
+from __future__ import annotations
+
+import pickle
+from collections import defaultdict
+from typing import Any, Dict, Iterable, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from flink_tpu.core.keygroups import (
+    KeyGroupRange,
+    assign_to_key_group,
+    stable_hash64,
+)
+from flink_tpu.core.state import (
+    AggregatingState,
+    AggregatingStateDescriptor,
+    ListStateDescriptor,
+    MapStateDescriptor,
+    ReducingStateDescriptor,
+    FoldingStateDescriptor,
+    ValueStateDescriptor,
+)
+from flink_tpu.ops.device_agg import DeviceAggregateFunction
+from flink_tpu.state.backend import (
+    VOID_NAMESPACE,
+    KeyedStateBackend,
+    KeyedStateSnapshot,
+)
+from flink_tpu.state.heap_backend import (
+    HeapAggregatingState,
+    HeapFoldingState,
+    HeapListState,
+    HeapMapState,
+    HeapReducingState,
+    HeapValueState,
+    StateTable,
+)
+
+DEFAULT_INITIAL_CAPACITY = 4096
+DEFAULT_MICROBATCH = 16384
+
+
+def _round_up_pow2(n: int) -> int:
+    return 1 << max(0, (n - 1)).bit_length()
+
+
+class DeviceAggregatingState(AggregatingState):
+    """Slot-indexed, micro-batched device aggregation state.
+
+    The device twin of RocksDBAggregatingState / HeapAggregatingState:
+    identical observable semantics through the AggregatingState
+    interface, but `add` enqueues into a pending batch and `get`
+    flushes + gathers, so the per-record cost is a few Python list ops
+    and the per-batch cost is one XLA scatter over the whole key group.
+    """
+
+    def __init__(self, backend: "TpuKeyedStateBackend",
+                 descriptor: AggregatingStateDescriptor,
+                 initial_capacity: int = DEFAULT_INITIAL_CAPACITY,
+                 microbatch: int = DEFAULT_MICROBATCH):
+        agg = descriptor.aggregate_function
+        assert isinstance(agg, DeviceAggregateFunction)
+        self._backend = backend
+        self._descriptor = descriptor
+        self.agg: DeviceAggregateFunction = agg
+        self._namespace = VOID_NAMESPACE
+        self.capacity = initial_capacity
+        self.device_state: Dict[str, jnp.ndarray] = agg.init_state(initial_capacity)
+        #: (key, namespace) → slot
+        self.slot_index: Dict[Tuple[Any, Any], int] = {}
+        #: slot → (key, namespace) (None = free)
+        self.slot_meta: List[Optional[Tuple[Any, Any]]] = [None] * initial_capacity
+        self._free: List[int] = list(range(initial_capacity - 1, -1, -1))
+        self.microbatch = microbatch
+        self._pending_slots: List[int] = []
+        self._pending_values: List[Any] = []
+        self._pending_hi: List[int] = []
+        self._pending_lo: List[int] = []
+        # jit-compiled entry points (cached per state object; XLA caches
+        # per padded batch shape)
+        self._jit_update = jax.jit(self._update_fn, donate_argnums=0)
+        self._jit_merge = jax.jit(self.agg.merge_slots, donate_argnums=0)
+        self._jit_clear = jax.jit(self.agg.clear_slots, donate_argnums=0)
+        self._jit_result = jax.jit(self.agg.result)
+
+    def _update_fn(self, state, slots, values, hi, lo, mask):
+        return self.agg.update(state, slots, values, hi, lo, mask)
+
+    # ---- namespace / key context ------------------------------------
+    def set_current_namespace(self, namespace) -> None:
+        self._namespace = namespace
+
+    # ---- slot management --------------------------------------------
+    def _slot_for(self, key, namespace, create: bool = True) -> Optional[int]:
+        entry = (key, namespace)
+        slot = self.slot_index.get(entry)
+        if slot is None and create:
+            if not self._free:
+                self._grow(self.capacity * 2)
+            slot = self._free.pop()
+            self.slot_index[entry] = slot
+            self.slot_meta[slot] = entry
+        return slot
+
+    def _grow(self, new_capacity: int) -> None:
+        self._flush()
+        self.device_state = self.agg.grow_state(self.device_state, new_capacity)
+        self._free.extend(range(new_capacity - 1, self.capacity - 1, -1))
+        self.slot_meta.extend([None] * (new_capacity - self.capacity))
+        self.capacity = new_capacity
+
+    # ---- write path -------------------------------------------------
+    def add(self, value) -> None:
+        slot = self._slot_for(self._backend.current_key, self._namespace)
+        self._pending_slots.append(slot)
+        if self.agg.needs_value:
+            self._pending_values.append(value)
+        if self.agg.needs_value_hash:
+            h = stable_hash64(value)
+            self._pending_hi.append(h >> 32)
+            self._pending_lo.append(h & 0xFFFFFFFF)
+        if len(self._pending_slots) >= self.microbatch:
+            self._flush()
+
+    def add_batch(self, keys: Iterable[Any], namespace, values,
+                  namespaces=None) -> None:
+        """Vectorized write: one slot lookup loop, no per-record method
+        dispatch.  `namespace` is ONE namespace shared by the whole
+        batch (a window tuple is a single namespace); pass a parallel
+        sequence via `namespaces=` to override per record.  `values` is
+        a sequence/ndarray parallel to keys."""
+        slot_for = self._slot_for
+        if namespaces is None:
+            slots = [slot_for(k, namespace) for k in keys]
+        else:
+            slots = [slot_for(k, namespaces[i]) for i, k in enumerate(keys)]
+        self._pending_slots.extend(slots)
+        if self.agg.needs_value:
+            self._pending_values.extend(values)
+        if self.agg.needs_value_hash:
+            hi = self._pending_hi
+            lo = self._pending_lo
+            for v in values:
+                h = stable_hash64(v)
+                hi.append(h >> 32)
+                lo.append(h & 0xFFFFFFFF)
+        if len(self._pending_slots) >= self.microbatch:
+            self._flush()
+
+    def add_batch_hashed(self, slots: np.ndarray, values: np.ndarray,
+                         vh_hi: np.ndarray, vh_lo: np.ndarray) -> None:
+        """Lowest-level write: caller already resolved slots and value
+        hashes (the vectorized window operator path)."""
+        self._pending_slots.extend(int(s) for s in slots)
+        if self.agg.needs_value:
+            self._pending_values.extend(values)
+        if self.agg.needs_value_hash:
+            self._pending_hi.extend(int(h) for h in vh_hi)
+            self._pending_lo.extend(int(h) for h in vh_lo)
+        if len(self._pending_slots) >= self.microbatch:
+            self._flush()
+
+    def _flush(self) -> None:
+        n = len(self._pending_slots)
+        if n == 0:
+            return
+        padded = _round_up_pow2(n)
+        slots = np.zeros(padded, np.int32)
+        slots[:n] = self._pending_slots
+        mask = np.zeros(padded, bool)
+        mask[:n] = True
+        if self.agg.needs_value:
+            values = np.zeros(padded, self.agg.value_dtype)
+            values[:n] = np.asarray(self._pending_values, self.agg.value_dtype)
+        else:
+            values = np.zeros(padded, self.agg.value_dtype)
+        if self.agg.needs_value_hash:
+            hi = np.zeros(padded, np.uint32)
+            lo = np.zeros(padded, np.uint32)
+            hi[:n] = np.asarray(self._pending_hi, np.uint64).astype(np.uint32)
+            lo[:n] = np.asarray(self._pending_lo, np.uint64).astype(np.uint32)
+        else:
+            hi = np.zeros(padded, np.uint32)
+            lo = np.zeros(padded, np.uint32)
+        self.device_state = self._jit_update(
+            self.device_state, slots, values, hi, lo, mask)
+        self._pending_slots.clear()
+        self._pending_values.clear()
+        self._pending_hi.clear()
+        self._pending_lo.clear()
+
+    # ---- read path --------------------------------------------------
+    def get(self):
+        slot = self.slot_index.get((self._backend.current_key, self._namespace))
+        if slot is None:
+            return None
+        self._flush()
+        out = np.asarray(self._jit_result(
+            self.device_state, jnp.asarray(np.array([slot], np.int32))))[0]
+        return out.item() if np.ndim(out) == 0 else out
+
+    def get_batch(self, keys, namespace, namespaces=None) -> Tuple[np.ndarray, np.ndarray]:
+        """Gather results for many (key, namespace) pairs in one device
+        call; returns (results, found_mask).  Namespace semantics as in
+        `add_batch`."""
+        slots = []
+        found = []
+        for i, k in enumerate(keys):
+            ns = namespace if namespaces is None else namespaces[i]
+            s = self.slot_index.get((k, ns))
+            found.append(s is not None)
+            slots.append(s if s is not None else 0)
+        self._flush()
+        res = np.asarray(self._jit_result(
+            self.device_state, jnp.asarray(np.array(slots, np.int32))))
+        return res, np.array(found, bool)
+
+    # ---- lifecycle --------------------------------------------------
+    def clear(self) -> None:
+        entry = (self._backend.current_key, self._namespace)
+        slot = self.slot_index.pop(entry, None)
+        if slot is None:
+            return
+        self._flush()
+        self.device_state = self._jit_clear(
+            self.device_state, jnp.asarray(np.array([slot], np.int32)))
+        self.slot_meta[slot] = None
+        self._free.append(slot)
+
+    def clear_batch(self, keys, namespace, namespaces=None) -> None:
+        slots = []
+        for i, k in enumerate(keys):
+            ns = namespace if namespaces is None else namespaces[i]
+            s = self.slot_index.pop((k, ns), None)
+            if s is not None:
+                slots.append(s)
+                self.slot_meta[s] = None
+        if not slots:
+            return
+        self._flush()
+        n = len(slots)
+        padded = _round_up_pow2(n)
+        arr = np.full(padded, slots[0], np.int32)
+        arr[:n] = slots
+        self.device_state = self._jit_clear(self.device_state, jnp.asarray(arr))
+        self._free.extend(slots)
+
+    def merge_namespaces(self, target, sources) -> None:
+        """Session-window merge: device merge_slots(dst, src), then
+        free source slots (ref: mergeNamespaces,
+        WindowOperator.java:338 / MergingWindowSet.java:156)."""
+        key = self._backend.current_key
+        self._flush()
+        # don't materialize a target slot unless some source has state
+        # (matches heap: merging all-empty namespaces leaves no state)
+        popped = []
+        for src in sources:
+            s = self.slot_index.pop((key, src), None)
+            if s is not None:
+                popped.append(s)
+        if not popped:
+            return  # nothing to fold in; target (if any) stays as-is
+        dst = self._slot_for(key, target)
+        src_slots = []
+        for s in popped:
+            if s != dst:
+                src_slots.append(s)
+                self.slot_meta[s] = None
+        if not src_slots:
+            return
+        dsts = np.full(len(src_slots), dst, np.int32)
+        srcs = np.array(src_slots, np.int32)
+        self.device_state = self._jit_merge(
+            self.device_state, jnp.asarray(dsts), jnp.asarray(srcs))
+        self.device_state = self._jit_clear(self.device_state, jnp.asarray(srcs))
+        self._free.extend(src_slots)
+
+    # ---- snapshot ---------------------------------------------------
+    def snapshot_entries(self) -> Dict[int, List[Tuple[Any, Any, Dict[str, np.ndarray]]]]:
+        """Per key group: [(key, namespace, {component: row})]."""
+        self._flush()
+        host = {name: np.asarray(arr) for name, arr in self.device_state.items()}
+        per_kg: Dict[int, List[Tuple[Any, Any, Dict[str, np.ndarray]]]] = defaultdict(list)
+        mp = self._backend.max_parallelism
+        for (key, namespace), slot in self.slot_index.items():
+            kg = assign_to_key_group(key, mp)
+            row = {name: host[name][slot] for name in host}
+            per_kg[kg].append((key, namespace, row))
+        return per_kg
+
+    def restore_entries(self, entries: List[Tuple[Any, Any, Dict[str, np.ndarray]]]) -> None:
+        if not entries:
+            return
+        needed = len(self.slot_index) + len(entries)
+        if needed > self.capacity - len(self._pending_slots):
+            self._grow(max(self.capacity * 2, _round_up_pow2(needed)))
+        slots = []
+        rows: Dict[str, List[np.ndarray]] = defaultdict(list)
+        for key, namespace, row in entries:
+            slot = self._slot_for(key, namespace)
+            slots.append(slot)
+            for name, val in row.items():
+                rows[name].append(val)
+        idx = jnp.asarray(np.array(slots, np.int32))
+        new_state = dict(self.device_state)
+        for name, vals in rows.items():
+            new_state[name] = new_state[name].at[idx].set(
+                jnp.asarray(np.stack(vals)))
+        self.device_state = new_state
+
+    def active_entries(self) -> Iterable[Tuple[Any, Any]]:
+        return self.slot_index.keys()
+
+
+class TpuKeyedStateBackend(KeyedStateBackend):
+    """Hybrid backend: device slots for DeviceAggregateFunction
+    aggregation state, host tables for everything else."""
+
+    name = "tpu"
+
+    def __init__(self, key_group_range: KeyGroupRange, max_parallelism: int,
+                 initial_capacity: int = DEFAULT_INITIAL_CAPACITY,
+                 microbatch: int = DEFAULT_MICROBATCH):
+        super().__init__(key_group_range, max_parallelism)
+        self._tables: Dict[str, StateTable] = {}
+        self._device_states: Dict[str, DeviceAggregatingState] = {}
+        self.initial_capacity = initial_capacity
+        self.microbatch = microbatch
+
+    def _table(self, name: str) -> StateTable:
+        t = self._tables.get(name)
+        if t is None:
+            t = StateTable()
+            self._tables[name] = t
+        return t
+
+    # ---- factories --------------------------------------------------
+    def create_value_state(self, d: ValueStateDescriptor):
+        return HeapValueState(self, d, self._table(d.name))
+
+    def create_list_state(self, d: ListStateDescriptor):
+        return HeapListState(self, d, self._table(d.name))
+
+    def create_reducing_state(self, d: ReducingStateDescriptor):
+        return HeapReducingState(self, d, self._table(d.name))
+
+    def create_aggregating_state(self, d: AggregatingStateDescriptor):
+        if isinstance(d.aggregate_function, DeviceAggregateFunction):
+            st = DeviceAggregatingState(
+                self, d, self.initial_capacity, self.microbatch)
+            self._device_states[d.name] = st
+            # a restore() that ran before this descriptor was bound
+            # parked this state's accumulators in a host table (it had
+            # no way to know they were device-resident) — migrate them
+            leftover = self._tables.pop(d.name, None)
+            if leftover is not None:
+                specs = d.aggregate_function.state_specs()
+                entries = []
+                for namespace, key, value in leftover.entries():
+                    row = {n: np.asarray(value[n]).reshape(specs[n].shape)
+                           for n in specs}
+                    entries.append((key, namespace, row))
+                st.restore_entries(entries)
+            return st
+        return HeapAggregatingState(self, d, self._table(d.name))
+
+    def create_folding_state(self, d: FoldingStateDescriptor):
+        return HeapFoldingState(self, d, self._table(d.name))
+
+    def create_map_state(self, d: MapStateDescriptor):
+        return HeapMapState(self, d, self._table(d.name))
+
+    # ---- introspection ----------------------------------------------
+    def get_keys(self, state_name: str, namespace) -> Iterable[Any]:
+        if state_name in self._device_states:
+            return [k for (k, ns) in self._device_states[state_name].active_entries()
+                    if ns == namespace]
+        t = self._tables.get(state_name)
+        return list(t.keys(namespace)) if t else []
+
+    # ---- snapshot / restore -----------------------------------------
+    def snapshot(self) -> KeyedStateSnapshot:
+        per_kg: Dict[int, dict] = defaultdict(lambda: {"host": [], "device": {}})
+        for name, table in self._tables.items():
+            for namespace, key, value in table.entries():
+                kg = assign_to_key_group(key, self.max_parallelism)
+                per_kg[kg]["host"].append((name, namespace, key, value))
+        for name, dstate in self._device_states.items():
+            for kg, entries in dstate.snapshot_entries().items():
+                per_kg[kg]["device"][name] = entries
+        return KeyedStateSnapshot(
+            {kg: pickle.dumps(chunk, protocol=pickle.HIGHEST_PROTOCOL)
+             for kg, chunk in per_kg.items()},
+            meta={"backend": self.name},
+        )
+
+    def restore(self, snapshots) -> None:
+        # clear in place: bound state objects hold table references
+        for table in self._tables.values():
+            table.by_namespace.clear()
+        for dstate in self._device_states.values():
+            # reset device state in place (descriptor bindings survive);
+            # pending micro-batches are pre-failure writes — drop them,
+            # the restored checkpoint supersedes them
+            dstate.device_state = dstate.agg.init_state(dstate.capacity)
+            dstate.slot_index.clear()
+            dstate.slot_meta = [None] * dstate.capacity
+            dstate._free = list(range(dstate.capacity - 1, -1, -1))
+            dstate._pending_slots.clear()
+            dstate._pending_values.clear()
+            dstate._pending_hi.clear()
+            dstate._pending_lo.clear()
+        pending_device: Dict[str, list] = defaultdict(list)
+        for snap in snapshots:
+            for kg, blob in snap.key_group_bytes.items():
+                if not self.key_group_range.contains(kg):
+                    continue
+                chunk = pickle.loads(blob)
+                if isinstance(chunk, list):
+                    # chunk written by the heap backend: entries whose
+                    # state is device-resident here carry the scalar-twin
+                    # accumulator format (dict of per-component arrays,
+                    # see DeviceAggregateFunction.create_accumulator) —
+                    # normalize to device rows; the rest go to host tables
+                    for name, namespace, key, value in chunk:
+                        dstate = self._device_states.get(name)
+                        if dstate is not None and isinstance(value, dict):
+                            specs = dstate.agg.state_specs()
+                            row = {n: np.asarray(value[n]).reshape(specs[n].shape)
+                                   for n in specs}
+                            pending_device[name].append((key, namespace, row))
+                        else:
+                            self._table(name).put(key, namespace, value)
+                    continue
+                for name, namespace, key, value in chunk["host"]:
+                    self._table(name).put(key, namespace, value)
+                for name, entries in chunk["device"].items():
+                    pending_device[name].extend(entries)
+        for name, entries in pending_device.items():
+            dstate = self._device_states.get(name)
+            if dstate is None:
+                raise RuntimeError(
+                    f"restoring device state {name!r} before its descriptor "
+                    "was registered; bind states before restore()")
+            dstate.restore_entries(entries)
+
+    def flush_all(self) -> None:
+        """Barrier hook: push all pending micro-batches to HBM before a
+        snapshot is taken (SURVEY.md §7 hard-parts list)."""
+        for dstate in self._device_states.values():
+            dstate._flush()
+
+    def dispose(self) -> None:
+        super().dispose()
+        self._tables.clear()
+        self._device_states.clear()

@@ -1,0 +1,464 @@
+"""State-backend contract suite, run against heap AND tpu backends.
+
+Ports the intent of the reference's StateBackendTestBase.java (3,726
+LoC abstract suite run against every backend — SURVEY.md §4.3): value/
+list/map/reducing/aggregating semantics, namespaces, snapshot/restore,
+rescale re-split, and (tpu-only) device/heap differential equivalence.
+"""
+
+import numpy as np
+import pytest
+
+from flink_tpu.core.config import Configuration
+from flink_tpu.core.keygroups import (
+    KeyGroupRange,
+    assign_to_key_group,
+    compute_key_group_range_for_operator_index,
+)
+from flink_tpu.core.state import (
+    AggregatingStateDescriptor,
+    ListStateDescriptor,
+    MapStateDescriptor,
+    ReducingStateDescriptor,
+    ValueStateDescriptor,
+)
+from flink_tpu.ops.device_agg import CountAggregate, SumAggregate
+from flink_tpu.ops.sketches import HyperLogLogAggregate
+from flink_tpu.state import (
+    HeapKeyedStateBackend,
+    TpuKeyedStateBackend,
+    load_state_backend,
+)
+from flink_tpu.state.operator_state import (
+    OperatorStateBackend,
+    OperatorStateSnapshot,
+)
+
+MAX_PAR = 128
+FULL_RANGE = KeyGroupRange(0, MAX_PAR - 1)
+
+BACKENDS = ["heap", "tpu"]
+
+
+def make_backend(name):
+    return load_state_backend(name, FULL_RANGE, MAX_PAR)
+
+
+@pytest.fixture(params=BACKENDS)
+def backend(request):
+    b = make_backend(request.param)
+    yield b
+    b.dispose()
+
+
+# ---------------------------------------------------------------------
+# loader
+# ---------------------------------------------------------------------
+
+def test_loader_config_switch():
+    cfg = Configuration()
+    assert isinstance(load_state_backend(cfg, FULL_RANGE, MAX_PAR),
+                      HeapKeyedStateBackend)
+    cfg.set("state.backend", "tpu")
+    assert isinstance(load_state_backend(cfg, FULL_RANGE, MAX_PAR),
+                      TpuKeyedStateBackend)
+    with pytest.raises(ValueError):
+        load_state_backend("nope", FULL_RANGE, MAX_PAR)
+
+
+# ---------------------------------------------------------------------
+# value / list / map state
+# ---------------------------------------------------------------------
+
+def test_value_state(backend):
+    st = backend.get_or_create_keyed_state(ValueStateDescriptor("v"))
+    backend.set_current_key("a")
+    assert st.value() is None
+    st.update(42)
+    assert st.value() == 42
+    backend.set_current_key("b")
+    assert st.value() is None
+    st.update(7)
+    backend.set_current_key("a")
+    assert st.value() == 42
+    st.clear()
+    assert st.value() is None
+    backend.set_current_key("b")
+    assert st.value() == 7
+
+
+def test_value_state_default(backend):
+    st = backend.get_or_create_keyed_state(
+        ValueStateDescriptor("vd", default_value=99))
+    backend.set_current_key("x")
+    assert st.value() == 99
+    st.update(1)
+    assert st.value() == 1
+
+
+def test_list_state(backend):
+    st = backend.get_or_create_keyed_state(ListStateDescriptor("l"))
+    backend.set_current_key("k1")
+    assert st.get() is None
+    st.add(1)
+    st.add(2)
+    st.add_all([3, 4])
+    assert list(st.get()) == [1, 2, 3, 4]
+    st.update([9])
+    assert list(st.get()) == [9]
+    backend.set_current_key("k2")
+    assert st.get() is None
+    backend.set_current_key("k1")
+    st.clear()
+    assert st.get() is None
+
+
+def test_map_state(backend):
+    st = backend.get_or_create_keyed_state(MapStateDescriptor("m"))
+    backend.set_current_key("k")
+    assert st.is_empty()
+    st.put("a", 1)
+    st.put_all({"b": 2, "c": 3})
+    assert st.get("a") == 1
+    assert st.contains("b")
+    assert not st.contains("z")
+    assert sorted(st.keys()) == ["a", "b", "c"]
+    assert sorted(st.values()) == [1, 2, 3]
+    st.remove("a")
+    assert st.get("a") is None
+    assert sorted(dict(st.entries()).keys()) == ["b", "c"]
+    st.clear()
+    assert st.is_empty()
+
+
+# ---------------------------------------------------------------------
+# reducing / aggregating
+# ---------------------------------------------------------------------
+
+def test_reducing_state(backend):
+    st = backend.get_or_create_keyed_state(
+        ReducingStateDescriptor("r", lambda a, b: a + b))
+    backend.set_current_key("k")
+    assert st.get() is None
+    st.add(5)
+    st.add(6)
+    assert st.get() == 11
+    backend.set_current_key("other")
+    st.add(1)
+    assert st.get() == 1
+
+
+def test_aggregating_state_device_sum(backend):
+    st = backend.get_or_create_keyed_state(
+        AggregatingStateDescriptor("agg", SumAggregate(np.float32)))
+    backend.set_current_key("k")
+    assert st.get() is None
+    st.add(1.5)
+    st.add(2.5)
+    assert st.get() == pytest.approx(4.0)
+    backend.set_current_key("j")
+    st.add(10.0)
+    assert st.get() == pytest.approx(10.0)
+    backend.set_current_key("k")
+    st.clear()
+    assert st.get() is None
+
+
+def test_aggregating_state_namespaces(backend):
+    st = backend.get_or_create_keyed_state(
+        AggregatingStateDescriptor("aggns", CountAggregate()))
+    backend.set_current_key("k")
+    st.set_current_namespace(("w", 0))
+    st.add(object())
+    st.add(object())
+    st.set_current_namespace(("w", 1))
+    st.add(object())
+    assert st.get() == 1
+    st.set_current_namespace(("w", 0))
+    assert st.get() == 2
+
+
+def test_merge_namespaces(backend):
+    st = backend.get_or_create_keyed_state(
+        AggregatingStateDescriptor("m_agg", SumAggregate(np.float32)))
+    backend.set_current_key("k")
+    for ns, v in [(("s", 1), 1.0), (("s", 2), 2.0), (("s", 3), 4.0)]:
+        st.set_current_namespace(ns)
+        st.add(v)
+    st.merge_namespaces(("s", 9), [("s", 1), ("s", 2), ("s", 3)])
+    st.set_current_namespace(("s", 9))
+    assert st.get() == pytest.approx(7.0)
+    for ns in [("s", 1), ("s", 2), ("s", 3)]:
+        st.set_current_namespace(ns)
+        assert st.get() is None
+
+
+def test_hll_aggregating(backend):
+    st = backend.get_or_create_keyed_state(
+        AggregatingStateDescriptor("hll", HyperLogLogAggregate(precision=10)))
+    backend.set_current_key("page1")
+    for i in range(1000):
+        st.add(f"user-{i}")
+    est = st.get()
+    assert abs(est - 1000) / 1000 < 0.12
+
+
+def test_get_keys(backend):
+    st = backend.get_or_create_keyed_state(
+        AggregatingStateDescriptor("gk", CountAggregate()))
+    for k in ["a", "b", "c"]:
+        backend.set_current_key(k)
+        st.set_current_namespace("ns0")
+        st.add(1)
+    assert sorted(backend.get_keys("gk", "ns0")) == ["a", "b", "c"]
+    assert backend.get_keys("gk", "nsX") == []
+
+
+# ---------------------------------------------------------------------
+# snapshot / restore / rescale
+# ---------------------------------------------------------------------
+
+def _populate(backend, n=50):
+    v = backend.get_or_create_keyed_state(ValueStateDescriptor("v"))
+    agg = backend.get_or_create_keyed_state(
+        AggregatingStateDescriptor("agg", SumAggregate(np.float32)))
+    for i in range(n):
+        backend.set_current_key(f"key-{i}")
+        v.update(i)
+        agg.set_current_namespace("w0")
+        agg.add(float(i))
+        agg.add(1.0)
+
+
+def _check(backend, n=50):
+    v = backend.get_or_create_keyed_state(ValueStateDescriptor("v"))
+    agg = backend.get_or_create_keyed_state(
+        AggregatingStateDescriptor("agg", SumAggregate(np.float32)))
+    for i in range(n):
+        backend.set_current_key(f"key-{i}")
+        assert v.value() == i
+        agg.set_current_namespace("w0")
+        assert agg.get() == pytest.approx(i + 1.0)
+
+
+@pytest.mark.parametrize("name", BACKENDS)
+def test_snapshot_restore_roundtrip(name):
+    b1 = make_backend(name)
+    _populate(b1)
+    snap = b1.snapshot()
+    assert snap.total_bytes > 0
+    b2 = make_backend(name)
+    # bind states first (descriptors must be known before restore)
+    b2.get_or_create_keyed_state(ValueStateDescriptor("v"))
+    b2.get_or_create_keyed_state(
+        AggregatingStateDescriptor("agg", SumAggregate(np.float32)))
+    b2.restore([snap])
+    _check(b2)
+
+
+@pytest.mark.parametrize("name", BACKENDS)
+def test_cross_backend_restore(name):
+    """heap snapshot restores into tpu backend and vice versa — the
+    `state.backend` switch must be transparent across restarts."""
+    other = "tpu" if name == "heap" else "heap"
+    b1 = make_backend(name)
+    _populate(b1, 20)
+    snap = b1.snapshot()
+    b2 = make_backend(other)
+    b2.get_or_create_keyed_state(ValueStateDescriptor("v"))
+    b2.get_or_create_keyed_state(
+        AggregatingStateDescriptor("agg", SumAggregate(np.float32)))
+    b2.restore([snap])
+    _check(b2, 20)
+
+
+@pytest.mark.parametrize("name", BACKENDS)
+def test_rescale_resplit(name):
+    """Snapshot at parallelism 1, restore at parallelism 2: each new
+    subtask takes only the chunks in its key-group range (ref:
+    RescalingITCase, StateAssignmentOperation)."""
+    b1 = make_backend(name)
+    _populate(b1, 60)
+    snap = b1.snapshot()
+
+    parts = []
+    for idx in range(2):
+        rng = compute_key_group_range_for_operator_index(MAX_PAR, 2, idx)
+        b = load_state_backend(name, rng, MAX_PAR)
+        b.get_or_create_keyed_state(ValueStateDescriptor("v"))
+        b.get_or_create_keyed_state(
+            AggregatingStateDescriptor("agg", SumAggregate(np.float32)))
+        b.restore([snap])
+        parts.append((rng, b))
+
+    seen = set()
+    for i in range(60):
+        key = f"key-{i}"
+        kg = assign_to_key_group(key, MAX_PAR)
+        owner = [b for rng, b in parts if rng.contains(kg)]
+        assert len(owner) == 1
+        b = owner[0]
+        v = b.get_or_create_keyed_state(ValueStateDescriptor("v"))
+        b.set_current_key(key)
+        assert v.value() == i
+        seen.add(key)
+    assert len(seen) == 60
+    # both subtasks actually own some keys
+    for rng, b in parts:
+        assert any(rng.contains(assign_to_key_group(f"key-{i}", MAX_PAR))
+                   for i in range(60))
+
+
+# ---------------------------------------------------------------------
+# tpu-specific: batched API + differential vs heap
+# ---------------------------------------------------------------------
+
+def test_tpu_add_batch_matches_heap():
+    rng_keys = [f"k{i % 17}" for i in range(500)]
+    vals = np.arange(500, dtype=np.float32)
+
+    heap = make_backend("heap")
+    hs = heap.get_or_create_keyed_state(
+        AggregatingStateDescriptor("s", SumAggregate(np.float32)))
+    for k, v in zip(rng_keys, vals):
+        heap.set_current_key(k)
+        hs.set_current_namespace("w")
+        hs.add(float(v))
+
+    tpu = make_backend("tpu")
+    ts = tpu.get_or_create_keyed_state(
+        AggregatingStateDescriptor("s", SumAggregate(np.float32)))
+    ts.add_batch(rng_keys, "w", vals)
+
+    for k in set(rng_keys):
+        heap.set_current_key(k)
+        hs.set_current_namespace("w")
+        tpu.set_current_key(k)
+        ts.set_current_namespace("w")
+        assert ts.get() == pytest.approx(hs.get()), k
+
+
+def test_tpu_capacity_growth():
+    tpu = TpuKeyedStateBackend(FULL_RANGE, MAX_PAR, initial_capacity=8)
+    st = tpu.get_or_create_keyed_state(
+        AggregatingStateDescriptor("g", CountAggregate()))
+    for i in range(100):
+        tpu.set_current_key(i)
+        st.add(1)
+    for i in range(100):
+        tpu.set_current_key(i)
+        assert st.get() == 1
+
+
+def test_tpu_get_batch():
+    tpu = make_backend("tpu")
+    st = tpu.get_or_create_keyed_state(
+        AggregatingStateDescriptor("gb", SumAggregate(np.float32)))
+    keys = [f"k{i}" for i in range(10)]
+    st.add_batch(keys, "w", np.arange(10, dtype=np.float32))
+    res, found = st.get_batch(keys + ["missing"], "w")
+    assert found[:10].all() and not found[10]
+    np.testing.assert_allclose(res[:10], np.arange(10, dtype=np.float32))
+
+
+# ---------------------------------------------------------------------
+# operator state
+# ---------------------------------------------------------------------
+
+def test_operator_list_state_roundtrip():
+    b = OperatorStateBackend()
+    ls = b.get_list_state("offsets")
+    ls.add_all([("p0", 5), ("p1", 7)])
+    bs = b.get_broadcast_state("rules")
+    bs.put("r1", "drop")
+    snap = b.snapshot()
+
+    b2 = OperatorStateBackend()
+    b2.restore(snap)
+    assert b2.get_list_state("offsets").get() == [("p0", 5), ("p1", 7)]
+    assert b2.get_broadcast_state("rules").get("r1") == "drop"
+
+
+def test_operator_state_redistribute():
+    snaps = []
+    for subtask in range(2):
+        b = OperatorStateBackend()
+        b.get_list_state("split").add_all([f"s{subtask}-{i}" for i in range(3)])
+        b.get_union_list_state("union").add(f"u{subtask}")
+        snaps.append(b.snapshot())
+
+    parts = OperatorStateSnapshot.redistribute(snaps, 3)
+    assert len(parts) == 3
+    backends = []
+    for p in parts:
+        b = OperatorStateBackend()
+        b.restore(p)
+        backends.append(b)
+    all_split = sorted(sum((b.get_list_state("split").get() for b in backends), []))
+    assert all_split == sorted(f"s{s}-{i}" for s in range(2) for i in range(3))
+    for b in backends:
+        assert sorted(b.get_union_list_state("union").get()) == ["u0", "u1"]
+
+
+# ---------------------------------------------------------------------
+# regression tests for review findings
+# ---------------------------------------------------------------------
+
+def test_restore_drops_inflight_pending_writes():
+    """Pre-restore buffered writes must not leak into restored state."""
+    tpu = make_backend("tpu")
+    st = tpu.get_or_create_keyed_state(
+        AggregatingStateDescriptor("p", SumAggregate(np.float32)))
+    tpu.set_current_key("a")
+    st.add(1.0)
+    snap = tpu.snapshot()  # flushes: a=1.0
+    tpu.set_current_key("b")
+    st.add(100.0)          # in-flight, never snapshotted
+    tpu.restore([snap])
+    tpu.set_current_key("c")
+    st.add(1.0)
+    assert st.get() == pytest.approx(1.0)  # not 101.0
+    tpu.set_current_key("a")
+    assert st.get() == pytest.approx(1.0)
+
+
+def test_merge_empty_namespaces_leaves_no_state(backend):
+    st = backend.get_or_create_keyed_state(
+        AggregatingStateDescriptor("me", SumAggregate(np.float32)))
+    backend.set_current_key("k")
+    st.merge_namespaces(("w", 9), [("w", 1), ("w", 2)])
+    st.set_current_namespace(("w", 9))
+    assert st.get() is None
+
+
+def test_nan_inf_keys():
+    b = make_backend("heap")
+    st = b.get_or_create_keyed_state(ValueStateDescriptor("f"))
+    for k in [float("nan"), float("inf"), float("-inf"), 1.5]:
+        b.set_current_key(k)
+        st.update("ok")
+        assert st.value() == "ok"
+
+
+def test_descriptor_rebind_type_mismatch(backend):
+    backend.get_or_create_keyed_state(ValueStateDescriptor("dup"))
+    with pytest.raises(ValueError):
+        backend.get_or_create_keyed_state(MapStateDescriptor("dup"))
+
+
+def test_restore_before_bind_then_late_bind():
+    """Heap-format snapshot restored before the device descriptor is
+    bound: accumulators must surface once the descriptor binds."""
+    heap = make_backend("heap")
+    hs = heap.get_or_create_keyed_state(
+        AggregatingStateDescriptor("lb", SumAggregate(np.float32)))
+    heap.set_current_key("x")
+    hs.add(5.0)
+    snap = heap.snapshot()
+
+    tpu = make_backend("tpu")
+    tpu.restore([snap])  # descriptor not bound yet
+    st = tpu.get_or_create_keyed_state(
+        AggregatingStateDescriptor("lb", SumAggregate(np.float32)))
+    tpu.set_current_key("x")
+    assert st.get() == pytest.approx(5.0)
