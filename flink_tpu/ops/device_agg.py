@@ -56,6 +56,13 @@ class DeviceAggregateFunction(AggregateFunction):
     value_dtype: np.dtype = np.float32
 
     # ---- device contract -------------------------------------------
+    def extract_value(self, value):
+        """Project the aggregated quantity out of a record (e.g. a
+        tuple field) before it is buffered/hashed for the device; the
+        IN-side of the reference's AggregateFunction.add happens here
+        so the device batch carries plain numerics."""
+        return value
+
     @abc.abstractmethod
     def state_specs(self) -> Dict[str, StateSpec]:
         ...
@@ -142,6 +149,7 @@ class DeviceAggregateFunction(AggregateFunction):
     def _host_record(self, value):
         """Turn one scalar value into (values[1], vh_hi[1], vh_lo[1])."""
         from flink_tpu.core.keygroups import stable_hash64
+        value = self.extract_value(value)
         if self.needs_value_hash:
             h = stable_hash64(value)
             hi = np.array([h >> 32], np.uint32)

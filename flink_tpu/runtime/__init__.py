@@ -1,0 +1,2 @@
+"""Runtime: job execution, checkpoint coordination, cluster services
+(ref: flink-runtime)."""

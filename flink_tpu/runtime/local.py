@@ -1,0 +1,321 @@
+"""Single-process job execution.
+
+Re-designs the task layer of flink-streaming-java (StreamTask.java:
+lifecycle :233-392, OperatorChain.java, StreamInputProcessor.java:176,
+StatusWatermarkValve) as a synchronous in-process dataflow: operator
+subtask instances are wired with direct-call outputs (operator chaining
+is literal function composition here), cross-vertex edges route through
+partitioners to per-subtask input valves that min-combine watermarks
+per channel.
+
+The single-owner execution loop replaces the reference's checkpoint
+lock (SURVEY.md §5 race-detection note): all element processing, timer
+firing, and snapshots happen on one thread.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+from flink_tpu.core.keygroups import (
+    KeyGroupRange,
+    compute_key_group_range_for_operator_index,
+)
+from flink_tpu.state.loader import load_state_backend
+from flink_tpu.state.operator_state import OperatorStateBackend
+from flink_tpu.streaming.elements import (
+    MAX_WATERMARK,
+    MIN_TIMESTAMP,
+    StreamRecord,
+    Watermark,
+)
+from flink_tpu.streaming.graph import JobEdge, JobGraph, JobVertex
+from flink_tpu.streaming.operators import (
+    Output,
+    StreamOperator,
+    TwoInputStreamOperator,
+)
+from flink_tpu.streaming.sources import StreamSource
+from flink_tpu.streaming.timers import TestProcessingTimeService
+
+
+class JobExecutionResult:
+    def __init__(self, job_name: str):
+        self.job_name = job_name
+        self.accumulators: Dict[str, Any] = {}
+        self.checkpoints_completed = 0
+
+
+class _ChainedOutput(Output):
+    """Direct call into the next operator in the chain
+    (ref: ChainingOutput in OperatorChain.java)."""
+
+    __slots__ = ("op", "router")
+
+    def __init__(self, op: StreamOperator, router: "_RouterOutput"):
+        self.op = op
+        self.router = router
+
+    def collect(self, record):
+        self.op.set_key_context(record)
+        self.op.process_element(record)
+
+    def emit_watermark(self, watermark):
+        self.op.process_watermark(watermark)
+
+    def collect_side(self, tag, record):
+        # side outputs bypass the chain and route at the task boundary
+        self.router.collect_side(tag, record)
+
+
+class _RouterOutput(Output):
+    """Chain-tail output: routes records through each out-edge's
+    partitioner to downstream subtask channels
+    (ref: RecordWriterOutput + RecordWriter)."""
+
+    def __init__(self):
+        #: (partitioner, channels: List[_InputChannel], side_tag)
+        self.routes: List[Tuple[Any, List["_InputChannel"], Any]] = []
+
+    def add_route(self, partitioner, channels, side_tag=None):
+        partitioner.setup(len(channels))
+        self.routes.append((partitioner, channels, side_tag))
+
+    def collect(self, record):
+        for partitioner, channels, side_tag in self.routes:
+            if side_tag is not None:
+                continue
+            for idx in partitioner.select_channels(record.value, len(channels)):
+                channels[idx].push_record(record)
+
+    def collect_side(self, tag, record):
+        for partitioner, channels, side_tag in self.routes:
+            if side_tag is not None and side_tag.tag_id == tag.tag_id:
+                for idx in partitioner.select_channels(record.value, len(channels)):
+                    channels[idx].push_record(record)
+
+    def emit_watermark(self, watermark):
+        # watermarks broadcast to every channel of every route
+        for _, channels, _ in self.routes:
+            for ch in channels:
+                ch.push_watermark(watermark)
+
+
+class _InputChannel:
+    """One logical channel into a subtask's input valve."""
+
+    __slots__ = ("subtask", "input_index", "channel_id")
+
+    def __init__(self, subtask: "SubtaskInstance", input_index: int, channel_id: int):
+        self.subtask = subtask
+        self.input_index = input_index
+        self.channel_id = channel_id
+
+    def push_record(self, record):
+        self.subtask.process_record(self.input_index, record)
+
+    def push_watermark(self, watermark):
+        self.subtask.process_channel_watermark(
+            self.input_index, self.channel_id, watermark)
+
+
+class SubtaskInstance:
+    """One parallel instance of a JobVertex: the operator chain plus
+    input valves (ref: StreamTask + OperatorChain)."""
+
+    def __init__(self, vertex: JobVertex, subtask_index: int,
+                 state_backend_name: str, max_parallelism: int,
+                 processing_time_service):
+        self.vertex = vertex
+        self.subtask_index = subtask_index
+        self.max_parallelism = max_parallelism
+        self.operators: List[StreamOperator] = []
+        self.pts = processing_time_service
+        self._watermarks: Dict[int, Dict[int, int]] = {}  # input -> channel -> wm
+        self._current_wm: Dict[int, int] = {}
+        self._channel_count = 0
+
+        # build the chain, tail first so outputs exist when wiring heads
+        chain = vertex.chain
+        self.router = _RouterOutput()
+        outputs: Dict[int, Output] = {}
+        ops_by_node: Dict[int, StreamOperator] = {}
+        for node in reversed(chain):
+            out_edge = next((e for e in vertex.chain_edges
+                             if e.source_id == node.id), None)
+            if out_edge is None:
+                output = self.router
+            else:
+                output = _ChainedOutput(ops_by_node[out_edge.target_id],
+                                        self.router)
+            op = node.operator_factory()
+            keyed = None
+            if node.key_selector is not None:
+                rng = compute_key_group_range_for_operator_index(
+                    max_parallelism, vertex.parallelism, subtask_index)
+                keyed = load_state_backend(
+                    state_backend_name if node.state_backend is None
+                    else node.state_backend,
+                    rng, max_parallelism)
+            op.setup(
+                output,
+                keyed_backend=keyed,
+                operator_state_backend=OperatorStateBackend(),
+                processing_time_service=processing_time_service,
+                key_selector=node.key_selector,
+                operator_id=node.uid,
+            )
+            ops_by_node[node.id] = op
+            outputs[node.id] = output
+        # operators in chain order (head first)
+        self.operators = [ops_by_node[n.id] for n in chain]
+
+    @property
+    def head(self) -> StreamOperator:
+        return self.operators[0]
+
+    @property
+    def is_source(self) -> bool:
+        return isinstance(self.head, StreamSource)
+
+    def new_channel(self, input_index: int) -> _InputChannel:
+        ch = _InputChannel(self, input_index, self._channel_count)
+        self._channel_count += 1
+        self._watermarks.setdefault(input_index, {})[ch.channel_id] = MIN_TIMESTAMP
+        return ch
+
+    # ---- lifecycle --------------------------------------------------
+    def open(self):
+        for op in self.operators:
+            op.open()
+
+    def close(self):
+        for op in self.operators:
+            op.close()
+
+    def run_source(self):
+        assert self.is_source
+        self.head.run()
+        # end of input: flush event time (ref: StreamSource closes with
+        # MAX_WATERMARK so windows drain)
+        self.head.output.emit_watermark(MAX_WATERMARK)
+
+    # ---- input path (ref: StreamInputProcessor.processInput :176) ---
+    def process_record(self, input_index: int, record: StreamRecord):
+        head = self.head
+        if isinstance(head, TwoInputStreamOperator):
+            if input_index == 0:
+                head.set_key_context(record)
+                head.process_element1(record)
+            else:
+                if hasattr(head, "set_key_context2"):
+                    head.set_key_context2(record)
+                head.process_element2(record)
+        else:
+            head.set_key_context(record)
+            head.process_element(record)
+
+    def process_channel_watermark(self, input_index: int, channel_id: int,
+                                  watermark: Watermark):
+        """Per-channel min-combine (ref: StatusWatermarkValve)."""
+        chans = self._watermarks.setdefault(input_index, {})
+        if channel_id not in chans:
+            chans[channel_id] = MIN_TIMESTAMP
+        if watermark.timestamp <= chans[channel_id]:
+            return
+        chans[channel_id] = watermark.timestamp
+        new_min = min(chans.values())
+        if new_min <= self._current_wm.get(input_index, MIN_TIMESTAMP):
+            return
+        self._current_wm[input_index] = new_min
+        head = self.head
+        wm = Watermark(new_min)
+        if isinstance(head, TwoInputStreamOperator):
+            if input_index == 0:
+                head.process_watermark1(wm)
+            else:
+                head.process_watermark2(wm)
+        else:
+            head.process_watermark(wm)
+
+    # ---- snapshot ---------------------------------------------------
+    def snapshot(self) -> dict:
+        return {"operators": {op.operator_id: op.snapshot_state()
+                              for op in self.operators}}
+
+    def restore(self, snapshots: List[dict]) -> None:
+        for op in self.operators:
+            per_op = [s["operators"][op.operator_id] for s in snapshots
+                      if op.operator_id in s.get("operators", {})]
+            if per_op:
+                op.restore_state(per_op)
+
+
+class LocalExecutor:
+    """Runs a JobGraph to completion in-process
+    (the MiniCluster-equivalent for one process; multi-worker execution
+    lives in flink_tpu/runtime/minicluster.py)."""
+
+    def __init__(self, state_backend: str = "heap", max_parallelism: int = 128,
+                 restart_strategy: Optional[dict] = None,
+                 processing_time_service=None):
+        self.state_backend = state_backend
+        self.max_parallelism = max_parallelism
+        self.restart_strategy = restart_strategy or {"strategy": "none"}
+        self.pts = processing_time_service or TestProcessingTimeService()
+
+    def build_subtasks(self, job_graph: JobGraph) -> Dict[int, List[SubtaskInstance]]:
+        subtasks: Dict[int, List[SubtaskInstance]] = {}
+        for vid, vertex in job_graph.vertices.items():
+            subtasks[vid] = [
+                SubtaskInstance(vertex, i, self.state_backend,
+                                self.max_parallelism, self.pts)
+                for i in range(vertex.parallelism)
+            ]
+        # wire edges: all-to-all for shuffling partitioners; contiguous
+        # groups for pointwise ones (forward/rescale — ref: the
+        # DistributionPattern.POINTWISE wiring in ExecutionGraph)
+        for edge in job_graph.edges:
+            ups = subtasks[edge.source_vertex_id]
+            downs = subtasks[edge.target_vertex_id]
+            for i, up in enumerate(ups):
+                if edge.partitioner.is_pointwise:
+                    n_up, n_down = len(ups), len(downs)
+                    if n_down >= n_up:
+                        targets = downs[i * n_down // n_up:(i + 1) * n_down // n_up]
+                    else:
+                        targets = [downs[i * n_down // n_up]]
+                else:
+                    targets = downs
+                channels = [d.new_channel(edge.type_number) for d in targets]
+                partitioner = _clone_partitioner(edge.partitioner)
+                up.router.add_route(partitioner, channels, edge.side_output_tag)
+        return subtasks
+
+    def execute(self, job_graph: JobGraph) -> JobExecutionResult:
+        subtasks = self.build_subtasks(job_graph)
+        order = job_graph.topological_vertices()
+        all_instances = [st for v in order for st in subtasks[v.id]]
+        for st in all_instances:
+            st.open()
+        try:
+            for v in order:
+                if v.is_source:
+                    for st in subtasks[v.id]:
+                        st.run_source()
+            # end of input: drain processing-time timers so finite jobs
+            # with processing-time windows emit their tails (a local-
+            # runtime convenience; a long-running cluster job's clock
+            # keeps advancing instead)
+            if isinstance(self.pts, TestProcessingTimeService):
+                self.pts.fire_all_pending()
+        finally:
+            for st in all_instances:
+                st.close()
+        result = JobExecutionResult(job_graph.job_name)
+        return result
+
+
+def _clone_partitioner(p):
+    import copy
+    return copy.copy(p)

@@ -139,6 +139,7 @@ class DeviceAggregatingState(AggregatingState):
     def add(self, value) -> None:
         slot = self._slot_for(self._backend.current_key, self._namespace)
         self._pending_slots.append(slot)
+        value = self.agg.extract_value(value)
         if self.agg.needs_value:
             self._pending_values.append(value)
         if self.agg.needs_value_hash:
@@ -161,6 +162,9 @@ class DeviceAggregatingState(AggregatingState):
         else:
             slots = [slot_for(k, namespaces[i]) for i, k in enumerate(keys)]
         self._pending_slots.extend(slots)
+        extract = type(self.agg).extract_value
+        if extract is not DeviceAggregateFunction.extract_value:
+            values = [self.agg.extract_value(v) for v in values]
         if self.agg.needs_value:
             self._pending_values.extend(values)
         if self.agg.needs_value_hash:
@@ -464,11 +468,15 @@ class TpuKeyedStateBackend(KeyedStateBackend):
                     pending_device[name].extend(entries)
         for name, entries in pending_device.items():
             dstate = self._device_states.get(name)
-            if dstate is None:
-                raise RuntimeError(
-                    f"restoring device state {name!r} before its descriptor "
-                    "was registered; bind states before restore()")
-            dstate.restore_entries(entries)
+            if dstate is not None:
+                dstate.restore_entries(entries)
+            else:
+                # descriptor not bound yet (standard recovery order is
+                # restore-then-open): park rows in a host table; the
+                # migration in create_aggregating_state picks them up
+                table = self._table(name)
+                for key, namespace, row in entries:
+                    table.put(key, namespace, row)
 
     def flush_all(self) -> None:
         """Barrier hook: push all pending micro-batches to HBM before a

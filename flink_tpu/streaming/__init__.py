@@ -1,0 +1,12 @@
+"""Streaming runtime + DataStream API (ref: flink-streaming-java).
+
+  elements    StreamRecord / Watermark / StreamStatus / LatencyMarker
+  windowing   windows, assigners, triggers, evictors, time
+  timers      InternalTimerService (event + processing time)
+  operators   operator lifecycle + stateless/keyed operators
+  window_operator  WindowOperator + MergingWindowSet (session merging)
+  functions   ProcessFunction, window functions, source/sink contracts
+  datastream  fluent API (in flink_tpu/streaming/datastream.py)
+  graph       StreamGraph -> JobGraph translation with chaining
+  task        single-process StreamTask execution
+"""

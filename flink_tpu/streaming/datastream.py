@@ -1,0 +1,686 @@
+"""DataStream API: fluent stream-graph building.
+
+Re-designs flink-streaming-java/.../api/datastream/ (DataStream.java,
+KeyedStream.java, WindowedStream.java:305-850, AllWindowedStream,
+ConnectedStreams) and api/environment/StreamExecutionEnvironment.java
+(execute :1508, getStreamGraph :1532).  SURVEY.md §2.9 lists the
+surface this mirrors.
+
+Naming is pythonic snake_case; the call shapes match the reference:
+env.from_collection(...).key_by(...).time_window(Time.seconds(5))
+   .aggregate(agg).add_sink(sink); env.execute().
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Iterable, List, Optional, Union
+
+from flink_tpu.core.config import Configuration
+from flink_tpu.core.functions import (
+    AggregateFunction,
+    as_filter_function,
+    as_flat_map_function,
+    as_key_selector,
+    as_map_function,
+    as_reduce_function,
+)
+from flink_tpu.core.state import (
+    AggregatingStateDescriptor,
+    FoldingStateDescriptor,
+    ListStateDescriptor,
+    ReducingStateDescriptor,
+)
+from flink_tpu.streaming.graph import (
+    StreamEdge,
+    StreamGraph,
+    StreamNode,
+    create_job_graph,
+)
+from flink_tpu.streaming.operators import (
+    CoProcessOperator,
+    CoStreamFlatMap,
+    CoStreamMap,
+    KeyedProcessOperator,
+    ProcessOperator,
+    StreamFilter,
+    StreamFlatMap,
+    StreamGroupedReduce,
+    StreamMap,
+    StreamSink,
+)
+from flink_tpu.streaming.partitioners import (
+    BroadcastPartitioner,
+    CustomPartitionerWrapper,
+    ForwardPartitioner,
+    GlobalPartitioner,
+    KeyGroupStreamPartitioner,
+    RebalancePartitioner,
+    RescalePartitioner,
+    ShufflePartitioner,
+    StreamPartitioner,
+)
+from flink_tpu.streaming.sources import (
+    CollectSink,
+    FileTextSource,
+    FromCollectionSource,
+    PrintSink,
+    SocketTextStreamSource,
+    SourceFunction,
+    StreamSource,
+    TimestampsAndWatermarksOperator,
+    WriteAsTextSink,
+)
+from flink_tpu.streaming.window_operator import (
+    EvictingWindowOperator,
+    WindowOperator,
+)
+from flink_tpu.streaming.windowing import (
+    GlobalWindows,
+    SlidingEventTimeWindows,
+    SlidingProcessingTimeWindows,
+    Time,
+    TumblingEventTimeWindows,
+    TumblingProcessingTimeWindows,
+    CountTrigger,
+    PurgingTrigger,
+    WindowAssigner,
+)
+
+
+class StreamExecutionEnvironment:
+    """(ref: StreamExecutionEnvironment.java)"""
+
+    def __init__(self, configuration: Optional[Configuration] = None):
+        self.config = configuration or Configuration()
+        self.graph = StreamGraph()
+        self.parallelism = 1
+        self.max_parallelism = 128
+        self.time_characteristic = "event"  # event | processing | ingestion
+        self.checkpoint_interval: Optional[int] = None
+        self.checkpoint_mode = "exactly_once"
+        self.state_backend: str = self.config.get_string("state.backend", "heap")
+        self.restart_strategy: Optional[dict] = {"strategy": "none"}
+        self._executed = False
+
+    # ---- factory ----------------------------------------------------
+    @staticmethod
+    def get_execution_environment(configuration=None) -> "StreamExecutionEnvironment":
+        return StreamExecutionEnvironment(configuration)
+
+    # ---- configuration ----------------------------------------------
+    def set_parallelism(self, parallelism: int) -> "StreamExecutionEnvironment":
+        self.parallelism = parallelism
+        return self
+
+    def set_max_parallelism(self, max_parallelism: int) -> "StreamExecutionEnvironment":
+        self.max_parallelism = max_parallelism
+        return self
+
+    def set_stream_time_characteristic(self, tc: str) -> "StreamExecutionEnvironment":
+        assert tc in ("event", "processing", "ingestion")
+        self.time_characteristic = tc
+        return self
+
+    def set_state_backend(self, backend: str) -> "StreamExecutionEnvironment":
+        self.state_backend = backend
+        return self
+
+    def enable_checkpointing(self, interval_ms: int,
+                             mode: str = "exactly_once") -> "StreamExecutionEnvironment":
+        self.checkpoint_interval = interval_ms
+        self.checkpoint_mode = mode
+        return self
+
+    def set_restart_strategy(self, strategy: str, **kw) -> "StreamExecutionEnvironment":
+        """fixed_delay(restart_attempts, delay_ms) | failure_rate | none
+        (ref: RestartStrategies)"""
+        self.restart_strategy = {"strategy": strategy, **kw}
+        return self
+
+    # ---- sources ----------------------------------------------------
+    def add_source(self, source_function: SourceFunction,
+                   name: str = "source", parallelism: int = 1) -> "DataStream":
+        node = self.graph.add_node(StreamNode(
+            self.graph.new_node_id(), name,
+            _source_factory(source_function, self.time_characteristic),
+            parallelism=parallelism,
+            max_parallelism=self.max_parallelism,
+            is_source=True,
+            time_characteristic=self.time_characteristic,
+        ))
+        return DataStream(self, node)
+
+    def from_collection(self, items: Iterable[Any], timestamped: bool = False) -> "DataStream":
+        return self.add_source(
+            FromCollectionSource(list(items), timestamped=timestamped),
+            name="from_collection")
+
+    def from_elements(self, *items) -> "DataStream":
+        return self.from_collection(list(items))
+
+    def socket_text_stream(self, hostname: str, port: int,
+                           delimiter: str = "\n", max_retries: int = 0) -> "DataStream":
+        return self.add_source(
+            SocketTextStreamSource(hostname, port, delimiter, max_retries),
+            name="socket_source")
+
+    def read_text_file(self, path: str) -> "DataStream":
+        return self.add_source(FileTextSource(path), name="file_source")
+
+    # ---- execution --------------------------------------------------
+    def get_stream_graph(self) -> StreamGraph:
+        return self.graph
+
+    def get_job_graph(self):
+        jg = create_job_graph(self.graph)
+        if self.checkpoint_interval is not None:
+            jg.checkpoint_config = {
+                "interval": self.checkpoint_interval,
+                "mode": self.checkpoint_mode,
+            }
+        return jg
+
+    def execute(self, job_name: str = "job"):
+        """(ref: execute :1508) — runs on the local executor."""
+        from flink_tpu.runtime.local import LocalExecutor
+        self.graph.job_name = job_name
+        executor = LocalExecutor(
+            state_backend=self.state_backend,
+            max_parallelism=self.max_parallelism,
+            restart_strategy=self.restart_strategy,
+        )
+        return executor.execute(self.get_job_graph())
+
+
+def _source_factory(source_function: SourceFunction, time_characteristic: str):
+    import copy
+
+    def factory():
+        return StreamSource(copy.deepcopy(source_function), time_characteristic)
+    return factory
+
+
+def _op_factory(cls, fn_factory):
+    def factory():
+        return cls(fn_factory())
+    return factory
+
+
+class DataStream:
+    """(ref: DataStream.java)"""
+
+    def __init__(self, env: StreamExecutionEnvironment, node: StreamNode,
+                 partitioner: Optional[StreamPartitioner] = None,
+                 side_tag=None):
+        self.env = env
+        self.node = node
+        #: pending partitioner for the NEXT edge out of this stream
+        self._partitioner = partitioner
+        #: set → edges out of this stream carry this side-output tag
+        self._side_tag = side_tag
+
+    # ---- plumbing ---------------------------------------------------
+    def _edge_partitioner(self, target_parallelism: int) -> StreamPartitioner:
+        if self._partitioner is not None:
+            return self._partitioner
+        if self.node.parallelism == target_parallelism:
+            return ForwardPartitioner()
+        return RebalancePartitioner()
+
+    def _add_op(self, name: str, operator_factory, parallelism=None,
+                key_selector=None, type_number: int = 0,
+                extra_inputs: Optional[List["DataStream"]] = None,
+                chaining: str = "always") -> "DataStream":
+        p = parallelism if parallelism is not None else self.node.parallelism
+        node = self.env.graph.add_node(StreamNode(
+            self.env.graph.new_node_id(), name, operator_factory,
+            parallelism=p,
+            max_parallelism=self.env.max_parallelism,
+            key_selector=key_selector,
+            chaining_strategy=chaining,
+            time_characteristic=self.env.time_characteristic,
+        ))
+        self.env.graph.add_edge(StreamEdge(
+            self.node.id, node.id, self._edge_partitioner(p), type_number,
+            side_output_tag=self._side_tag))
+        for i, s in enumerate(extra_inputs or [], start=1):
+            self.env.graph.add_edge(StreamEdge(
+                s.node.id, node.id, s._edge_partitioner(p), i,
+                side_output_tag=s._side_tag))
+        return DataStream(self.env, node)
+
+    # ---- basic transforms -------------------------------------------
+    def map(self, fn, name: str = "map") -> "DataStream":
+        f = as_map_function(fn)
+        return self._add_op(name, _op_factory(StreamMap, lambda: f))
+
+    def flat_map(self, fn, name: str = "flat_map") -> "DataStream":
+        f = as_flat_map_function(fn)
+        return self._add_op(name, _op_factory(StreamFlatMap, lambda: f))
+
+    def filter(self, fn, name: str = "filter") -> "DataStream":
+        f = as_filter_function(fn)
+        return self._add_op(name, _op_factory(StreamFilter, lambda: f))
+
+    def process(self, process_function, name: str = "process") -> "DataStream":
+        return self._add_op(name, _op_factory(ProcessOperator, lambda: process_function))
+
+    def set_parallelism(self, parallelism: int) -> "DataStream":
+        self.node.parallelism = parallelism
+        return self
+
+    def name(self, name: str) -> "DataStream":
+        self.node.name = name
+        return self
+
+    def uid(self, uid: str) -> "DataStream":
+        self.node.uid = uid
+        return self
+
+    def disable_chaining(self) -> "DataStream":
+        self.node.chaining_strategy = "never"
+        return self
+
+    def start_new_chain(self) -> "DataStream":
+        self.node.chaining_strategy = "head"
+        return self
+
+    # ---- partitioning (ref: DataStream.java :395-410 etc.) ----------
+    def key_by(self, key_selector) -> "KeyedStream":
+        ks = as_key_selector(key_selector)
+        return KeyedStream(self.env, self.node, ks)
+
+    def rebalance(self) -> "DataStream":
+        return DataStream(self.env, self.node, RebalancePartitioner())
+
+    def rescale(self) -> "DataStream":
+        return DataStream(self.env, self.node, RescalePartitioner())
+
+    def shuffle(self) -> "DataStream":
+        return DataStream(self.env, self.node, ShufflePartitioner())
+
+    def broadcast(self) -> "DataStream":
+        return DataStream(self.env, self.node, BroadcastPartitioner())
+
+    def global_(self) -> "DataStream":
+        return DataStream(self.env, self.node, GlobalPartitioner())
+
+    def forward(self) -> "DataStream":
+        return DataStream(self.env, self.node, ForwardPartitioner())
+
+    def get_side_output(self, tag) -> "DataStream":
+        """Consume a side output of this operator
+        (ref: SingleOutputStreamOperator#getSideOutput)."""
+        return DataStream(self.env, self.node, side_tag=tag)
+
+    def partition_custom(self, partitioner, key_selector=None) -> "DataStream":
+        ks = as_key_selector(key_selector) if key_selector is not None else None
+        return DataStream(self.env, self.node,
+                          CustomPartitionerWrapper(partitioner, ks))
+
+    # ---- union / connect (ref: union :212, connect :252) ------------
+    def union(self, *streams: "DataStream") -> "DataStream":
+        """Merge same-type streams: a pass-through node with N inputs."""
+        f = as_map_function(lambda x: x)
+        node = self.env.graph.add_node(StreamNode(
+            self.env.graph.new_node_id(), "union",
+            _op_factory(StreamMap, lambda: f),
+            parallelism=self.node.parallelism,
+            max_parallelism=self.env.max_parallelism,
+            chaining_strategy="never",
+        ))
+        for s in (self,) + streams:
+            self.env.graph.add_edge(StreamEdge(
+                s.node.id, node.id, s._edge_partitioner(node.parallelism), 0))
+        return DataStream(self.env, node)
+
+    def connect(self, other: "DataStream") -> "ConnectedStreams":
+        return ConnectedStreams(self.env, self, other)
+
+    # ---- windows over non-keyed streams -----------------------------
+    def window_all(self, assigner: WindowAssigner) -> "AllWindowedStream":
+        return AllWindowedStream(self.key_by(lambda x: 0), assigner)
+
+    def count_window_all(self, size: int) -> "AllWindowedStream":
+        ws = AllWindowedStream(self.key_by(lambda x: 0), GlobalWindows.create())
+        ws._trigger = PurgingTrigger.of(CountTrigger(size))
+        return ws
+
+    # ---- timestamps -------------------------------------------------
+    def assign_timestamps_and_watermarks(self, assigner,
+                                         watermark_interval: int = 1) -> "DataStream":
+        return self._add_op(
+            "timestamps",
+            lambda: TimestampsAndWatermarksOperator(assigner, watermark_interval))
+
+    # ---- sinks ------------------------------------------------------
+    def add_sink(self, sink_function, name: str = "sink") -> "DataStreamSink":
+        node = self._add_op(name, _op_factory(StreamSink, lambda: sink_function))
+        return DataStreamSink(node)
+
+    def print_(self, prefix: str = "") -> "DataStreamSink":
+        return self.add_sink(PrintSink(prefix), name="print")
+
+    def write_as_text(self, path: str) -> "DataStreamSink":
+        return self.add_sink(WriteAsTextSink(path), name="write_text")
+
+    def collect_into(self, target: list) -> "DataStreamSink":
+        """Convenience: sink into a Python list (test/driver use)."""
+        return self.add_sink(CollectSink(target), name="collect")
+
+
+class DataStreamSink:
+    def __init__(self, stream: DataStream):
+        self._stream = stream
+        self.node = stream.node
+
+    def set_parallelism(self, parallelism: int) -> "DataStreamSink":
+        self.node.parallelism = parallelism
+        return self
+
+    def name(self, name: str) -> "DataStreamSink":
+        self.node.name = name
+        return self
+
+
+class KeyedStream(DataStream):
+    """(ref: KeyedStream.java)"""
+
+    def __init__(self, env, node, key_selector):
+        super().__init__(env, node,
+                         KeyGroupStreamPartitioner(key_selector, env.max_parallelism))
+        self.key_selector = key_selector
+
+    def _add_keyed_op(self, name: str, operator_factory, chaining="always") -> DataStream:
+        ks = self.key_selector
+        return self._add_op(name, operator_factory, key_selector=ks,
+                            chaining=chaining)
+
+    # ---- keyed transforms -------------------------------------------
+    def process(self, process_function, name: str = "keyed_process") -> DataStream:
+        return self._add_keyed_op(
+            name, _op_factory(KeyedProcessOperator, lambda: process_function))
+
+    def reduce(self, fn, name: str = "reduce") -> DataStream:
+        f = as_reduce_function(fn)
+        return self._add_keyed_op(name, _op_factory(StreamGroupedReduce, lambda: f))
+
+    def sum(self, field=None) -> DataStream:
+        return self.reduce(_field_reduce(field, lambda a, b: a + b), name="sum")
+
+    def min(self, field=None) -> DataStream:
+        return self.reduce(_field_reduce(field, min), name="min")
+
+    def max(self, field=None) -> DataStream:
+        return self.reduce(_field_reduce(field, max), name="max")
+
+    def min_by(self, field) -> DataStream:
+        getter = _field_getter(field)
+        return self.reduce(lambda a, b: a if getter(a) <= getter(b) else b, name="min_by")
+
+    def max_by(self, field) -> DataStream:
+        getter = _field_getter(field)
+        return self.reduce(lambda a, b: a if getter(a) >= getter(b) else b, name="max_by")
+
+    # ---- windows (ref: KeyedStream.timeWindow :352-370) -------------
+    def window(self, assigner: WindowAssigner) -> "WindowedStream":
+        return WindowedStream(self, assigner)
+
+    def time_window(self, size: Time, slide: Optional[Time] = None) -> "WindowedStream":
+        if self.env.time_characteristic == "processing":
+            assigner = (TumblingProcessingTimeWindows.of(size) if slide is None
+                        else SlidingProcessingTimeWindows.of(size, slide))
+        else:
+            assigner = (TumblingEventTimeWindows.of(size) if slide is None
+                        else SlidingEventTimeWindows.of(size, slide))
+        return WindowedStream(self, assigner)
+
+    def count_window(self, size: int, slide: Optional[int] = None) -> "WindowedStream":
+        ws = WindowedStream(self, GlobalWindows.create())
+        if slide is None:
+            ws._trigger = PurgingTrigger.of(CountTrigger(size))
+        else:
+            from flink_tpu.streaming.windowing import CountEvictor
+            ws._trigger = CountTrigger(slide)
+            ws._evictor = CountEvictor.of(size)
+        return ws
+
+    def connect(self, other: DataStream) -> "ConnectedStreams":
+        return ConnectedStreams(self.env, self, other)
+
+    def as_queryable_state(self, name: str, descriptor=None):
+        """(ref: KeyedStream.asQueryableState :745-788) — registers the
+        rolling reduce state as externally queryable."""
+        from flink_tpu.core.state import ValueStateDescriptor
+
+        class _QueryableSink:
+            def __init__(self, state_name):
+                self.state_name = state_name
+
+        desc = descriptor or ValueStateDescriptor(name)
+        desc.set_queryable(name)
+
+        class _QueryableOp(KeyedProcessOperator):
+            def open(self):
+                super().open()
+                self._qstate = self.keyed_backend.get_or_create_keyed_state(desc)
+
+            def process_element(self, record):
+                from flink_tpu.state.backend import VOID_NAMESPACE
+                self._qstate.set_current_namespace(VOID_NAMESPACE)
+                self._qstate.update(record.value)
+
+        class _Noop:
+            def process_element(self, value, ctx, out):
+                pass
+
+        return self._add_keyed_op(f"queryable-{name}",
+                                  lambda: _QueryableOp(_Noop()))
+
+
+def _field_getter(field):
+    if field is None:
+        return lambda x: x
+    if callable(field):
+        return field
+    return lambda x: x[field] if isinstance(x, (tuple, list)) else getattr(x, field)
+
+
+def _field_reduce(field, combine):
+    if field is None:
+        return lambda a, b: combine(a, b)
+
+    def reducer(a, b):
+        if isinstance(a, tuple):
+            lst = list(a)
+            lst[field] = combine(a[field], b[field])
+            return tuple(lst)
+        if isinstance(a, list):
+            lst = list(a)
+            lst[field] = combine(a[field], b[field])
+            return lst
+        setattr(a, field, combine(getattr(a, field), getattr(b, field)))
+        return a
+
+    return reducer
+
+
+class WindowedStream:
+    """(ref: WindowedStream.java :305-850)"""
+
+    def __init__(self, keyed: KeyedStream, assigner: WindowAssigner):
+        self._keyed = keyed
+        self._assigner = assigner
+        self._trigger = None
+        self._evictor = None
+        self._allowed_lateness = 0
+        self._late_tag = None
+
+    def trigger(self, trigger) -> "WindowedStream":
+        self._trigger = trigger
+        return self
+
+    def evictor(self, evictor) -> "WindowedStream":
+        self._evictor = evictor
+        return self
+
+    def allowed_lateness(self, lateness: Union[Time, int]) -> "WindowedStream":
+        self._allowed_lateness = (lateness.milliseconds
+                                  if isinstance(lateness, Time) else int(lateness))
+        return self
+
+    def side_output_late_data(self, tag) -> "WindowedStream":
+        self._late_tag = tag
+        return self
+
+    def _build(self, name, state_descriptor, window_function,
+               single_value=None) -> DataStream:
+        assigner = self._assigner
+        trigger = self._trigger
+        evictor = self._evictor
+        lateness = self._allowed_lateness
+        late_tag = self._late_tag
+
+        if evictor is not None:
+            pre = _pre_aggregator_for(state_descriptor) if single_value else None
+
+            def factory():
+                return EvictingWindowOperator(
+                    assigner, window_function, trigger, evictor,
+                    lateness, late_tag, pre_aggregator=pre)
+        else:
+            def factory():
+                return WindowOperator(
+                    assigner, state_descriptor, window_function, trigger,
+                    lateness, late_tag, single_value_contents=single_value)
+        return self._keyed._add_keyed_op(name, factory, chaining="head")
+
+    # ---- terminal ops -----------------------------------------------
+    def aggregate(self, aggregate_function: AggregateFunction,
+                  window_function=None, name: str = "window_aggregate") -> DataStream:
+        """(ref: WindowedStream.aggregate :687-716)"""
+        return self._build(
+            name,
+            AggregatingStateDescriptor("window-contents", aggregate_function),
+            window_function,
+            single_value=True)
+
+    def reduce(self, fn, window_function=None, name: str = "window_reduce") -> DataStream:
+        f = as_reduce_function(fn)
+        return self._build(
+            name,
+            ReducingStateDescriptor("window-contents", f),
+            window_function,
+            single_value=True)
+
+    def fold(self, initial_value, fold_function, window_function=None) -> DataStream:
+        return self._build(
+            "window_fold",
+            FoldingStateDescriptor("window-contents", initial_value, fold_function),
+            window_function,
+            single_value=True)
+
+    def apply(self, window_function, name: str = "window_apply") -> DataStream:
+        return self._build(
+            name, ListStateDescriptor("window-contents"), window_function,
+            single_value=False)
+
+    def process(self, process_window_function, name: str = "window_process") -> DataStream:
+        return self._build(
+            name, ListStateDescriptor("window-contents"),
+            process_window_function, single_value=False)
+
+    def sum(self, field=None) -> DataStream:
+        return self.reduce(_field_reduce(field, lambda a, b: a + b), name="window_sum")
+
+    def min(self, field=None) -> DataStream:
+        return self.reduce(_field_reduce(field, min), name="window_min")
+
+    def max(self, field=None) -> DataStream:
+        return self.reduce(_field_reduce(field, max), name="window_max")
+
+
+def _pre_aggregator_for(state_descriptor):
+    """Fire-time aggregation over raw elements for the evictor path
+    (ref: the Reduce/Aggregate/FoldApplyWindowFunction wrappers the
+    reference's WindowedStream builds when an evictor is set)."""
+    if isinstance(state_descriptor, ReducingStateDescriptor):
+        reduce = state_descriptor.reduce_function.reduce
+
+        def pre(values):
+            it = iter(values)
+            acc = next(it)
+            for v in it:
+                acc = reduce(acc, v)
+            return acc
+        return pre
+    if isinstance(state_descriptor, AggregatingStateDescriptor):
+        agg = state_descriptor.aggregate_function
+
+        def pre(values):
+            acc = agg.create_accumulator()
+            for v in values:
+                acc = agg.add(v, acc)
+            return agg.get_result(acc)
+        return pre
+    if isinstance(state_descriptor, FoldingStateDescriptor):
+        fold = state_descriptor.fold_function
+
+        def pre(values):
+            acc = state_descriptor.get_default_value()
+            for v in values:
+                acc = fold(acc, v)
+            return acc
+        return pre
+    return None
+
+
+class AllWindowedStream(WindowedStream):
+    """Non-keyed windows — parallelism forced to 1
+    (ref: AllWindowedStream.java)."""
+
+    def _build(self, name, state_descriptor, window_function, single_value=None):
+        stream = super()._build(name, state_descriptor, window_function, single_value)
+        stream.node.parallelism = 1
+        return stream
+
+
+class ConnectedStreams:
+    """(ref: ConnectedStreams.java)"""
+
+    def __init__(self, env, first: DataStream, second: DataStream):
+        self.env = env
+        self.first = first
+        self.second = second
+
+    def _add_two_input(self, name, factory) -> DataStream:
+        ks1 = getattr(self.first, "key_selector", None)
+
+        def wrapped_factory():
+            op = factory()
+            if hasattr(op, "key_selector2"):
+                op.key_selector2 = getattr(self.second, "key_selector", None)
+            return op
+
+        return self.first._add_op(
+            name, wrapped_factory,
+            key_selector=ks1,
+            extra_inputs=[self.second],
+            chaining="never")
+
+    def map(self, co_map_function) -> DataStream:
+        return self._add_two_input("co_map", lambda: CoStreamMap(co_map_function))
+
+    def flat_map(self, co_flat_map_function) -> DataStream:
+        return self._add_two_input("co_flat_map",
+                                   lambda: CoStreamFlatMap(co_flat_map_function))
+
+    def process(self, co_process_function) -> DataStream:
+        return self._add_two_input("co_process",
+                                   lambda: CoProcessOperator(co_process_function))
+
+    def key_by(self, key_selector1, key_selector2) -> "ConnectedStreams":
+        return ConnectedStreams(
+            self.env,
+            self.first.key_by(key_selector1),
+            self.second.key_by(key_selector2))

@@ -1,0 +1,166 @@
+"""StreamElement model.
+
+Re-designs flink-streaming-java/.../runtime/streamrecord/: the four
+element kinds flowing through operator pipelines — records, watermarks,
+stream status, latency markers — plus checkpoint barriers, which in the
+reference travel the network data plane (io/network/api/
+CheckpointBarrier.java) and here flow in-band through the same channel
+abstraction.
+
+Timestamps are int milliseconds (event time), matching the reference's
+long-millis convention; MAX_WATERMARK flushes all event-time state at
+end of input (ref: Watermark.MAX_WATERMARK).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+MAX_TIMESTAMP = 2**63 - 1
+MIN_TIMESTAMP = -(2**63)
+
+
+class StreamElement:
+    __slots__ = ()
+
+    is_record = False
+    is_watermark = False
+    is_stream_status = False
+    is_latency_marker = False
+    is_barrier = False
+
+
+class StreamRecord(StreamElement):
+    """(ref: StreamRecord.java — value + optional timestamp)"""
+
+    __slots__ = ("value", "timestamp")
+
+    is_record = True
+
+    def __init__(self, value: Any, timestamp: Optional[int] = None):
+        self.value = value
+        self.timestamp = timestamp
+
+    @property
+    def has_timestamp(self) -> bool:
+        return self.timestamp is not None
+
+    def replace(self, value, timestamp=None) -> "StreamRecord":
+        return StreamRecord(value, timestamp if timestamp is not None else self.timestamp)
+
+    def __repr__(self):
+        return f"Record({self.value!r} @ {self.timestamp})"
+
+    def __eq__(self, other):
+        return (isinstance(other, StreamRecord) and self.value == other.value
+                and self.timestamp == other.timestamp)
+
+    def __hash__(self):
+        return hash((self.value if not isinstance(self.value, (list, dict)) else id(self.value),
+                     self.timestamp))
+
+
+class Watermark(StreamElement):
+    """Event-time progress marker (ref: Watermark.java): asserts no
+    records with timestamp <= this will follow."""
+
+    __slots__ = ("timestamp",)
+
+    is_watermark = True
+
+    def __init__(self, timestamp: int):
+        self.timestamp = timestamp
+
+    def __repr__(self):
+        return f"Watermark({self.timestamp})"
+
+    def __eq__(self, other):
+        return isinstance(other, Watermark) and self.timestamp == other.timestamp
+
+    def __hash__(self):
+        return hash(("wm", self.timestamp))
+
+
+MAX_WATERMARK = Watermark(MAX_TIMESTAMP)
+
+
+class StreamStatus(StreamElement):
+    """ACTIVE/IDLE channel status so idle inputs don't hold back the
+    watermark (ref: StreamStatus.java)."""
+
+    __slots__ = ("status",)
+
+    is_stream_status = True
+
+    ACTIVE = 0
+    IDLE = 1
+
+    def __init__(self, status: int):
+        self.status = status
+
+    @property
+    def is_active(self) -> bool:
+        return self.status == StreamStatus.ACTIVE
+
+    def __repr__(self):
+        return "StreamStatus(ACTIVE)" if self.is_active else "StreamStatus(IDLE)"
+
+    def __eq__(self, other):
+        return isinstance(other, StreamStatus) and self.status == other.status
+
+
+ACTIVE_STATUS = StreamStatus(StreamStatus.ACTIVE)
+IDLE_STATUS = StreamStatus(StreamStatus.IDLE)
+
+
+class LatencyMarker(StreamElement):
+    """Periodic source-emitted marker for latency histograms
+    (ref: LatencyMarker.java:32)."""
+
+    __slots__ = ("marked_time", "operator_id", "subtask_index")
+
+    is_latency_marker = True
+
+    def __init__(self, marked_time: int, operator_id: str, subtask_index: int):
+        self.marked_time = marked_time
+        self.operator_id = operator_id
+        self.subtask_index = subtask_index
+
+    def __repr__(self):
+        return f"LatencyMarker({self.marked_time} from {self.operator_id}/{self.subtask_index})"
+
+
+class CheckpointBarrier(StreamElement):
+    """In-band barrier (ref: io/network/api/CheckpointBarrier.java).
+    options: 'exactly_once' aligns channels; 'at_least_once' does not;
+    savepoints carry a savepoint path."""
+
+    __slots__ = ("checkpoint_id", "timestamp", "options")
+
+    is_barrier = True
+
+    def __init__(self, checkpoint_id: int, timestamp: int, options: Optional[dict] = None):
+        self.checkpoint_id = checkpoint_id
+        self.timestamp = timestamp
+        self.options = options or {}
+
+    def __repr__(self):
+        return f"Barrier(#{self.checkpoint_id})"
+
+    def __eq__(self, other):
+        return (isinstance(other, CheckpointBarrier)
+                and self.checkpoint_id == other.checkpoint_id)
+
+
+class EndOfStream(StreamElement):
+    """End-of-input sentinel propagated through operator chains (the
+    reference signals this via channel close; an explicit element keeps
+    the single-process runtime simple)."""
+
+    __slots__ = ()
+
+    def __repr__(self):
+        return "EndOfStream"
+
+
+END_OF_STREAM = EndOfStream()

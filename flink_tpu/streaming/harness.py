@@ -1,0 +1,138 @@
+"""Operator test harness.
+
+Re-designs the reference's workhorse test infrastructure
+(AbstractStreamOperatorTestHarness.java:90,
+KeyedOneInputStreamOperatorTestHarness.java, TestProcessingTimeService):
+host a single operator in a fake task environment, push records and
+watermarks, advance fake processing time, snapshot/restore, and
+inspect emitted elements — no cluster required (SURVEY.md §4.2).
+Shipped in the main package (not tests/) so downstream users test
+their own operators the same way.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional
+
+from flink_tpu.core.functions import KeySelector, as_key_selector
+from flink_tpu.core.keygroups import KeyGroupRange
+from flink_tpu.state.loader import load_state_backend
+from flink_tpu.state.operator_state import OperatorStateBackend
+from flink_tpu.streaming.elements import StreamRecord, Watermark
+from flink_tpu.streaming.operators import (
+    CollectorOutput,
+    StreamOperator,
+    TwoInputStreamOperator,
+)
+from flink_tpu.streaming.timers import TestProcessingTimeService
+
+
+class OneInputStreamOperatorTestHarness:
+    def __init__(
+        self,
+        operator: StreamOperator,
+        key_selector=None,
+        state_backend: str = "heap",
+        max_parallelism: int = 128,
+        key_group_range: Optional[KeyGroupRange] = None,
+    ):
+        self.operator = operator
+        self.output = CollectorOutput()
+        self.processing_time_service = TestProcessingTimeService()
+        self.max_parallelism = max_parallelism
+        if key_group_range is None:
+            key_group_range = KeyGroupRange(0, max_parallelism - 1)
+        if key_selector is not None:
+            key_selector = as_key_selector(key_selector)
+            self.keyed_backend = load_state_backend(
+                state_backend, key_group_range, max_parallelism)
+        else:
+            self.keyed_backend = None
+        operator.setup(
+            self.output,
+            keyed_backend=self.keyed_backend,
+            operator_state_backend=OperatorStateBackend(),
+            processing_time_service=self.processing_time_service,
+            key_selector=key_selector,
+        )
+        self._open = False
+
+    # ---- lifecycle --------------------------------------------------
+    def open(self) -> None:
+        self.operator.open()
+        self._open = True
+
+    def close(self) -> None:
+        if self._open:
+            self.operator.close()
+            self._open = False
+
+    # ---- drive ------------------------------------------------------
+    def process_element(self, value, timestamp: Optional[int] = None) -> None:
+        record = value if isinstance(value, StreamRecord) else StreamRecord(value, timestamp)
+        self.operator.set_key_context(record)
+        self.operator.process_element(record)
+
+    def process_watermark(self, timestamp) -> None:
+        wm = timestamp if isinstance(timestamp, Watermark) else Watermark(timestamp)
+        self.operator.process_watermark(wm)
+
+    def set_processing_time(self, now: int) -> None:
+        self.processing_time_service.set_current_time(now)
+
+    # ---- snapshot / restore -----------------------------------------
+    def snapshot(self) -> dict:
+        return self.operator.snapshot_state()
+
+    def initialize_state(self, snapshots) -> None:
+        if isinstance(snapshots, dict):
+            snapshots = [snapshots]
+        self.operator.restore_state(snapshots)
+
+    # ---- inspect ----------------------------------------------------
+    def get_output(self) -> List[StreamRecord]:
+        return self.output.records
+
+    def extract_output_values(self) -> List[Any]:
+        return [r.value for r in self.output.records]
+
+    def get_side_output(self, tag) -> List[StreamRecord]:
+        tag_id = tag.tag_id if hasattr(tag, "tag_id") else tag
+        return self.output.side.get(tag_id, [])
+
+    def get_watermarks(self) -> List[Watermark]:
+        return self.output.watermarks
+
+    def clear_output(self) -> None:
+        self.output.records.clear()
+        self.output.watermarks.clear()
+
+
+KeyedOneInputStreamOperatorTestHarness = OneInputStreamOperatorTestHarness
+
+
+class TwoInputStreamOperatorTestHarness(OneInputStreamOperatorTestHarness):
+    def __init__(self, operator: TwoInputStreamOperator, key_selector1=None,
+                 key_selector2=None, **kw):
+        super().__init__(operator, key_selector=key_selector1, **kw)
+        if key_selector2 is not None and hasattr(operator, "key_selector2"):
+            operator.key_selector2 = as_key_selector(key_selector2)
+
+    def process_element1(self, value, timestamp=None) -> None:
+        record = value if isinstance(value, StreamRecord) else StreamRecord(value, timestamp)
+        self.operator.set_key_context(record)
+        self.operator.process_element1(record)
+
+    def process_element2(self, value, timestamp=None) -> None:
+        record = value if isinstance(value, StreamRecord) else StreamRecord(value, timestamp)
+        if hasattr(self.operator, "set_key_context2"):
+            self.operator.set_key_context2(record)
+        self.operator.process_element2(record)
+
+    def process_watermark1(self, timestamp) -> None:
+        wm = timestamp if isinstance(timestamp, Watermark) else Watermark(timestamp)
+        self.operator.process_watermark1(wm)
+
+    def process_watermark2(self, timestamp) -> None:
+        wm = timestamp if isinstance(timestamp, Watermark) else Watermark(timestamp)
+        self.operator.process_watermark2(wm)

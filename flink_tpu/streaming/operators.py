@@ -1,0 +1,554 @@
+"""Stream operators: lifecycle + the stateless/keyed operator family.
+
+Re-designs flink-streaming-java/.../api/operators/:
+AbstractStreamOperator (state/timer plumbing), AbstractUdfStreamOperator,
+StreamMap/StreamFlatMap/StreamFilter, ProcessOperator,
+KeyedProcessOperator, StreamGroupedReduce, StreamSink, and the co-
+(two-input) operators.  An operator receives StreamElements from its
+input(s) and emits to an `Output`; chains of operators are built by the
+task layer (ref: OperatorChain.java).
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import Any, Callable, Generic, Iterable, List, Optional, TypeVar
+
+from flink_tpu.core.functions import (
+    FilterFunction,
+    FlatMapFunction,
+    KeySelector,
+    MapFunction,
+    ReduceFunction,
+    RichFunction,
+)
+from flink_tpu.core.state import ReducingStateDescriptor, StateDescriptor
+from flink_tpu.state.backend import VOID_NAMESPACE, KeyedStateBackend
+from flink_tpu.state.operator_state import OperatorStateBackend
+from flink_tpu.streaming.elements import (
+    MIN_TIMESTAMP,
+    LatencyMarker,
+    StreamRecord,
+    Watermark,
+)
+from flink_tpu.streaming.timers import (
+    InternalTimerService,
+    ProcessingTimeService,
+)
+
+IN = TypeVar("IN")
+OUT = TypeVar("OUT")
+
+
+class OutputTag:
+    """Side-output tag (ref: org.apache.flink.util.OutputTag)."""
+
+    __slots__ = ("tag_id",)
+
+    def __init__(self, tag_id: str):
+        self.tag_id = tag_id
+
+    def __eq__(self, other):
+        return isinstance(other, OutputTag) and self.tag_id == other.tag_id
+
+    def __hash__(self):
+        return hash(self.tag_id)
+
+    def __repr__(self):
+        return f"OutputTag({self.tag_id!r})"
+
+
+class Output(abc.ABC):
+    """Where an operator emits (ref: Output.java extends Collector)."""
+
+    @abc.abstractmethod
+    def collect(self, record: StreamRecord) -> None: ...
+
+    @abc.abstractmethod
+    def emit_watermark(self, watermark: Watermark) -> None: ...
+
+    def collect_side(self, tag: OutputTag, record: StreamRecord) -> None:
+        pass  # dropped unless a side output is wired
+
+    def emit_latency_marker(self, marker: LatencyMarker) -> None:  # noqa: B027
+        pass
+
+    def close(self) -> None:  # noqa: B027
+        pass
+
+
+class CollectorOutput(Output):
+    """Buffers emissions in lists — test harness + chain tails."""
+
+    def __init__(self):
+        self.records: List[StreamRecord] = []
+        self.watermarks: List[Watermark] = []
+        self.side: dict = {}
+        self.latency_markers: List[LatencyMarker] = []
+
+    def collect(self, record):
+        self.records.append(record)
+
+    def emit_watermark(self, watermark):
+        self.watermarks.append(watermark)
+
+    def collect_side(self, tag, record):
+        self.side.setdefault(tag.tag_id, []).append(record)
+
+    def emit_latency_marker(self, marker):
+        self.latency_markers.append(marker)
+
+    def extract_values(self):
+        return [r.value for r in self.records]
+
+
+class TimestampedCollector:
+    """Collector bound to one timestamp (ref:
+    api/operators/TimestampedCollector.java)."""
+
+    __slots__ = ("_output", "timestamp")
+
+    def __init__(self, output: Output, timestamp: Optional[int] = None):
+        self._output = output
+        self.timestamp = timestamp
+
+    def collect(self, value) -> None:
+        self._output.collect(StreamRecord(value, self.timestamp))
+
+    def set_absolute_timestamp(self, ts: Optional[int]) -> None:
+        self.timestamp = ts
+
+
+class StreamOperator(abc.ABC):
+    """Operator lifecycle (ref: StreamOperator.java + lifecycle docs
+    docs/internals/task_lifecycle.md): setup → open → process* →
+    snapshot* → close → dispose."""
+
+    def __init__(self):
+        self.output: Optional[Output] = None
+        self.keyed_backend: Optional[KeyedStateBackend] = None
+        self.operator_state_backend: Optional[OperatorStateBackend] = None
+        self.processing_time_service: Optional[ProcessingTimeService] = None
+        self.timer_service: Optional[InternalTimerService] = None
+        self.current_watermark: int = MIN_TIMESTAMP
+        self.key_selector: Optional[KeySelector] = None
+        self.operator_id: str = ""
+        self.metrics = None  # OperatorMetricGroup, set by task layer
+
+    # ---- wiring -----------------------------------------------------
+    def setup(self, output: Output,
+              keyed_backend: Optional[KeyedStateBackend] = None,
+              operator_state_backend: Optional[OperatorStateBackend] = None,
+              processing_time_service: Optional[ProcessingTimeService] = None,
+              key_selector: Optional[KeySelector] = None,
+              operator_id: str = "") -> None:
+        self.output = output
+        self.keyed_backend = keyed_backend
+        self.operator_state_backend = operator_state_backend or OperatorStateBackend()
+        self.processing_time_service = processing_time_service
+        self.key_selector = key_selector
+        self.operator_id = operator_id or type(self).__name__
+        if keyed_backend is not None and processing_time_service is not None:
+            self.timer_service = InternalTimerService(
+                f"{self.operator_id}-timers", keyed_backend,
+                processing_time_service, self)
+
+    def open(self) -> None:  # noqa: B027
+        pass
+
+    def close(self) -> None:  # noqa: B027
+        pass
+
+    def dispose(self) -> None:  # noqa: B027
+        pass
+
+    # ---- elements ---------------------------------------------------
+    @abc.abstractmethod
+    def process_element(self, record: StreamRecord) -> None: ...
+
+    def process_watermark(self, watermark: Watermark) -> None:
+        """(ref: AbstractStreamOperator.processWatermark :737)"""
+        self.current_watermark = watermark.timestamp
+        if self.timer_service is not None:
+            self.timer_service.advance_watermark(watermark.timestamp)
+        self.output.emit_watermark(watermark)
+
+    def process_latency_marker(self, marker: LatencyMarker) -> None:
+        self.output.emit_latency_marker(marker)
+
+    # ---- keyed context ----------------------------------------------
+    def set_key_context(self, record: StreamRecord) -> None:
+        """(ref: setKeyContextElement1 — key extraction + backend key)"""
+        if self.key_selector is not None and self.keyed_backend is not None:
+            self.keyed_backend.set_current_key(
+                self.key_selector.get_key(record.value))
+
+    # ---- timers (Triggerable contract) ------------------------------
+    def on_event_time(self, timer) -> None:  # noqa: B027
+        pass
+
+    def on_processing_time(self, timer) -> None:  # noqa: B027
+        pass
+
+    # ---- snapshot ---------------------------------------------------
+    def snapshot_state(self) -> dict:
+        snap = {}
+        if self.keyed_backend is not None:
+            if hasattr(self.keyed_backend, "flush_all"):
+                self.keyed_backend.flush_all()
+            snap["keyed"] = self.keyed_backend.snapshot()
+        if self.operator_state_backend is not None:
+            snap["operator"] = self.operator_state_backend.snapshot()
+        if self.timer_service is not None:
+            snap["timers"] = self.timer_service.snapshot()
+        return snap
+
+    def restore_state(self, snapshots: List[dict]) -> None:
+        keyed = [s["keyed"] for s in snapshots if "keyed" in s]
+        if keyed and self.keyed_backend is not None:
+            self.keyed_backend.restore(keyed)
+        ops = [s["operator"] for s in snapshots if "operator" in s]
+        if ops and self.operator_state_backend is not None:
+            from flink_tpu.state.operator_state import OperatorStateSnapshot
+            if len(ops) == 1:
+                self.operator_state_backend.restore(ops[0])
+            else:
+                self.operator_state_backend.restore(
+                    OperatorStateSnapshot.redistribute(ops, 1)[0])
+        timers = [s["timers"] for s in snapshots if "timers" in s]
+        if timers and self.timer_service is not None:
+            self.timer_service.restore(timers)
+
+    def notify_checkpoint_complete(self, checkpoint_id: int) -> None:  # noqa: B027
+        pass
+
+
+class KeyedStateStore:
+    """Adapter giving user functions keyed-state access in the VOID
+    namespace (ref: DefaultKeyedStateStore.java)."""
+
+    def __init__(self, backend: KeyedStateBackend):
+        self._backend = backend
+
+    def _bind(self, descriptor):
+        return self._backend.get_partitioned_state(VOID_NAMESPACE, descriptor)
+
+    get_value_state = _bind
+    get_list_state = _bind
+    get_reducing_state = _bind
+    get_aggregating_state = _bind
+    get_map_state = _bind
+
+
+class AbstractUdfStreamOperator(StreamOperator):
+    """Hosts a user function, forwarding open/close
+    (ref: AbstractUdfStreamOperator.java)."""
+
+    def __init__(self, user_function):
+        super().__init__()
+        self.user_function = user_function
+
+    def open(self):
+        if isinstance(self.user_function, RichFunction):
+            from flink_tpu.core.functions import RuntimeContext
+            store = (KeyedStateStore(self.keyed_backend)
+                     if self.keyed_backend is not None else None)
+            ctx = RuntimeContext(
+                task_name=self.operator_id,
+                keyed_state_store=store,
+                operator_state_store=self.operator_state_backend,
+            )
+            self.user_function.set_runtime_context(ctx)
+            self.user_function.open(None)
+
+    def close(self):
+        if isinstance(self.user_function, RichFunction):
+            self.user_function.close()
+
+    def notify_checkpoint_complete(self, checkpoint_id: int) -> None:
+        fn = self.user_function
+        if hasattr(fn, "notify_checkpoint_complete"):
+            fn.notify_checkpoint_complete(checkpoint_id)
+
+
+class StreamMap(AbstractUdfStreamOperator):
+    """(ref: StreamMap.java)"""
+
+    def process_element(self, record):
+        self.output.collect(record.replace(self.user_function.map(record.value)))
+
+
+class StreamFlatMap(AbstractUdfStreamOperator):
+    """(ref: StreamFlatMap.java)"""
+
+    def process_element(self, record):
+        out = self.user_function.flat_map(record.value)
+        if out is not None:
+            for value in out:
+                self.output.collect(record.replace(value))
+
+
+class StreamFilter(AbstractUdfStreamOperator):
+    """(ref: StreamFilter.java)"""
+
+    def process_element(self, record):
+        if self.user_function.filter(record.value):
+            self.output.collect(record)
+
+
+class StreamSink(AbstractUdfStreamOperator):
+    """(ref: StreamSink.java) — user_function is a SinkFunction."""
+
+    def process_element(self, record):
+        self.user_function.invoke(record.value,
+                                  SinkContext(record.timestamp, self))
+
+
+class SinkContext:
+    """(ref: SinkFunction.Context)"""
+
+    __slots__ = ("timestamp", "_op")
+
+    def __init__(self, timestamp, op):
+        self.timestamp = timestamp
+        self._op = op
+
+    def current_processing_time(self):
+        pts = self._op.processing_time_service
+        return pts.get_current_processing_time() if pts else 0
+
+    def current_watermark(self):
+        return self._op.current_watermark
+
+
+class StreamGroupedReduce(AbstractUdfStreamOperator):
+    """Rolling keyed reduce: emits the running reduction per element
+    (ref: StreamGroupedReduce.java)."""
+
+    STATE_NAME = "_reduce_state"
+
+    def __init__(self, reduce_function: ReduceFunction):
+        super().__init__(reduce_function)
+
+    def open(self):
+        super().open()
+        self._state = self.keyed_backend.get_or_create_keyed_state(
+            ReducingStateDescriptor(self.STATE_NAME, self.user_function))
+
+    def process_element(self, record):
+        self._state.set_current_namespace(VOID_NAMESPACE)
+        self._state.add(record.value)
+        self.output.collect(record.replace(self._state.get()))
+
+
+class ProcessOperator(AbstractUdfStreamOperator):
+    """Non-keyed ProcessFunction host (ref: ProcessOperator.java)."""
+
+    def open(self):
+        super().open()
+        self._collector = TimestampedCollector(self.output)
+
+    def process_element(self, record):
+        self._collector.set_absolute_timestamp(record.timestamp)
+        ctx = ProcessFunctionContext(record, self)
+        self.user_function.process_element(record.value, ctx, self._collector)
+
+
+class KeyedProcessOperator(AbstractUdfStreamOperator):
+    """Keyed ProcessFunction with timer access
+    (ref: KeyedProcessOperator.java)."""
+
+    def open(self):
+        super().open()
+        self._collector = TimestampedCollector(self.output)
+
+    def process_element(self, record):
+        self._collector.set_absolute_timestamp(record.timestamp)
+        ctx = KeyedProcessFunctionContext(record, self)
+        self.user_function.process_element(record.value, ctx, self._collector)
+
+    def on_event_time(self, timer):
+        self._collector.set_absolute_timestamp(timer.timestamp)
+        ctx = OnTimerContext(timer, self, "event")
+        self.user_function.on_timer(timer.timestamp, ctx, self._collector)
+
+    def on_processing_time(self, timer):
+        self._collector.set_absolute_timestamp(None)
+        ctx = OnTimerContext(timer, self, "processing")
+        self.user_function.on_timer(timer.timestamp, ctx, self._collector)
+
+
+class ProcessFunctionContext:
+    """(ref: ProcessFunction.Context)"""
+
+    def __init__(self, record: StreamRecord, op: StreamOperator):
+        self._record = record
+        self._op = op
+
+    def timestamp(self) -> Optional[int]:
+        return self._record.timestamp
+
+    def current_processing_time(self) -> int:
+        pts = self._op.processing_time_service
+        return pts.get_current_processing_time() if pts else 0
+
+    def current_watermark(self) -> int:
+        return self._op.current_watermark
+
+    def output(self, tag: OutputTag, value) -> None:
+        self._op.output.collect_side(tag, StreamRecord(value, self._record.timestamp))
+
+
+class KeyedProcessFunctionContext(ProcessFunctionContext):
+    """Adds timers + current key (ref: KeyedProcessFunction.Context)."""
+
+    def get_current_key(self):
+        return self._op.keyed_backend.current_key
+
+    def register_event_time_timer(self, timestamp: int) -> None:
+        self._op.timer_service.register_event_time_timer(VOID_NAMESPACE, timestamp)
+
+    def register_processing_time_timer(self, timestamp: int) -> None:
+        self._op.timer_service.register_processing_time_timer(VOID_NAMESPACE, timestamp)
+
+    def delete_event_time_timer(self, timestamp: int) -> None:
+        self._op.timer_service.delete_event_time_timer(VOID_NAMESPACE, timestamp)
+
+    def delete_processing_time_timer(self, timestamp: int) -> None:
+        self._op.timer_service.delete_processing_time_timer(VOID_NAMESPACE, timestamp)
+
+    # state access for ProcessFunctions
+    def get_state(self, descriptor: StateDescriptor):
+        return self._op.keyed_backend.get_partitioned_state(VOID_NAMESPACE, descriptor)
+
+
+class OnTimerContext(KeyedProcessFunctionContext):
+    """(ref: ProcessFunction.OnTimerContext)"""
+
+    def __init__(self, timer, op, time_domain: str):
+        self._timer = timer
+        self._op = op
+        self._record = StreamRecord(None, timer.timestamp)
+        self.time_domain = time_domain
+
+    def timestamp(self):
+        return self._timer.timestamp
+
+    def get_current_key(self):
+        return self._timer.key
+
+
+class ProcessFunction(abc.ABC):
+    """(ref: api/functions/ProcessFunction.java)"""
+
+    @abc.abstractmethod
+    def process_element(self, value, ctx, out) -> None: ...
+
+    def on_timer(self, timestamp: int, ctx, out) -> None:  # noqa: B027
+        pass
+
+
+KeyedProcessFunction = ProcessFunction  # same shape; keyed ctx at runtime
+
+
+# ---------------------------------------------------------------------
+# Two-input (co-) operators (ref: api/operators/co/)
+# ---------------------------------------------------------------------
+
+class TwoInputStreamOperator(StreamOperator):
+    @abc.abstractmethod
+    def process_element1(self, record: StreamRecord) -> None: ...
+
+    @abc.abstractmethod
+    def process_element2(self, record: StreamRecord) -> None: ...
+
+    def process_element(self, record):
+        raise RuntimeError("two-input operator: use process_element1/2")
+
+    def process_watermark1(self, watermark: Watermark) -> None:
+        self._wm1 = watermark.timestamp
+        self._combine_watermarks()
+
+    def process_watermark2(self, watermark: Watermark) -> None:
+        self._wm2 = watermark.timestamp
+        self._combine_watermarks()
+
+    def _combine_watermarks(self):
+        """min-combine the two input watermarks
+        (ref: AbstractStreamOperator.processWatermark1/2)."""
+        wm1 = getattr(self, "_wm1", MIN_TIMESTAMP)
+        wm2 = getattr(self, "_wm2", MIN_TIMESTAMP)
+        combined = min(wm1, wm2)
+        if combined > self.current_watermark:
+            self.process_watermark(Watermark(combined))
+
+
+class CoStreamMap(TwoInputStreamOperator, AbstractUdfStreamOperator):
+    """(ref: CoStreamMap.java) — user_function is a CoMapFunction."""
+
+    def __init__(self, fn):
+        AbstractUdfStreamOperator.__init__(self, fn)
+
+    def process_element1(self, record):
+        self.output.collect(record.replace(self.user_function.map1(record.value)))
+
+    def process_element2(self, record):
+        self.output.collect(record.replace(self.user_function.map2(record.value)))
+
+
+class CoStreamFlatMap(TwoInputStreamOperator, AbstractUdfStreamOperator):
+    """(ref: CoStreamFlatMap.java)"""
+
+    def __init__(self, fn):
+        AbstractUdfStreamOperator.__init__(self, fn)
+
+    def process_element1(self, record):
+        out = self.user_function.flat_map1(record.value)
+        if out is not None:
+            for v in out:
+                self.output.collect(record.replace(v))
+
+    def process_element2(self, record):
+        out = self.user_function.flat_map2(record.value)
+        if out is not None:
+            for v in out:
+                self.output.collect(record.replace(v))
+
+
+class CoProcessOperator(TwoInputStreamOperator, AbstractUdfStreamOperator):
+    """(ref: CoProcessOperator.java / KeyedCoProcessOperator.java)"""
+
+    def __init__(self, fn):
+        AbstractUdfStreamOperator.__init__(self, fn)
+        self.key_selector2: Optional[KeySelector] = None
+
+    def open(self):
+        AbstractUdfStreamOperator.open(self)
+        self._collector = TimestampedCollector(self.output)
+
+    def set_key_context2(self, record: StreamRecord) -> None:
+        if self.key_selector2 is not None and self.keyed_backend is not None:
+            self.keyed_backend.set_current_key(
+                self.key_selector2.get_key(record.value))
+
+    def process_element1(self, record):
+        self._collector.set_absolute_timestamp(record.timestamp)
+        ctx = KeyedProcessFunctionContext(record, self)
+        self.user_function.process_element1(record.value, ctx, self._collector)
+
+    def process_element2(self, record):
+        self._collector.set_absolute_timestamp(record.timestamp)
+        ctx = KeyedProcessFunctionContext(record, self)
+        self.user_function.process_element2(record.value, ctx, self._collector)
+
+    def on_event_time(self, timer):
+        self._collector.set_absolute_timestamp(timer.timestamp)
+        ctx = OnTimerContext(timer, self, "event")
+        if hasattr(self.user_function, "on_timer"):
+            self.user_function.on_timer(timer.timestamp, ctx, self._collector)
+
+    def on_processing_time(self, timer):
+        self._collector.set_absolute_timestamp(None)
+        ctx = OnTimerContext(timer, self, "processing")
+        if hasattr(self.user_function, "on_timer"):
+            self.user_function.on_timer(timer.timestamp, ctx, self._collector)

@@ -1,0 +1,146 @@
+"""Stream partitioners: how records pick a downstream channel.
+
+Re-designs flink-streaming-java/.../runtime/partitioner/ (10 files:
+KeyGroupStreamPartitioner, ForwardPartitioner, RebalancePartitioner,
+RescalePartitioner, BroadcastPartitioner, ShufflePartitioner,
+GlobalPartitioner, CustomPartitionerWrapper).  select_channels returns
+the list of target channel indices for one record.
+"""
+
+from __future__ import annotations
+
+import abc
+import random
+from typing import Any, Callable, List, Optional
+
+from flink_tpu.core.functions import KeySelector
+from flink_tpu.core.keygroups import (
+    assign_to_key_group,
+    compute_operator_index_for_key_group,
+)
+
+
+class StreamPartitioner(abc.ABC):
+    is_broadcast = False
+    is_pointwise = False
+
+    @abc.abstractmethod
+    def select_channels(self, value: Any, num_channels: int) -> List[int]:
+        ...
+
+    def setup(self, num_channels: int) -> None:  # noqa: B027
+        pass
+
+
+class ForwardPartitioner(StreamPartitioner):
+    """Local forward, requires equal parallelism (ref: ForwardPartitioner)."""
+
+    is_pointwise = True
+
+    def select_channels(self, value, num_channels):
+        return [0]
+
+    def __repr__(self):
+        return "FORWARD"
+
+
+class RebalancePartitioner(StreamPartitioner):
+    """Round-robin (ref: RebalancePartitioner)."""
+
+    def __init__(self):
+        self._next = -1
+
+    def setup(self, num_channels):
+        self._next = random.randrange(num_channels) - 1 if num_channels else -1
+
+    def select_channels(self, value, num_channels):
+        self._next = (self._next + 1) % num_channels
+        return [self._next]
+
+    def __repr__(self):
+        return "REBALANCE"
+
+
+class RescalePartitioner(StreamPartitioner):
+    """Round-robin within local groups (ref: RescalePartitioner) —
+    pointwise wiring is decided by the scheduler; per-instance this is
+    round-robin over its subset."""
+
+    is_pointwise = True
+
+    def __init__(self):
+        self._next = -1
+
+    def select_channels(self, value, num_channels):
+        self._next = (self._next + 1) % num_channels
+        return [self._next]
+
+    def __repr__(self):
+        return "RESCALE"
+
+
+class ShufflePartitioner(StreamPartitioner):
+    """Uniform random (ref: ShufflePartitioner)."""
+
+    def select_channels(self, value, num_channels):
+        return [random.randrange(num_channels)]
+
+    def __repr__(self):
+        return "SHUFFLE"
+
+
+class BroadcastPartitioner(StreamPartitioner):
+    """All channels (ref: BroadcastPartitioner)."""
+
+    is_broadcast = True
+
+    def select_channels(self, value, num_channels):
+        return list(range(num_channels))
+
+    def __repr__(self):
+        return "BROADCAST"
+
+
+class GlobalPartitioner(StreamPartitioner):
+    """Everything to subtask 0 (ref: GlobalPartitioner)."""
+
+    def select_channels(self, value, num_channels):
+        return [0]
+
+    def __repr__(self):
+        return "GLOBAL"
+
+
+class KeyGroupStreamPartitioner(StreamPartitioner):
+    """hash(key) → key group → operator index
+    (ref: KeyGroupStreamPartitioner.java)."""
+
+    def __init__(self, key_selector: KeySelector, max_parallelism: int):
+        self.key_selector = key_selector
+        self.max_parallelism = max_parallelism
+
+    def select_channels(self, value, num_channels):
+        key = self.key_selector.get_key(value)
+        kg = assign_to_key_group(key, self.max_parallelism)
+        return [compute_operator_index_for_key_group(
+            self.max_parallelism, num_channels, kg)]
+
+    def __repr__(self):
+        return "HASH"
+
+
+class CustomPartitionerWrapper(StreamPartitioner):
+    """(ref: CustomPartitionerWrapper.java) — partitioner(key,
+    num_channels) -> channel."""
+
+    def __init__(self, partitioner: Callable[[Any, int], int],
+                 key_selector: Optional[KeySelector] = None):
+        self.partitioner = partitioner
+        self.key_selector = key_selector
+
+    def select_channels(self, value, num_channels):
+        key = self.key_selector.get_key(value) if self.key_selector else value
+        return [self.partitioner(key, num_channels) % num_channels]
+
+    def __repr__(self):
+        return "CUSTOM"

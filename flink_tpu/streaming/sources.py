@@ -1,0 +1,425 @@
+"""Source & sink function contracts + built-in implementations.
+
+Re-designs flink-streaming-java/.../api/functions/source/
+SourceFunction.java (SourceContext emission contract: collect /
+collectWithTimestamp / emitWatermark), StreamSource.java +
+StreamSourceContexts.java:46-285 (per-time-characteristic contexts),
+and the sink side (SinkFunction, RichSinkFunction, PrintSinkFunction,
+writeAsText).  The reference's "emit under checkpoint lock" contract
+maps here to the task's single-owner loop: a source emits only inside
+run(), which the task interleaves with barrier handling.
+"""
+
+from __future__ import annotations
+
+import abc
+import socket as _socket
+import time as _time
+from typing import Any, Iterable, List, Optional
+
+from flink_tpu.core.functions import RichFunction
+from flink_tpu.streaming.elements import StreamRecord, Watermark
+from flink_tpu.streaming.operators import AbstractUdfStreamOperator, Output
+
+
+class SourceContext(abc.ABC):
+    """(ref: SourceFunction.SourceContext)"""
+
+    @abc.abstractmethod
+    def collect(self, value) -> None: ...
+
+    @abc.abstractmethod
+    def collect_with_timestamp(self, value, timestamp: int) -> None: ...
+
+    @abc.abstractmethod
+    def emit_watermark(self, watermark: Watermark) -> None: ...
+
+    def mark_as_temporarily_idle(self) -> None:  # noqa: B027
+        pass
+
+    def close(self) -> None:  # noqa: B027
+        pass
+
+
+class SourceFunction(abc.ABC):
+    """(ref: SourceFunction.java) — run() emits via the context until
+    exhausted or cancel() is called."""
+
+    @abc.abstractmethod
+    def run(self, ctx: SourceContext) -> None: ...
+
+    def cancel(self) -> None:  # noqa: B027
+        pass
+
+
+class ParallelSourceFunction(SourceFunction):
+    """Marker: may run at parallelism > 1 (ref: ParallelSourceFunction)."""
+
+
+class RichSourceFunction(SourceFunction, RichFunction):
+    def __init__(self):
+        RichFunction.__init__(self)
+
+
+class RichParallelSourceFunction(ParallelSourceFunction, RichFunction):
+    def __init__(self):
+        RichFunction.__init__(self)
+
+
+class SinkFunction(abc.ABC):
+    """(ref: SinkFunction.java)"""
+
+    @abc.abstractmethod
+    def invoke(self, value, context=None) -> None: ...
+
+
+class RichSinkFunction(SinkFunction, RichFunction):
+    def __init__(self):
+        RichFunction.__init__(self)
+
+
+# ---------------------------------------------------------------------
+# Source contexts per time characteristic (ref: StreamSourceContexts.java)
+# ---------------------------------------------------------------------
+
+class NonTimestampContext(SourceContext):
+    """Processing time: no timestamps, watermarks ignored
+    (ref: NonTimestampContext :46)."""
+
+    def __init__(self, output: Output):
+        self._output = output
+
+    def collect(self, value):
+        self._output.collect(StreamRecord(value, None))
+
+    def collect_with_timestamp(self, value, timestamp):
+        self.collect(value)  # timestamps ignored in processing time
+
+    def emit_watermark(self, watermark):
+        pass
+
+
+class ManualWatermarkContext(SourceContext):
+    """Event time: source provides timestamps + watermarks
+    (ref: ManualWatermarkContext :285)."""
+
+    def __init__(self, output: Output):
+        self._output = output
+
+    def collect(self, value):
+        self._output.collect(StreamRecord(value, None))
+
+    def collect_with_timestamp(self, value, timestamp):
+        self._output.collect(StreamRecord(value, timestamp))
+
+    def emit_watermark(self, watermark):
+        self._output.emit_watermark(watermark)
+
+
+class AutomaticWatermarkContext(SourceContext):
+    """Ingestion time: stamp with processing time, emit periodic
+    watermarks (ref: AutomaticWatermarkContext :120)."""
+
+    def __init__(self, output: Output, processing_time_service, interval_ms: int = 200):
+        self._output = output
+        self._pts = processing_time_service
+        self._interval = interval_ms
+        self._last_wm = None
+
+    def collect(self, value):
+        now = self._pts.get_current_processing_time()
+        self._output.collect(StreamRecord(value, now))
+        self._maybe_watermark(now)
+
+    def collect_with_timestamp(self, value, timestamp):
+        self.collect(value)  # source timestamps overridden in ingestion time
+
+    def emit_watermark(self, watermark):
+        pass  # automatic only
+
+    def _maybe_watermark(self, now: int):
+        bucket = now - (now % self._interval)
+        if self._last_wm is None or bucket > self._last_wm:
+            self._last_wm = bucket
+            self._output.emit_watermark(Watermark(bucket - 1))
+
+
+class StreamSource(AbstractUdfStreamOperator):
+    """Operator hosting a SourceFunction (ref: StreamSource.java)."""
+
+    def __init__(self, source_function: SourceFunction,
+                 time_characteristic: str = "event"):
+        super().__init__(source_function)
+        self.time_characteristic = time_characteristic
+
+    def make_context(self) -> SourceContext:
+        if self.time_characteristic == "processing":
+            return NonTimestampContext(self.output)
+        if self.time_characteristic == "ingestion":
+            return AutomaticWatermarkContext(
+                self.output, self.processing_time_service)
+        return ManualWatermarkContext(self.output)
+
+    def run(self) -> None:
+        self.user_function.run(self.make_context())
+
+    def cancel(self) -> None:
+        self.user_function.cancel()
+
+    def process_element(self, record):
+        raise RuntimeError("sources have no input")
+
+
+# ---------------------------------------------------------------------
+# Built-in sources
+# ---------------------------------------------------------------------
+
+class FromCollectionSource(SourceFunction):
+    """(ref: FromElementsFunction.java / fromCollection)
+    Items may be plain values or (value, timestamp) pairs when
+    `timestamped=True`."""
+
+    def __init__(self, items: Iterable[Any], timestamped: bool = False,
+                 final_watermark: bool = True):
+        self.items = list(items)
+        self.timestamped = timestamped
+        self.final_watermark = final_watermark
+        self._cancelled = False
+        #: resume offset (exactly-once source state)
+        self.offset = 0
+
+    def run(self, ctx: SourceContext):
+        from flink_tpu.streaming.elements import MAX_WATERMARK
+        while self.offset < len(self.items):
+            if self._cancelled:
+                return
+            item = self.items[self.offset]
+            if self.timestamped:
+                value, ts = item
+                ctx.collect_with_timestamp(value, ts)
+            else:
+                ctx.collect(item)
+            self.offset += 1
+        if self.final_watermark:
+            ctx.emit_watermark(MAX_WATERMARK)
+
+    def cancel(self):
+        self._cancelled = True
+
+    # checkpoint hooks used by the source task
+    def snapshot_offset(self) -> int:
+        return self.offset
+
+    def restore_offset(self, offset: int) -> None:
+        self.offset = offset
+
+
+class SocketTextStreamSource(SourceFunction):
+    """(ref: SocketTextStreamFunction.java — baseline config #1 source)"""
+
+    def __init__(self, hostname: str, port: int, delimiter: str = "\n",
+                 max_retries: int = 0):
+        self.hostname = hostname
+        self.port = port
+        self.delimiter = delimiter
+        self.max_retries = max_retries
+        self._cancelled = False
+        self._sock: Optional[_socket.socket] = None
+
+    def run(self, ctx: SourceContext):
+        attempts = 0
+        while not self._cancelled:
+            try:
+                with _socket.create_connection((self.hostname, self.port)) as sock:
+                    self._sock = sock
+                    buf = ""
+                    while not self._cancelled:
+                        data = sock.recv(8192)
+                        if not data:
+                            return
+                        buf += data.decode("utf-8", errors="replace")
+                        while self.delimiter in buf:
+                            line, buf = buf.split(self.delimiter, 1)
+                            ctx.collect(line)
+            except OSError:
+                attempts += 1
+                if attempts > self.max_retries:
+                    raise
+                _time.sleep(0.5)
+
+    def cancel(self):
+        self._cancelled = True
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+
+
+class FileTextSource(SourceFunction):
+    """(ref: readTextFile → TextInputFormat path)"""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._cancelled = False
+
+    def run(self, ctx: SourceContext):
+        with open(self.path, "r") as f:
+            for line in f:
+                if self._cancelled:
+                    return
+                ctx.collect(line.rstrip("\n"))
+
+    def cancel(self):
+        self._cancelled = True
+
+
+# ---------------------------------------------------------------------
+# Built-in sinks
+# ---------------------------------------------------------------------
+
+class CollectSink(SinkFunction):
+    """Accumulates into a shared list (test/driver use)."""
+
+    def __init__(self, target: Optional[List[Any]] = None):
+        self.values: List[Any] = target if target is not None else []
+
+    def invoke(self, value, context=None):
+        self.values.append(value)
+
+
+class PrintSink(SinkFunction):
+    """(ref: PrintSinkFunction.java)"""
+
+    def __init__(self, prefix: str = ""):
+        self.prefix = prefix
+
+    def invoke(self, value, context=None):
+        print(f"{self.prefix}{value}" if self.prefix else str(value))
+
+
+class WriteAsTextSink(RichSinkFunction):
+    """(ref: writeAsText — TextOutputFormat)"""
+
+    def __init__(self, path: str):
+        super().__init__()
+        self.path = path
+        self._fh = None
+
+    def open(self, configuration):
+        self._fh = open(self.path, "w")
+
+    def invoke(self, value, context=None):
+        self._fh.write(str(value) + "\n")
+
+    def close(self):
+        if self._fh:
+            self._fh.close()
+            self._fh = None
+
+
+# ---------------------------------------------------------------------
+# Timestamp / watermark assignment (ref: api/functions/timestamps/)
+# ---------------------------------------------------------------------
+
+class AssignerWithPeriodicWatermarks(abc.ABC):
+    """(ref: AssignerWithPeriodicWatermarks.java)"""
+
+    @abc.abstractmethod
+    def extract_timestamp(self, element, previous_timestamp: Optional[int]) -> int: ...
+
+    @abc.abstractmethod
+    def get_current_watermark(self) -> Optional[Watermark]: ...
+
+
+class AssignerWithPunctuatedWatermarks(abc.ABC):
+    """(ref: AssignerWithPunctuatedWatermarks.java)"""
+
+    @abc.abstractmethod
+    def extract_timestamp(self, element, previous_timestamp: Optional[int]) -> int: ...
+
+    @abc.abstractmethod
+    def check_and_get_next_watermark(self, element, extracted_timestamp: int) -> Optional[Watermark]: ...
+
+
+class AscendingTimestampExtractor(AssignerWithPeriodicWatermarks):
+    """(ref: AscendingTimestampExtractor.java) — timestamps are
+    monotonically increasing per subtask; watermark = last - 1."""
+
+    def __init__(self, extractor):
+        self._extract = extractor
+        self._current = None
+
+    def extract_timestamp(self, element, previous_timestamp):
+        ts = self._extract(element)
+        if self._current is None or ts >= self._current:
+            self._current = ts
+        # on violation the element keeps its own (late) timestamp; only
+        # the watermark stays monotonic (ref: the log-and-ignore
+        # MonotonyViolationHandler returns the extracted timestamp)
+        return ts
+
+    def get_current_watermark(self):
+        return None if self._current is None else Watermark(self._current - 1)
+
+
+class BoundedOutOfOrdernessTimestampExtractor(AssignerWithPeriodicWatermarks):
+    """(ref: BoundedOutOfOrdernessTimestampExtractor.java)"""
+
+    def __init__(self, max_out_of_orderness_ms: int, extractor):
+        self.delay = max_out_of_orderness_ms
+        self._extract = extractor
+        self._max_ts = None
+
+    def extract_timestamp(self, element, previous_timestamp):
+        ts = self._extract(element)
+        if self._max_ts is None or ts > self._max_ts:
+            self._max_ts = ts
+        return ts
+
+    def get_current_watermark(self):
+        if self._max_ts is None:
+            return None
+        return Watermark(self._max_ts - self.delay - 1)
+
+
+class TimestampsAndWatermarksOperator(AbstractUdfStreamOperator):
+    """Operator applying an assigner
+    (ref: TimestampsAndPeriodicWatermarksOperator.java /
+    TimestampsAndPunctuatedWatermarksOperator.java).  Periodic
+    assigners emit on a watermark interval measured in elements here
+    (the single-process runtime has no timer thread between elements);
+    `watermark_interval` counts elements between watermark probes."""
+
+    def __init__(self, assigner, watermark_interval: int = 1):
+        super().__init__(assigner)
+        self.watermark_interval = max(1, watermark_interval)
+        self._since_last = 0
+        self._last_emitted = None
+
+    def process_element(self, record):
+        ts = self.user_function.extract_timestamp(record.value, record.timestamp)
+        self.output.collect(StreamRecord(record.value, ts))
+        if isinstance(self.user_function, AssignerWithPunctuatedWatermarks):
+            wm = self.user_function.check_and_get_next_watermark(record.value, ts)
+            if wm is not None and (self._last_emitted is None
+                                   or wm.timestamp > self._last_emitted):
+                self._last_emitted = wm.timestamp
+                self.output.emit_watermark(wm)
+        else:
+            self._since_last += 1
+            if self._since_last >= self.watermark_interval:
+                self._since_last = 0
+                wm = self.user_function.get_current_watermark()
+                if wm is not None and (self._last_emitted is None
+                                       or wm.timestamp > self._last_emitted):
+                    self._last_emitted = wm.timestamp
+                    self.output.emit_watermark(wm)
+
+    def process_watermark(self, watermark):
+        """Upstream watermarks are swallowed except the final flush
+        (ref: TimestampsAndPeriodicWatermarksOperator.processWatermark
+        — only Long.MAX_VALUE passes)."""
+        from flink_tpu.streaming.elements import MAX_TIMESTAMP
+        if watermark.timestamp == MAX_TIMESTAMP:
+            super().process_watermark(watermark)

@@ -1,0 +1,245 @@
+"""Timer services.
+
+Re-designs flink-streaming-java/.../api/operators/
+HeapInternalTimerService.java:43 (two priority queues of
+InternalTimer(timestamp, key, namespace), advanceWatermark :276-288
+draining event-time timers) and runtime/tasks/
+SystemProcessingTimeService.java / TestProcessingTimeService.java.
+
+Timers are exactly-once: registering the same (key, namespace,
+timestamp) twice is a no-op; they are part of operator snapshots, keyed
+per key group (ref: InternalTimerServiceSerializationProxy.java).
+"""
+
+from __future__ import annotations
+
+import abc
+import heapq
+import threading
+import time as _time
+from typing import Any, Callable, Dict, List, Optional, Set, Tuple
+
+from flink_tpu.core.keygroups import assign_to_key_group
+from flink_tpu.streaming.elements import MIN_TIMESTAMP
+
+
+class ProcessingTimeService(abc.ABC):
+    """(ref: ProcessingTimeService.java)"""
+
+    @abc.abstractmethod
+    def get_current_processing_time(self) -> int:
+        ...
+
+    @abc.abstractmethod
+    def register_timer(self, timestamp: int, callback: Callable[[int], None]):
+        ...
+
+    def shutdown(self) -> None:  # noqa: B027
+        pass
+
+
+class SystemProcessingTimeService(ProcessingTimeService):
+    """Wall-clock timers on a scheduler thread; callbacks run under the
+    owner's callback lock, mirroring how the reference fires timers
+    under the checkpoint lock (SystemProcessingTimeService.java)."""
+
+    def __init__(self, lock: Optional[threading.Lock] = None):
+        self._lock = lock or threading.Lock()
+        self._timers: List[threading.Timer] = []
+        self._shutdown = False
+
+    def get_current_processing_time(self) -> int:
+        return int(_time.time() * 1000)
+
+    def register_timer(self, timestamp: int, callback):
+        delay = max(0.0, (timestamp - self.get_current_processing_time()) / 1000.0)
+
+        def fire():
+            with self._lock:
+                if not self._shutdown:
+                    callback(timestamp)
+
+        t = threading.Timer(delay, fire)
+        t.daemon = True
+        t.start()
+        self._timers.append(t)
+        return t
+
+    def shutdown(self):
+        self._shutdown = True
+        for t in self._timers:
+            t.cancel()
+        self._timers.clear()
+
+
+class TestProcessingTimeService(ProcessingTimeService):
+    """Manually advanced clock for harness tests
+    (ref: TestProcessingTimeService.java)."""
+
+    def __init__(self):
+        self._now = 0
+        #: (timestamp, seq, callback) min-heap
+        self._queue: List[Tuple[int, int, Callable]] = []
+        self._seq = 0
+
+    def get_current_processing_time(self) -> int:
+        return self._now
+
+    def register_timer(self, timestamp: int, callback):
+        heapq.heappush(self._queue, (timestamp, self._seq, callback))
+        self._seq += 1
+
+    def set_current_time(self, now: int) -> None:
+        """Advance the clock, firing due timers in order."""
+        self._now = now
+        while self._queue and self._queue[0][0] <= now:
+            ts, _, cb = heapq.heappop(self._queue)
+            cb(ts)
+
+    def advance(self, delta: int) -> None:
+        self.set_current_time(self._now + delta)
+
+    def fire_all_pending(self) -> None:
+        """Advance the clock to the latest currently-registered timer,
+        firing everything due.  Timers that re-arm themselves past that
+        horizon (continuous triggers) stop firing — this bounds the
+        end-of-input drain of a finite job."""
+        if not self._queue:
+            return
+        horizon = max(ts for ts, _, _ in self._queue)
+        self.set_current_time(max(horizon, self._now))
+
+
+class InternalTimer:
+    __slots__ = ("timestamp", "key", "namespace")
+
+    def __init__(self, timestamp: int, key, namespace):
+        self.timestamp = timestamp
+        self.key = key
+        self.namespace = namespace
+
+    def __repr__(self):
+        return f"Timer({self.timestamp}, {self.key!r}, {self.namespace!r})"
+
+
+class InternalTimerService:
+    """Keyed event-time + processing-time timers for one operator
+    (ref: HeapInternalTimerService.java)."""
+
+    def __init__(self, name: str, keyed_backend, processing_time_service: ProcessingTimeService,
+                 triggerable):
+        self.name = name
+        self._backend = keyed_backend
+        self._pts = processing_time_service
+        #: the operator: has on_event_time(timer) / on_processing_time(timer)
+        self._triggerable = triggerable
+        self.current_watermark = MIN_TIMESTAMP
+        # heaps of (timestamp, seq, key, namespace); set for dedup
+        self._event_heap: List[Tuple[int, int, Any, Any]] = []
+        self._event_set: Set[Tuple[int, Any, Any]] = set()
+        self._proc_heap: List[Tuple[int, int, Any, Any]] = []
+        self._proc_set: Set[Tuple[int, Any, Any]] = set()
+        self._seq = 0
+        self._next_proc_registered: Optional[int] = None
+
+    # ---- registration (key = backend's current key) -----------------
+    def register_event_time_timer(self, namespace, timestamp: int) -> None:
+        key = self._backend.current_key
+        entry = (timestamp, key, namespace)
+        if entry in self._event_set:
+            return
+        self._event_set.add(entry)
+        heapq.heappush(self._event_heap, (timestamp, self._seq, key, namespace))
+        self._seq += 1
+
+    def delete_event_time_timer(self, namespace, timestamp: int) -> None:
+        # lazy deletion: remove from the set; heap entries are skipped
+        self._event_set.discard((timestamp, self._backend.current_key, namespace))
+
+    def register_processing_time_timer(self, namespace, timestamp: int) -> None:
+        key = self._backend.current_key
+        entry = (timestamp, key, namespace)
+        if entry in self._proc_set:
+            return
+        self._proc_set.add(entry)
+        heapq.heappush(self._proc_heap, (timestamp, self._seq, key, namespace))
+        self._seq += 1
+        if self._next_proc_registered is None or timestamp < self._next_proc_registered:
+            self._next_proc_registered = timestamp
+            self._pts.register_timer(timestamp, self._on_processing_time)
+
+    def delete_processing_time_timer(self, namespace, timestamp: int) -> None:
+        self._proc_set.discard((timestamp, self._backend.current_key, namespace))
+
+    def num_event_time_timers(self) -> int:
+        return len(self._event_set)
+
+    def num_processing_time_timers(self) -> int:
+        return len(self._proc_set)
+
+    # ---- firing -----------------------------------------------------
+    def advance_watermark(self, watermark: int) -> None:
+        """Fire all event-time timers <= watermark
+        (ref: HeapInternalTimerService.advanceWatermark :276-288)."""
+        self.current_watermark = watermark
+        while self._event_heap and self._event_heap[0][0] <= watermark:
+            ts, _, key, namespace = heapq.heappop(self._event_heap)
+            entry = (ts, key, namespace)
+            if entry not in self._event_set:
+                continue  # deleted
+            self._event_set.remove(entry)
+            self._backend.set_current_key(key)
+            self._triggerable.on_event_time(InternalTimer(ts, key, namespace))
+
+    def _on_processing_time(self, fired_at: int) -> None:
+        self._next_proc_registered = None
+        now = self._pts.get_current_processing_time()
+        while self._proc_heap and self._proc_heap[0][0] <= now:
+            ts, _, key, namespace = heapq.heappop(self._proc_heap)
+            entry = (ts, key, namespace)
+            if entry not in self._proc_set:
+                continue
+            self._proc_set.remove(entry)
+            self._backend.set_current_key(key)
+            self._triggerable.on_processing_time(InternalTimer(ts, key, namespace))
+        if self._proc_heap:
+            nxt = self._proc_heap[0][0]
+            self._next_proc_registered = nxt
+            self._pts.register_timer(nxt, self._on_processing_time)
+
+    # ---- snapshot (timers are state, keyed per key group) -----------
+    def snapshot(self) -> dict:
+        per_kg_event: Dict[int, list] = {}
+        per_kg_proc: Dict[int, list] = {}
+        mp = self._backend.max_parallelism
+        for ts, key, namespace in self._event_set:
+            per_kg_event.setdefault(assign_to_key_group(key, mp), []).append(
+                (ts, key, namespace))
+        for ts, key, namespace in self._proc_set:
+            per_kg_proc.setdefault(assign_to_key_group(key, mp), []).append(
+                (ts, key, namespace))
+        return {"watermark": self.current_watermark,
+                "event": per_kg_event, "proc": per_kg_proc}
+
+    def restore(self, snapshots: List[dict]) -> None:
+        self._event_heap.clear()
+        self._event_set.clear()
+        self._proc_heap.clear()
+        self._proc_set.clear()
+        rng = self._backend.key_group_range
+        saved_key = self._backend.current_key
+        for snap in snapshots:
+            for kg, timers in snap.get("event", {}).items():
+                if not rng.contains(kg):
+                    continue
+                for ts, key, namespace in timers:
+                    self._backend.set_current_key(key)
+                    self.register_event_time_timer(namespace, ts)
+            for kg, timers in snap.get("proc", {}).items():
+                if not rng.contains(kg):
+                    continue
+                for ts, key, namespace in timers:
+                    self._backend.set_current_key(key)
+                    self.register_processing_time_timer(namespace, ts)
+        if saved_key is not None:
+            self._backend.set_current_key(saved_key)

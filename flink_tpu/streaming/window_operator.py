@@ -1,0 +1,608 @@
+"""WindowOperator: windowed keyed aggregation with triggers, allowed
+lateness, and merging session windows.
+
+Re-designs flink-streaming-java/.../runtime/operators/windowing/
+WindowOperator.java:97 — processElement :291-421, onEventTime :424,
+onProcessingTime :472, emitWindowContents :544, cleanup timers
+:596-626, lateness :576-589 — and MergingWindowSet.java:54,119,156.
+Window state is keyed state under namespace = window
+(WindowOperator.java:387), so ALL backends (heap and TPU) serve it
+unchanged; on the TPU backend a window-fire is a device gather and
+`add` is a micro-batched scatter.
+
+EvictingWindowOperator keeps the raw elements in a ListState and runs
+the Evictor before/after the window function
+(ref: EvictingWindowOperator.java).
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import Any, Iterable, List, Optional, Tuple
+
+from flink_tpu.core.state import (
+    AggregatingStateDescriptor,
+    ListStateDescriptor,
+    ReducingStateDescriptor,
+    StateDescriptor,
+    ValueStateDescriptor,
+)
+from flink_tpu.streaming.elements import MAX_TIMESTAMP, StreamRecord
+from flink_tpu.streaming.operators import (
+    AbstractUdfStreamOperator,
+    OutputTag,
+    TimestampedCollector,
+)
+from flink_tpu.streaming.windowing import (
+    Trigger,
+    TriggerContext,
+    TriggerResult,
+    WindowAssigner,
+)
+
+
+# ---------------------------------------------------------------------
+# Window functions (ref: runtime/operators/windowing/functions/)
+# ---------------------------------------------------------------------
+
+class ProcessWindowFunction(abc.ABC):
+    """(ref: ProcessWindowFunction.java) — full access to window
+    metadata; elements is the window contents iterable."""
+
+    @abc.abstractmethod
+    def process(self, key, context: "WindowContext", elements: Iterable, out) -> None:
+        ...
+
+    def clear(self, context: "WindowContext") -> None:  # noqa: B027
+        pass
+
+
+class WindowFunction(abc.ABC):
+    """(ref: WindowFunction.java) — apply(key, window, inputs, out)."""
+
+    @abc.abstractmethod
+    def apply(self, key, window, inputs: Iterable, out) -> None:
+        ...
+
+
+class PassThroughWindowFunction(WindowFunction):
+    """Emit the (single) pre-aggregated value
+    (ref: PassThroughWindowFunction.java)."""
+
+    def apply(self, key, window, inputs, out):
+        out.collect(inputs)
+
+
+class WindowContext:
+    """(ref: ProcessWindowFunction.Context)"""
+
+    def __init__(self, window, op: "WindowOperator"):
+        self.window = window
+        self._op = op
+
+    def current_processing_time(self) -> int:
+        return self._op.processing_time_service.get_current_processing_time()
+
+    def current_watermark(self) -> int:
+        return self._op.timer_service.current_watermark
+
+    def window_state(self, descriptor: StateDescriptor):
+        """Per-(key, window) state."""
+        return self._op.keyed_backend.get_partitioned_state(
+            self._op._namespace_of(self.window), descriptor)
+
+    def global_state(self, descriptor: StateDescriptor):
+        """Per-key state shared across windows."""
+        from flink_tpu.state.backend import VOID_NAMESPACE
+        return self._op.keyed_backend.get_partitioned_state(VOID_NAMESPACE, descriptor)
+
+    def output(self, tag: OutputTag, value) -> None:
+        self._op.output.collect_side(
+            tag, StreamRecord(value, self.window.max_timestamp()))
+
+
+class _InternalWindowFunction:
+    """Normalizes the three user-function shapes to one call."""
+
+    def __init__(self, fn, single_value: bool):
+        self.fn = fn
+        #: True when window contents are a single pre-aggregated value
+        self.single_value = single_value
+
+    def process(self, key, window, op, contents, collector) -> None:
+        if self.fn is None:
+            collector.collect(contents)
+        elif isinstance(self.fn, ProcessWindowFunction):
+            elements = [contents] if self.single_value else contents
+            self.fn.process(key, WindowContext(window, op), elements, collector)
+        elif isinstance(self.fn, WindowFunction):
+            elements = [contents] if self.single_value else contents
+            self.fn.apply(key, window, elements, collector)
+        else:  # plain callable(key, window, elements) -> iterable
+            elements = [contents] if self.single_value else contents
+            result = self.fn(key, window, elements)
+            if result is not None:
+                for v in result:
+                    collector.collect(v)
+
+    def clear(self, key, window, op) -> None:
+        if isinstance(self.fn, ProcessWindowFunction):
+            self.fn.clear(WindowContext(window, op))
+
+
+# ---------------------------------------------------------------------
+# MergingWindowSet (ref: MergingWindowSet.java)
+# ---------------------------------------------------------------------
+
+class MergingWindowSet:
+    """Per-key mapping window → state window for merging (session)
+    assigners.  When windows merge, one pre-existing state window is
+    kept as the merge target and the others' state is folded into it —
+    so state never has to be re-namespaced (ref: MergingWindowSet.java:54)."""
+
+    def __init__(self, mapping_state):
+        #: ValueState holding {window_namespace: state_window_namespace}
+        self._mapping_state = mapping_state
+        m = mapping_state.value()
+        self.mapping: dict = dict(m) if m else {}
+
+    def persist(self) -> None:
+        if self.mapping:
+            self._mapping_state.update(dict(self.mapping))
+        else:
+            self._mapping_state.clear()
+
+    def get_state_window(self, window):
+        return self.mapping.get(window)
+
+    def retire_window(self, window) -> None:
+        if window in self.mapping:
+            del self.mapping[window]
+
+    def add_window(self, new_window, merge_callback):
+        """Add `new_window`, eagerly merging all transitively
+        intersecting windows.  merge_callback(merge_result,
+        merged_windows, state_window_result, merged_state_windows) is
+        invoked when a merge happens (ref: addWindow :119)."""
+        windows = list(self.mapping.keys()) + [new_window]
+        merge_result = new_window
+        to_merge = []
+        changed = True
+        while changed:
+            changed = False
+            for w in windows:
+                if w is merge_result or w in to_merge:
+                    continue
+                if w.intersects(merge_result):
+                    merge_result = merge_result.cover(w)
+                    to_merge.append(w)
+                    changed = True
+        # to_merge = pre-existing windows (and possibly none) swallowed
+        to_merge_existing = [w for w in to_merge if w in self.mapping]
+        if not to_merge_existing and new_window not in self.mapping:
+            # brand-new non-overlapping window: its own state window
+            self.mapping[new_window] = new_window
+            return new_window
+        if not to_merge_existing:
+            return new_window  # exact duplicate of an existing window
+        # keep the first existing window's state window as target
+        state_window_result = self.mapping[to_merge_existing[0]]
+        merged_state_windows = []
+        for w in to_merge_existing:
+            sw = self.mapping.pop(w)
+            if sw != state_window_result:
+                merged_state_windows.append(sw)
+        self.mapping[merge_result] = state_window_result
+        merged_windows = to_merge_existing + (
+            [new_window] if new_window not in to_merge_existing else [])
+        # don't fire the callback for a no-op (new window already covered
+        # by one existing window and nothing else merged)
+        if len(to_merge_existing) > 1 or (
+                merge_result != to_merge_existing[0]) or merged_state_windows:
+            if merge_result not in to_merge_existing or merged_state_windows:
+                merge_callback(merge_result, merged_windows,
+                               state_window_result, merged_state_windows)
+        return merge_result
+
+
+# ---------------------------------------------------------------------
+# WindowOperator
+# ---------------------------------------------------------------------
+
+class _WindowTriggerContext(TriggerContext):
+    """(ref: WindowOperator.Context :649)"""
+
+    def __init__(self, op: "WindowOperator"):
+        self._op = op
+        self.window = None
+
+    def register_event_time_timer(self, time):
+        self._op.timer_service.register_event_time_timer(
+            self._op._namespace_of(self.window), time)
+
+    def register_processing_time_timer(self, time):
+        self._op.timer_service.register_processing_time_timer(
+            self._op._namespace_of(self.window), time)
+
+    def delete_event_time_timer(self, time):
+        self._op.timer_service.delete_event_time_timer(
+            self._op._namespace_of(self.window), time)
+
+    def delete_processing_time_timer(self, time):
+        self._op.timer_service.delete_processing_time_timer(
+            self._op._namespace_of(self.window), time)
+
+    def get_current_watermark(self):
+        return self._op.timer_service.current_watermark
+
+    def get_current_processing_time(self):
+        return self._op.processing_time_service.get_current_processing_time()
+
+    def get_partitioned_state(self, descriptor):
+        """Trigger state, scoped (key, window)."""
+        return self._op.keyed_backend.get_partitioned_state(
+            self._op._namespace_of(self.window), descriptor)
+
+    #: set before trigger.on_merge fires (ref: OnMergeContext)
+    merged_windows = ()
+
+    def merge_partitioned_state(self, descriptor):
+        """Merge per-window trigger state of the merged windows into
+        the merge result's namespace (ref:
+        Trigger.OnMergeContext#mergePartitionedState)."""
+        state = self._op.keyed_backend.get_or_create_keyed_state(descriptor)
+        if hasattr(state, "merge_namespaces"):
+            state.merge_namespaces(
+                self._op._namespace_of(self.window),
+                [self._op._namespace_of(w) for w in self.merged_windows])
+
+
+class _AssignerContext:
+    """(ref: WindowAssigner.WindowAssignerContext)"""
+
+    def __init__(self, op: "WindowOperator"):
+        self._op = op
+
+    def get_current_processing_time(self):
+        return self._op.processing_time_service.get_current_processing_time()
+
+
+class WindowOperator(AbstractUdfStreamOperator):
+    """One-input keyed window operator."""
+
+    MAPPING_STATE_NAME = "window-merge-mapping"
+
+    def __init__(
+        self,
+        assigner: WindowAssigner,
+        state_descriptor: StateDescriptor,
+        window_function=None,
+        trigger: Optional[Trigger] = None,
+        allowed_lateness: int = 0,
+        late_data_tag: Optional[OutputTag] = None,
+        single_value_contents: Optional[bool] = None,
+    ):
+        super().__init__(window_function)
+        self.assigner = assigner
+        self.state_descriptor = state_descriptor
+        self.trigger = trigger or assigner.get_default_trigger()
+        if allowed_lateness < 0:
+            raise ValueError("allowed lateness must be >= 0")
+        if assigner.is_merging() and not self.trigger.can_merge():
+            raise ValueError(
+                f"trigger {self.trigger!r} cannot merge but assigner "
+                f"{assigner!r} is a merging assigner")
+        self.allowed_lateness = allowed_lateness
+        self.late_data_tag = late_data_tag
+        if single_value_contents is None:
+            single_value_contents = isinstance(
+                state_descriptor,
+                (ReducingStateDescriptor, AggregatingStateDescriptor))
+        self._internal_fn = _InternalWindowFunction(
+            window_function, single_value_contents)
+        # metrics (ref: numLateRecordsDropped, WindowOperator.java:138)
+        self.num_late_records_dropped = 0
+
+    # ---- lifecycle --------------------------------------------------
+    def open(self):
+        super().open()
+        self.window_state = self.keyed_backend.get_or_create_keyed_state(
+            self.state_descriptor)
+        self.trigger_ctx = _WindowTriggerContext(self)
+        self.assigner_ctx = _AssignerContext(self)
+        self.collector = TimestampedCollector(self.output)
+        if self.assigner.is_merging():
+            self._mapping_desc = ValueStateDescriptor(self.MAPPING_STATE_NAME)
+
+    # namespace encoding: window -> hashable tuple (state namespaces)
+    def _namespace_of(self, window):
+        return window.to_namespace()
+
+    def _state_value(self, record: StreamRecord):
+        """What goes into window state for one record; the evicting
+        variant stores (timestamp, value) pairs."""
+        return record.value
+
+    # ---- element path (ref: processElement :291-421) ----------------
+    def process_element(self, record: StreamRecord):
+        windows = self.assigner.assign_windows(
+            record.value, record.timestamp, self.assigner_ctx)
+        skipped = True
+        if self.assigner.is_merging():
+            skipped = self._process_merging(record, windows, skipped)
+        else:
+            for window in windows:
+                if self._is_window_late(window):
+                    continue
+                skipped = False
+                ns = self._namespace_of(window)
+                self.window_state.set_current_namespace(ns)
+                self.window_state.add(self._state_value(record))
+                self.trigger_ctx.window = window
+                result = self.trigger.on_element(
+                    record.value, record.timestamp, window, self.trigger_ctx)
+                self._react(result, window)
+                self._register_cleanup_timer(window)
+        if skipped and self._is_element_late(record):
+            if self.late_data_tag is not None:
+                self.output.collect_side(self.late_data_tag, record)
+            else:
+                self.num_late_records_dropped += 1
+                if self.metrics is not None:
+                    self.metrics.counter("numLateRecordsDropped").inc()
+
+    def _process_merging(self, record, windows, skipped):
+        from flink_tpu.state.backend import VOID_NAMESPACE
+        mapping_state = self.keyed_backend.get_partitioned_state(
+            VOID_NAMESPACE, self._mapping_desc)
+        merging = MergingWindowSet(mapping_state)
+
+        def on_merge(merge_result, merged_windows, state_window, merged_state_windows):
+            # fold merged state windows into the surviving one
+            if merged_state_windows and hasattr(self.window_state, "merge_namespaces"):
+                self.window_state.merge_namespaces(
+                    self._namespace_of(state_window),
+                    [self._namespace_of(w) for w in merged_state_windows])
+            # trigger merges its per-window state FIRST (ref: the order
+            # in WindowOperator's merge callback: onMerge, then clear
+            # each merged window), then old windows' trigger state,
+            # timers, and cleanup timers are dropped
+            self.trigger_ctx.window = merge_result
+            self.trigger_ctx.merged_windows = [
+                w for w in merged_windows if w != merge_result]
+            self.trigger.on_merge(merge_result, self.trigger_ctx)
+            self.trigger_ctx.merged_windows = ()
+            for w in merged_windows:
+                if w == merge_result:
+                    continue
+                self.trigger_ctx.window = w
+                self.trigger.clear(w, self.trigger_ctx)
+                self._delete_cleanup_timer(w)
+
+        for window in windows:
+            actual = merging.add_window(window, on_merge)
+            if self._is_window_late(actual):
+                merging.retire_window(actual)
+                continue
+            skipped = False
+            state_window = merging.get_state_window(actual)
+            self.window_state.set_current_namespace(
+                self._namespace_of(state_window))
+            self.window_state.add(self._state_value(record))
+            self.trigger_ctx.window = actual
+            result = self.trigger.on_element(
+                record.value, record.timestamp, actual, self.trigger_ctx)
+            if TriggerResult.is_fire(result):
+                contents = self._contents_for(actual, merging)
+                if contents is not None:
+                    self._emit(actual, contents)
+            if TriggerResult.is_purge(result):
+                self.window_state.clear()
+            self._register_cleanup_timer(actual)
+        merging.persist()
+        return skipped
+
+    # ---- timers (ref: onEventTime :424 / onProcessingTime :472) -----
+    def on_event_time(self, timer):
+        window = self._window_from_namespace(timer.namespace)
+        self.trigger_ctx.window = window
+        merging = None
+        if self.assigner.is_merging():
+            from flink_tpu.state.backend import VOID_NAMESPACE
+            mapping_state = self.keyed_backend.get_partitioned_state(
+                VOID_NAMESPACE, self._mapping_desc)
+            merging = MergingWindowSet(mapping_state)
+            state_window = merging.get_state_window(window)
+            if state_window is None:
+                return  # window was merged away; timer is stale
+            self.window_state.set_current_namespace(
+                self._namespace_of(state_window))
+        else:
+            self.window_state.set_current_namespace(self._namespace_of(window))
+
+        result = self.trigger.on_event_time(timer.timestamp, window, self.trigger_ctx)
+        if TriggerResult.is_fire(result):
+            contents = self.window_state.get()
+            if contents is not None:
+                self._emit(window, contents)
+        if TriggerResult.is_purge(result):
+            self.window_state.clear()
+        if self.assigner.is_event_time() and self._is_cleanup_time(window, timer.timestamp):
+            self._clear_all_state(window, merging)
+        if merging is not None:
+            merging.persist()
+
+    def on_processing_time(self, timer):
+        window = self._window_from_namespace(timer.namespace)
+        self.trigger_ctx.window = window
+        merging = None
+        if self.assigner.is_merging():
+            from flink_tpu.state.backend import VOID_NAMESPACE
+            mapping_state = self.keyed_backend.get_partitioned_state(
+                VOID_NAMESPACE, self._mapping_desc)
+            merging = MergingWindowSet(mapping_state)
+            state_window = merging.get_state_window(window)
+            if state_window is None:
+                return
+            self.window_state.set_current_namespace(
+                self._namespace_of(state_window))
+        else:
+            self.window_state.set_current_namespace(self._namespace_of(window))
+
+        result = self.trigger.on_processing_time(
+            timer.timestamp, window, self.trigger_ctx)
+        if TriggerResult.is_fire(result):
+            contents = self.window_state.get()
+            if contents is not None:
+                self._emit(window, contents)
+        if TriggerResult.is_purge(result):
+            self.window_state.clear()
+        if (not self.assigner.is_event_time()
+                and self._is_cleanup_time(window, timer.timestamp)):
+            self._clear_all_state(window, merging)
+        if merging is not None:
+            merging.persist()
+
+    # ---- helpers ----------------------------------------------------
+    def _react(self, result: int, window) -> None:
+        if TriggerResult.is_fire(result):
+            contents = self.window_state.get()
+            if contents is not None:
+                self._emit(window, contents)
+        if TriggerResult.is_purge(result):
+            self.window_state.clear()
+
+    def _contents_for(self, window, merging: Optional[MergingWindowSet]):
+        if merging is not None:
+            state_window = merging.get_state_window(window)
+            if state_window is None:
+                return None
+            self.window_state.set_current_namespace(
+                self._namespace_of(state_window))
+        return self.window_state.get()
+
+    def _emit(self, window, contents) -> None:
+        """(ref: emitWindowContents :544 — output timestamp =
+        window.maxTimestamp)"""
+        self.collector.set_absolute_timestamp(window.max_timestamp())
+        key = self.keyed_backend.current_key
+        self._internal_fn.process(key, window, self, contents, self.collector)
+
+    def _window_from_namespace(self, namespace):
+        wt = self.assigner.window_type()
+        return wt.from_namespace(namespace)
+
+    def _cleanup_time(self, window) -> int:
+        if self.assigner.is_event_time():
+            # cap at MAX_TIMESTAMP — Python ints don't overflow, so an
+            # explicit cap replaces the reference's wraparound check
+            # (GlobalWindows + lateness must stay at "end of time")
+            t = window.max_timestamp() + self.allowed_lateness
+            return t if t < MAX_TIMESTAMP else MAX_TIMESTAMP
+        return window.max_timestamp()
+
+    def _is_cleanup_time(self, window, time: int) -> bool:
+        return time == self._cleanup_time(window)
+
+    def _register_cleanup_timer(self, window) -> None:
+        cleanup = self._cleanup_time(window)
+        if cleanup == MAX_TIMESTAMP:
+            return  # end of time — nothing to GC (ref: :596-626)
+        self.trigger_ctx.window = window
+        if self.assigner.is_event_time():
+            self.timer_service.register_event_time_timer(
+                self._namespace_of(window), cleanup)
+        else:
+            self.timer_service.register_processing_time_timer(
+                self._namespace_of(window), cleanup)
+
+    def _delete_cleanup_timer(self, window) -> None:
+        cleanup = self._cleanup_time(window)
+        if cleanup == MAX_TIMESTAMP:
+            return
+        if self.assigner.is_event_time():
+            self.timer_service.delete_event_time_timer(
+                self._namespace_of(window), cleanup)
+        else:
+            self.timer_service.delete_processing_time_timer(
+                self._namespace_of(window), cleanup)
+
+    def _is_window_late(self, window) -> bool:
+        """(ref: isWindowLate :576)"""
+        return (self.assigner.is_event_time()
+                and self._cleanup_time(window) <= self.timer_service.current_watermark)
+
+    def _is_element_late(self, record: StreamRecord) -> bool:
+        """(ref: isElementLate :589)"""
+        return (self.assigner.is_event_time()
+                and record.timestamp is not None
+                and record.timestamp + self.allowed_lateness
+                <= self.timer_service.current_watermark)
+
+    def _clear_all_state(self, window, merging: Optional[MergingWindowSet]) -> None:
+        """(ref: clearAllState :517)"""
+        self.window_state.clear()
+        self.trigger_ctx.window = window
+        self.trigger.clear(window, self.trigger_ctx)
+        key = self.keyed_backend.current_key
+        self._internal_fn.clear(key, window, self)
+        if merging is not None:
+            merging.retire_window(window)
+
+
+# ---------------------------------------------------------------------
+# Evicting variant (ref: EvictingWindowOperator.java)
+# ---------------------------------------------------------------------
+
+class EvictingWindowOperator(WindowOperator):
+    """Keeps raw (timestamp, value) pairs and applies the evictor
+    around the window function."""
+
+    def __init__(self, assigner, window_function, trigger=None,
+                 evictor=None, allowed_lateness=0, late_data_tag=None,
+                 pre_aggregator=None):
+        if evictor is None:
+            raise ValueError("EvictingWindowOperator requires an evictor")
+        super().__init__(
+            assigner,
+            ListStateDescriptor("window-contents-evicting"),
+            window_function,
+            trigger,
+            allowed_lateness,
+            late_data_tag,
+            single_value_contents=False,
+        )
+        self.evictor = evictor
+        #: with an evictor, pre-aggregation is impossible (raw elements
+        #: must be retained), so reduce/aggregate run at fire time over
+        #: the surviving elements (ref: WindowedStream.reduce's
+        #: evictor branch wrapping into ReduceApplyWindowFunction)
+        self.pre_aggregator = pre_aggregator
+        if pre_aggregator is not None:
+            self._internal_fn = _InternalWindowFunction(
+                window_function, single_value=True)
+
+    def _state_value(self, record: StreamRecord):
+        # store (timestamp, value) so time-based eviction works; the
+        # raw record still flows to triggers and late-data side output
+        return (record.timestamp, record.value)
+
+    def _emit(self, window, contents) -> None:
+        elements: List[Tuple[int, Any]] = list(contents)
+        now = (self.timer_service.current_watermark
+               if self.assigner.is_event_time()
+               else self.processing_time_service.get_current_processing_time())
+        kept = self.evictor.evict_before(elements, len(elements), window, now)
+        self.collector.set_absolute_timestamp(window.max_timestamp())
+        key = self.keyed_backend.current_key
+        values = [v for _, v in kept]
+        if self.pre_aggregator is not None:
+            if values:
+                self._internal_fn.process(
+                    key, window, self, self.pre_aggregator(values),
+                    self.collector)
+        else:
+            self._internal_fn.process(key, window, self, values, self.collector)
+        after = self.evictor.evict_after(kept, len(kept), window, now)
+        # write back the surviving elements
+        self.window_state.update([(ts, v) for ts, v in after])

@@ -1,0 +1,784 @@
+"""Windows, assigners, triggers, evictors.
+
+Re-designs flink-streaming-java/.../api/windowing/ (SURVEY.md §2.3
+"Windowing" row — the complete assigner/trigger/evictor inventory).
+Window semantics follow the reference exactly: a TimeWindow covers
+[start, end); maxTimestamp = end - 1; tumbling/sliding starts align to
+`timestamp - (timestamp - offset) % slide`.
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import Any, Callable, Iterable, List, Optional, Tuple
+
+from flink_tpu.streaming.elements import MAX_TIMESTAMP
+
+
+class Time:
+    """Duration helper (ref: api/windowing/time/Time.java) — value in ms."""
+
+    __slots__ = ("milliseconds",)
+
+    def __init__(self, milliseconds: int):
+        self.milliseconds = int(milliseconds)
+
+    @staticmethod
+    def milliseconds_of(ms) -> "Time":
+        return Time(ms)
+
+    @staticmethod
+    def seconds(s) -> "Time":
+        return Time(s * 1000)
+
+    @staticmethod
+    def minutes(m) -> "Time":
+        return Time(m * 60 * 1000)
+
+    @staticmethod
+    def hours(h) -> "Time":
+        return Time(h * 60 * 60 * 1000)
+
+    @staticmethod
+    def days(d) -> "Time":
+        return Time(d * 24 * 60 * 60 * 1000)
+
+    def to_milliseconds(self) -> int:
+        return self.milliseconds
+
+    def __repr__(self):
+        return f"Time({self.milliseconds}ms)"
+
+
+def _ms(t) -> int:
+    if isinstance(t, Time):
+        return t.milliseconds
+    return int(t)
+
+
+# ---------------------------------------------------------------------
+# Windows (ref: api/windowing/windows/)
+# ---------------------------------------------------------------------
+
+class Window(abc.ABC):
+    @abc.abstractmethod
+    def max_timestamp(self) -> int:
+        ...
+
+
+class TimeWindow(Window):
+    """[start, end) (ref: TimeWindow.java)."""
+
+    __slots__ = ("start", "end")
+
+    def __init__(self, start: int, end: int):
+        self.start = start
+        self.end = end
+
+    def max_timestamp(self) -> int:
+        return self.end - 1
+
+    def intersects(self, other: "TimeWindow") -> bool:
+        return self.start <= other.end and self.end >= other.start
+
+    def cover(self, other: "TimeWindow") -> "TimeWindow":
+        return TimeWindow(min(self.start, other.start), max(self.end, other.end))
+
+    @staticmethod
+    def get_window_start_with_offset(timestamp: int, offset: int, window_size: int) -> int:
+        """(ref: TimeWindow.java getWindowStartWithOffset)"""
+        return timestamp - (timestamp - offset + window_size) % window_size
+
+    # namespace identity: (start, end) — tuples serialize naturally
+    def __eq__(self, other):
+        return (isinstance(other, TimeWindow) and self.start == other.start
+                and self.end == other.end)
+
+    def __hash__(self):
+        return hash((self.start, self.end))
+
+    def __lt__(self, other):
+        return (self.start, self.end) < (other.start, other.end)
+
+    def __repr__(self):
+        return f"TimeWindow[{self.start}, {self.end})"
+
+    def to_namespace(self) -> Tuple[int, int]:
+        return (self.start, self.end)
+
+    @staticmethod
+    def from_namespace(ns: Tuple[int, int]) -> "TimeWindow":
+        return TimeWindow(ns[0], ns[1])
+
+
+class GlobalWindow(Window):
+    """Singleton window covering everything (ref: GlobalWindow.java)."""
+
+    _instance: Optional["GlobalWindow"] = None
+
+    def __new__(cls):
+        if cls._instance is None:
+            cls._instance = super().__new__(cls)
+        return cls._instance
+
+    def max_timestamp(self) -> int:
+        return MAX_TIMESTAMP
+
+    def __eq__(self, other):
+        return isinstance(other, GlobalWindow)
+
+    def __hash__(self):
+        return hash("GlobalWindow")
+
+    def __repr__(self):
+        return "GlobalWindow"
+
+    def to_namespace(self):
+        return ("__global__",)
+
+    @staticmethod
+    def from_namespace(ns) -> "GlobalWindow":
+        return GlobalWindow()
+
+
+# ---------------------------------------------------------------------
+# Trigger results & context (ref: triggers/TriggerResult.java, Trigger.java)
+# ---------------------------------------------------------------------
+
+class TriggerResult:
+    CONTINUE = 0
+    FIRE = 1
+    PURGE = 2
+    FIRE_AND_PURGE = 3
+
+    @staticmethod
+    def is_fire(r: int) -> bool:
+        return r in (TriggerResult.FIRE, TriggerResult.FIRE_AND_PURGE)
+
+    @staticmethod
+    def is_purge(r: int) -> bool:
+        return r in (TriggerResult.PURGE, TriggerResult.FIRE_AND_PURGE)
+
+
+class TriggerContext(abc.ABC):
+    """What a trigger may do (ref: Trigger.TriggerContext): timers +
+    partitioned trigger state."""
+
+    @abc.abstractmethod
+    def register_event_time_timer(self, time: int) -> None: ...
+
+    @abc.abstractmethod
+    def register_processing_time_timer(self, time: int) -> None: ...
+
+    @abc.abstractmethod
+    def delete_event_time_timer(self, time: int) -> None: ...
+
+    @abc.abstractmethod
+    def delete_processing_time_timer(self, time: int) -> None: ...
+
+    @abc.abstractmethod
+    def get_current_watermark(self) -> int: ...
+
+    @abc.abstractmethod
+    def get_current_processing_time(self) -> int: ...
+
+    @abc.abstractmethod
+    def get_partitioned_state(self, descriptor): ...
+
+
+class Trigger(abc.ABC):
+    """(ref: Trigger.java)"""
+
+    def on_element(self, element, timestamp: int, window, ctx: TriggerContext) -> int:
+        return TriggerResult.CONTINUE
+
+    def on_event_time(self, time: int, window, ctx: TriggerContext) -> int:
+        return TriggerResult.CONTINUE
+
+    def on_processing_time(self, time: int, window, ctx: TriggerContext) -> int:
+        return TriggerResult.CONTINUE
+
+    def can_merge(self) -> bool:
+        return False
+
+    def on_merge(self, window, ctx) -> None:
+        raise NotImplementedError(f"{type(self).__name__} cannot merge")
+
+    def clear(self, window, ctx: TriggerContext) -> None:  # noqa: B027
+        pass
+
+
+class EventTimeTrigger(Trigger):
+    """FIRE when the watermark passes window.maxTimestamp
+    (ref: EventTimeTrigger.java)."""
+
+    def on_element(self, element, timestamp, window, ctx):
+        if window.max_timestamp() <= ctx.get_current_watermark():
+            return TriggerResult.FIRE  # late but in allowed lateness
+        ctx.register_event_time_timer(window.max_timestamp())
+        return TriggerResult.CONTINUE
+
+    def on_event_time(self, time, window, ctx):
+        return (TriggerResult.FIRE if time == window.max_timestamp()
+                else TriggerResult.CONTINUE)
+
+    def can_merge(self):
+        return True
+
+    def on_merge(self, window, ctx):
+        if window.max_timestamp() > ctx.get_current_watermark():
+            ctx.register_event_time_timer(window.max_timestamp())
+
+    def clear(self, window, ctx):
+        ctx.delete_event_time_timer(window.max_timestamp())
+
+    def __repr__(self):
+        return "EventTimeTrigger()"
+
+
+class ProcessingTimeTrigger(Trigger):
+    """(ref: ProcessingTimeTrigger.java)"""
+
+    def on_element(self, element, timestamp, window, ctx):
+        ctx.register_processing_time_timer(window.max_timestamp())
+        return TriggerResult.CONTINUE
+
+    def on_processing_time(self, time, window, ctx):
+        return TriggerResult.FIRE
+
+    def can_merge(self):
+        return True
+
+    def on_merge(self, window, ctx):
+        ctx.register_processing_time_timer(window.max_timestamp())
+
+    def clear(self, window, ctx):
+        ctx.delete_processing_time_timer(window.max_timestamp())
+
+    def __repr__(self):
+        return "ProcessingTimeTrigger()"
+
+
+class CountTrigger(Trigger):
+    """FIRE every `max_count` elements (ref: CountTrigger.java) —
+    per-(key, window) count kept in partitioned trigger state."""
+
+    def __init__(self, max_count: int):
+        self.max_count = max_count
+        from flink_tpu.core.state import ReducingStateDescriptor
+        self._desc = ReducingStateDescriptor(
+            "trigger-count", lambda a, b: a + b)
+
+    def on_element(self, element, timestamp, window, ctx):
+        count = ctx.get_partitioned_state(self._desc)
+        count.add(1)
+        if count.get() >= self.max_count:
+            count.clear()
+            return TriggerResult.FIRE
+        return TriggerResult.CONTINUE
+
+    def can_merge(self):
+        return True
+
+    def on_merge(self, window, ctx):
+        # fold merged windows' counts into the result window's count
+        # (ref: CountTrigger.onMerge → ctx.mergePartitionedState)
+        if hasattr(ctx, "merge_partitioned_state"):
+            ctx.merge_partitioned_state(self._desc)
+
+    def clear(self, window, ctx):
+        ctx.get_partitioned_state(self._desc).clear()
+
+    def __repr__(self):
+        return f"CountTrigger({self.max_count})"
+
+
+class PurgingTrigger(Trigger):
+    """Wraps a trigger, turning FIRE into FIRE_AND_PURGE
+    (ref: PurgingTrigger.java)."""
+
+    def __init__(self, inner: Trigger):
+        self.inner = inner
+
+    @staticmethod
+    def of(inner: Trigger) -> "PurgingTrigger":
+        return PurgingTrigger(inner)
+
+    def _wrap(self, r: int) -> int:
+        return TriggerResult.FIRE_AND_PURGE if TriggerResult.is_fire(r) else r
+
+    def on_element(self, element, timestamp, window, ctx):
+        return self._wrap(self.inner.on_element(element, timestamp, window, ctx))
+
+    def on_event_time(self, time, window, ctx):
+        return self._wrap(self.inner.on_event_time(time, window, ctx))
+
+    def on_processing_time(self, time, window, ctx):
+        return self._wrap(self.inner.on_processing_time(time, window, ctx))
+
+    def can_merge(self):
+        return self.inner.can_merge()
+
+    def on_merge(self, window, ctx):
+        self.inner.on_merge(window, ctx)
+
+    def clear(self, window, ctx):
+        self.inner.clear(window, ctx)
+
+    def __repr__(self):
+        return f"PurgingTrigger({self.inner!r})"
+
+
+class ContinuousEventTimeTrigger(Trigger):
+    """FIRE periodically in event time while the window is open
+    (ref: ContinuousEventTimeTrigger.java)."""
+
+    def __init__(self, interval):
+        self.interval = _ms(interval)
+        from flink_tpu.core.state import ReducingStateDescriptor
+        self._desc = ReducingStateDescriptor("fire-time", min)
+
+    def on_element(self, element, timestamp, window, ctx):
+        if window.max_timestamp() <= ctx.get_current_watermark():
+            return TriggerResult.FIRE
+        ctx.register_event_time_timer(window.max_timestamp())
+        fire = ctx.get_partitioned_state(self._desc)
+        if fire.get() is None:
+            start = timestamp - (timestamp % self.interval)
+            nxt = start + self.interval
+            ctx.register_event_time_timer(nxt)
+            fire.add(nxt)
+        return TriggerResult.CONTINUE
+
+    def on_event_time(self, time, window, ctx):
+        if time == window.max_timestamp():
+            return TriggerResult.FIRE
+        fire = ctx.get_partitioned_state(self._desc)
+        t = fire.get()
+        if t is not None and t == time:
+            fire.clear()
+            fire.add(time + self.interval)
+            ctx.register_event_time_timer(time + self.interval)
+            return TriggerResult.FIRE
+        return TriggerResult.CONTINUE
+
+    def can_merge(self):
+        return True
+
+    def on_merge(self, window, ctx):
+        if window.max_timestamp() > ctx.get_current_watermark():
+            ctx.register_event_time_timer(window.max_timestamp())
+
+    def clear(self, window, ctx):
+        fire = ctx.get_partitioned_state(self._desc)
+        t = fire.get()
+        if t is not None:
+            ctx.delete_event_time_timer(t)
+        fire.clear()
+
+
+class ContinuousProcessingTimeTrigger(Trigger):
+    """(ref: ContinuousProcessingTimeTrigger.java)"""
+
+    def __init__(self, interval):
+        self.interval = _ms(interval)
+        from flink_tpu.core.state import ReducingStateDescriptor
+        self._desc = ReducingStateDescriptor("fire-time-proc", min)
+
+    def on_element(self, element, timestamp, window, ctx):
+        now = ctx.get_current_processing_time()
+        fire = ctx.get_partitioned_state(self._desc)
+        if fire.get() is None:
+            start = now - (now % self.interval)
+            nxt = start + self.interval
+            ctx.register_processing_time_timer(nxt)
+            fire.add(nxt)
+        return TriggerResult.CONTINUE
+
+    def on_processing_time(self, time, window, ctx):
+        fire = ctx.get_partitioned_state(self._desc)
+        t = fire.get()
+        if t is not None and t == time:
+            fire.clear()
+            fire.add(time + self.interval)
+            ctx.register_processing_time_timer(time + self.interval)
+            return TriggerResult.FIRE
+        return TriggerResult.CONTINUE
+
+    def can_merge(self):
+        return True
+
+    def on_merge(self, window, ctx):
+        pass
+
+    def clear(self, window, ctx):
+        fire = ctx.get_partitioned_state(self._desc)
+        t = fire.get()
+        if t is not None:
+            ctx.delete_processing_time_timer(t)
+        fire.clear()
+
+
+class DeltaTrigger(Trigger):
+    """FIRE when delta(last_fired_element, current) > threshold
+    (ref: DeltaTrigger.java)."""
+
+    def __init__(self, threshold: float, delta_function: Callable[[Any, Any], float]):
+        self.threshold = threshold
+        self.delta_function = delta_function
+        from flink_tpu.core.state import ValueStateDescriptor
+        self._desc = ValueStateDescriptor("delta-last")
+
+    def on_element(self, element, timestamp, window, ctx):
+        last = ctx.get_partitioned_state(self._desc)
+        if last.value() is None:
+            last.update(element)
+            return TriggerResult.CONTINUE
+        if self.delta_function(last.value(), element) > self.threshold:
+            last.update(element)
+            return TriggerResult.FIRE
+        return TriggerResult.CONTINUE
+
+    def clear(self, window, ctx):
+        ctx.get_partitioned_state(self._desc).clear()
+
+
+# ---------------------------------------------------------------------
+# Window assigners (ref: api/windowing/assigners/)
+# ---------------------------------------------------------------------
+
+class WindowAssigner(abc.ABC):
+    @abc.abstractmethod
+    def assign_windows(self, element, timestamp: int, ctx) -> Iterable[Window]:
+        ...
+
+    @abc.abstractmethod
+    def get_default_trigger(self) -> Trigger:
+        ...
+
+    @abc.abstractmethod
+    def is_event_time(self) -> bool:
+        ...
+
+    def is_merging(self) -> bool:
+        return False
+
+    def window_type(self):
+        return TimeWindow
+
+
+class TumblingEventTimeWindows(WindowAssigner):
+    """(ref: TumblingEventTimeWindows.java)"""
+
+    def __init__(self, size, offset=0):
+        self.size = _ms(size)
+        self.offset = _ms(offset)
+        if not (0 <= self.offset < self.size):
+            raise ValueError("offset must satisfy 0 <= offset < size")
+
+    @staticmethod
+    def of(size, offset=0) -> "TumblingEventTimeWindows":
+        return TumblingEventTimeWindows(size, offset)
+
+    def assign_windows(self, element, timestamp, ctx):
+        if timestamp is None:
+            raise ValueError(
+                "record has no timestamp — event-time windowing requires "
+                "timestamp assignment (assign_timestamps_and_watermarks)")
+        start = TimeWindow.get_window_start_with_offset(timestamp, self.offset, self.size)
+        return [TimeWindow(start, start + self.size)]
+
+    def get_default_trigger(self):
+        return EventTimeTrigger()
+
+    def is_event_time(self):
+        return True
+
+    def __repr__(self):
+        return f"TumblingEventTimeWindows({self.size})"
+
+
+class TumblingProcessingTimeWindows(WindowAssigner):
+    """(ref: TumblingProcessingTimeWindows.java)"""
+
+    def __init__(self, size, offset=0):
+        self.size = _ms(size)
+        self.offset = _ms(offset)
+
+    @staticmethod
+    def of(size, offset=0) -> "TumblingProcessingTimeWindows":
+        return TumblingProcessingTimeWindows(size, offset)
+
+    def assign_windows(self, element, timestamp, ctx):
+        now = ctx.get_current_processing_time()
+        start = TimeWindow.get_window_start_with_offset(now, self.offset, self.size)
+        return [TimeWindow(start, start + self.size)]
+
+    def get_default_trigger(self):
+        return ProcessingTimeTrigger()
+
+    def is_event_time(self):
+        return False
+
+
+class SlidingEventTimeWindows(WindowAssigner):
+    """(ref: SlidingEventTimeWindows.java)"""
+
+    def __init__(self, size, slide, offset=0):
+        self.size = _ms(size)
+        self.slide = _ms(slide)
+        self.offset = _ms(offset)
+
+    @staticmethod
+    def of(size, slide, offset=0) -> "SlidingEventTimeWindows":
+        return SlidingEventTimeWindows(size, slide, offset)
+
+    def assign_windows(self, element, timestamp, ctx):
+        if timestamp is None:
+            raise ValueError("record has no timestamp for event-time windowing")
+        windows = []
+        last_start = TimeWindow.get_window_start_with_offset(
+            timestamp, self.offset, self.slide)
+        start = last_start
+        while start > timestamp - self.size:
+            windows.append(TimeWindow(start, start + self.size))
+            start -= self.slide
+        return windows
+
+    def get_default_trigger(self):
+        return EventTimeTrigger()
+
+    def is_event_time(self):
+        return True
+
+    def __repr__(self):
+        return f"SlidingEventTimeWindows({self.size}/{self.slide})"
+
+
+class SlidingProcessingTimeWindows(WindowAssigner):
+    """(ref: SlidingProcessingTimeWindows.java)"""
+
+    def __init__(self, size, slide, offset=0):
+        self.size = _ms(size)
+        self.slide = _ms(slide)
+        self.offset = _ms(offset)
+
+    @staticmethod
+    def of(size, slide, offset=0) -> "SlidingProcessingTimeWindows":
+        return SlidingProcessingTimeWindows(size, slide, offset)
+
+    def assign_windows(self, element, timestamp, ctx):
+        now = ctx.get_current_processing_time()
+        windows = []
+        last_start = TimeWindow.get_window_start_with_offset(now, self.offset, self.slide)
+        start = last_start
+        while start > now - self.size:
+            windows.append(TimeWindow(start, start + self.size))
+            start -= self.slide
+        return windows
+
+    def get_default_trigger(self):
+        return ProcessingTimeTrigger()
+
+    def is_event_time(self):
+        return False
+
+
+class _SessionWindowsBase(WindowAssigner):
+    def is_merging(self):
+        return True
+
+
+class EventTimeSessionWindows(_SessionWindowsBase):
+    """(ref: EventTimeSessionWindows.java)"""
+
+    def __init__(self, gap):
+        self.gap = _ms(gap)
+
+    @staticmethod
+    def with_gap(gap) -> "EventTimeSessionWindows":
+        return EventTimeSessionWindows(gap)
+
+    def assign_windows(self, element, timestamp, ctx):
+        if timestamp is None:
+            raise ValueError("record has no timestamp for event-time windowing")
+        return [TimeWindow(timestamp, timestamp + self.gap)]
+
+    def get_default_trigger(self):
+        return EventTimeTrigger()
+
+    def is_event_time(self):
+        return True
+
+
+class ProcessingTimeSessionWindows(_SessionWindowsBase):
+    """(ref: ProcessingTimeSessionWindows.java)"""
+
+    def __init__(self, gap):
+        self.gap = _ms(gap)
+
+    @staticmethod
+    def with_gap(gap) -> "ProcessingTimeSessionWindows":
+        return ProcessingTimeSessionWindows(gap)
+
+    def assign_windows(self, element, timestamp, ctx):
+        now = ctx.get_current_processing_time()
+        return [TimeWindow(now, now + self.gap)]
+
+    def get_default_trigger(self):
+        return ProcessingTimeTrigger()
+
+    def is_event_time(self):
+        return False
+
+
+class DynamicEventTimeSessionWindows(_SessionWindowsBase):
+    """Per-element gap (ref: DynamicEventTimeSessionWindows.java +
+    SessionWindowTimeGapExtractor)."""
+
+    def __init__(self, gap_extractor: Callable[[Any], int]):
+        self.gap_extractor = gap_extractor
+
+    @staticmethod
+    def with_dynamic_gap(extractor) -> "DynamicEventTimeSessionWindows":
+        return DynamicEventTimeSessionWindows(extractor)
+
+    def assign_windows(self, element, timestamp, ctx):
+        gap = self.gap_extractor(element)
+        if gap <= 0:
+            raise ValueError("session gap must be positive")
+        return [TimeWindow(timestamp, timestamp + gap)]
+
+    def get_default_trigger(self):
+        return EventTimeTrigger()
+
+    def is_event_time(self):
+        return True
+
+
+class DynamicProcessingTimeSessionWindows(_SessionWindowsBase):
+    """(ref: DynamicProcessingTimeSessionWindows.java)"""
+
+    def __init__(self, gap_extractor: Callable[[Any], int]):
+        self.gap_extractor = gap_extractor
+
+    @staticmethod
+    def with_dynamic_gap(extractor) -> "DynamicProcessingTimeSessionWindows":
+        return DynamicProcessingTimeSessionWindows(extractor)
+
+    def assign_windows(self, element, timestamp, ctx):
+        now = ctx.get_current_processing_time()
+        gap = self.gap_extractor(element)
+        if gap <= 0:
+            raise ValueError("session gap must be positive")
+        return [TimeWindow(now, now + gap)]
+
+    def get_default_trigger(self):
+        return ProcessingTimeTrigger()
+
+    def is_event_time(self):
+        return False
+
+
+class GlobalWindows(WindowAssigner):
+    """Everything into one window; fires only with an explicit trigger
+    (ref: GlobalWindows.java — default NeverTrigger)."""
+
+    class NeverTrigger(Trigger):
+        def can_merge(self):
+            return True
+
+        def on_merge(self, window, ctx):
+            pass
+
+    @staticmethod
+    def create() -> "GlobalWindows":
+        return GlobalWindows()
+
+    def assign_windows(self, element, timestamp, ctx):
+        return [GlobalWindow()]
+
+    def get_default_trigger(self):
+        return GlobalWindows.NeverTrigger()
+
+    def is_event_time(self):
+        return False
+
+    def window_type(self):
+        return GlobalWindow
+
+
+# ---------------------------------------------------------------------
+# Evictors (ref: api/windowing/evictors/)
+# ---------------------------------------------------------------------
+
+class Evictor(abc.ABC):
+    """Operates on the raw element buffer of an EvictingWindowOperator.
+    Elements are (timestamp, value) pairs."""
+
+    @abc.abstractmethod
+    def evict_before(self, elements: List[Tuple[int, Any]], size: int,
+                     window, current_time: int) -> List[Tuple[int, Any]]:
+        ...
+
+    def evict_after(self, elements: List[Tuple[int, Any]], size: int,
+                    window, current_time: int) -> List[Tuple[int, Any]]:
+        return elements
+
+
+class CountEvictor(Evictor):
+    """Keep only the last `max_count` elements (ref: CountEvictor.java)."""
+
+    def __init__(self, max_count: int):
+        self.max_count = max_count
+
+    @staticmethod
+    def of(max_count: int) -> "CountEvictor":
+        return CountEvictor(max_count)
+
+    def evict_before(self, elements, size, window, current_time):
+        if size <= self.max_count:
+            return elements
+        return elements[size - self.max_count:]
+
+
+class TimeEvictor(Evictor):
+    """Keep only elements within `window_size` of the max timestamp
+    (ref: TimeEvictor.java)."""
+
+    def __init__(self, window_size):
+        self.window_size = _ms(window_size)
+
+    @staticmethod
+    def of(window_size) -> "TimeEvictor":
+        return TimeEvictor(window_size)
+
+    def evict_before(self, elements, size, window, current_time):
+        if not elements:
+            return elements
+        has_ts = any(ts is not None for ts, _ in elements)
+        if not has_ts:
+            return elements
+        max_ts = max(ts for ts, _ in elements if ts is not None)
+        cutoff = max_ts - self.window_size
+        return [(ts, v) for ts, v in elements if ts is None or ts > cutoff]
+
+
+class DeltaEvictor(Evictor):
+    """Evict elements whose delta to the newest exceeds threshold
+    (ref: DeltaEvictor.java)."""
+
+    def __init__(self, threshold: float, delta_function: Callable[[Any, Any], float]):
+        self.threshold = threshold
+        self.delta_function = delta_function
+
+    @staticmethod
+    def of(threshold, delta_function) -> "DeltaEvictor":
+        return DeltaEvictor(threshold, delta_function)
+
+    def evict_before(self, elements, size, window, current_time):
+        if not elements:
+            return elements
+        newest = elements[-1][1]
+        return [(ts, v) for ts, v in elements
+                if self.delta_function(v, newest) < self.threshold]

@@ -1,0 +1,411 @@
+"""End-to-end DataStream API tests on the local executor.
+
+Covers the reference's API surface contract (SURVEY.md §2.9) including
+the baseline config #1 shape: flatMap → keyBy → timeWindow → reduce
+(SocketWindowWordCount.java:70-84, driven from a collection instead of
+a socket).
+"""
+
+import numpy as np
+import pytest
+
+from flink_tpu.core.config import Configuration
+from flink_tpu.ops.device_agg import SumAggregate
+from flink_tpu.ops.sketches import HyperLogLogAggregate
+from flink_tpu.streaming.datastream import StreamExecutionEnvironment
+from flink_tpu.streaming.graph import create_job_graph
+from flink_tpu.streaming.operators import ProcessFunction
+from flink_tpu.streaming.sources import (
+    AscendingTimestampExtractor,
+    BoundedOutOfOrdernessTimestampExtractor,
+)
+from flink_tpu.streaming.windowing import (
+    EventTimeSessionWindows,
+    Time,
+    TimeWindow,
+    TumblingEventTimeWindows,
+)
+
+BACKENDS = ["heap", "tpu"]
+
+
+def make_env(backend="heap", parallelism=1):
+    env = StreamExecutionEnvironment()
+    env.set_state_backend(backend)
+    env.set_parallelism(parallelism)
+    return env
+
+
+def test_map_filter_flatmap():
+    env = make_env()
+    out = []
+    (env.from_collection([1, 2, 3, 4, 5])
+        .map(lambda x: x * 10)
+        .filter(lambda x: x >= 30)
+        .flat_map(lambda x: [x, x + 1])
+        .collect_into(out))
+    env.execute("basic")
+    assert out == [30, 31, 40, 41, 50, 51]
+
+
+def test_keyed_rolling_sum():
+    env = make_env()
+    out = []
+    (env.from_collection([("a", 1), ("a", 2), ("b", 5), ("a", 3)])
+        .key_by(lambda t: t[0])
+        .sum(1)
+        .collect_into(out))
+    env.execute()
+    assert out == [("a", 1), ("a", 3), ("b", 5), ("a", 6)]
+
+
+def test_rolling_reduce_min_max():
+    env = make_env()
+    mins = []
+    s = env.from_collection([("k", 5), ("k", 3), ("k", 7)]).key_by(lambda t: t[0])
+    s.min(1).collect_into(mins)
+    env.execute()
+    assert mins == [("k", 5), ("k", 3), ("k", 3)]
+
+
+@pytest.mark.parametrize("backend", BACKENDS)
+def test_window_word_count(backend):
+    """Baseline config #1: flatMap → keyBy → timeWindow(5s) → reduce."""
+    lines = [
+        ("hello world", 1000),
+        ("hello flink", 2000),
+        ("world", 6000),
+    ]
+    env = make_env(backend)
+    out = []
+    (env.from_collection(lines, timestamped=True)
+        .flat_map(lambda line: [(w, 1) for w in line.split()])
+        .key_by(lambda t: t[0])
+        .time_window(Time.seconds(5))
+        .reduce(lambda a, b: (a[0], a[1] + b[1]))
+        .collect_into(out))
+    env.execute("word_count")
+    assert sorted(out) == [("flink", 1), ("hello", 2), ("world", 1), ("world", 1)]
+
+
+@pytest.mark.parametrize("backend", BACKENDS)
+def test_window_aggregate_device_sum(backend):
+    class TupleSum(SumAggregate):
+        def extract_value(self, value):
+            return value[1] if isinstance(value, tuple) else value
+
+    env = make_env(backend)
+    out = []
+
+    def emit(key, window, elements):
+        for v in elements:
+            yield (key, float(v))
+
+    (env.from_collection(
+        [(("a", 1.0), 0), (("a", 2.0), 500), (("b", 4.0), 700)],
+        timestamped=True)
+        .key_by(lambda t: t[0])
+        .time_window(Time.seconds(1))
+        .aggregate(TupleSum(), window_function=emit)
+        .collect_into(out))
+    env.execute()
+    assert sorted(out) == [("a", 3.0), ("b", 4.0)]
+
+
+@pytest.mark.parametrize("backend", BACKENDS)
+def test_window_hll_count_distinct(backend):
+    """North-star shape: tumbling window HLL COUNT DISTINCT."""
+    class UserHLL(HyperLogLogAggregate):
+        def extract_value(self, value):
+            return value[1]
+
+    events = [((f"page{i % 3}", f"user{i}"), i) for i in range(300)]
+    env = make_env(backend)
+    out = []
+
+    def emit(key, window, elements):
+        for v in elements:
+            yield (key, float(v))
+
+    (env.from_collection(events, timestamped=True)
+        .key_by(lambda t: t[0])
+        .time_window(Time.seconds(1))
+        .aggregate(UserHLL(precision=10), window_function=emit)
+        .collect_into(out))
+    env.execute()
+    assert len(out) == 3
+    for _, est in out:
+        assert abs(est - 100) / 100 < 0.15
+
+
+def test_session_window_end_to_end():
+    env = make_env()
+    out = []
+    (env.from_collection(
+        [(("s", 1), 0), (("s", 2), 500), (("s", 10), 5000)], timestamped=True)
+        .key_by(lambda t: t[0])
+        .window(EventTimeSessionWindows.with_gap(Time.seconds(1)))
+        .reduce(lambda a, b: (a[0], a[1] + b[1]))
+        .collect_into(out))
+    env.execute()
+    assert sorted(out) == [("s", 3), ("s", 10)]
+
+
+def test_union():
+    env = make_env()
+    out = []
+    a = env.from_collection([1, 2])
+    b = env.from_collection([3, 4])
+    a.union(b).map(lambda x: x * 2).collect_into(out)
+    env.execute()
+    assert sorted(out) == [2, 4, 6, 8]
+
+
+def test_connect_comap():
+    from flink_tpu.core.functions import CoMapFunction
+
+    class Tag(CoMapFunction):
+        def map1(self, v):
+            return ("left", v)
+
+        def map2(self, v):
+            return ("right", v)
+
+    env = make_env()
+    out = []
+    a = env.from_collection([1])
+    b = env.from_collection(["x"])
+    a.connect(b).map(Tag()).collect_into(out)
+    env.execute()
+    assert sorted(out, key=str) == [("left", 1), ("right", "x")]
+
+
+def test_keyed_process_function_with_timers():
+    class Waiter(ProcessFunction):
+        def process_element(self, value, ctx, out):
+            ctx.register_event_time_timer(value[1] + 100)
+
+        def on_timer(self, timestamp, ctx, out):
+            out.collect((ctx.get_current_key(), timestamp))
+
+    env = make_env()
+    out = []
+    (env.from_collection([(("k", 500), 500)], timestamped=True)
+        .key_by(lambda t: t[0][0] if isinstance(t[0], tuple) else t[0])
+        .process(Waiter())
+        .collect_into(out))
+    env.execute()
+    assert out == [("k", 600)]
+
+
+def test_parallel_keyed_window():
+    """Parallelism 2: keyBy routes each key to exactly one subtask."""
+    env = make_env(parallelism=2)
+    out = []
+    events = [((f"k{i % 5}", 1), i * 10) for i in range(50)]
+    (env.from_collection(events, timestamped=True)
+        .flat_map(lambda t: [t])
+        .key_by(lambda t: t[0])
+        .time_window(Time.seconds(10))
+        .reduce(lambda a, b: (a[0], a[1] + b[1]))
+        .set_parallelism(2)
+        .collect_into(out))
+    env.execute()
+    assert sorted(out) == [(f"k{i}", 10) for i in range(5)]
+
+
+def test_timestamp_assignment_bounded_out_of_orderness():
+    env = make_env()
+    out = []
+    (env.from_collection([("k", 1000), ("k", 3000), ("k", 2000), ("k", 8000)])
+        .assign_timestamps_and_watermarks(
+            BoundedOutOfOrdernessTimestampExtractor(1500, lambda t: t[1]))
+        .key_by(lambda t: t[0])
+        .time_window(Time.seconds(5))
+        .reduce(lambda a, b: (a[0], a[1] + b[1]))
+        .collect_into(out))
+    env.execute()
+    assert sorted(out) == [("k", 6000), ("k", 8000)]
+
+
+def test_rebalance_broadcast_global():
+    env = make_env()
+    out = []
+    env.from_collection([1, 2, 3, 4]).rebalance().map(lambda x: x).set_parallelism(2) \
+       .global_().map(lambda x: x).collect_into(out)
+    env.execute()
+    assert sorted(out) == [1, 2, 3, 4]
+
+    env2 = make_env()
+    out2 = []
+    env2.from_collection([7]).broadcast().map(lambda x: x).set_parallelism(3) \
+        .collect_into(out2)
+    env2.execute()
+    assert out2 == [7, 7, 7]
+
+
+def test_chaining_in_job_graph():
+    env = make_env()
+    out = []
+    (env.from_collection([1]).map(lambda x: x).filter(lambda x: True)
+        .collect_into(out))
+    jg = create_job_graph(env.get_stream_graph())
+    # source -> map -> filter -> sink all chain into ONE vertex
+    assert len(jg.vertices) == 1
+    assert len(jg.edges) == 0
+    env.execute()
+    assert out == [1]
+
+
+def test_keyby_breaks_chain():
+    env = make_env()
+    out = []
+    (env.from_collection([("a", 1)]).key_by(lambda t: t[0]).sum(1)
+        .collect_into(out))
+    jg = create_job_graph(env.get_stream_graph())
+    assert len(jg.vertices) == 2  # source | keyed-sum -> sink
+    env.execute()
+    assert out == [("a", 1)]
+
+
+def test_side_output_late_data_end_to_end():
+    from flink_tpu.streaming.operators import OutputTag
+    # covered at operator level in test_window_operator; API wiring of
+    # side outputs across edges lands with the side_output() API
+    assert OutputTag("x") == OutputTag("x")
+
+
+def test_count_window():
+    env = make_env()
+    out = []
+    (env.from_collection([("c", i) for i in range(7)])
+        .key_by(lambda t: t[0])
+        .count_window(3)
+        .reduce(lambda a, b: (a[0], a[1] + b[1]))
+        .collect_into(out))
+    env.execute()
+    # windows of 3: (0+1+2)=3, (3+4+5)=12; trailing 6 never fires
+    assert out == [("c", 3), ("c", 12)]
+
+
+def test_window_all():
+    env = make_env()
+    out = []
+    (env.from_collection([(i, 100 * i) for i in range(4)], timestamped=True)
+        .window_all(TumblingEventTimeWindows.of(Time.seconds(1)))
+        .reduce(lambda a, b: a + b)
+        .collect_into(out))
+    env.execute()
+    assert out == [0 + 1 + 2 + 3]
+
+
+def test_queryable_state_registration():
+    env = make_env()
+    (env.from_collection([("q", 1), ("q", 2)])
+        .key_by(lambda t: t[0])
+        .as_queryable_state("latest"))
+    env.execute()
+    # registration is exercised; external query path in queryable-state tests
+
+
+# ---------------------------------------------------------------------
+# regression tests for review findings
+# ---------------------------------------------------------------------
+
+def test_count_window_with_slide_aggregates():
+    """Evictor path must still apply the reduce function (not emit raw
+    element lists)."""
+    env = make_env()
+    out = []
+    (env.from_collection([("k", 1), ("k", 2), ("k", 3), ("k", 4)])
+        .key_by(lambda t: t[0])
+        .count_window(2, 2)
+        .sum(1)
+        .collect_into(out))
+    env.execute()
+    assert out == [("k", 3), ("k", 7)]
+
+
+def test_processing_time_windows_flush_at_end():
+    env = make_env()
+    env.set_stream_time_characteristic("processing")
+    out = []
+    (env.from_collection([("p", 1), ("p", 2)])
+        .key_by(lambda t: t[0])
+        .time_window(Time.seconds(5))
+        .sum(1)
+        .collect_into(out))
+    env.execute()
+    assert out == [("p", 3)]
+
+
+def test_side_output_flows_through_pipeline():
+    from flink_tpu.streaming.operators import OutputTag
+    tag = OutputTag("late")
+    env = make_env()
+    main, late = [], []
+    wins = (env.from_collection(
+        [(("k", 1), 1000), (("k", 2), 9000), (("k", 99), 1500)],
+        timestamped=True)
+        .key_by(lambda t: t[0])
+        .time_window(Time.seconds(5))
+        .side_output_late_data(tag)
+        .reduce(lambda a, b: (a[0], a[1] + b[1])))
+    wins.collect_into(main)
+    wins.get_side_output(tag).collect_into(late)
+    env.execute()
+    # watermark jumps to 8999 via the 9000 record? no watermark until
+    # MAX at end — the ("k",99)@1500 record is NOT late here because
+    # watermarks only advance at end of input; so late list is empty
+    # and all records aggregate normally
+    assert sorted(main) == [("k", 2), ("k", 100)]
+    assert late == []
+
+
+def test_side_output_late_data_with_watermark_assigner():
+    from flink_tpu.streaming.operators import OutputTag
+    tag = OutputTag("late2")
+    env = make_env()
+    main, late = [], []
+    wins = (env.from_collection([("k", 1000), ("k", 9000), ("k", 1500)])
+        .assign_timestamps_and_watermarks(
+            AscendingTimestampExtractor(lambda t: t[1]))
+        .key_by(lambda t: t[0])
+        .time_window(Time.seconds(5))
+        .side_output_late_data(tag)
+        .reduce(lambda a, b: (a[0], a[1] + b[1])))
+    wins.collect_into(main)
+    wins.get_side_output(tag).collect_into(late)
+    env.execute()
+    # ascending extractor pushes watermark to 8999 after the 9000
+    # record; the 1500 record then lands behind the fired [0,5000)
+    assert sorted(main) == [("k", 1000), ("k", 9000)]
+    assert [v for v in late] == [("k", 1500)]
+
+
+def test_forward_edge_parallel_not_funneled():
+    env = make_env()
+    out = []
+    (env.from_collection([1, 2, 3, 4, 5, 6])
+        .rebalance()
+        .map(lambda x: x).set_parallelism(2).disable_chaining()
+        .map(lambda x: x).set_parallelism(2).disable_chaining()
+        .collect_into(out))
+    env.execute()
+    assert sorted(out) == [1, 2, 3, 4, 5, 6]
+
+
+def test_session_count_trigger_fires_across_merges():
+    from flink_tpu.streaming.windowing import CountTrigger, EventTimeSessionWindows
+    env = make_env()
+    out = []
+    (env.from_collection(
+        [(("k", i), i * 10) for i in range(1, 5)], timestamped=True)
+        .key_by(lambda t: t[0])
+        .window(EventTimeSessionWindows.with_gap(Time.milliseconds_of(100)))
+        .trigger(CountTrigger(2))
+        .reduce(lambda a, b: (a[0], a[1] + b[1]))
+        .collect_into(out))
+    env.execute()
+    # counts survive merges: fires at the 2nd and 4th element
+    assert out == [("k", 3), ("k", 10)]
