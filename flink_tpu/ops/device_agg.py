@@ -63,6 +63,13 @@ class DeviceAggregateFunction(AggregateFunction):
         so the device batch carries plain numerics."""
         return value
 
+    def compress_value_hash(self, vh_hi: np.ndarray, vh_lo: np.ndarray):
+        """Optionally shrink the per-record value-hash lanes on the
+        host before transfer (e.g. HLL needs only register + rank, 3
+        bytes instead of 8).  Whatever this returns is what update()
+        receives as (vh_hi, vh_lo); default is identity."""
+        return vh_hi, vh_lo
+
     @abc.abstractmethod
     def state_specs(self) -> Dict[str, StateSpec]:
         ...

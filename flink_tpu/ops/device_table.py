@@ -1,0 +1,167 @@
+"""Device-resident hash table: batched insert-or-lookup in HBM.
+
+The fully on-device replacement for the role RocksDB's memtable plays
+in the reference's keyed backend (RocksDBKeyedStateBackend.java —
+per-record JNI get/put): a linear-probing open-addressing table whose
+keys are 64-bit hashes stored as (hi, lo) uint32 lanes, with batched
+insert-or-lookup that resolves an entire micro-batch inside one jit
+region.  Slot = table position, so the table IS the slot allocator:
+state arrays are addressed by the same position.
+
+Batch insertion resolves intra-batch races with a claim round: all
+unresolved records scatter-min their record index into a claim array at
+their probe position; winners write their key, losers (and duplicates
+of a just-inserted key) re-check the same position next round and
+either match it or advance their probe.  Convergence: each round every
+contended position resolves at least its winner, and probes advance at
+most `max_probes` times; keep load factor <= 0.7.
+
+This is jit/shard_map-safe: static shapes, lax.while_loop control flow,
+no host round trips — so the keyBy exchange + state update of the
+multi-chip path runs as ONE compiled SPMD program per micro-batch.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from flink_tpu.ops.hashing import fmix32
+
+
+class DeviceHashTable(NamedTuple):
+    """Table arrays: key lanes + occupancy. capacity is static."""
+    key_hi: jnp.ndarray   # [C] uint32
+    key_lo: jnp.ndarray   # [C] uint32
+    occupied: jnp.ndarray  # [C] bool
+
+
+def make_table(capacity: int) -> DeviceHashTable:
+    return DeviceHashTable(
+        key_hi=jnp.zeros(capacity, jnp.uint32),
+        key_lo=jnp.zeros(capacity, jnp.uint32),
+        occupied=jnp.zeros(capacity, bool),
+    )
+
+
+class _InsertState(NamedTuple):
+    table: DeviceHashTable
+    probe: jnp.ndarray      # [N] int32 current probe offset
+    slots: jnp.ndarray      # [N] int32 resolved position (or -1)
+    resolved: jnp.ndarray   # [N] bool
+    round_: jnp.ndarray     # scalar int32
+
+
+def _probe_pos(h_hi, h_lo, probe, capacity):
+    base = fmix32(h_lo ^ (h_hi * jnp.uint32(0x9E3779B9)))
+    return ((base + probe.astype(jnp.uint32))
+            % jnp.uint32(capacity)).astype(jnp.int32)
+
+
+def insert_or_lookup_impl(
+    table: DeviceHashTable,
+    h_hi: jnp.ndarray,   # [N] uint32
+    h_lo: jnp.ndarray,   # [N] uint32
+    mask: jnp.ndarray,   # [N] bool (False = padding)
+    max_probes: int = 64,
+) -> Tuple[DeviceHashTable, jnp.ndarray, jnp.ndarray]:
+    """Traceable body of insert_or_lookup — call inside a larger jit
+    region to fuse table resolution with the state update (slots never
+    leave the device).  Returns (table, slots[N] int32, ok[N] bool);
+    ok=False means the probe limit was hit (table overfull) — callers
+    treat that as a resize signal."""
+    n = h_hi.shape[0]
+    capacity = table.key_hi.shape[0]
+    idx = jnp.arange(n, dtype=jnp.int32)
+    sentinel = jnp.int32(n)
+
+    def cond(s: _InsertState):
+        busy = ~s.resolved & mask
+        return jnp.logical_and(busy.any(), s.round_ < max_probes)
+
+    def body(s: _InsertState):
+        pos = _probe_pos(h_hi, h_lo, s.probe, capacity)
+        active = ~s.resolved & mask
+        cur_hi = s.table.key_hi[pos]
+        cur_lo = s.table.key_lo[pos]
+        occ = s.table.occupied[pos]
+        match = active & occ & (cur_hi == h_hi) & (cur_lo == h_lo)
+        # claim empty positions: lowest record index wins
+        want_claim = active & ~occ
+        claim = jnp.full(capacity, sentinel, jnp.int32).at[pos].min(
+            jnp.where(want_claim, idx, sentinel))
+        won = want_claim & (claim[pos] == idx)
+        new_table = DeviceHashTable(
+            key_hi=s.table.key_hi.at[jnp.where(won, pos, capacity)].set(
+                h_hi, mode="drop"),
+            key_lo=s.table.key_lo.at[jnp.where(won, pos, capacity)].set(
+                h_lo, mode="drop"),
+            occupied=s.table.occupied.at[jnp.where(won, pos, capacity)].set(
+                True, mode="drop"),
+        )
+        resolved_now = match | won
+        slots = jnp.where(resolved_now, pos, s.slots)
+        # advance probe only if position is occupied by a DIFFERENT key
+        # (losers of the claim and duplicates re-check the same slot)
+        collide = active & occ & ~match
+        probe = s.probe + jnp.where(collide, 1, 0)
+        return _InsertState(new_table, probe, slots,
+                            s.resolved | resolved_now, s.round_ + 1)
+
+    # derive the init carry from the inputs (not fresh constants) so
+    # its axis-varying type matches the body outputs under shard_map
+    zero = (h_hi ^ h_hi).astype(jnp.int32)
+    init = _InsertState(
+        table=table,
+        probe=zero,
+        slots=zero - 1,
+        resolved=zero != 0,
+        round_=jnp.int32(0),
+    )
+    final = jax.lax.while_loop(cond, body, init)
+    ok = final.resolved | ~mask
+    return final.table, final.slots, ok
+
+
+insert_or_lookup = partial(jax.jit, static_argnames=("max_probes",),
+                           donate_argnums=0)(insert_or_lookup_impl)
+
+
+@partial(jax.jit, donate_argnums=0)
+def clear_entries(table: DeviceHashTable, slots: jnp.ndarray) -> DeviceHashTable:
+    """Free table positions (window fired).  Linear probing requires
+    tombstone-free deletion in general; here windows clear their WHOLE
+    shard (separate tables per window), so full clears are the common
+    case and point deletes mark unoccupied (acceptable because the
+    probe chain re-inserts on next touch)."""
+    return DeviceHashTable(
+        key_hi=table.key_hi,
+        key_lo=table.key_lo,
+        occupied=table.occupied.at[slots].set(False),
+    )
+
+
+def lookup_np(table: DeviceHashTable, h64: np.ndarray, max_probes: int = 64):
+    """Host-side lookup twin for tests."""
+    hi = (h64 >> np.uint64(32)).astype(np.uint32)
+    lo = (h64 & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+    t_hi = np.asarray(table.key_hi)
+    t_lo = np.asarray(table.key_lo)
+    occ = np.asarray(table.occupied)
+    capacity = len(t_hi)
+    out = np.full(len(h64), -1, np.int64)
+    for i, (a, b) in enumerate(zip(hi, lo)):
+        base = int(np.asarray(fmix32(
+            jnp.uint32(int(b)) ^ (jnp.uint32(int(a)) * jnp.uint32(0x9E3779B9)))))
+        for p in range(max_probes):
+            pos = (base + p) % capacity
+            if not occ[pos]:
+                break
+            if t_hi[pos] == a and t_lo[pos] == b:
+                out[i] = pos
+                break
+    return out

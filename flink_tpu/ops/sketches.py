@@ -59,13 +59,34 @@ class HyperLogLogAggregate(DeviceAggregateFunction):
     def state_specs(self) -> Dict[str, StateSpec]:
         return {"regs": StateSpec((self.m,), np.dtype(np.uint8), 0)}
 
+    def compress_value_hash(self, vh_hi, vh_lo):
+        """Host-side precompute: ship (rank uint8, register uint16)
+        instead of the 8-byte hash — 2.7x less ingest bandwidth.
+        floor(log2) on float64 is exact for uint32 inputs."""
+        hi = np.asarray(vh_hi, np.uint32)
+        lo = np.asarray(vh_lo, np.uint32)
+        x = hi.astype(np.float64)
+        clz = np.where(hi == 0, 32,
+                       31 - np.floor(np.log2(np.maximum(x, 1.0))).astype(np.int64))
+        rank = (clz + 1).astype(np.uint8)
+        # uint16 covers precision <= 16; larger register files need the
+        # full 32-bit index
+        reg_dtype = np.uint16 if self.precision <= 16 else np.uint32
+        reg = (lo & np.uint32(self.m - 1)).astype(reg_dtype)
+        return rank, reg
+
     def update(self, state, slots, values, vh_hi, vh_lo, mask):
-        reg, rank = hll_register_and_rank(vh_hi, vh_lo, self.precision)
+        if vh_hi.dtype == jnp.uint8:
+            # pre-compressed on host: vh_hi = rank, vh_lo = register
+            rank = vh_hi.astype(jnp.int32)
+            reg = vh_lo.astype(jnp.int32)
+        else:
+            reg, rank = hll_register_and_rank(vh_hi, vh_lo, self.precision)
         rank = jnp.where(mask, rank, 0).astype(jnp.uint8)
-        flat = state["regs"].reshape(-1)
-        idx = slots.astype(jnp.int32) * self.m + reg
-        flat = flat.at[idx].max(rank)
-        return {**state, "regs": flat.reshape(state["regs"].shape)}
+        # 2-d scatter-max: no flattened index, so capacity*m may exceed
+        # int32 range (TPU indices are per-dimension 32-bit)
+        return {**state,
+                "regs": state["regs"].at[slots.astype(jnp.int32), reg].max(rank)}
 
     def result(self, state, slots):
         regs = state["regs"][slots].astype(jnp.float32)        # [S, m]
@@ -106,13 +127,12 @@ class CountMinSketchAggregate(DeviceAggregateFunction):
     def update(self, state, slots, values, vh_hi, vh_lo, mask):
         w = jnp.where(mask, values.astype(jnp.int32), 0)           # [N]
         cols = countmin_rows(vh_hi, vh_lo, self.depth, self.width)  # [d, N]
-        flat = state["table"].reshape(-1)
-        base = slots.astype(jnp.int32)[None, :] * (self.depth * self.width)
-        rows = jnp.arange(self.depth, dtype=jnp.int32)[:, None] * self.width
-        idx = (base + rows + cols).reshape(-1)
-        flat = flat.at[idx].add(jnp.broadcast_to(w[None, :], cols.shape).reshape(-1))
+        slots_b = jnp.broadcast_to(slots.astype(jnp.int32)[None, :], cols.shape)
+        rows_b = jnp.broadcast_to(
+            jnp.arange(self.depth, dtype=jnp.int32)[:, None], cols.shape)
+        w_b = jnp.broadcast_to(w[None, :], cols.shape)
         return {**state,
-                "table": flat.reshape(state["table"].shape),
+                "table": state["table"].at[slots_b, rows_b, cols].add(w_b),
                 "total": state["total"].at[slots].add(w)}
 
     def result(self, state, slots):
@@ -169,10 +189,11 @@ class QuantileSketchAggregate(DeviceAggregateFunction):
 
     def update(self, state, slots, values, vh_hi, vh_lo, mask):
         b = self._bucket_of(values)
-        idx = slots.astype(jnp.int32) * self.buckets + b
-        flat = state["hist"].reshape(-1)
-        flat = flat.at[idx].add(mask.astype(jnp.int32))
-        return {**state, "hist": flat.reshape(state["hist"].shape)}
+        # 2-d scatter: no flattened index, so capacity*buckets may
+        # exceed int32 range (same rationale as the HLL kernel)
+        return {**state,
+                "hist": state["hist"].at[slots.astype(jnp.int32), b].add(
+                    mask.astype(jnp.int32))}
 
     def result(self, state, slots):
         hist = state["hist"][slots].astype(jnp.float32)          # [S, B]

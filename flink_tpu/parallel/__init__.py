@@ -1,0 +1,17 @@
+"""Multi-chip parallelism: key-group sharding over a jax Mesh.
+
+The reference distributes keyed state by assigning key-group ranges to
+parallel subtasks and shuffling records over Netty TCP with
+credit-based flow control (SURVEY.md §2.2 network stack, §2.8).  Here
+the same key-group contract maps onto a device mesh: state shards live
+per-device, and the keyBy exchange is a device-side bucketed
+all_to_all inside one jitted SPMD program — collectives ride ICI, not
+a host network stack.
+"""
+
+from flink_tpu.parallel.mesh_agg import (
+    MeshWindowAggregation,
+    make_sharded_step,
+)
+
+__all__ = ["MeshWindowAggregation", "make_sharded_step"]

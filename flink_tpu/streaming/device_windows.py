@@ -1,0 +1,180 @@
+"""Fully device-resident tumbling-window aggregation.
+
+The fastest path in the framework: key→slot resolution happens ON the
+TPU in an HBM hash table (flink_tpu.ops.device_table), fused into the
+same XLA program as the aggregation scatter — per micro-batch the host
+ships only raw key/value hash lanes and gets back an overflow counter.
+Compare the reference's per-record paths (hashmap probe per record in
+HeapAggregatingState.java:80-89; two JNI hops per record in
+RocksDBAggregatingState.java:108-131) and the host-indexed engine in
+flink_tpu/streaming/vectorized.py whose searchsorted/np.unique work
+this removes.
+
+Keys must be 64-bit integers (or anything the caller pre-hashes
+injectively): the table stores the ORIGINAL key lanes, so window fires
+reconstruct exact keys from the table — no host-side key dictionary.
+Non-integer keys use the host-indexed engine instead.
+
+Per live window: one DeviceHashTable + one state arena (table position
+= state slot).  Tumbling windows keep 1-2 windows live, so per-window
+arenas cost little and firing frees the whole window at once.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from flink_tpu.ops.device_agg import DeviceAggregateFunction
+from flink_tpu.ops.device_table import (
+    DeviceHashTable,
+    insert_or_lookup_impl,
+    make_table,
+)
+
+
+class _DeviceWindow:
+    __slots__ = ("start", "table", "state")
+
+    def __init__(self, start: int, table: DeviceHashTable, state: dict):
+        self.start = start
+        self.table = table
+        self.state = state
+
+
+class DeviceTumblingWindows:
+    """keyBy().window(Tumbling).aggregate(agg) with on-device key index.
+
+    API: `process_batch(key_lanes..., value_hashes..., values, ts)` then
+    `advance_watermark(wm)`; results come back as numpy arrays
+    (keys reconstructed from the device table)."""
+
+    def __init__(self, agg: DeviceAggregateFunction, window_size_ms: int,
+                 capacity: int = 1 << 20, max_probes: int = 128,
+                 fire_tile: int = 1 << 18):
+        self.agg = agg
+        self.size = window_size_ms
+        self.capacity = capacity
+        self.max_probes = max_probes
+        self.fire_tile = fire_tile
+        self.watermark = -(2**63)
+        self.windows: Dict[int, _DeviceWindow] = {}
+        self.num_late_dropped = 0
+        self.overflowed = 0
+        #: (keys[np.uint64], results[np], start, end) per fired window
+        self.fired: List[Tuple[np.ndarray, np.ndarray, int, int]] = []
+
+        def fused_step(table, state, k_hi, k_lo, values, vh_hi, vh_lo, mask):
+            table, slots, ok = insert_or_lookup_impl(
+                table, k_hi, k_lo, mask, max_probes=self.max_probes)
+            eff = mask & ok & (slots >= 0)
+            safe = jnp.where(slots >= 0, slots, 0)
+            state = self.agg.update(state, safe, values, vh_hi, vh_lo, eff)
+            overflow = (mask & ~ok).sum()
+            return table, state, overflow
+
+        self._jit_step = jax.jit(fused_step, donate_argnums=(0, 1))
+
+        def fire_tile_fn(state, slots):
+            return self.agg.result(state, slots)
+
+        self._jit_fire = jax.jit(fire_tile_fn)
+
+    def _new_window(self, start: int) -> _DeviceWindow:
+        return _DeviceWindow(
+            int(start), make_table(self.capacity),
+            self.agg.init_state(self.capacity))
+
+    # ---- ingestion --------------------------------------------------
+    def process_batch(self, key_hi: np.ndarray, key_lo: np.ndarray,
+                      timestamps: np.ndarray,
+                      values: Optional[np.ndarray] = None,
+                      vh_hi: Optional[np.ndarray] = None,
+                      vh_lo: Optional[np.ndarray] = None) -> None:
+        ts = np.asarray(timestamps, np.int64)
+        starts = ts - np.mod(ts, self.size)
+        live = starts + self.size - 1 > self.watermark
+        if not live.all():
+            self.num_late_dropped += int((~live).sum())
+        dummy = np.zeros(1, np.uint32)
+        for start in np.unique(starts[live]):
+            w = self.windows.get(start)
+            if w is None:
+                w = self._new_window(int(start))
+                self.windows[int(start)] = w
+            mask = (starts == start) & live
+            if mask.all():
+                k_hi, k_lo, m = key_hi, key_lo, mask
+                vals = (np.asarray(values, self.agg.value_dtype)
+                        if self.agg.needs_value else
+                        np.zeros(1, self.agg.value_dtype))
+                hh = vh_hi if self.agg.needs_value_hash else dummy
+                hl = vh_lo if self.agg.needs_value_hash else dummy
+            else:
+                # pad the selection to the next power of two — stable
+                # shapes, one compile per bucket instead of one per
+                # distinct straddle length
+                n_sel = int(mask.sum())
+                padded = 1 << max(0, (n_sel - 1)).bit_length()
+
+                def pad(a, dtype):
+                    out = np.zeros(padded, dtype)
+                    out[:n_sel] = a[mask]
+                    return out
+
+                k_hi = pad(key_hi, np.uint32)
+                k_lo = pad(key_lo, np.uint32)
+                m = np.zeros(padded, bool)
+                m[:n_sel] = True
+                vals = (pad(np.asarray(values, self.agg.value_dtype),
+                            self.agg.value_dtype)
+                        if self.agg.needs_value else
+                        np.zeros(1, self.agg.value_dtype))
+                hh = pad(vh_hi, np.uint32) if self.agg.needs_value_hash else dummy
+                hl = pad(vh_lo, np.uint32) if self.agg.needs_value_hash else dummy
+            w.table, w.state, overflow = self._jit_step(
+                w.table, w.state, k_hi, k_lo, vals, hh, hl, m)
+            # overflow is a device scalar; defer the sync to fire time
+            self._pending_overflow = getattr(self, "_pending_overflow", [])
+            self._pending_overflow.append(overflow)
+
+    # ---- firing -----------------------------------------------------
+    def advance_watermark(self, watermark: int) -> int:
+        self.watermark = watermark
+        for ov in getattr(self, "_pending_overflow", []):
+            self.overflowed += int(np.asarray(ov))
+        self._pending_overflow = []
+        fired_total = 0
+        for start in sorted(self.windows):
+            if start + self.size - 1 > watermark:
+                continue
+            w = self.windows.pop(start)
+            # gather every table position's result, tiled
+            futures = []
+            for i in range(0, self.capacity, self.fire_tile):
+                slots = jnp.arange(i, min(i + self.fire_tile, self.capacity),
+                                   dtype=jnp.int32)
+                futures.append(self._jit_fire(w.state, slots))
+            results = np.concatenate([np.asarray(f) for f in futures])
+            occ = np.asarray(w.table.occupied)
+            hi = np.asarray(w.table.key_hi)[occ].astype(np.uint64)
+            lo = np.asarray(w.table.key_lo)[occ].astype(np.uint64)
+            keys = (hi << np.uint64(32)) | lo
+            self.fired.append((keys, results[occ], start, start + self.size))
+            fired_total += int(occ.sum())
+        return fired_total
+
+    def block_until_ready(self) -> None:
+        for w in self.windows.values():
+            jax.tree_util.tree_map(lambda a: a.block_until_ready(), w.state)
+
+
+def lanes_from_int_keys(keys: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+    """Original int64/uint64 keys → (hi, lo) uint32 lanes (identity
+    encoding — fires reconstruct the exact keys)."""
+    k = np.asarray(keys).astype(np.uint64)
+    return ((k >> np.uint64(32)).astype(np.uint32),
+            (k & np.uint64(0xFFFFFFFF)).astype(np.uint32))

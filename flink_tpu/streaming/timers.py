@@ -45,7 +45,7 @@ class SystemProcessingTimeService(ProcessingTimeService):
 
     def __init__(self, lock: Optional[threading.Lock] = None):
         self._lock = lock or threading.Lock()
-        self._timers: List[threading.Timer] = []
+        self._timers: Set[threading.Timer] = set()
         self._shutdown = False
 
     def get_current_processing_time(self) -> int:
@@ -53,23 +53,28 @@ class SystemProcessingTimeService(ProcessingTimeService):
 
     def register_timer(self, timestamp: int, callback):
         delay = max(0.0, (timestamp - self.get_current_processing_time()) / 1000.0)
+        t_box = []
 
         def fire():
             with self._lock:
+                self._timers.discard(t_box[0])  # fired → drop the ref
                 if not self._shutdown:
                     callback(timestamp)
 
         t = threading.Timer(delay, fire)
+        t_box.append(t)
         t.daemon = True
+        self._timers.add(t)
         t.start()
-        self._timers.append(t)
         return t
 
     def shutdown(self):
-        self._shutdown = True
-        for t in self._timers:
+        with self._lock:
+            self._shutdown = True
+            timers = list(self._timers)
+            self._timers.clear()
+        for t in timers:
             t.cancel()
-        self._timers.clear()
 
 
 class TestProcessingTimeService(ProcessingTimeService):

@@ -1,0 +1,514 @@
+"""Vectorized tumbling-window aggregation engine — the TPU hot path.
+
+This is the performance centerpiece (SURVEY.md §7 stage 1, BASELINE.md
+north star): where the reference walks one record at a time through
+WindowOperator.processElement → HeapAggregatingState.add (hashmap
+probe) or RocksDBAggregatingState.add (two JNI hops + serde,
+RocksDBAggregatingState.java:108-131), this engine consumes whole
+record batches:
+
+  host:   vectorized key hashing (numpy), vectorized window
+          assignment (ts - ts % size), slot resolution via
+          searchsorted over sorted hash arrays (no Python dict on the
+          hot path),
+  device: ONE jit-compiled scatter per micro-batch updating the whole
+          key-group range's accumulators in HBM
+          (add/max/min combiner per DeviceAggregateFunction), and ONE
+          gather per window fire.
+
+Semantics match WindowOperator + EventTimeTrigger for tumbling
+event-time windows with allowed_lateness=0 (the batched counterpart of
+the scalar operator — differentially tested against it).  Sliding
+windows reduce to this engine by pane replication; session windows
+stay on the scalar operator (they merge, SURVEY.md §7 hard parts).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from flink_tpu.core.keygroups import splitmix64_np, stable_hash64
+from flink_tpu.ops.device_agg import DeviceAggregateFunction
+from flink_tpu.ops.hashing import split_hash64_np
+
+
+def hash_keys_np(keys) -> np.ndarray:
+    """Vectorized stable 64-bit key hashing: integer arrays go through
+    splitmix64 in one numpy pass; object arrays fall back to per-key
+    stable_hash64 (paid once per record batch, not per state access)."""
+    arr = np.asarray(keys)
+    if arr.dtype.kind in "iu":
+        return splitmix64_np(arr.astype(np.uint64))
+    if arr.dtype.kind == "f" and np.all(arr == arr.astype(np.int64)):
+        return splitmix64_np(arr.astype(np.int64).astype(np.uint64))
+    return np.fromiter((stable_hash64(k) for k in arr),
+                       dtype=np.uint64, count=len(arr))
+
+
+_EMPTY = np.uint64(0)
+_ZERO_REMAP = np.uint64(0x9E3779B97F4A7C15)
+
+
+class VectorizedSlotIndex:
+    """hash64 → dense slot via a vectorized open-addressing table.
+
+    The replacement for the per-record dict probe: a whole batch
+    resolves in a handful of numpy gather/compare rounds over a
+    linear-probing table (load kept < 0.6).  A steady-state batch (all
+    keys known, few collisions) costs ~2 vector passes — far cheaper
+    per record than the reference heap backend's per-record hashmap
+    probe, and ~4x cheaper than binary search over a sorted array
+    (random binary searches are cache-miss bound).
+
+    Intra-batch insert races resolve exactly like the device table
+    (flink_tpu.ops.device_table): unresolved records write their hash
+    at their probe position, re-read to find winners, losers advance.
+    Slots are handed out by an external allocator callback so multiple
+    windows share one device-state arena."""
+
+    __slots__ = ("table_hash", "table_slot", "cap", "n")
+
+    def __init__(self, capacity: int = 1 << 12):
+        cap = 1 << max(4, (capacity - 1).bit_length())
+        self.table_hash = np.zeros(cap, np.uint64)   # 0 = empty
+        self.table_slot = np.zeros(cap, np.int64)
+        self.cap = cap
+        self.n = 0
+
+    def _pos0(self, h: np.ndarray) -> np.ndarray:
+        return ((h ^ (h >> np.uint64(32)))
+                & np.uint64(self.cap - 1)).astype(np.int64)
+
+    def _grow(self, need: int) -> None:
+        new_cap = self.cap
+        while (self.n + need) * 5 > new_cap * 3:   # load < 0.6
+            new_cap *= 2
+        if new_cap == self.cap:
+            return
+        old_hash, old_slot = self.table_hash, self.table_slot
+        occ = old_hash != _EMPTY
+        self.table_hash = np.zeros(new_cap, np.uint64)
+        self.table_slot = np.zeros(new_cap, np.int64)
+        self.cap = new_cap
+        self.n = 0
+        if occ.any():
+            self._insert_existing(old_hash[occ], old_slot[occ])
+
+    def _insert_existing(self, hashes: np.ndarray, slots: np.ndarray) -> None:
+        """Rehash unique entries into the (empty, larger) table."""
+        pos = self._pos0(hashes)
+        pending = np.arange(len(hashes))
+        mask_c = np.int64(self.cap - 1)
+        while len(pending):
+            pi = pos[pending]
+            empty = self.table_hash[pi] == _EMPTY
+            idx = pending[empty]
+            if len(idx):
+                self.table_hash[pos[idx]] = hashes[idx]
+                won = self.table_hash[pos[idx]] == hashes[idx]
+                w = idx[won]
+                self.table_slot[pos[w]] = slots[w]
+                self.n += len(w)
+                done = np.zeros(len(hashes), bool)
+                done[w] = True
+                pending = pending[~done[pending]]
+            if len(pending):
+                pos[pending] = (pos[pending] + 1) & mask_c
+
+    def lookup_or_insert(
+        self, batch_hashes: np.ndarray,
+        alloc: Callable[[int], np.ndarray],
+    ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """Resolve a batch to slots; new keys get slots from `alloc`.
+        Returns (slots[N] int64, new_mask_over_new_uniques (all True),
+        first_idx) where first_idx gives, for each inserted unique
+        hash, one position in the batch holding that key (for
+        first-seen key capture)."""
+        h = np.where(batch_hashes == _EMPTY, _ZERO_REMAP, batch_hashes)
+        self._grow(len(h))
+        n = len(h)
+        out = np.full(n, -1, np.int64)
+        pos = self._pos0(h)
+        pending = np.arange(n)
+        mask_c = np.int64(self.cap - 1)
+        new_first: List[np.ndarray] = []
+        while len(pending):
+            hp = h[pending]
+            p = pos[pending]
+            cur = self.table_hash[p]
+            match = cur == hp
+            if match.any():
+                m = pending[match]
+                out[m] = self.table_slot[pos[m]]
+            empty = cur == _EMPTY
+            if empty.any():
+                idx = pending[empty]
+                pi = pos[idx]
+                # last-write-wins per position; re-read to find winners
+                self.table_hash[pi] = h[idx]
+                won = self.table_hash[pi] == h[idx]
+                w = idx[won]
+                if len(w):
+                    # dedupe winners sharing a position AND hash (batch
+                    # duplicates): keep the first per position
+                    pw, first_per_pos = np.unique(pos[w], return_index=True)
+                    w = w[first_per_pos]
+                    new_slots = alloc(len(w))
+                    self.table_slot[pos[w]] = new_slots
+                    out[w] = new_slots
+                    self.n += len(w)
+                    new_first.append(w)
+            resolved = out[pending] >= 0
+            pending = pending[~resolved]
+            if len(pending):
+                # duplicates of a just-inserted key re-check their
+                # current position (it now matches); others advance
+                cur2 = self.table_hash[pos[pending]]
+                advance = pending[cur2 != h[pending]]
+                pos[advance] = (pos[advance] + 1) & mask_c
+        if new_first:
+            first_idx = np.concatenate(new_first)
+        else:
+            first_idx = np.zeros(0, np.int64)
+        return out, np.ones(len(first_idx), bool), first_idx
+
+
+class _SlotArena:
+    """Dense slot allocator over the device-state arrays."""
+
+    def __init__(self, capacity: int):
+        self.capacity = capacity
+        self.next = 0
+        self.free: List[np.ndarray] = []  # freed slot arrays
+
+    def alloc(self, n: int) -> np.ndarray:
+        out = np.empty(n, np.int64)
+        filled = 0
+        while self.free and filled < n:
+            chunk = self.free[-1]
+            take = min(len(chunk), n - filled)
+            out[filled:filled + take] = chunk[:take]
+            if take == len(chunk):
+                self.free.pop()
+            else:
+                self.free[-1] = chunk[take:]
+            filled += take
+        fresh = n - filled
+        if fresh:
+            out[filled:] = np.arange(self.next, self.next + fresh)
+            self.next += fresh
+        return out
+
+    def release(self, slots: np.ndarray) -> None:
+        if len(slots):
+            self.free.append(np.asarray(slots, np.int64))
+
+    @property
+    def high_water(self) -> int:
+        return self.next
+
+
+class _WindowShard:
+    """Per-live-window bookkeeping: its own slot index + first-seen
+    keys, all slots drawn from the shared arena."""
+
+    __slots__ = ("start", "index", "keys", "slot_list")
+
+    def __init__(self, start: int):
+        self.start = start
+        self.index = VectorizedSlotIndex()
+        self.keys: List[Any] = []
+        self.slot_list: List[np.ndarray] = []
+
+    def all_slots(self) -> np.ndarray:
+        if not self.slot_list:
+            return np.empty(0, np.int64)
+        if len(self.slot_list) > 1:
+            self.slot_list = [np.concatenate(self.slot_list)]
+        return self.slot_list[0]
+
+
+class VectorizedTumblingWindows:
+    """Batched keyBy().window(Tumbling...).aggregate(device_agg)."""
+
+    def __init__(self, aggregate: DeviceAggregateFunction, window_size_ms: int,
+                 initial_capacity: int = 1 << 16,
+                 microbatch: int = 1 << 17,
+                 emit: Optional[Callable[[Any, Any, int, int], None]] = None):
+        self.agg = aggregate
+        self.size = window_size_ms
+        self.capacity = initial_capacity
+        self.state = aggregate.init_state(initial_capacity)
+        self.arena = _SlotArena(initial_capacity)
+        self.windows: Dict[int, _WindowShard] = {}
+        self.watermark = -(2**63)
+        self.microbatch = microbatch
+        #: emit(key, result, window_start, window_end); None → collect
+        self.emit = emit
+        self.emitted: List[Tuple[Any, Any, int, int]] = []
+        #: True → skip per-key tuples; fires land in `fired` as
+        #: (keys_list, results_np, start, end) batches
+        self.emit_arrays = False
+        self.fired: List[Tuple[list, np.ndarray, int, int]] = []
+        self.num_late_dropped = 0
+        # pending micro-batch (pre-allocated growing buffers)
+        self._p_slots: List[np.ndarray] = []
+        self._p_values: List[np.ndarray] = []
+        self._p_hi: List[np.ndarray] = []
+        self._p_lo: List[np.ndarray] = []
+        self._p_count = 0
+        self._jit_update = jax.jit(self._update_fn, donate_argnums=0)
+        self._jit_result = jax.jit(self.agg.result)
+        self._jit_clear = jax.jit(self.agg.clear_slots, donate_argnums=0)
+
+    def _update_fn(self, state, slots, values, hi, lo, n):
+        # mask derives on device from the live count — one scalar
+        # instead of a bool array over the wire
+        mask = jnp.arange(slots.shape[0], dtype=jnp.int32) < n
+        return self.agg.update(state, slots, values, hi, lo, mask)
+
+    # ---- ingestion --------------------------------------------------
+    def process_batch(
+        self,
+        keys,
+        timestamps: np.ndarray,
+        values: Optional[np.ndarray] = None,
+        key_hashes: Optional[np.ndarray] = None,
+        value_hashes: Optional[np.ndarray] = None,
+    ) -> None:
+        """One batch of records: assign windows, resolve slots, buffer
+        the scatter. `keys` may be any sequence; pass `key_hashes` to
+        skip hashing (e.g. when the exchange already hashed them)."""
+        ts = np.asarray(timestamps, np.int64)
+        kh = key_hashes if key_hashes is not None else hash_keys_np(keys)
+        starts = ts - np.mod(ts, self.size)
+        # drop late records (window end <= watermark, lateness 0)
+        live = starts + self.size - 1 > self.watermark
+        if not live.all():
+            self.num_late_dropped += int((~live).sum())
+            if not live.any():
+                return
+            ts, kh, starts = ts[live], kh[live], starts[live]
+            # keep numeric dtype — boxing to object arrays is only for
+            # non-array key sequences
+            keys = (keys[live] if isinstance(keys, np.ndarray)
+                    else np.asarray(keys, dtype=object)[live])
+            if values is not None:
+                values = np.asarray(values)[live]
+            if value_hashes is not None:
+                value_hashes = np.asarray(value_hashes)[live]
+
+        if self.agg.needs_value_hash and value_hashes is None:
+            value_hashes = hash_keys_np(values)
+
+        keys_arr = keys if isinstance(keys, np.ndarray) else np.asarray(
+            keys, dtype=object)
+        uniq_starts = np.unique(starts)
+        single_window = len(uniq_starts) == 1
+        for start in uniq_starts:
+            shard = self.windows.get(start)
+            if shard is None:
+                shard = _WindowShard(int(start))
+                self.windows[int(start)] = shard
+            if single_window:
+                bh, masked_keys = kh, keys_arr
+                m_values = values
+                m_vhashes = value_hashes
+            else:
+                mask = starts == start
+                bh = kh[mask]
+                masked_keys = keys_arr[mask]
+                m_values = None if values is None else np.asarray(values)[mask]
+                m_vhashes = None if value_hashes is None else value_hashes[mask]
+            slots, new_uniq, first_idx = shard.index.lookup_or_insert(
+                bh, self.arena.alloc)
+            if len(first_idx):
+                shard.keys.extend(masked_keys[first_idx].tolist())
+                shard.slot_list.append(np.asarray(slots[first_idx], np.int64))
+            self._buffer(slots, m_values, m_vhashes)
+        if self._p_count >= self.microbatch:
+            self.flush()
+
+    def _buffer(self, slots, values, value_hashes) -> None:
+        self._p_slots.append(slots.astype(np.int32))
+        if self.agg.needs_value:
+            self._p_values.append(np.asarray(values, self.agg.value_dtype))
+        if self.agg.needs_value_hash:
+            hi, lo = split_hash64_np(value_hashes)
+            self._p_hi.append(hi)
+            self._p_lo.append(lo)
+        self._p_count += len(slots)
+        # grow device arrays before slots overflow capacity
+        if self.arena.high_water > self.capacity:
+            self.flush(grow_to=max(self.capacity * 2,
+                                   1 << (self.arena.high_water - 1).bit_length()))
+
+    def flush(self, grow_to: Optional[int] = None) -> None:
+        if grow_to is not None and grow_to > self.capacity:
+            # growing reallocates; flush pending first at old capacity
+            # only if slots fit — otherwise grow first
+            self.state = self.agg.grow_state(self.state, grow_to)
+            self.capacity = grow_to
+        if self._p_count == 0:
+            return
+        n = self._p_count
+        padded = 1 << max(0, (n - 1)).bit_length()
+        slots = np.zeros(padded, np.int32)
+        np.concatenate(self._p_slots, out=slots[:n])
+        # unused operands ship as broadcastable dummies — no transfer
+        if self.agg.needs_value:
+            values = np.zeros(padded, self.agg.value_dtype)
+            np.concatenate(self._p_values, out=values[:n])
+        else:
+            values = np.zeros(1, self.agg.value_dtype)
+        if self.agg.needs_value_hash:
+            hi0 = np.concatenate(self._p_hi) if len(self._p_hi) > 1 else self._p_hi[0]
+            lo0 = np.concatenate(self._p_lo) if len(self._p_lo) > 1 else self._p_lo[0]
+            hi0, lo0 = self.agg.compress_value_hash(hi0, lo0)
+            hi = np.zeros(padded, hi0.dtype)
+            lo = np.zeros(padded, lo0.dtype)
+            hi[:n] = hi0
+            lo[:n] = lo0
+        else:
+            hi = np.zeros(1, np.uint32)
+            lo = np.zeros(1, np.uint32)
+        self.state = self._jit_update(self.state, slots, values, hi, lo,
+                                      np.int32(n))
+        self._p_slots.clear()
+        self._p_values.clear()
+        self._p_hi.clear()
+        self._p_lo.clear()
+        self._p_count = 0
+
+    # ---- firing -----------------------------------------------------
+    #: gather/clear tile: fixed shape → one compile, bounded
+    #: intermediates (HLL result materializes [TILE, m] floats)
+    FIRE_TILE = 1 << 18
+
+    def advance_watermark(self, watermark: int) -> int:
+        """Fire every window whose end-1 <= watermark; returns the
+        number of (key, window) results emitted.  Tiled device gathers
+        (the TPU twin of onEventTime → emitWindowContents)."""
+        self.watermark = watermark
+        fired = 0
+        for start in sorted(self.windows):
+            if start + self.size - 1 > watermark:
+                continue
+            shard = self.windows.pop(start)
+            self.flush()
+            slots = shard.all_slots()
+            if len(slots):
+                end = start + self.size
+                if self.emit_arrays:
+                    self.fired.append(
+                        (shard.keys, self._gather_tiled_np(slots), start, end))
+                else:
+                    results = self._gather_tiled(slots)
+                    if self.emit is not None:
+                        for key, res in zip(shard.keys, results):
+                            self.emit(key, res, start, end)
+                    else:
+                        self.emitted.extend(
+                            zip(shard.keys, results,
+                                [start] * len(slots), [end] * len(slots)))
+                fired += len(slots)
+                self._clear_tiled(slots)
+                self.arena.release(slots)
+        return fired
+
+    def _gather_tiled(self, slots: np.ndarray) -> list:
+        n = len(slots)
+        tile = self.FIRE_TILE
+        futures = []
+        for i in range(0, n, tile):
+            chunk = slots[i:i + tile]
+            if len(chunk) < tile:
+                padded = np.full(tile, chunk[0], np.int32)
+                padded[:len(chunk)] = chunk
+            else:
+                padded = chunk.astype(np.int32)
+            # dispatch all tiles before materializing any — transfers
+            # overlap device compute on the async dispatch queue
+            futures.append((self._jit_result(self.state, jnp.asarray(padded)),
+                            len(chunk)))
+        outs = [np.asarray(f)[:ln] for f, ln in futures]
+        return np.concatenate(outs).tolist() if outs else []
+
+    def _gather_tiled_np(self, slots: np.ndarray) -> np.ndarray:
+        n = len(slots)
+        tile = self.FIRE_TILE
+        futures = []
+        for i in range(0, n, tile):
+            chunk = slots[i:i + tile]
+            if len(chunk) < tile:
+                padded = np.full(tile, chunk[0], np.int32)
+                padded[:len(chunk)] = chunk
+            else:
+                padded = chunk.astype(np.int32)
+            futures.append((self._jit_result(self.state, jnp.asarray(padded)),
+                            len(chunk)))
+        return np.concatenate([np.asarray(f)[:ln] for f, ln in futures])
+
+    def _clear_tiled(self, slots: np.ndarray) -> None:
+        n = len(slots)
+        tile = self.FIRE_TILE
+        for i in range(0, n, tile):
+            chunk = slots[i:i + tile]
+            padded = np.full(tile, chunk[0], np.int32)
+            padded[:len(chunk)] = chunk
+            self.state = self._jit_clear(self.state, jnp.asarray(padded))
+
+    def block_until_ready(self) -> None:
+        jax.tree_util.tree_map(lambda a: a.block_until_ready(), self.state)
+
+
+class ScalarHeapTumblingWindows:
+    """The per-record heap baseline the north star measures against:
+    dict-of-dicts accumulator tables updated one record at a time with
+    the scalar AggregateFunction contract — the same work
+    HeapAggregatingState.add does (HeapAggregatingState.java:80-89)."""
+
+    def __init__(self, aggregate, window_size_ms: int,
+                 emit: Optional[Callable] = None):
+        self.agg = aggregate
+        self.size = window_size_ms
+        self.windows: Dict[int, Dict[Any, Any]] = {}
+        self.watermark = -(2**63)
+        self.emit = emit
+        self.emitted: List[Tuple[Any, Any, int, int]] = []
+        self.num_late_dropped = 0
+
+    def process(self, key, timestamp: int, value=None) -> None:
+        start = timestamp - timestamp % self.size
+        if start + self.size - 1 <= self.watermark:
+            self.num_late_dropped += 1
+            return
+        table = self.windows.get(start)
+        if table is None:
+            table = {}
+            self.windows[start] = table
+        acc = table.get(key)
+        if acc is None:
+            acc = self.agg.create_accumulator()
+        table[key] = self.agg.add(value, acc)
+
+    def advance_watermark(self, watermark: int) -> int:
+        self.watermark = watermark
+        fired = 0
+        for start in sorted(self.windows):
+            if start + self.size - 1 > watermark:
+                continue
+            table = self.windows.pop(start)
+            end = start + self.size
+            for key, acc in table.items():
+                res = self.agg.get_result(acc)
+                if self.emit is not None:
+                    self.emit(key, res, start, end)
+                else:
+                    self.emitted.append((key, res, start, end))
+            fired += len(table)
+        return fired

@@ -1,0 +1,201 @@
+"""Mesh-sharded aggregation on the 8-device virtual CPU mesh."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+from flink_tpu.core.keygroups import assign_key_groups_np, splitmix64_np
+from flink_tpu.ops.device_agg import CountAggregate, SumAggregate
+from flink_tpu.ops.device_table import (
+    insert_or_lookup,
+    lookup_np,
+    make_table,
+)
+from flink_tpu.ops.sketches import HyperLogLogAggregate
+from flink_tpu.parallel import MeshWindowAggregation
+
+
+# ---------------------------------------------------------------------
+# device hash table
+# ---------------------------------------------------------------------
+
+def _lanes(h64):
+    h64 = np.asarray(h64, np.uint64)
+    return ((h64 >> np.uint64(32)).astype(np.uint32),
+            (h64 & np.uint64(0xFFFFFFFF)).astype(np.uint32))
+
+
+def test_device_table_insert_and_dedup():
+    table = make_table(64)
+    h = splitmix64_np(np.arange(10, dtype=np.uint64))
+    hi, lo = _lanes(h)
+    mask = np.ones(10, bool)
+    table, slots, ok = insert_or_lookup(table, jnp.asarray(hi), jnp.asarray(lo),
+                                        jnp.asarray(mask))
+    slots = np.asarray(slots)
+    assert np.asarray(ok).all()
+    assert len(set(slots.tolist())) == 10  # distinct keys → distinct slots
+    # same keys again → same slots
+    table2, slots2, ok2 = insert_or_lookup(
+        table, jnp.asarray(hi), jnp.asarray(lo), jnp.asarray(mask))
+    np.testing.assert_array_equal(np.asarray(slots2), slots)
+    # duplicates within one batch → one slot
+    dup_hi = jnp.asarray(np.repeat(hi[:1], 5))
+    dup_lo = jnp.asarray(np.repeat(lo[:1], 5))
+    _, dslots, _ = insert_or_lookup(table2, dup_hi, dup_lo,
+                                    jnp.ones(5, bool))
+    assert len(set(np.asarray(dslots).tolist())) == 1
+    assert np.asarray(dslots)[0] == slots[0]
+
+
+def test_device_table_host_lookup_agrees():
+    table = make_table(128)
+    h = splitmix64_np(np.arange(40, dtype=np.uint64))
+    hi, lo = _lanes(h)
+    table, slots, ok = insert_or_lookup(
+        table, jnp.asarray(hi), jnp.asarray(lo), jnp.ones(40, bool))
+    host_slots = lookup_np(table, h)
+    np.testing.assert_array_equal(host_slots, np.asarray(slots))
+
+
+def test_device_table_overflow_signals():
+    table = make_table(8)
+    h = splitmix64_np(np.arange(32, dtype=np.uint64))
+    hi, lo = _lanes(h)
+    table, slots, ok = insert_or_lookup(
+        table, jnp.asarray(hi), jnp.asarray(lo), jnp.ones(32, bool),
+        max_probes=8)
+    ok = np.asarray(ok)
+    assert ok.sum() <= 8  # at most capacity resolve
+    assert (~ok).any()    # and overflow is reported, not silent
+
+
+def test_padding_not_inserted():
+    table = make_table(32)
+    h = splitmix64_np(np.arange(4, dtype=np.uint64))
+    hi, lo = _lanes(h)
+    mask = np.array([True, True, False, False])
+    table, slots, ok = insert_or_lookup(
+        table, jnp.asarray(hi), jnp.asarray(lo), jnp.asarray(mask))
+    assert int(np.asarray(table.occupied).sum()) == 2
+
+
+# ---------------------------------------------------------------------
+# mesh-sharded aggregation (8 virtual devices)
+# ---------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def mesh():
+    devices = np.array(jax.devices()[:8])
+    assert len(devices) == 8, "conftest must provide 8 virtual devices"
+    return Mesh(devices, ("kg",))
+
+
+def _prepare(keys, values, n_shards):
+    """Host-side batch prep: hash keys, split lanes, pad to shards."""
+    h64 = splitmix64_np(np.asarray(keys, np.uint64))
+    hi, lo = _lanes(h64)
+    n = len(keys)
+    per = -(-n // n_shards)
+    total = per * n_shards
+    pad = total - n
+
+    def padded(a, dtype):
+        out = np.zeros(total, dtype)
+        out[:n] = a
+        return out
+
+    mask = np.zeros(total, bool)
+    mask[:n] = True
+    return (padded(hi, np.uint32), padded(lo, np.uint32),
+            padded(values, np.float32), padded(np.zeros(n), np.uint32),
+            padded(np.zeros(n), np.uint32), mask, h64)
+
+
+def test_mesh_sum_matches_host(mesh):
+    agg = SumAggregate(np.float32)
+    mwa = MeshWindowAggregation(mesh, "kg", agg, max_parallelism=128,
+                                capacity_per_shard=256)
+    rng = np.random.default_rng(3)
+    keys = rng.integers(0, 100, 1000)
+    vals = rng.random(1000).astype(np.float32)
+    hi, lo, v, vhi, vlo, mask, h64 = _prepare(keys, vals, mesh.shape["kg"])
+    mwa.step(hi, lo, v, vhi, vlo, mask)
+    assert mwa.overflowed == 0
+
+    khi, klo, res, occ = mwa.fire()
+    got = {}
+    for i in np.nonzero(occ)[0]:
+        got[(int(khi[i]), int(klo[i]))] = float(res[i])
+
+    expect = {}
+    for k, val in zip(keys, vals):
+        h = int(splitmix64_np(np.array([k], np.uint64))[0])
+        lane = (h >> 32, h & 0xFFFFFFFF)
+        expect[lane] = expect.get(lane, 0.0) + float(val)
+    assert set(got) == set(expect)
+    for lane in expect:
+        assert got[lane] == pytest.approx(expect[lane], rel=1e-4)
+
+
+def test_mesh_keys_land_on_owner_shard(mesh):
+    """Each key's state must live on the shard its key group maps to."""
+    agg = CountAggregate()
+    n_shards = mesh.shape["kg"]
+    cap = 128
+    mwa = MeshWindowAggregation(mesh, "kg", agg, max_parallelism=128,
+                                capacity_per_shard=cap)
+    keys = np.arange(200)
+    hi, lo, v, vhi, vlo, mask, h64 = _prepare(keys, np.zeros(200), n_shards)
+    mwa.step(hi, lo, v, vhi, vlo, mask)
+    khi, klo, res, occ = mwa.fire()
+    kgs = assign_key_groups_np(h64, 128)
+    expected_shard = (kgs.astype(np.int64) * n_shards) // 128
+    lane_to_shard = {}
+    for i in np.nonzero(occ)[0]:
+        lane_to_shard[(int(khi[i]), int(klo[i]))] = i // cap
+    for h, s in zip(h64, expected_shard):
+        lane = (int(h >> np.uint64(32)), int(h & np.uint64(0xFFFFFFFF)))
+        assert lane_to_shard[lane] == s
+
+
+def test_mesh_hll(mesh):
+    agg = HyperLogLogAggregate(precision=9)
+    mwa = MeshWindowAggregation(mesh, "kg", agg, max_parallelism=128,
+                                capacity_per_shard=64)
+    n = 4000
+    keys = np.repeat(np.arange(4), n // 4)
+    users = np.arange(n)  # 1000 distinct per key
+    h64u = splitmix64_np(users.astype(np.uint64))
+    hi, lo, v, _, _, mask, h64 = _prepare(keys, np.zeros(n), mesh.shape["kg"])
+    vhi = np.zeros(len(mask), np.uint32)
+    vlo = np.zeros(len(mask), np.uint32)
+    vhi[:n] = (h64u >> np.uint64(32)).astype(np.uint32)
+    vlo[:n] = (h64u & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+    mwa.step(hi, lo, v, vhi, vlo, mask)
+    khi, klo, res, occ = mwa.fire()
+    ests = res[occ]
+    assert len(ests) == 4
+    for est in ests:
+        assert abs(est - 1000) / 1000 < 0.10
+
+
+def test_mesh_multiple_steps_accumulate(mesh):
+    agg = CountAggregate()
+    mwa = MeshWindowAggregation(mesh, "kg", agg, max_parallelism=128,
+                                capacity_per_shard=64)
+    keys = np.arange(16)
+    for _ in range(3):
+        hi, lo, v, vhi, vlo, mask, _ = _prepare(keys, np.zeros(16),
+                                                mesh.shape["kg"])
+        mwa.step(hi, lo, v, vhi, vlo, mask)
+    khi, klo, res, occ = mwa.fire()
+    assert (res[occ] == 3).all()
+    # after fire, state reset
+    hi, lo, v, vhi, vlo, mask, _ = _prepare(keys, np.zeros(16),
+                                            mesh.shape["kg"])
+    mwa.step(hi, lo, v, vhi, vlo, mask)
+    _, _, res2, occ2 = mwa.fire()
+    assert (res2[occ2] == 1).all()
