@@ -63,16 +63,18 @@ def _bucketize(tgt: jnp.ndarray, n_shards: int, payload: Tuple[jnp.ndarray, ...]
     offsets = jnp.concatenate([jnp.zeros(1, counts.dtype),
                                jnp.cumsum(counts)[:-1]])
     rank = jnp.arange(n) - offsets[tgt_sorted]
-    out_mask = jnp.zeros((n_shards, n), bool)
-    rows = jnp.where(tgt_sorted < n_shards, tgt_sorted, 0)
+    # padding rows target the virtual shard n_shards, which is out of
+    # bounds for the (n_shards, n) bucket array; mode="drop" discards
+    # those writes instead of letting them collide with real shard-0
+    # entries at [0, rank]
     valid = tgt_sorted < n_shards
-    out_mask = out_mask.at[rows, rank].set(valid)
+    out_mask = jnp.zeros((n_shards, n), bool)
+    out_mask = out_mask.at[tgt_sorted, rank].set(valid, mode="drop")
     outs = []
     for arr in payload:
         sorted_arr = arr[order]
         buck = jnp.zeros((n_shards, n), sorted_arr.dtype)
-        buck = buck.at[rows, rank].set(
-            jnp.where(valid, sorted_arr, jnp.zeros((), sorted_arr.dtype)))
+        buck = buck.at[tgt_sorted, rank].set(sorted_arr, mode="drop")
         outs.append(buck)
     return outs, out_mask
 

@@ -177,19 +177,6 @@ class DeviceAggregatingState(AggregatingState):
         if len(self._pending_slots) >= self.microbatch:
             self._flush()
 
-    def add_batch_hashed(self, slots: np.ndarray, values: np.ndarray,
-                         vh_hi: np.ndarray, vh_lo: np.ndarray) -> None:
-        """Lowest-level write: caller already resolved slots and value
-        hashes (the vectorized window operator path)."""
-        self._pending_slots.extend(int(s) for s in slots)
-        if self.agg.needs_value:
-            self._pending_values.extend(values)
-        if self.agg.needs_value_hash:
-            self._pending_hi.extend(int(h) for h in vh_hi)
-            self._pending_lo.extend(int(h) for h in vh_lo)
-        if len(self._pending_slots) >= self.microbatch:
-            self._flush()
-
     def _flush(self) -> None:
         n = len(self._pending_slots)
         if n == 0:

@@ -106,35 +106,28 @@ class DeviceTumblingWindows:
                 w = self._new_window(int(start))
                 self.windows[int(start)] = w
             mask = (starts == start) & live
-            if mask.all():
-                k_hi, k_lo, m = key_hi, key_lo, mask
-                vals = (np.asarray(values, self.agg.value_dtype)
-                        if self.agg.needs_value else
-                        np.zeros(1, self.agg.value_dtype))
-                hh = vh_hi if self.agg.needs_value_hash else dummy
-                hl = vh_lo if self.agg.needs_value_hash else dummy
-            else:
-                # pad the selection to the next power of two — stable
-                # shapes, one compile per bucket instead of one per
-                # distinct straddle length
-                n_sel = int(mask.sum())
-                padded = 1 << max(0, (n_sel - 1)).bit_length()
+            # pad the selection to the next power of two — stable shapes,
+            # one compile per size bucket instead of one per distinct
+            # batch/straddle length (full batches included: a raw-length
+            # fast path would recompile for every new batch size)
+            n_sel = int(mask.sum())
+            padded = 1 << max(0, (n_sel - 1)).bit_length()
 
-                def pad(a, dtype):
-                    out = np.zeros(padded, dtype)
-                    out[:n_sel] = a[mask]
-                    return out
+            def pad(a, dtype):
+                out = np.zeros(padded, dtype)
+                out[:n_sel] = a[mask]
+                return out
 
-                k_hi = pad(key_hi, np.uint32)
-                k_lo = pad(key_lo, np.uint32)
-                m = np.zeros(padded, bool)
-                m[:n_sel] = True
-                vals = (pad(np.asarray(values, self.agg.value_dtype),
-                            self.agg.value_dtype)
-                        if self.agg.needs_value else
-                        np.zeros(1, self.agg.value_dtype))
-                hh = pad(vh_hi, np.uint32) if self.agg.needs_value_hash else dummy
-                hl = pad(vh_lo, np.uint32) if self.agg.needs_value_hash else dummy
+            k_hi = pad(key_hi, np.uint32)
+            k_lo = pad(key_lo, np.uint32)
+            m = np.zeros(padded, bool)
+            m[:n_sel] = True
+            vals = (pad(np.asarray(values, self.agg.value_dtype),
+                        self.agg.value_dtype)
+                    if self.agg.needs_value else
+                    np.zeros(1, self.agg.value_dtype))
+            hh = pad(vh_hi, np.uint32) if self.agg.needs_value_hash else dummy
+            hl = pad(vh_lo, np.uint32) if self.agg.needs_value_hash else dummy
             w.table, w.state, overflow = self._jit_step(
                 w.table, w.state, k_hi, k_lo, vals, hh, hl, m)
             # overflow is a device scalar; defer the sync to fire time

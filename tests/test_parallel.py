@@ -199,3 +199,48 @@ def test_mesh_multiple_steps_accumulate(mesh):
     mwa.step(hi, lo, v, vhi, vlo, mask)
     _, _, res2, occ2 = mwa.fire()
     assert (res2[occ2] == 1).all()
+
+
+def test_mesh_padding_does_not_clobber_shard0(mesh):
+    """Regression: padded (mask=False) records used to scatter to bucket
+    row 0 during _bucketize, colliding with real shard-0 records at the
+    same [0, rank] positions and silently dropping them."""
+    agg = CountAggregate()
+    n_shards = mesh.shape["kg"]
+    mwa = MeshWindowAggregation(mesh, "kg", agg, max_parallelism=128,
+                                capacity_per_shard=128)
+    # pick n_shards keys that all target shard 0, and place exactly one
+    # at the FRONT of each device's slice so every device holds a real
+    # shard-0 record followed by padding — the layout where padding's
+    # bucket-row-0 writes used to collide with the real entry
+    def shard_of(k):
+        h64 = splitmix64_np(np.array([k], np.uint64))
+        kg = int(assign_key_groups_np(h64, 128)[0])
+        return (kg * n_shards) // 128
+
+    keys = []
+    k = 0
+    while len(keys) < n_shards:
+        if shard_of(k) == 0:
+            keys.append(k)
+        k += 1
+    keys = np.array(keys, np.uint64)
+    per = 8  # slice length per device
+    total = per * n_shards
+    h64 = splitmix64_np(keys)
+    hi = np.zeros(total, np.uint32)
+    lo = np.zeros(total, np.uint32)
+    mask = np.zeros(total, bool)
+    idx = np.arange(n_shards) * per  # index 0 of each device slice
+    hi[idx] = (h64 >> np.uint64(32)).astype(np.uint32)
+    lo[idx] = (h64 & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+    mask[idx] = True
+    mwa.step(hi, lo, np.zeros(total, np.float32),
+             np.zeros(total, np.uint32), np.zeros(total, np.uint32), mask)
+    assert mwa.overflowed == 0
+    khi, klo, res, occ = mwa.fire()
+    got = {(int(khi[i]), int(klo[i])) for i in np.nonzero(occ)[0]}
+    expect = {(int(h >> np.uint64(32)), int(h & np.uint64(0xFFFFFFFF)))
+              for h in h64}
+    assert got == expect  # every key survives, including shard-0 ones
+    assert (res[occ] == 1).all()
