@@ -1,0 +1,374 @@
+"""Checkpoint coordination, storage, and restart strategies.
+
+Re-designs flink-runtime/.../checkpoint/ (CheckpointCoordinator.java:394
+triggerCheckpoint, :665 receiveAcknowledgeMessage, :802
+completePendingCheckpoint, :883 notifyCheckpointComplete), the
+checkpoint-storage side of the state backends
+(flink-runtime/.../state/memory/MemoryBackendCheckpointStorage,
+.../state/filesystem/FsCheckpointStorage) and the restart strategies
+(flink-runtime/.../executiongraph/restart/FixedDelayRestartStrategy.java,
+FailureRateRestartStrategy.java, RestartStrategyFactory.java).
+
+The coordinator here runs inside the single-process executor loop: it
+trigger-marks source subtasks (which inject CheckpointBarriers in-band
+at a record boundary), collects per-subtask snapshot acks, and on full
+acknowledgement persists a completed checkpoint and notifies operators
+(the commit signal for two-phase-commit sinks / source offset commits).
+
+Snapshots persist through the serialization layer to a checkpoint
+directory as one file per checkpoint (`chk-N`), retained N deep —
+the FsStateBackend analogue; MemoryCheckpointStorage keeps them in a
+dict (the `jobmanager` backend analogue).
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import shutil
+import time as _time
+from typing import Any, Callable, Dict, List, Optional, Set, Tuple
+
+
+class CheckpointStorage:
+    """Completed-checkpoint store contract (ref: CompletedCheckpointStore
+    + CheckpointStorage).  Keys are (vertex_id, subtask_index)."""
+
+    def persist(self, checkpoint_id: int, metadata: dict,
+                task_snapshots: Dict[Tuple[int, int], dict]) -> None:
+        raise NotImplementedError
+
+    def latest(self) -> Optional[dict]:
+        """Returns {"checkpoint_id", "metadata", "tasks"} or None."""
+        raise NotImplementedError
+
+    def load(self, checkpoint_id: int) -> Optional[dict]:
+        raise NotImplementedError
+
+    def checkpoint_ids(self) -> List[int]:
+        raise NotImplementedError
+
+
+class MemoryCheckpointStorage(CheckpointStorage):
+    """In-memory retained checkpoints (ref: MemoryStateBackend /
+    `jobmanager` shortcut in StateBackendLoader.java:92-109)."""
+
+    def __init__(self, retain: int = 1):
+        self.retain = retain
+        self._store: Dict[int, dict] = {}
+
+    def persist(self, checkpoint_id, metadata, task_snapshots):
+        self._store[checkpoint_id] = {
+            "checkpoint_id": checkpoint_id,
+            "metadata": metadata,
+            "tasks": task_snapshots,
+        }
+        for cid in sorted(self._store)[:-self.retain]:
+            del self._store[cid]
+
+    def latest(self):
+        if not self._store:
+            return None
+        return self._store[max(self._store)]
+
+    def load(self, checkpoint_id):
+        return self._store.get(checkpoint_id)
+
+    def checkpoint_ids(self):
+        return sorted(self._store)
+
+
+class FsCheckpointStorage(CheckpointStorage):
+    """One pickle file per completed checkpoint under `dir/chk-N`
+    (ref: FsStateBackend / FsCheckpointStorage — rename-free write then
+    atomic rename, so a torn write never becomes `latest`)."""
+
+    def __init__(self, directory: str, retain: int = 1):
+        self.directory = directory
+        self.retain = retain
+        os.makedirs(directory, exist_ok=True)
+
+    def _path(self, checkpoint_id: int) -> str:
+        return os.path.join(self.directory, f"chk-{checkpoint_id}")
+
+    def persist(self, checkpoint_id, metadata, task_snapshots):
+        payload = {
+            "checkpoint_id": checkpoint_id,
+            "metadata": metadata,
+            "tasks": task_snapshots,
+        }
+        tmp = self._path(checkpoint_id) + ".part"
+        with open(tmp, "wb") as f:
+            pickle.dump(payload, f, protocol=pickle.HIGHEST_PROTOCOL)
+        os.replace(tmp, self._path(checkpoint_id))
+        for cid in self.checkpoint_ids()[:-self.retain]:
+            try:
+                os.remove(self._path(cid))
+            except OSError:
+                pass
+
+    def latest(self):
+        ids = self.checkpoint_ids()
+        return self.load(ids[-1]) if ids else None
+
+    def load(self, checkpoint_id):
+        path = self._path(checkpoint_id)
+        if not os.path.exists(path):
+            return None
+        with open(path, "rb") as f:
+            return pickle.load(f)
+
+    def checkpoint_ids(self):
+        ids = []
+        for name in os.listdir(self.directory):
+            if name.startswith("chk-") and not name.endswith(".part"):
+                try:
+                    ids.append(int(name[4:]))
+                except ValueError:
+                    pass
+        return sorted(ids)
+
+    def dispose(self):
+        shutil.rmtree(self.directory, ignore_errors=True)
+
+
+def make_checkpoint_storage(config: Optional[dict]) -> CheckpointStorage:
+    """`checkpoint.storage` switch: `memory` (default) | `filesystem`
+    with `checkpoint.dir` (ref: StateBackendLoader name resolution)."""
+    config = config or {}
+    kind = config.get("storage", "memory")
+    retain = config.get("retain", 1)
+    if kind == "filesystem":
+        return FsCheckpointStorage(config["dir"], retain=retain)
+    if kind == "memory":
+        return MemoryCheckpointStorage(retain=retain)
+    raise ValueError(f"unknown checkpoint storage '{kind}'")
+
+
+class PendingCheckpoint:
+    """(ref: PendingCheckpoint.java) — in-flight checkpoint awaiting
+    acknowledgements from every subtask."""
+
+    def __init__(self, checkpoint_id: int, timestamp: int,
+                 expected: Set[Tuple[int, int]]):
+        self.checkpoint_id = checkpoint_id
+        self.timestamp = timestamp
+        self.expected = set(expected)
+        self.acks: Dict[Tuple[int, int], dict] = {}
+        self.discarded = False
+
+    def acknowledge(self, task_key: Tuple[int, int], snapshot: dict) -> None:
+        if task_key in self.expected:
+            self.acks[task_key] = snapshot
+
+    @property
+    def fully_acknowledged(self) -> bool:
+        return set(self.acks) == self.expected
+
+
+class CheckpointStats:
+    """Per-checkpoint stats the reference tracks in
+    CheckpointStatsTracker.java: trigger→complete duration + byte size."""
+
+    def __init__(self, checkpoint_id: int, trigger_ms: float):
+        self.checkpoint_id = checkpoint_id
+        self.trigger_ms = trigger_ms
+        self.complete_ms: Optional[float] = None
+        self.state_bytes = 0
+
+    @property
+    def duration_ms(self) -> Optional[float]:
+        if self.complete_ms is None:
+            return None
+        return self.complete_ms - self.trigger_ms
+
+
+class CheckpointCoordinator:
+    """Periodic barrier-checkpoint driver (ref:
+    CheckpointCoordinator.java).  `trigger_sources` is a callback that
+    marks every source subtask with a pending (checkpoint_id, options)
+    trigger; sources inject the barrier at their next record boundary
+    and ack immediately after snapshotting themselves."""
+
+    def __init__(self, interval_ms: int, mode: str,
+                 storage: CheckpointStorage,
+                 expected_tasks: Set[Tuple[int, int]],
+                 trigger_sources: Callable[[int, int, dict], None],
+                 notify_complete: Callable[[int], None],
+                 min_pause_ms: int = 0,
+                 max_concurrent: int = 1,
+                 clock: Callable[[], float] = None):
+        self.interval_ms = interval_ms
+        self.mode = mode  # exactly_once | at_least_once
+        self.storage = storage
+        self.expected_tasks = set(expected_tasks)
+        self._trigger_sources = trigger_sources
+        self._notify_complete = notify_complete
+        self.min_pause_ms = min_pause_ms
+        self.max_concurrent = max_concurrent
+        self._clock = clock or (lambda: _time.monotonic() * 1000.0)
+        self._id_counter = 0
+        self.pending: Dict[int, PendingCheckpoint] = {}
+        self.completed_count = 0
+        self.latest_completed_id: Optional[int] = None
+        self._last_completed_at: float = -1e18
+        # first trigger fires immediately — fast finite jobs still get
+        # a checkpoint in before their sources drain
+        self._last_triggered_at: float = self._clock() - (interval_ms or 0)
+        self.stats: List[CheckpointStats] = []
+        self.stopped = False
+
+    # ---- trigger ----------------------------------------------------
+    def maybe_trigger(self) -> Optional[int]:
+        """Called from the executor loop; triggers when the interval has
+        elapsed (ref: the coordinator's ScheduledTrigger)."""
+        if self.stopped or self.interval_ms is None:
+            return None
+        now = self._clock()
+        if len(self.pending) >= self.max_concurrent:
+            return None
+        if now - self._last_triggered_at < self.interval_ms:
+            return None
+        if now - self._last_completed_at < self.min_pause_ms:
+            return None
+        return self.trigger()
+
+    def trigger(self) -> Optional[int]:
+        """(ref: triggerCheckpoint :394).  Returns None when sources
+        refuse the trigger (e.g. a task already finished)."""
+        self._id_counter += 1
+        cid = self._id_counter
+        now = self._clock()
+        self._last_triggered_at = now
+        self.pending[cid] = PendingCheckpoint(
+            cid, int(now), self.expected_tasks)
+        self.stats.append(CheckpointStats(cid, now))
+        ok = self._trigger_sources(cid, int(now), {"mode": self.mode})
+        if ok is False:
+            del self.pending[cid]
+            return None
+        return cid
+
+    # ---- acks -------------------------------------------------------
+    def acknowledge(self, task_key: Tuple[int, int], checkpoint_id: int,
+                    snapshot: dict) -> None:
+        """(ref: receiveAcknowledgeMessage :665)"""
+        pc = self.pending.get(checkpoint_id)
+        if pc is None:
+            return  # late ack of an aborted checkpoint
+        pc.acknowledge(task_key, snapshot)
+        if pc.fully_acknowledged:
+            self._complete(pc)
+
+    def decline(self, checkpoint_id: int) -> None:
+        """(ref: CheckpointDeclineReason / abortDeclined)"""
+        self.pending.pop(checkpoint_id, None)
+
+    def abort_all_pending(self) -> None:
+        self.pending.clear()
+
+    def _complete(self, pc: PendingCheckpoint) -> None:
+        """(ref: completePendingCheckpoint :802)"""
+        del self.pending[pc.checkpoint_id]
+        now = self._clock()
+        self.storage.persist(
+            pc.checkpoint_id,
+            {"timestamp": pc.timestamp, "mode": self.mode},
+            pc.acks)
+        self.completed_count += 1
+        self.latest_completed_id = pc.checkpoint_id
+        self._last_completed_at = now
+        for st in self.stats:
+            if st.checkpoint_id == pc.checkpoint_id:
+                st.complete_ms = now
+                try:
+                    st.state_bytes = len(pickle.dumps(pc.acks))
+                except Exception:
+                    st.state_bytes = -1
+        # commit signal (ref: notifyCheckpointComplete :883)
+        self._notify_complete(pc.checkpoint_id)
+
+
+# ---------------------------------------------------------------------
+# Restart strategies (ref: flink-runtime/.../executiongraph/restart/)
+# ---------------------------------------------------------------------
+
+class RestartStrategy:
+    def can_restart(self) -> bool:
+        raise NotImplementedError
+
+    def notify_failure(self, now_ms: float) -> None:
+        pass
+
+    @property
+    def delay_ms(self) -> int:
+        return 0
+
+
+class NoRestartStrategy(RestartStrategy):
+    """(ref: NoRestartStrategy.java)"""
+
+    def can_restart(self) -> bool:
+        return False
+
+
+class FixedDelayRestartStrategy(RestartStrategy):
+    """(ref: FixedDelayRestartStrategy.java) — at most
+    `restart_attempts` restarts, `delay_ms` apart."""
+
+    def __init__(self, restart_attempts: int, delay_ms: int = 0):
+        self.restart_attempts = restart_attempts
+        self._delay_ms = delay_ms
+        self.attempts_used = 0
+
+    def can_restart(self) -> bool:
+        return self.attempts_used < self.restart_attempts
+
+    def notify_failure(self, now_ms: float) -> None:
+        self.attempts_used += 1
+
+    @property
+    def delay_ms(self) -> int:
+        return self._delay_ms
+
+
+class FailureRateRestartStrategy(RestartStrategy):
+    """(ref: FailureRateRestartStrategy.java) — restart unless more
+    than `max_failures` within `failure_interval_ms`."""
+
+    def __init__(self, max_failures: int, failure_interval_ms: int,
+                 delay_ms: int = 0):
+        self.max_failures = max_failures
+        self.failure_interval_ms = failure_interval_ms
+        self._delay_ms = delay_ms
+        self._failures: List[float] = []
+
+    def can_restart(self) -> bool:
+        return len(self._failures) < self.max_failures
+
+    def notify_failure(self, now_ms: float) -> None:
+        self._failures.append(now_ms)
+        horizon = now_ms - self.failure_interval_ms
+        self._failures = [t for t in self._failures if t >= horizon]
+
+    @property
+    def delay_ms(self) -> int:
+        return self._delay_ms
+
+
+def make_restart_strategy(config: Optional[dict]) -> RestartStrategy:
+    """(ref: RestartStrategyFactory.createRestartStrategy)"""
+    config = config or {"strategy": "none"}
+    kind = config.get("strategy", "none")
+    if kind == "none":
+        return NoRestartStrategy()
+    if kind == "fixed_delay":
+        return FixedDelayRestartStrategy(
+            config.get("restart_attempts", config.get("attempts", 1)),
+            config.get("delay_ms", 0))
+    if kind == "failure_rate":
+        return FailureRateRestartStrategy(
+            config.get("max_failures", 1),
+            config.get("failure_interval_ms", 60_000),
+            config.get("delay_ms", 0))
+    raise ValueError(f"unknown restart strategy '{kind}'")

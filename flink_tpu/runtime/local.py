@@ -1,35 +1,64 @@
-"""Single-process job execution.
+"""Single-process streaming job execution.
 
-Re-designs the task layer of flink-streaming-java (StreamTask.java:
-lifecycle :233-392, OperatorChain.java, StreamInputProcessor.java:176,
-StatusWatermarkValve) as a synchronous in-process dataflow: operator
-subtask instances are wired with direct-call outputs (operator chaining
-is literal function composition here), cross-vertex edges route through
-partitioners to per-subtask input valves that min-combine watermarks
-per channel.
+Re-designs the task layer of flink-streaming-java — StreamTask.java
+(lifecycle :233-392, run loop, performCheckpoint :618-668),
+OperatorChain.java, StreamInputProcessor.java:176 (the hot input loop),
+BarrierBuffer.java:222 (exactly-once alignment), BarrierTracker.java
+(at-least-once), StatusWatermarkValve, and SourceStreamTask — as a
+cooperative in-process dataflow:
 
-The single-owner execution loop replaces the reference's checkpoint
-lock (SURVEY.md §5 race-detection note): all element processing, timer
-firing, and snapshots happen on one thread.
+- Every cross-vertex edge delivers through per-channel bounded queues
+  (the credit-based-flow-control analogue of RemoteInputChannel.java:
+  285-298: a producer is runnable only while its output channels have
+  capacity, so backpressure propagates upstream for free).
+- Subtasks are STEPPED by one executor loop thread — all element
+  processing, timer firing, alignment, and snapshots for a subtask
+  happen on that loop, replacing the reference's checkpoint lock
+  (SURVEY.md §5 race-detection note) with single-owner execution.
+- Sources emit in steps on the same loop when they support it
+  (`emit_step`); blocking sources (sockets, external consumers) run on
+  a dedicated thread and emit under a per-subtask emission lock — the
+  literal checkpoint-lock contract of SourceContext
+  (SourceFunction.java "emit under checkpoint lock").
+- Checkpoint barriers are injected at sources at record boundaries,
+  align in-band at multi-input subtasks (blocked channels simply stop
+  being polled — their queues are the BufferSpiller analogue), and
+  each subtask acks its snapshot to the CheckpointCoordinator, which
+  persists completed checkpoints and broadcasts the commit signal.
+- Failure → restart via the configured strategy, restoring every
+  operator (and source read positions) from the latest completed
+  checkpoint (ref: ExecutionGraph.restart :1148 →
+  restoreLatestCheckpointedState :1223).
 """
 
 from __future__ import annotations
 
-from typing import Any, Dict, List, Optional, Tuple
+import threading
+import time as _time
+from collections import deque
+from typing import Any, Dict, List, Optional, Set, Tuple
 
 from flink_tpu.core.keygroups import (
-    KeyGroupRange,
     compute_key_group_range_for_operator_index,
+)
+from flink_tpu.runtime.checkpoints import (
+    CheckpointCoordinator,
+    make_checkpoint_storage,
+    make_restart_strategy,
 )
 from flink_tpu.state.loader import load_state_backend
 from flink_tpu.state.operator_state import OperatorStateBackend
 from flink_tpu.streaming.elements import (
+    END_OF_STREAM,
     MAX_WATERMARK,
     MIN_TIMESTAMP,
+    CheckpointBarrier,
+    EndOfStream,
+    LatencyMarker,
     StreamRecord,
     Watermark,
 )
-from flink_tpu.streaming.graph import JobEdge, JobGraph, JobVertex
+from flink_tpu.streaming.graph import JobGraph, JobVertex
 from flink_tpu.streaming.operators import (
     Output,
     StreamOperator,
@@ -38,12 +67,22 @@ from flink_tpu.streaming.operators import (
 from flink_tpu.streaming.sources import StreamSource
 from flink_tpu.streaming.timers import TestProcessingTimeService
 
+#: soft per-channel queue bound (the exclusive-buffer count analogue,
+#: NetworkEnvironmentConfiguration.java:45-47)
+DEFAULT_CHANNEL_CAPACITY = 1024
+
 
 class JobExecutionResult:
     def __init__(self, job_name: str):
         self.job_name = job_name
         self.accumulators: Dict[str, Any] = {}
         self.checkpoints_completed = 0
+        self.restarts = 0
+        self.cancelled = False
+
+
+class JobCancelledException(Exception):
+    pass
 
 
 class _ChainedOutput(Output):
@@ -67,6 +106,9 @@ class _ChainedOutput(Output):
         # side outputs bypass the chain and route at the task boundary
         self.router.collect_side(tag, record)
 
+    def emit_latency_marker(self, marker):
+        self.op.process_latency_marker(marker)
+
 
 class _RouterOutput(Output):
     """Chain-tail output: routes records through each out-edge's
@@ -86,65 +128,131 @@ class _RouterOutput(Output):
             if side_tag is not None:
                 continue
             for idx in partitioner.select_channels(record.value, len(channels)):
-                channels[idx].push_record(record)
+                channels[idx].push(record)
 
     def collect_side(self, tag, record):
         for partitioner, channels, side_tag in self.routes:
             if side_tag is not None and side_tag.tag_id == tag.tag_id:
                 for idx in partitioner.select_channels(record.value, len(channels)):
-                    channels[idx].push_record(record)
+                    channels[idx].push(record)
 
     def emit_watermark(self, watermark):
         # watermarks broadcast to every channel of every route
         for _, channels, _ in self.routes:
             for ch in channels:
-                ch.push_watermark(watermark)
+                ch.push(watermark)
+
+    def emit_latency_marker(self, marker):
+        for _, channels, side_tag in self.routes:
+            if side_tag is None:
+                for ch in channels:
+                    ch.push(marker)
+
+    def broadcast_barrier(self, barrier: CheckpointBarrier):
+        """(ref: OperatorChain.broadcastCheckpointBarrier)"""
+        for _, channels, _ in self.routes:
+            for ch in channels:
+                ch.push(barrier)
+
+    def broadcast_end_of_stream(self):
+        for _, channels, _ in self.routes:
+            for ch in channels:
+                ch.push(END_OF_STREAM)
+
+    def has_capacity(self) -> bool:
+        """Producer runnable check — credit-based flow control
+        analogue.  Channels blocked for alignment don't count (their
+        growth is the BufferSpiller analogue)."""
+        for _, channels, _ in self.routes:
+            for ch in channels:
+                if not ch.blocked and len(ch.queue) >= ch.capacity:
+                    return False
+        return True
 
 
 class _InputChannel:
-    """One logical channel into a subtask's input valve."""
+    """One logical channel into a subtask: a bounded FIFO of
+    StreamElements (ref: InputChannel + its queued buffers)."""
 
-    __slots__ = ("subtask", "input_index", "channel_id")
+    __slots__ = ("subtask", "input_index", "channel_id", "queue",
+                 "capacity", "blocked", "eos")
 
-    def __init__(self, subtask: "SubtaskInstance", input_index: int, channel_id: int):
+    def __init__(self, subtask: "SubtaskInstance", input_index: int,
+                 channel_id: int, capacity: int = DEFAULT_CHANNEL_CAPACITY):
         self.subtask = subtask
         self.input_index = input_index
         self.channel_id = channel_id
+        self.queue: deque = deque()
+        self.capacity = capacity
+        #: alignment-blocked (exactly-once barrier received, waiting
+        #: for the rest — ref: BarrierBuffer blocked channels)
+        self.blocked = False
+        self.eos = False
 
-    def push_record(self, record):
-        self.subtask.process_record(self.input_index, record)
-
-    def push_watermark(self, watermark):
-        self.subtask.process_channel_watermark(
-            self.input_index, self.channel_id, watermark)
+    def push(self, element) -> None:
+        self.queue.append(element)
 
 
 class SubtaskInstance:
     """One parallel instance of a JobVertex: the operator chain plus
-    input valves (ref: StreamTask + OperatorChain)."""
+    input channels and barrier alignment (ref: StreamTask +
+    OperatorChain + BarrierBuffer)."""
 
     def __init__(self, vertex: JobVertex, subtask_index: int,
                  state_backend_name: str, max_parallelism: int,
-                 processing_time_service):
+                 processing_time_service,
+                 channel_capacity: int = DEFAULT_CHANNEL_CAPACITY):
         self.vertex = vertex
         self.subtask_index = subtask_index
+        self.task_key = (vertex.id, subtask_index)
         self.max_parallelism = max_parallelism
         self.operators: List[StreamOperator] = []
         self.pts = processing_time_service
+        self.channel_capacity = channel_capacity
         self._watermarks: Dict[int, Dict[int, int]] = {}  # input -> channel -> wm
         self._current_wm: Dict[int, int] = {}
         self._channel_count = 0
+        self.input_channels: List[_InputChannel] = []
+        self._rr = 0  # round-robin cursor over channels
+        self.finished = False
+        self.closed = False
+        #: teardown signal observed by the threaded-source
+        #: backpressure wait (set before joining the thread)
+        self.cancelling = False
+
+        # barrier alignment state (exactly-once)
+        self._align_id: Optional[int] = None
+        self._align_barrier: Optional[CheckpointBarrier] = None
+        self._align_received: Set[int] = set()  # channel ids
+        # at-least-once barrier counting (ref: BarrierTracker)
+        self._tracker_counts: Dict[int, Tuple[CheckpointBarrier, Set[int]]] = {}
+
+        #: set by the executor: callable(task_key, checkpoint_id, snapshot)
+        self.ack_fn = None
+        #: source-only: (checkpoint_id, timestamp, options) to inject
+        self.pending_trigger: Optional[Tuple[int, int, dict]] = None
+        #: source-only (threaded): checkpoint-complete notifications
+        #: awaiting delivery under the emission lock
+        self.pending_notifications: deque = deque()
+        #: source-only: serializes emissions vs. barrier injection for
+        #: threaded sources (the checkpoint lock, StreamTask.java:106).
+        #: Reentrant so a source can hold it across emit+offset-advance
+        #: (SourceContext.get_checkpoint_lock contract) while collect
+        #: re-acquires it.
+        self.emission_lock = threading.RLock()
+        self._source_ctx = None
+        self._thread: Optional[threading.Thread] = None
+        self.thread_error: Optional[BaseException] = None
 
         # build the chain, tail first so outputs exist when wiring heads
         chain = vertex.chain
         self.router = _RouterOutput()
-        outputs: Dict[int, Output] = {}
         ops_by_node: Dict[int, StreamOperator] = {}
         for node in reversed(chain):
             out_edge = next((e for e in vertex.chain_edges
                              if e.source_id == node.id), None)
             if out_edge is None:
-                output = self.router
+                output: Output = self.router
             else:
                 output = _ChainedOutput(ops_by_node[out_edge.target_id],
                                         self.router)
@@ -166,7 +274,6 @@ class SubtaskInstance:
                 operator_id=node.uid,
             )
             ops_by_node[node.id] = op
-            outputs[node.id] = output
         # operators in chain order (head first)
         self.operators = [ops_by_node[n.id] for n in chain]
 
@@ -179,8 +286,10 @@ class SubtaskInstance:
         return isinstance(self.head, StreamSource)
 
     def new_channel(self, input_index: int) -> _InputChannel:
-        ch = _InputChannel(self, input_index, self._channel_count)
+        ch = _InputChannel(self, input_index, self._channel_count,
+                           self.channel_capacity)
         self._channel_count += 1
+        self.input_channels.append(ch)
         self._watermarks.setdefault(input_index, {})[ch.channel_id] = MIN_TIMESTAMP
         return ch
 
@@ -190,15 +299,210 @@ class SubtaskInstance:
             op.open()
 
     def close(self):
+        if self.closed:
+            return
+        self.closed = True
         for op in self.operators:
             op.close()
 
-    def run_source(self):
-        assert self.is_source
-        self.head.run()
-        # end of input: flush event time (ref: StreamSource closes with
-        # MAX_WATERMARK so windows drain)
+    # ---- source path (ref: SourceStreamTask / StreamSource) ---------
+    def source_context(self):
+        if self._source_ctx is None:
+            self._source_ctx = self.head.make_context()
+        return self._source_ctx
+
+    @property
+    def supports_stepping(self) -> bool:
+        return hasattr(self.head.user_function, "emit_step")
+
+    def source_step(self, max_records: int) -> int:
+        """Cooperative source: emit up to max_records on the executor
+        loop; inject a pending barrier first (record boundary)."""
+        if self.finished:
+            return 0
+        self.handle_pending_trigger()
+        if not self.router.has_capacity():
+            return 0
+        more = self.head.user_function.emit_step(
+            self.source_context(), max_records)
+        if not more:
+            self.finish_source()
+        return 1
+
+    def finish_source(self):
+        """End of input: flush a pending barrier, then event time, then
+        signal end-of-stream downstream (ref: StreamSource closes with
+        MAX_WATERMARK so windows drain)."""
+        if self.finished:
+            return
+        self.handle_pending_trigger()
+        # through the chain (head.output), not the router: chained
+        # operators must see the final watermark too (timer flushes)
         self.head.output.emit_watermark(MAX_WATERMARK)
+        self.router.broadcast_end_of_stream()
+        self.finished = True
+
+    def run_source_threaded(self):
+        """Blocking source on its own thread, emitting under the
+        emission lock (the SourceContext checkpoint-lock contract)."""
+        assert self.is_source
+
+        def target():
+            try:
+                ctx = self.head.make_context(
+                    output=_LockedSourceOutput(self))
+                ctx._checkpoint_lock = self.emission_lock
+                self._source_ctx = ctx
+                self.head.user_function.run(ctx)
+                with self.emission_lock:
+                    self.finish_source()
+            except BaseException as e:  # noqa: BLE001
+                self.thread_error = e
+
+        self._thread = threading.Thread(target=target, daemon=True,
+                                        name=f"source-{self.task_key}")
+        self._thread.start()
+
+    def cancel_source(self):
+        if self.is_source:
+            self.cancelling = True  # unblocks a backpressured emit wait
+            try:
+                self.head.cancel()
+            except Exception:  # noqa: BLE001
+                pass
+
+    def join_source(self, timeout: float = 5.0):
+        if self._thread is not None:
+            self._thread.join(timeout)
+
+    # ---- barrier injection (sources) --------------------------------
+    def handle_pending_trigger(self):
+        """Snapshot + inject the barrier at a record boundary (ref:
+        StreamTask.performCheckpoint :618-668 — barrier broadcast and
+        snapshot happen atomically w.r.t. element processing)."""
+        trig = self.pending_trigger
+        if trig is None or self.finished:
+            return
+        self.pending_trigger = None
+        cid, ts, options = trig
+        barrier = CheckpointBarrier(cid, ts, options)
+        snapshot = self.snapshot()
+        self.router.broadcast_barrier(barrier)
+        if self.ack_fn is not None:
+            self.ack_fn(self.task_key, cid, snapshot)
+
+    def try_inject_threaded_trigger(self):
+        """Executor-side injection for blocking sources: take the
+        emission lock opportunistically (the trigger thread acquiring
+        the checkpoint lock, StreamTask.java:563)."""
+        if self.pending_trigger is None or self.finished:
+            return
+        if self.emission_lock.acquire(blocking=False):
+            try:
+                self.handle_pending_trigger()
+            finally:
+                self.emission_lock.release()
+
+    # ---- input stepping (ref: StreamInputProcessor.processInput) ----
+    def step(self, budget: int) -> int:
+        """Process up to `budget` queued elements, round-robin over
+        non-blocked channels.  Returns elements processed.  Finished
+        tasks still drain stray queued elements (end-of-job timer
+        firings can emit after EOS propagated)."""
+        if not self.input_channels:
+            return 0
+        processed = 0
+        n = len(self.input_channels)
+        idle_scan = 0
+        while processed < budget and idle_scan < n:
+            ch = self.input_channels[self._rr % n]
+            self._rr += 1
+            if ch.blocked or not ch.queue:
+                idle_scan += 1
+                continue
+            idle_scan = 0
+            element = ch.queue.popleft()
+            self._dispatch(ch, element)
+            processed += 1
+        return processed
+
+    def _dispatch(self, ch: _InputChannel, element):
+        if element.__class__ is StreamRecord or element.is_record:
+            self.process_record(ch.input_index, element)
+        elif element.is_watermark:
+            self.process_channel_watermark(ch.input_index, ch.channel_id,
+                                           element)
+        elif element.is_barrier:
+            self._on_barrier(ch, element)
+        elif isinstance(element, EndOfStream):
+            self._on_end_of_stream(ch)
+        elif element.is_latency_marker:
+            self.head.process_latency_marker(element)
+
+    # ---- barrier handling -------------------------------------------
+    def _live_channel_ids(self) -> Set[int]:
+        return {c.channel_id for c in self.input_channels if not c.eos}
+
+    def _on_barrier(self, ch: _InputChannel, barrier: CheckpointBarrier):
+        if barrier.options.get("mode") == "at_least_once":
+            # ref: BarrierTracker — count, never block
+            entry = self._tracker_counts.setdefault(
+                barrier.checkpoint_id, (barrier, set()))
+            entry[1].add(ch.channel_id)
+            if entry[1] >= self._live_channel_ids():
+                del self._tracker_counts[barrier.checkpoint_id]
+                self._complete_checkpoint(barrier)
+            return
+        # exactly-once alignment (ref: BarrierBuffer.processBarrier :222)
+        if self._align_id is None:
+            self._align_id = barrier.checkpoint_id
+            self._align_barrier = barrier
+            self._align_received = set()
+        elif barrier.checkpoint_id != self._align_id:
+            # a newer barrier cancels the in-flight alignment
+            self._release_alignment()
+            self._align_id = barrier.checkpoint_id
+            self._align_barrier = barrier
+            self._align_received = set()
+        self._align_received.add(ch.channel_id)
+        ch.blocked = True
+        self._maybe_complete_alignment()
+
+    def _maybe_complete_alignment(self):
+        if self._align_id is None:
+            return
+        if self._align_received >= self._live_channel_ids():
+            barrier = self._align_barrier
+            self._release_alignment()
+            self._complete_checkpoint(barrier)
+
+    def _release_alignment(self):
+        for c in self.input_channels:
+            c.blocked = False
+        self._align_id = None
+        self._align_barrier = None
+        self._align_received = set()
+
+    def _complete_checkpoint(self, barrier: CheckpointBarrier):
+        """All channels aligned: snapshot, forward barrier, ack (ref:
+        StreamTask.triggerCheckpointOnBarrier :586 →
+        performCheckpoint :618 — barrier forwarded first, then
+        snapshot, both atomically on this loop)."""
+        snapshot = self.snapshot()
+        self.router.broadcast_barrier(barrier)
+        if self.ack_fn is not None:
+            self.ack_fn(self.task_key, barrier.checkpoint_id, snapshot)
+
+    def _on_end_of_stream(self, ch: _InputChannel):
+        ch.eos = True
+        ch.blocked = False
+        self._maybe_complete_alignment()
+        if all(c.eos for c in self.input_channels):
+            self.finished = True
+            self.router.broadcast_end_of_stream()
+
+    def has_queued_input(self) -> bool:
+        return any(c.queue for c in self.input_channels)
 
     # ---- input path (ref: StreamInputProcessor.processInput :176) ---
     def process_record(self, input_index: int, record: StreamRecord):
@@ -250,26 +554,139 @@ class SubtaskInstance:
             if per_op:
                 op.restore_state(per_op)
 
+    def notify_checkpoint_complete(self, checkpoint_id: int) -> None:
+        if self._thread is not None:
+            # a thread-hosted source's run() may mutate the same state
+            # its commit callback touches — the callback must run under
+            # the emission lock.  A BLOCKING acquire here would
+            # deadlock: the source can hold the lock across a
+            # backpressure wait that only this executor loop relieves.
+            # So queue it; it is delivered at the next emission
+            # boundary (or opportunistically from the loop).
+            self.pending_notifications.append(checkpoint_id)
+            self.try_deliver_notifications()
+            return
+        for op in self.operators:
+            op.notify_checkpoint_complete(checkpoint_id)
+
+    def try_deliver_notifications(self):
+        if not self.pending_notifications:
+            return
+        if self.emission_lock.acquire(blocking=False):
+            try:
+                self._deliver_notifications_locked()
+            finally:
+                self.emission_lock.release()
+
+    def _deliver_notifications_locked(self):
+        while self.pending_notifications:
+            cid = self.pending_notifications.popleft()
+            for op in self.operators:
+                op.notify_checkpoint_complete(cid)
+
+
+class _LockedSourceOutput(Output):
+    """Head output for threaded sources: every emission takes the
+    subtask's emission lock, handles a pending barrier trigger at the
+    record boundary, applies backpressure (bounded downstream queues),
+    then forwards to the head operator's real output."""
+
+    def __init__(self, subtask: SubtaskInstance):
+        self._st = subtask
+        self._inner = subtask.head.output
+
+    def _emit(self, fn, element):
+        st = self._st
+        # backpressure outside the lock so barrier injection can
+        # proceed while we wait; a closing task stops applying it so
+        # the thread can observe cancellation instead of spinning
+        while (not st.router.has_capacity() and not st.closed
+               and not st.cancelling):
+            _time.sleep(0.0005)
+        with st.emission_lock:
+            st._deliver_notifications_locked()
+            st.handle_pending_trigger()
+            fn(element)
+
+    def collect(self, record):
+        self._emit(self._inner.collect, record)
+
+    def emit_watermark(self, watermark):
+        self._emit(self._inner.emit_watermark, watermark)
+
+    def collect_side(self, tag, record):
+        with self._st.emission_lock:
+            self._inner.collect_side(tag, record)
+
+    def emit_latency_marker(self, marker):
+        self._emit(self._inner.emit_latency_marker, marker)
+
+
+class JobClient:
+    """Handle on a running job (ref: the client side of
+    ClusterClient/JobMaster: cancel + result retrieval)."""
+
+    def __init__(self):
+        self._cancel = threading.Event()
+        self._done = threading.Event()
+        self._result: Optional[JobExecutionResult] = None
+        self._error: Optional[BaseException] = None
+        self._thread: Optional[threading.Thread] = None
+        #: live view for tests/monitoring; swapped on restart
+        self.executor_state: Optional[dict] = None
+
+    def cancel(self) -> None:
+        self._cancel.set()
+
+    @property
+    def cancel_requested(self) -> bool:
+        return self._cancel.is_set()
+
+    def wait(self, timeout: Optional[float] = None) -> JobExecutionResult:
+        self._done.wait(timeout)
+        if not self._done.is_set():
+            raise TimeoutError("job still running")
+        if self._error is not None:
+            raise self._error
+        return self._result
+
+    @property
+    def done(self) -> bool:
+        return self._done.is_set()
+
+    def _finish(self, result=None, error=None):
+        self._result = result
+        self._error = error
+        self._done.set()
+
 
 class LocalExecutor:
-    """Runs a JobGraph to completion in-process
-    (the MiniCluster-equivalent for one process; multi-worker execution
-    lives in flink_tpu/runtime/minicluster.py)."""
+    """Runs a JobGraph in-process with a cooperative streaming loop
+    (the single-worker MiniCluster analogue)."""
+
+    #: elements per subtask per loop iteration
+    STEP_BUDGET = 256
+    #: records per cooperative source step
+    SOURCE_BATCH = 128
 
     def __init__(self, state_backend: str = "heap", max_parallelism: int = 128,
                  restart_strategy: Optional[dict] = None,
-                 processing_time_service=None):
+                 processing_time_service=None,
+                 channel_capacity: int = DEFAULT_CHANNEL_CAPACITY):
         self.state_backend = state_backend
         self.max_parallelism = max_parallelism
-        self.restart_strategy = restart_strategy or {"strategy": "none"}
+        self.restart_strategy_config = restart_strategy or {"strategy": "none"}
         self.pts = processing_time_service or TestProcessingTimeService()
+        self.channel_capacity = channel_capacity
 
+    # ---- graph → subtasks ------------------------------------------
     def build_subtasks(self, job_graph: JobGraph) -> Dict[int, List[SubtaskInstance]]:
         subtasks: Dict[int, List[SubtaskInstance]] = {}
         for vid, vertex in job_graph.vertices.items():
             subtasks[vid] = [
                 SubtaskInstance(vertex, i, self.state_backend,
-                                self.max_parallelism, self.pts)
+                                self.max_parallelism, self.pts,
+                                self.channel_capacity)
                 for i in range(vertex.parallelism)
             ]
         # wire edges: all-to-all for shuffling partitioners; contiguous
@@ -292,28 +709,218 @@ class LocalExecutor:
                 up.router.add_route(partitioner, channels, edge.side_output_tag)
         return subtasks
 
+    # ---- public API -------------------------------------------------
     def execute(self, job_graph: JobGraph) -> JobExecutionResult:
-        subtasks = self.build_subtasks(job_graph)
-        order = job_graph.topological_vertices()
-        all_instances = [st for v in order for st in subtasks[v.id]]
-        for st in all_instances:
-            st.open()
-        try:
-            for v in order:
-                if v.is_source:
-                    for st in subtasks[v.id]:
-                        st.run_source()
-            # end of input: drain processing-time timers so finite jobs
-            # with processing-time windows emit their tails (a local-
-            # runtime convenience; a long-running cluster job's clock
-            # keeps advancing instead)
-            if isinstance(self.pts, TestProcessingTimeService):
-                self.pts.fire_all_pending()
-        finally:
-            for st in all_instances:
-                st.close()
+        client = JobClient()
+        self._run_job(job_graph, client)
+        return client.wait()
+
+    def execute_async(self, job_graph: JobGraph) -> JobClient:
+        client = JobClient()
+        t = threading.Thread(target=self._run_job,
+                             args=(job_graph, client),
+                             daemon=True, name="job-executor")
+        client._thread = t
+        t.start()
+        return client
+
+    # ---- job driver (with restarts) ---------------------------------
+    def _run_job(self, job_graph: JobGraph, client: JobClient) -> None:
         result = JobExecutionResult(job_graph.job_name)
-        return result
+        cp_config = job_graph.checkpoint_config
+        storage = make_checkpoint_storage(cp_config) if cp_config else None
+        restart = make_restart_strategy(self.restart_strategy_config)
+        restore_from = None
+        try:
+            while True:
+                try:
+                    self._run_attempt(job_graph, client, result, storage,
+                                      restore_from)
+                    client._finish(result=result)
+                    return
+                except JobCancelledException:
+                    result.cancelled = True
+                    client._finish(result=result)
+                    return
+                except Exception as e:  # noqa: BLE001
+                    restart.notify_failure(_time.monotonic() * 1000.0)
+                    if client.cancel_requested or not restart.can_restart():
+                        raise
+                    result.restarts += 1
+                    if restart.delay_ms:
+                        _time.sleep(restart.delay_ms / 1000.0)
+                    restore_from = storage.latest() if storage else None
+        except BaseException as e:  # noqa: BLE001
+            client._finish(error=e)
+
+    def _run_attempt(self, job_graph: JobGraph, client: JobClient,
+                     result: JobExecutionResult, storage,
+                     restore_from: Optional[dict]) -> None:
+        subtasks = self.build_subtasks(job_graph)
+        all_tasks: List[SubtaskInstance] = [
+            st for v in job_graph.topological_vertices() for st in subtasks[v.id]]
+        sources = [st for st in all_tasks if st.is_source]
+        non_sources = [st for st in all_tasks if not st.is_source]
+        coop_sources = [s for s in sources if s.supports_stepping]
+        threaded_sources = [s for s in sources if not s.supports_stepping]
+
+        # restore BEFORE open: descriptors bind in open(), but keyed
+        # backends require registered descriptors before restore — so
+        # open first, then restore (matches StreamTask.initializeState
+        # ordering: state handles assigned, then operators opened; our
+        # backends support restore-after-bind)
+        for st in all_tasks:
+            st.open()
+        if restore_from is not None:
+            task_snaps: Dict[Tuple[int, int], dict] = restore_from["tasks"]
+            # restarts rebuild from the same JobGraph, so task keys
+            # always match one-to-one (rescale-on-restore is a
+            # savepoint operation, not a failover one)
+            for st in all_tasks:
+                if st.task_key in task_snaps:
+                    st.restore([task_snaps[st.task_key]])
+
+        # checkpoint coordination
+        ack_queue: deque = deque()
+        coordinator = None
+        if storage is not None and job_graph.checkpoint_config.get("interval"):
+            cfg = job_graph.checkpoint_config
+
+            def trigger_sources(cid, ts, options):
+                # 1.5 likewise fails checkpoints once a task finished
+                if any(s.finished for s in sources):
+                    return False
+                for s in sources:
+                    s.pending_trigger = (cid, ts, options)
+                return True
+
+            def notify_complete(cid):
+                for st in all_tasks:
+                    st.notify_checkpoint_complete(cid)
+
+            coordinator = CheckpointCoordinator(
+                interval_ms=cfg["interval"],
+                mode=cfg.get("mode", "exactly_once"),
+                storage=storage,
+                expected_tasks={st.task_key for st in all_tasks},
+                trigger_sources=trigger_sources,
+                notify_complete=notify_complete,
+                min_pause_ms=cfg.get("min_pause", 0),
+            )
+            # continue the id sequence across restarts
+            ids = storage.checkpoint_ids()
+            if ids:
+                coordinator._id_counter = ids[-1]
+
+        def ack(task_key, cid, snapshot):
+            ack_queue.append((task_key, cid, snapshot))
+
+        for st in all_tasks:
+            st.ack_fn = ack
+
+        client.executor_state = {
+            "subtasks": subtasks, "coordinator": coordinator,
+        }
+
+        for s in threaded_sources:
+            s.run_source_threaded()
+
+        try:
+            self._loop(client, result, coordinator, ack_queue,
+                       all_tasks, sources, coop_sources, threaded_sources,
+                       non_sources)
+        finally:
+            if coordinator is not None:
+                # completed_count is per attempt; accumulate across restarts
+                result.checkpoints_completed = (
+                    getattr(result, "_cp_base", 0) + coordinator.completed_count)
+                result._cp_base = result.checkpoints_completed
+                coordinator.stopped = True
+            for s in sources:
+                s.cancel_source()
+            for s in threaded_sources:
+                s.join_source()
+            for st in all_tasks:
+                st.close()
+
+    # ---- the loop ---------------------------------------------------
+    def _loop(self, client, result, coordinator, ack_queue, all_tasks,
+              sources, coop_sources, threaded_sources, non_sources):
+        pts = self.pts
+        pts_poll = getattr(pts, "fire_due", None)
+        while True:
+            if client.cancel_requested:
+                raise JobCancelledException()
+            progress = 0
+
+            # 0. trigger before sources step, so a due checkpoint's
+            # barrier rides ahead of this iteration's records
+            if coordinator is not None and all(not s.finished for s in sources):
+                coordinator.maybe_trigger()
+
+            # 1. sources
+            for s in coop_sources:
+                if not s.finished:
+                    progress += s.source_step(self.SOURCE_BATCH)
+            for s in threaded_sources:
+                if s.thread_error is not None:
+                    raise s.thread_error
+                s.try_inject_threaded_trigger()
+                s.try_deliver_notifications()
+
+            # 2. operators
+            for st in non_sources:
+                progress += st.step(self.STEP_BUDGET)
+
+            # 3. processing time (polled services fire on this loop —
+            # the single-owner replacement for the reference's timer
+            # thread + checkpoint lock)
+            if pts_poll is not None:
+                progress += pts_poll()
+
+            # 4. checkpoints
+            if coordinator is not None:
+                while ack_queue:
+                    task_key, cid, snapshot = ack_queue.popleft()
+                    coordinator.acknowledge(task_key, cid, snapshot)
+                # a source that finished with an unhandled trigger can
+                # never ack — decline that checkpoint (threaded-source
+                # race; cooperative sources handle triggers in-step)
+                for s in sources:
+                    if s.finished and s.pending_trigger is not None:
+                        cid = s.pending_trigger[0]
+                        s.pending_trigger = None
+                        coordinator.decline(cid)
+
+            # 5. termination: sources done, every queue drained, and
+            # no source thread still able to produce
+            if (all(s.finished for s in sources)
+                    and not any(st.has_queued_input() for st in non_sources)
+                    and all(s._thread is None or not s._thread.is_alive()
+                            for s in threaded_sources)):
+                break
+            if progress == 0:
+                # nothing runnable on this loop; threaded sources or
+                # wall-clock timers may produce work
+                _time.sleep(0.0002)
+
+        # end of input: drain processing-time timers so finite jobs
+        # with processing-time windows emit their tails (a local-
+        # runtime convenience; a long-running job's clock keeps going).
+        # Timer firings can EMIT across vertex edges, whose queued
+        # records must then be processed — and that processing can
+        # register further timers, so alternate until quiescent.
+        if isinstance(pts, TestProcessingTimeService):
+            for _ in range(1000):  # bounded cascade
+                pts.fire_all_pending()
+                moved = sum(st.step(1 << 30) for st in non_sources)
+                if moved == 0 and not pts.has_pending():
+                    break
+        # final acks (a checkpoint may complete exactly at the end)
+        if coordinator is not None:
+            while ack_queue:
+                task_key, cid, snapshot = ack_queue.popleft()
+                coordinator.acknowledge(task_key, cid, snapshot)
 
 
 def _clone_partitioner(p):

@@ -98,6 +98,8 @@ class StreamExecutionEnvironment:
         self.time_characteristic = "event"  # event | processing | ingestion
         self.checkpoint_interval: Optional[int] = None
         self.checkpoint_mode = "exactly_once"
+        self.checkpoint_storage: dict = {"storage": "memory", "retain": 1}
+        self.processing_time_service = None  # executor default if None
         self.state_backend: str = self.config.get_string("state.backend", "heap")
         self.restart_strategy: Optional[dict] = {"strategy": "none"}
         self._executed = False
@@ -129,6 +131,16 @@ class StreamExecutionEnvironment:
                              mode: str = "exactly_once") -> "StreamExecutionEnvironment":
         self.checkpoint_interval = interval_ms
         self.checkpoint_mode = mode
+        return self
+
+    def set_checkpoint_storage(self, storage: str, directory: Optional[str] = None,
+                               retain: int = 1) -> "StreamExecutionEnvironment":
+        """`memory` | `filesystem` (with directory) — the checkpoint-
+        storage half of the state.backend switch (ref:
+        MemoryStateBackend vs FsStateBackend checkpoint streams)."""
+        self.checkpoint_storage = {"storage": storage, "retain": retain}
+        if directory is not None:
+            self.checkpoint_storage["dir"] = directory
         return self
 
     def set_restart_strategy(self, strategy: str, **kw) -> "StreamExecutionEnvironment":
@@ -177,19 +189,29 @@ class StreamExecutionEnvironment:
             jg.checkpoint_config = {
                 "interval": self.checkpoint_interval,
                 "mode": self.checkpoint_mode,
+                **self.checkpoint_storage,
             }
         return jg
 
-    def execute(self, job_name: str = "job"):
-        """(ref: execute :1508) — runs on the local executor."""
+    def _make_executor(self):
         from flink_tpu.runtime.local import LocalExecutor
-        self.graph.job_name = job_name
-        executor = LocalExecutor(
+        return LocalExecutor(
             state_backend=self.state_backend,
             max_parallelism=self.max_parallelism,
             restart_strategy=self.restart_strategy,
+            processing_time_service=self.processing_time_service,
         )
-        return executor.execute(self.get_job_graph())
+
+    def execute(self, job_name: str = "job"):
+        """(ref: execute :1508) — runs on the local executor."""
+        self.graph.job_name = job_name
+        return self._make_executor().execute(self.get_job_graph())
+
+    def execute_async(self, job_name: str = "job"):
+        """Submit and return a JobClient with cancel()/wait() — the
+        detached-submission shape of ClusterClient.run()."""
+        self.graph.job_name = job_name
+        return self._make_executor().execute_async(self.get_job_graph())
 
 
 def _source_factory(source_function: SourceFunction, time_characteristic: str):

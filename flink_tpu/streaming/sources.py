@@ -25,6 +25,10 @@ from flink_tpu.streaming.operators import AbstractUdfStreamOperator, Output
 class SourceContext(abc.ABC):
     """(ref: SourceFunction.SourceContext)"""
 
+    #: set by the task layer for thread-hosted sources (the emission
+    #: lock); lazily created otherwise
+    _checkpoint_lock = None
+
     @abc.abstractmethod
     def collect(self, value) -> None: ...
 
@@ -33,6 +37,18 @@ class SourceContext(abc.ABC):
 
     @abc.abstractmethod
     def emit_watermark(self, watermark: Watermark) -> None: ...
+
+    def get_checkpoint_lock(self):
+        """(ref: SourceContext.getCheckpointLock) — a thread-hosted
+        source MUST advance its replay position inside this lock in the
+        same critical section as the emission, or a barrier injected
+        between emit and position-update snapshots a stale offset and
+        replay duplicates the record.  Reentrant: ctx.collect takes the
+        same lock."""
+        import threading
+        if self._checkpoint_lock is None:
+            self._checkpoint_lock = threading.RLock()
+        return self._checkpoint_lock
 
     def mark_as_temporarily_idle(self) -> None:  # noqa: B027
         pass
@@ -152,13 +168,16 @@ class StreamSource(AbstractUdfStreamOperator):
         super().__init__(source_function)
         self.time_characteristic = time_characteristic
 
-    def make_context(self) -> SourceContext:
+    def make_context(self, output: Optional[Output] = None) -> SourceContext:
+        """`output` override lets the task layer interpose the
+        emission-lock wrapper for thread-hosted sources."""
+        out = output if output is not None else self.output
         if self.time_characteristic == "processing":
-            return NonTimestampContext(self.output)
+            return NonTimestampContext(out)
         if self.time_characteristic == "ingestion":
             return AutomaticWatermarkContext(
-                self.output, self.processing_time_service)
-        return ManualWatermarkContext(self.output)
+                out, self.processing_time_service)
+        return ManualWatermarkContext(out)
 
     def run(self) -> None:
         self.user_function.run(self.make_context())
@@ -168,6 +187,28 @@ class StreamSource(AbstractUdfStreamOperator):
 
     def process_element(self, record):
         raise RuntimeError("sources have no input")
+
+    # ---- source position in checkpoints -----------------------------
+    def snapshot_state(self) -> dict:
+        """The source's read position rides in the operator snapshot so
+        restore rewinds it (ref: the CheckpointedFunction contract used
+        by replayable sources, FlinkKafkaConsumerBase.snapshotState)."""
+        snap = super().snapshot_state()
+        fn = self.user_function
+        if hasattr(fn, "snapshot_offset"):
+            snap["source_offset"] = fn.snapshot_offset()
+        elif hasattr(fn, "snapshot_source_state"):
+            snap["source_state"] = fn.snapshot_source_state()
+        return snap
+
+    def restore_state(self, snapshots) -> None:
+        super().restore_state(snapshots)
+        fn = self.user_function
+        for snap in snapshots:
+            if "source_offset" in snap and hasattr(fn, "restore_offset"):
+                fn.restore_offset(snap["source_offset"])
+            elif "source_state" in snap and hasattr(fn, "restore_source_state"):
+                fn.restore_source_state(snap["source_state"])
 
 
 # ---------------------------------------------------------------------
@@ -189,10 +230,19 @@ class FromCollectionSource(SourceFunction):
         self.offset = 0
 
     def run(self, ctx: SourceContext):
+        while self.emit_step(ctx, len(self.items) + 1):
+            pass
+
+    def emit_step(self, ctx: SourceContext, max_records: int) -> bool:
+        """Cooperative-stepping contract used by the executor loop:
+        emit up to `max_records`, return True while more remain.  The
+        offset is the exactly-once resume point — snapshots taken at
+        step boundaries see only fully-emitted records."""
         from flink_tpu.streaming.elements import MAX_WATERMARK
-        while self.offset < len(self.items):
+        n = 0
+        while self.offset < len(self.items) and n < max_records:
             if self._cancelled:
-                return
+                return False
             item = self.items[self.offset]
             if self.timestamped:
                 value, ts = item
@@ -200,8 +250,13 @@ class FromCollectionSource(SourceFunction):
             else:
                 ctx.collect(item)
             self.offset += 1
+            n += 1
+        if self.offset < len(self.items):
+            return True
         if self.final_watermark:
             ctx.emit_watermark(MAX_WATERMARK)
+            self.final_watermark = False  # emit once
+        return False
 
     def cancel(self):
         self._cancelled = True

@@ -77,6 +77,45 @@ class SystemProcessingTimeService(ProcessingTimeService):
             t.cancel()
 
 
+class PolledProcessingTimeService(ProcessingTimeService):
+    """Wall-clock timers fired on the CALLER's thread via fire_due() —
+    the executor loop polls it each iteration, keeping timer callbacks
+    on the single-owner loop (the reference instead fires on a
+    scheduler thread under the checkpoint lock,
+    SystemProcessingTimeService.java)."""
+
+    def __init__(self):
+        self._queue: List[Tuple[int, int, Callable]] = []
+        self._seq = 0
+        # register_timer may be called from a source thread (ingestion-
+        # time contexts register inside collect) while fire_due pops on
+        # the executor loop — guard the heap
+        self._lock = threading.Lock()
+
+    def get_current_processing_time(self) -> int:
+        return int(_time.time() * 1000)
+
+    def register_timer(self, timestamp: int, callback):
+        with self._lock:
+            heapq.heappush(self._queue, (timestamp, self._seq, callback))
+            self._seq += 1
+
+    def fire_due(self) -> int:
+        """Fire every timer due at the current wall clock; returns the
+        number fired (loop-progress signal).  Callbacks run OUTSIDE the
+        heap lock, on the caller's (executor-loop) thread."""
+        now = self.get_current_processing_time()
+        fired = 0
+        while True:
+            with self._lock:
+                if not self._queue or self._queue[0][0] > now:
+                    break
+                ts, _, cb = heapq.heappop(self._queue)
+            cb(ts)
+            fired += 1
+        return fired
+
+
 class TestProcessingTimeService(ProcessingTimeService):
     """Manually advanced clock for harness tests
     (ref: TestProcessingTimeService.java)."""
@@ -113,6 +152,9 @@ class TestProcessingTimeService(ProcessingTimeService):
             return
         horizon = max(ts for ts, _, _ in self._queue)
         self.set_current_time(max(horizon, self._now))
+
+    def has_pending(self) -> bool:
+        return bool(self._queue)
 
 
 class InternalTimer:
