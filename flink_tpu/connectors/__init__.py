@@ -1,0 +1,21 @@
+"""Connectors: replayable sources and transactional sinks
+(the flink-connectors/ tier, reduced to the Kafka-shaped contract the
+framework's exactly-once story runs through)."""
+
+from flink_tpu.connectors.partitioned_log import (
+    FilePartitionedLog,
+    InMemoryPartitionedLog,
+    PartitionedLog,
+)
+from flink_tpu.connectors.log_connector import (
+    ReplayableLogSource,
+    TransactionalLogSink,
+)
+
+__all__ = [
+    "FilePartitionedLog",
+    "InMemoryPartitionedLog",
+    "PartitionedLog",
+    "ReplayableLogSource",
+    "TransactionalLogSink",
+]

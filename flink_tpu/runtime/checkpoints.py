@@ -35,7 +35,8 @@ class CheckpointStorage:
     + CheckpointStorage).  Keys are (vertex_id, subtask_index)."""
 
     def persist(self, checkpoint_id: int, metadata: dict,
-                task_snapshots: Dict[Tuple[int, int], dict]) -> None:
+                task_snapshots: Dict[Tuple[int, int], dict]) -> Optional[int]:
+        """Returns the persisted size in bytes when known."""
         raise NotImplementedError
 
     def latest(self) -> Optional[dict]:
@@ -65,6 +66,7 @@ class MemoryCheckpointStorage(CheckpointStorage):
         }
         for cid in sorted(self._store)[:-self.retain]:
             del self._store[cid]
+        return None  # in-memory: size not measured
 
     def latest(self):
         if not self._store:
@@ -100,12 +102,14 @@ class FsCheckpointStorage(CheckpointStorage):
         tmp = self._path(checkpoint_id) + ".part"
         with open(tmp, "wb") as f:
             pickle.dump(payload, f, protocol=pickle.HIGHEST_PROTOCOL)
+            size = f.tell()
         os.replace(tmp, self._path(checkpoint_id))
         for cid in self.checkpoint_ids()[:-self.retain]:
             try:
                 os.remove(self._path(cid))
             except OSError:
                 pass
+        return size
 
     def latest(self):
         ids = self.checkpoint_ids()
@@ -215,7 +219,9 @@ class CheckpointCoordinator:
         # first trigger fires immediately — fast finite jobs still get
         # a checkpoint in before their sources drain
         self._last_triggered_at: float = self._clock() - (interval_ms or 0)
-        self.stats: List[CheckpointStats] = []
+        #: checkpoint_id -> CheckpointStats, pruned to STATS_RETAIN
+        self.stats: Dict[int, CheckpointStats] = {}
+        self.STATS_RETAIN = 128
         self.stopped = False
 
     # ---- trigger ----------------------------------------------------
@@ -242,10 +248,13 @@ class CheckpointCoordinator:
         self._last_triggered_at = now
         self.pending[cid] = PendingCheckpoint(
             cid, int(now), self.expected_tasks)
-        self.stats.append(CheckpointStats(cid, now))
+        self.stats[cid] = CheckpointStats(cid, now)
+        for old in sorted(self.stats)[:-self.STATS_RETAIN]:
+            del self.stats[old]
         ok = self._trigger_sources(cid, int(now), {"mode": self.mode})
         if ok is False:
             del self.pending[cid]
+            self.stats.pop(cid, None)
             return None
         return cid
 
@@ -271,20 +280,17 @@ class CheckpointCoordinator:
         """(ref: completePendingCheckpoint :802)"""
         del self.pending[pc.checkpoint_id]
         now = self._clock()
-        self.storage.persist(
+        state_bytes = self.storage.persist(
             pc.checkpoint_id,
             {"timestamp": pc.timestamp, "mode": self.mode},
             pc.acks)
         self.completed_count += 1
         self.latest_completed_id = pc.checkpoint_id
         self._last_completed_at = now
-        for st in self.stats:
-            if st.checkpoint_id == pc.checkpoint_id:
-                st.complete_ms = now
-                try:
-                    st.state_bytes = len(pickle.dumps(pc.acks))
-                except Exception:
-                    st.state_bytes = -1
+        st = self.stats.get(pc.checkpoint_id)
+        if st is not None:
+            st.complete_ms = now
+            st.state_bytes = state_bytes if state_bytes is not None else -1
         # commit signal (ref: notifyCheckpointComplete :883)
         self._notify_complete(pc.checkpoint_id)
 

@@ -85,6 +85,18 @@ class JobCancelledException(Exception):
     pass
 
 
+class SuppressRestartsException(Exception):
+    """Wraps a failure that must NOT trigger the restart strategy
+    (ref: flink-runtime/.../execution/SuppressRestartsException.java).
+    Raised for failures in the end-of-input finish phase: input is
+    fully consumed and final transactions may already be committed, so
+    a replay could not be exactly-once."""
+
+    def __init__(self, cause: BaseException):
+        super().__init__(str(cause))
+        self.cause = cause
+
+
 class _ChainedOutput(Output):
     """Direct call into the next operator in the chain
     (ref: ChainingOutput in OperatorChain.java)."""
@@ -272,6 +284,8 @@ class SubtaskInstance:
                 processing_time_service=processing_time_service,
                 key_selector=node.key_selector,
                 operator_id=node.uid,
+                subtask_index=subtask_index,
+                num_subtasks=vertex.parallelism,
             )
             ops_by_node[node.id] = op
         # operators in chain order (head first)
@@ -386,7 +400,7 @@ class SubtaskInstance:
         self.pending_trigger = None
         cid, ts, options = trig
         barrier = CheckpointBarrier(cid, ts, options)
-        snapshot = self.snapshot()
+        snapshot = self.snapshot(cid)
         self.router.broadcast_barrier(barrier)
         if self.ack_fn is not None:
             self.ack_fn(self.task_key, cid, snapshot)
@@ -488,7 +502,7 @@ class SubtaskInstance:
         StreamTask.triggerCheckpointOnBarrier :586 →
         performCheckpoint :618 — barrier forwarded first, then
         snapshot, both atomically on this loop)."""
-        snapshot = self.snapshot()
+        snapshot = self.snapshot(barrier.checkpoint_id)
         self.router.broadcast_barrier(barrier)
         if self.ack_fn is not None:
             self.ack_fn(self.task_key, barrier.checkpoint_id, snapshot)
@@ -543,8 +557,8 @@ class SubtaskInstance:
             head.process_watermark(wm)
 
     # ---- snapshot ---------------------------------------------------
-    def snapshot(self) -> dict:
-        return {"operators": {op.operator_id: op.snapshot_state()
+    def snapshot(self, checkpoint_id: Optional[int] = None) -> dict:
+        return {"operators": {op.operator_id: op.snapshot_state(checkpoint_id)
                               for op in self.operators}}
 
     def restore(self, snapshots: List[dict]) -> None:
@@ -742,6 +756,8 @@ class LocalExecutor:
                     result.cancelled = True
                     client._finish(result=result)
                     return
+                except SuppressRestartsException as e:
+                    raise e.cause
                 except Exception as e:  # noqa: BLE001
                     restart.notify_failure(_time.monotonic() * 1000.0)
                     if client.cancel_requested or not restart.can_restart():
@@ -921,6 +937,19 @@ class LocalExecutor:
             while ack_queue:
                 task_key, cid, snapshot = ack_queue.popleft()
                 coordinator.acknowledge(task_key, cid, snapshot)
+        # finish phase: end-of-input flush (2PC tail commits, source
+        # offset commits), topologically, draining any emissions.  Runs
+        # only once EVERY task has drained, and failures here suppress
+        # the restart strategy: input is fully consumed and committed
+        # transactions cannot be replayed exactly-once.
+        try:
+            for st in all_tasks:
+                for op in st.operators:
+                    op.finish()
+                for t in non_sources:
+                    t.step(1 << 30)
+        except Exception as e:  # noqa: BLE001
+            raise SuppressRestartsException(e) from e
 
 
 def _clone_partitioner(p):

@@ -141,19 +141,30 @@ class StreamOperator(abc.ABC):
               operator_state_backend: Optional[OperatorStateBackend] = None,
               processing_time_service: Optional[ProcessingTimeService] = None,
               key_selector: Optional[KeySelector] = None,
-              operator_id: str = "") -> None:
+              operator_id: str = "",
+              subtask_index: int = 0,
+              num_subtasks: int = 1) -> None:
         self.output = output
         self.keyed_backend = keyed_backend
         self.operator_state_backend = operator_state_backend or OperatorStateBackend()
         self.processing_time_service = processing_time_service
         self.key_selector = key_selector
         self.operator_id = operator_id or type(self).__name__
+        self.subtask_index = subtask_index
+        self.num_subtasks = num_subtasks
         if keyed_backend is not None and processing_time_service is not None:
             self.timer_service = InternalTimerService(
                 f"{self.operator_id}-timers", keyed_backend,
                 processing_time_service, self)
 
     def open(self) -> None:  # noqa: B027
+        pass
+
+    def finish(self) -> None:  # noqa: B027
+        """End of input reached (after the final watermark, before
+        close): flush buffered output.  The drain-then-flush step of
+        stop-with-savepoint, applied at natural end of input so finite
+        jobs don't strand a 2PC sink's tail transaction."""
         pass
 
     def close(self) -> None:  # noqa: B027
@@ -191,7 +202,7 @@ class StreamOperator(abc.ABC):
         pass
 
     # ---- snapshot ---------------------------------------------------
-    def snapshot_state(self) -> dict:
+    def snapshot_state(self, checkpoint_id: Optional[int] = None) -> dict:
         snap = {}
         if self.keyed_backend is not None:
             if hasattr(self.keyed_backend, "flush_all"):
@@ -244,9 +255,23 @@ class AbstractUdfStreamOperator(StreamOperator):
     """Hosts a user function, forwarding open/close
     (ref: AbstractUdfStreamOperator.java)."""
 
+    #: at parallelism > 1, rich functions are copied per subtask so each
+    #: gets its own RuntimeContext and state (the reference serializes
+    #: the function into every subtask).  At parallelism 1 the instance
+    #: is shared — tests rely on reading e.g. a CollectSink's buffer.
+    #: Sources opt out: their factory already deep-copies.
+    COPY_UDF_PER_SUBTASK = True
+
     def __init__(self, user_function):
         super().__init__()
         self.user_function = user_function
+
+    def setup(self, *args, **kwargs):
+        super().setup(*args, **kwargs)
+        if (self.COPY_UDF_PER_SUBTASK and self.num_subtasks > 1
+                and isinstance(self.user_function, RichFunction)):
+            import copy
+            self.user_function = copy.deepcopy(self.user_function)
 
     def open(self):
         if isinstance(self.user_function, RichFunction):
@@ -255,11 +280,18 @@ class AbstractUdfStreamOperator(StreamOperator):
                      if self.keyed_backend is not None else None)
             ctx = RuntimeContext(
                 task_name=self.operator_id,
+                index_of_subtask=self.subtask_index,
+                parallelism=self.num_subtasks,
                 keyed_state_store=store,
                 operator_state_store=self.operator_state_backend,
             )
             self.user_function.set_runtime_context(ctx)
             self.user_function.open(None)
+
+    def finish(self):
+        fn = self.user_function
+        if hasattr(fn, "finish"):
+            fn.finish()
 
     def close(self):
         if isinstance(self.user_function, RichFunction):
@@ -269,6 +301,25 @@ class AbstractUdfStreamOperator(StreamOperator):
         fn = self.user_function
         if hasattr(fn, "notify_checkpoint_complete"):
             fn.notify_checkpoint_complete(checkpoint_id)
+
+    def snapshot_state(self, checkpoint_id: Optional[int] = None) -> dict:
+        """Functions with checkpoint hooks (2PC sinks, replayable
+        sources) ride in the operator snapshot (ref: the
+        CheckpointedFunction path in AbstractUdfStreamOperator
+        .snapshotState)."""
+        snap = super().snapshot_state(checkpoint_id)
+        fn = self.user_function
+        if hasattr(fn, "snapshot_function_state"):
+            snap["function"] = fn.snapshot_function_state(checkpoint_id)
+        return snap
+
+    def restore_state(self, snapshots) -> None:
+        super().restore_state(snapshots)
+        fn = self.user_function
+        if hasattr(fn, "restore_function_state"):
+            for s in snapshots:
+                if "function" in s:
+                    fn.restore_function_state(s["function"])
 
 
 class StreamMap(AbstractUdfStreamOperator):

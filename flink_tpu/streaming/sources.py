@@ -163,6 +163,8 @@ class AutomaticWatermarkContext(SourceContext):
 class StreamSource(AbstractUdfStreamOperator):
     """Operator hosting a SourceFunction (ref: StreamSource.java)."""
 
+    COPY_UDF_PER_SUBTASK = False  # the source factory already copies
+
     def __init__(self, source_function: SourceFunction,
                  time_characteristic: str = "event"):
         super().__init__(source_function)
@@ -188,27 +190,12 @@ class StreamSource(AbstractUdfStreamOperator):
     def process_element(self, record):
         raise RuntimeError("sources have no input")
 
-    # ---- source position in checkpoints -----------------------------
-    def snapshot_state(self) -> dict:
-        """The source's read position rides in the operator snapshot so
-        restore rewinds it (ref: the CheckpointedFunction contract used
-        by replayable sources, FlinkKafkaConsumerBase.snapshotState)."""
-        snap = super().snapshot_state()
-        fn = self.user_function
-        if hasattr(fn, "snapshot_offset"):
-            snap["source_offset"] = fn.snapshot_offset()
-        elif hasattr(fn, "snapshot_source_state"):
-            snap["source_state"] = fn.snapshot_source_state()
-        return snap
-
-    def restore_state(self, snapshots) -> None:
-        super().restore_state(snapshots)
-        fn = self.user_function
-        for snap in snapshots:
-            if "source_offset" in snap and hasattr(fn, "restore_offset"):
-                fn.restore_offset(snap["source_offset"])
-            elif "source_state" in snap and hasattr(fn, "restore_source_state"):
-                fn.restore_source_state(snap["source_state"])
+    # The source's read position rides in the operator snapshot via the
+    # generic function-state hooks inherited from
+    # AbstractUdfStreamOperator: a replayable source implements
+    # snapshot_function_state/restore_function_state (ref: the
+    # CheckpointedFunction contract, FlinkKafkaConsumerBase
+    # .snapshotState) and restore rewinds it.
 
 
 # ---------------------------------------------------------------------
@@ -261,12 +248,13 @@ class FromCollectionSource(SourceFunction):
     def cancel(self):
         self._cancelled = True
 
-    # checkpoint hooks used by the source task
-    def snapshot_offset(self) -> int:
-        return self.offset
+    # checkpoint hooks (the CheckpointedFunction-shaped contract the
+    # operator layer snapshots/restores)
+    def snapshot_function_state(self, checkpoint_id=None) -> dict:
+        return {"offset": self.offset}
 
-    def restore_offset(self, offset: int) -> None:
-        self.offset = offset
+    def restore_function_state(self, state: dict) -> None:
+        self.offset = state["offset"]
 
 
 class SocketTextStreamSource(SourceFunction):

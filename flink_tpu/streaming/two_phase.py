@@ -1,0 +1,177 @@
+"""Two-phase-commit sink: exactly-once output tied to checkpoints.
+
+Re-designs flink-streaming-java/.../api/functions/sink/
+TwoPhaseCommitSinkFunction.java:73.  Protocol (doc comment there):
+
+- every incoming value is written into the CURRENT transaction;
+- on snapshot (the barrier reaching the sink) the current transaction
+  is PRE-COMMITTED (flushed, made durable but not visible), parked on
+  the pending list tagged with the checkpoint id, and a fresh
+  transaction begins — all atomically with the operator snapshot;
+- when the checkpoint COMPLETES (notifyCheckpointComplete), pending
+  transactions for that checkpoint (and older) are COMMITTED;
+- on restore, pending transactions from the restored checkpoint are
+  recover-and-committed (the checkpoint completed — we are restoring
+  from it), and the transaction that was open at snapshot time is
+  recover-and-aborted (its data lies after the barrier and will be
+  replayed).
+
+Commits MUST be idempotent: a failure after commit but before the next
+checkpoint replays the commit on recovery (same contract as the
+reference — Kafka transactional ids, file renames, etc.).
+"""
+
+from __future__ import annotations
+
+import abc
+import itertools
+from typing import Any, List, Optional, Tuple
+
+from flink_tpu.core.functions import RichFunction
+from flink_tpu.streaming.sources import SinkFunction
+
+
+class TwoPhaseCommitSinkFunction(SinkFunction, RichFunction, abc.ABC):
+    """(ref: TwoPhaseCommitSinkFunction.java:73)"""
+
+    def __init__(self):
+        RichFunction.__init__(self)
+        self._current_txn: Any = None
+        #: (checkpoint_id, transaction) awaiting notifyCheckpointComplete
+        self._pending_commit: List[Tuple[Optional[int], Any]] = []
+
+    # ---- user SPI ---------------------------------------------------
+    @abc.abstractmethod
+    def begin_transaction(self) -> Any: ...
+
+    @abc.abstractmethod
+    def invoke_in_transaction(self, transaction, value, context) -> None: ...
+
+    @abc.abstractmethod
+    def pre_commit(self, transaction) -> None: ...
+
+    @abc.abstractmethod
+    def commit(self, transaction) -> None: ...
+
+    @abc.abstractmethod
+    def abort(self, transaction) -> None: ...
+
+    def recover_and_commit(self, transaction) -> None:
+        """Commit a pre-committed transaction found in restored state
+        (default: plain commit — override if recovery needs e.g.
+        resuming an external transaction by id)."""
+        self.commit(transaction)
+
+    def recover_and_abort(self, transaction) -> None:
+        self.abort(transaction)
+
+    # ---- lifecycle --------------------------------------------------
+    def open(self, configuration):
+        """Abort any leftover transactions from a previous attempt —
+        the function instance is shared across restarts, and without a
+        restore (no completed checkpoint yet) attempt N+1 would
+        otherwise replay into attempt N's buffers and double-commit.
+        Pre-committed-but-uncheckpointed transactions roll back on
+        recovery, same as the reference."""
+        if self._current_txn is not None:
+            self.abort(self._current_txn)
+        for _cid, txn in self._pending_commit:
+            self.abort(txn)
+        self._pending_commit = []
+        self._current_txn = self.begin_transaction()
+
+    def invoke(self, value, context=None):
+        self.invoke_in_transaction(self._current_txn, value, context)
+
+    # ---- checkpoint integration (operator function-state hooks) -----
+    def snapshot_function_state(self, checkpoint_id: Optional[int]) -> dict:
+        """Runs at the barrier, atomically with the operator snapshot
+        (ref: snapshotState :313 — preCommit + beginTransaction)."""
+        self.pre_commit(self._current_txn)
+        self._pending_commit.append((checkpoint_id, self._current_txn))
+        self._current_txn = self.begin_transaction()
+        # `current` is the NEW post-barrier transaction: on restore its
+        # (replayed) data is aborted, while `pending` commits
+        return {
+            "pending": list(self._pending_commit),
+            "current": self._current_txn,
+        }
+
+    def restore_function_state(self, state: dict) -> None:
+        """(ref: initializeState :353 — recoverAndCommit pending,
+        recoverAndAbort the formerly-current transaction)."""
+        for _cid, txn in state["pending"]:
+            self.recover_and_commit(txn)
+        self._pending_commit = []
+        self.recover_and_abort(state["current"])
+        self._current_txn = self.begin_transaction()
+
+    def notify_checkpoint_complete(self, checkpoint_id: int) -> None:
+        """(ref: notifyCheckpointComplete :268)"""
+        remaining = []
+        for cid, txn in self._pending_commit:
+            if cid is None or cid <= checkpoint_id:
+                self.commit(txn)
+            else:
+                remaining.append((cid, txn))
+        self._pending_commit = remaining
+
+    def finish(self) -> None:
+        """End of input: commit everything still in flight — pending
+        transactions plus the current one.  The final-checkpoint
+        behavior for finite jobs (no barrier will ever arrive again to
+        commit them)."""
+        for _cid, txn in self._pending_commit:
+            self.commit(txn)
+        self._pending_commit = []
+        self.pre_commit(self._current_txn)
+        self.commit(self._current_txn)
+        self._current_txn = self.begin_transaction()
+
+
+class _BufferingTransaction:
+    """Transaction for buffering sinks: values parked until commit."""
+
+    _ids = itertools.count(1)
+
+    __slots__ = ("txn_id", "values", "prepared")
+
+    def __init__(self):
+        self.txn_id = next(self._ids)
+        self.values: List[Any] = []
+        self.prepared = False
+
+    def __getstate__(self):
+        return (self.txn_id, self.values, self.prepared)
+
+    def __setstate__(self, state):
+        self.txn_id, self.values, self.prepared = state
+
+
+class TransactionalCollectSink(TwoPhaseCommitSinkFunction):
+    """In-memory exactly-once sink: values become visible in
+    `committed` only when their checkpoint completes.  Commits are
+    idempotent by transaction id, as the contract requires."""
+
+    def __init__(self, target: Optional[list] = None):
+        super().__init__()
+        self.committed: List[Any] = target if target is not None else []
+        self._committed_txn_ids = set()
+
+    def begin_transaction(self):
+        return _BufferingTransaction()
+
+    def invoke_in_transaction(self, txn, value, context):
+        txn.values.append(value)
+
+    def pre_commit(self, txn):
+        txn.prepared = True
+
+    def commit(self, txn):
+        if txn.txn_id in self._committed_txn_ids:
+            return  # idempotent replay
+        self._committed_txn_ids.add(txn.txn_id)
+        self.committed.extend(txn.values)
+
+    def abort(self, txn):
+        txn.values.clear()

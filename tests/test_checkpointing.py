@@ -231,11 +231,11 @@ class InfiniteCountSource(SourceFunction):
     def cancel(self):
         self._cancelled = True
 
-    def snapshot_offset(self):
-        return self.next
+    def snapshot_function_state(self, checkpoint_id=None):
+        return {"next": self.next}
 
-    def restore_offset(self, offset):
-        self.next = offset
+    def restore_function_state(self, state):
+        self.next = state["next"]
 
 
 def test_unbounded_job_cancellation():
@@ -336,11 +336,11 @@ def test_threaded_source_recovery():
         def cancel(self):
             self._cancelled = True
 
-        def snapshot_offset(self):
-            return self.next
+        def snapshot_function_state(self, checkpoint_id=None):
+            return {"next": self.next}
 
-        def restore_offset(self, offset):
-            self.next = offset
+        def restore_function_state(self, state):
+            self.next = state["next"]
 
     failer = FailOnceAfterCheckpoint()
     sink = CollectSink()
