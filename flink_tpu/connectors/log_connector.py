@@ -21,7 +21,6 @@ transaction id (the Kafka-transactions role).
 
 from __future__ import annotations
 
-import itertools
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from flink_tpu.connectors.partitioned_log import PartitionedLog
@@ -145,12 +144,15 @@ class ReplayableLogSource(RichParallelSourceFunction):
 
 
 class _LogTransaction:
-    _ids = itertools.count(1)
+    """Globally-unique transaction id (uuid): a process-local counter
+    would collide with ids already committed to a durable log by a
+    previous run, and the idempotence dedupe would drop fresh data."""
 
     __slots__ = ("txn_id", "records")
 
     def __init__(self):
-        self.txn_id = f"txn-{next(self._ids)}"
+        import uuid
+        self.txn_id = f"txn-{uuid.uuid4().hex}"
         self.records: List[Tuple[int, Optional[int], Any]] = []
 
     def __getstate__(self):

@@ -137,6 +137,7 @@ class FilePartitionedLog(PartitionedLog):
         self.directory = directory
         self._n = num_partitions
         self._lock = threading.Lock()
+        self._txn_cache = None  # lazy: committed txn ids
         os.makedirs(directory, exist_ok=True)
         #: cached records per partition (files are append-only)
         self._cache: List[List[Tuple[Optional[int], Any]]] = [
@@ -199,14 +200,21 @@ class FilePartitionedLog(PartitionedLog):
     def _txns_path(self) -> str:
         return os.path.join(self.directory, "committed-txns.jsonl")
 
-    def append_transaction(self, txn_id, records) -> bool:
-        with self._lock:
-            seen = set()
+    def _seen_txns(self) -> set:
+        """Cached committed-txn ids (append-only file, loaded once)."""
+        if self._txn_cache is None:
+            self._txn_cache = set()
             if os.path.exists(self._txns_path()):
                 with open(self._txns_path()) as f:
-                    seen = {line.strip() for line in f}
+                    self._txn_cache = {line.strip() for line in f}
+        return self._txn_cache
+
+    def append_transaction(self, txn_id, records) -> bool:
+        with self._lock:
+            seen = self._seen_txns()
             if str(txn_id) in seen:
                 return False
+            seen.add(str(txn_id))
             for partition, ts, v in records:
                 with open(self._part_path(partition), "a") as f:
                     f.write(json.dumps([ts, v]) + "\n")

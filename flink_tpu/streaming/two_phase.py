@@ -24,7 +24,6 @@ reference — Kafka transactional ids, file renames, etc.).
 from __future__ import annotations
 
 import abc
-import itertools
 from typing import Any, List, Optional, Tuple
 
 from flink_tpu.core.functions import RichFunction
@@ -87,15 +86,20 @@ class TwoPhaseCommitSinkFunction(SinkFunction, RichFunction, abc.ABC):
     def snapshot_function_state(self, checkpoint_id: Optional[int]) -> dict:
         """Runs at the barrier, atomically with the operator snapshot
         (ref: snapshotState :313 — preCommit + beginTransaction)."""
+        import copy
         self.pre_commit(self._current_txn)
         self._pending_commit.append((checkpoint_id, self._current_txn))
         self._current_txn = self.begin_transaction()
         # `current` is the NEW post-barrier transaction: on restore its
-        # (replayed) data is aborted, while `pending` commits
-        return {
+        # (replayed) data is aborted, while `pending` commits.  Deep-
+        # copied: with in-memory checkpoint storage the snapshot would
+        # otherwise ALIAS the live transactions, and a later abort()
+        # (e.g. open() on restart) would clear the very objects the
+        # restored checkpoint recover-and-commits.
+        return copy.deepcopy({
             "pending": list(self._pending_commit),
             "current": self._current_txn,
-        }
+        })
 
     def restore_function_state(self, state: dict) -> None:
         """(ref: initializeState :353 — recoverAndCommit pending,
@@ -130,14 +134,17 @@ class TwoPhaseCommitSinkFunction(SinkFunction, RichFunction, abc.ABC):
 
 
 class _BufferingTransaction:
-    """Transaction for buffering sinks: values parked until commit."""
-
-    _ids = itertools.count(1)
+    """Transaction for buffering sinks: values parked until commit.
+    Transaction ids are globally unique (uuid), not a process-local
+    counter: a restarted process writing to a durable target must not
+    collide with ids committed by a previous run, or idempotence
+    dedupe silently drops the new data."""
 
     __slots__ = ("txn_id", "values", "prepared")
 
     def __init__(self):
-        self.txn_id = next(self._ids)
+        import uuid
+        self.txn_id = uuid.uuid4().hex
         self.values: List[Any] = []
         self.prepared = False
 

@@ -1,0 +1,240 @@
+"""Device-path sliding + session windows: differential tests against
+the scalar WindowOperator (the semantics spec) on random streams."""
+
+import numpy as np
+import pytest
+
+from flink_tpu.core.state import AggregatingStateDescriptor
+from flink_tpu.ops.device_agg import CountAggregate, SumAggregate
+from flink_tpu.ops.sketches import HyperLogLogAggregate
+from flink_tpu.streaming.harness import OneInputStreamOperatorTestHarness
+from flink_tpu.streaming.vectorized import VectorizedSlidingWindows
+from flink_tpu.streaming.vectorized_sessions import VectorizedSessionWindows
+from flink_tpu.streaming.window_operator import WindowOperator
+from flink_tpu.streaming.windowing import (
+    EventTimeSessionWindows,
+    SlidingEventTimeWindows,
+    Time,
+    TimeWindow,
+)
+
+
+class _KVSum(SumAggregate):
+    def __init__(self):
+        super().__init__(np.float32)
+
+    def extract_value(self, value):
+        return value[1] if isinstance(value, tuple) else value
+
+
+class _KVCount(CountAggregate):
+    pass
+
+
+def scalar_window_results(assigner, agg, records, watermarks_at):
+    """Run (key, value, ts) records through the real WindowOperator,
+    interleaving watermarks, and collect (key, result, start, end)."""
+    def fn(key, window, elements):
+        for v in elements:
+            yield (key, float(v), window.start, window.end)
+
+    op = WindowOperator(assigner,
+                        AggregatingStateDescriptor("diff", agg),
+                        window_function=fn)
+    h = OneInputStreamOperatorTestHarness(op, key_selector=lambda t: t[0],
+                                          state_backend="heap")
+    h.open()
+    wm_iter = iter(watermarks_at)
+    next_wm = next(wm_iter, None)
+    for i, (k, v, ts) in enumerate(records):
+        if next_wm is not None and i == next_wm[0]:
+            h.process_watermark(next_wm[1])
+            next_wm = next(wm_iter, None)
+        h.process_element((k, v), ts)
+    h.process_watermark(2**62)
+    out = h.extract_output_values()
+    h.close()
+    return sorted((int(k), round(r, 2), s, e) for k, r, s, e in out)
+
+
+# ---------------------------------------------------------------------
+# sliding (pane-composed)
+# ---------------------------------------------------------------------
+
+def test_sliding_matches_window_operator_sum():
+    rng = np.random.default_rng(11)
+    n = 6000
+    keys = rng.integers(0, 40, n)
+    ts = rng.integers(0, 20_000, n)
+    vals = rng.random(n).astype(np.float32)
+    size, slide = 5000, 1000
+
+    vec = VectorizedSlidingWindows(_KVSum(), size, slide,
+                                   initial_capacity=64)
+    half = n // 2
+    vec.process_batch(keys[:half], ts[:half], vals[:half])
+    vec.advance_watermark(9_999)
+    # second half: drop records that are now late, same as the operator
+    vec.process_batch(keys[half:], ts[half:], vals[half:])
+    vec.advance_watermark(2**62)
+
+    records = [(int(keys[i]), float(vals[i]), int(ts[i])) for i in range(n)]
+    want = scalar_window_results(
+        SlidingEventTimeWindows.of(Time.milliseconds_of(size),
+                                   Time.milliseconds_of(slide)),
+        _KVSum(), records, [(half, 9_999)])
+    got = sorted((int(k), round(float(r), 2), s, e)
+                 for k, r, s, e in vec.emitted)
+    assert got == want
+
+
+def test_sliding_pane_state_is_not_replicated():
+    """The engine's whole point: per-record state writes are paid once
+    per pane, not once per overlapping window."""
+    size, slide = 10_000, 1000  # overlap factor 10
+    vec = VectorizedSlidingWindows(CountAggregate(), size, slide,
+                                   initial_capacity=64)
+    keys = np.zeros(1000, np.int64)
+    ts = np.arange(1000)  # all within pane [0, 1000)
+    vec.process_batch(keys, ts)
+    # exactly ONE live pane shard, one slot — not 10 replicated states
+    assert len(vec.windows) == 1
+    assert vec.arena.high_water <= 2  # key slot (+ scratch)
+    vec.advance_watermark(2**62)
+    # the single pane feeds all 10 windows that contain it
+    assert len(vec.emitted) == 10
+    assert all(int(r) == 1000 for _, r, _, _ in vec.emitted)
+
+
+def test_sliding_hll_merges_across_panes():
+    """Distinct-count across panes must merge sketches, not add them."""
+    agg = HyperLogLogAggregate(11)
+    size, slide = 4000, 1000
+    vec = VectorizedSlidingWindows(agg, size, slide, initial_capacity=32)
+    # same 1000 users appear in FOUR consecutive panes for one key
+    users = np.arange(1000, dtype=np.uint64)
+    for pane in range(4):
+        ts = np.full(1000, pane * slide + 5)
+        vec.process_batch(np.zeros(1000, np.int64), ts, users)
+    vec.advance_watermark(2**62)
+    # window [0,4000) contains all four panes; duplicates across panes
+    # must not inflate the estimate
+    full = [r for _, r, s, e in vec.emitted if s == 0 and e == 4000]
+    assert len(full) == 1
+    assert abs(full[0] - 1000) / 1000 < 0.05
+
+
+def test_sliding_rejects_unaligned():
+    with pytest.raises(ValueError):
+        VectorizedSlidingWindows(CountAggregate(), 5000, 1500)
+
+
+def test_sliding_late_records_counted():
+    vec = VectorizedSlidingWindows(CountAggregate(), 2000, 1000)
+    vec.process_batch(np.array([1]), np.array([500]))
+    vec.advance_watermark(2999)  # all windows containing ts=500 fired
+    vec.process_batch(np.array([1, 1]), np.array([600, 3500]))
+    assert vec.num_late_dropped == 1  # ts=600 fully late; 3500 live
+    vec.advance_watermark(2**62)
+    # ts=500 appears in windows [-1000,1000) and [0,2000): 2 fires
+    # ts=3500 appears in [2000,4000) and [3000,5000): 2 fires
+    assert len(vec.emitted) == 4
+
+
+# ---------------------------------------------------------------------
+# sessions (batched merge)
+# ---------------------------------------------------------------------
+
+def test_sessions_match_window_operator_sum():
+    rng = np.random.default_rng(23)
+    n = 4000
+    keys = rng.integers(0, 25, n)
+    # clustered timestamps → real session structure
+    ts = (rng.integers(0, 40, n) * 1000
+          + rng.integers(0, 300, n)).astype(np.int64)
+    vals = rng.random(n).astype(np.float32)
+    gap = 700
+
+    vec = VectorizedSessionWindows(_KVSum(), gap, initial_capacity=64)
+    third = n // 3
+    vec.process_batch(keys[:third], ts[:third], vals[:third])
+    vec.advance_watermark(12_000)
+    vec.process_batch(keys[third:2 * third], ts[third:2 * third],
+                      vals[third:2 * third])
+    vec.advance_watermark(25_000)
+    vec.process_batch(keys[2 * third:], ts[2 * third:], vals[2 * third:])
+    vec.advance_watermark(2**62)
+
+    records = [(int(keys[i]), float(vals[i]), int(ts[i])) for i in range(n)]
+    want = scalar_window_results(
+        EventTimeSessionWindows.with_gap(Time.milliseconds_of(gap)),
+        _KVSum(), records, [(third, 12_000), (2 * third, 25_000)])
+    got = sorted((int(k), round(float(r), 2), s, e)
+                 for k, r, s, e in vec.emitted)
+    assert got == want
+
+
+def test_sessions_merge_within_and_across_batches():
+    vec = VectorizedSessionWindows(_KVCount(), 100, initial_capacity=16)
+    # batch 1: two separate sessions for key 7
+    vec.process_batch(np.array([7, 7]), np.array([0, 500]))
+    assert sum(len(s) for s in vec.table.values()) == 2
+    # batch 2: a bridging record merges them into one
+    vec.process_batch(np.array([7]), np.array([250]))
+    # intervals [0,100) [250,350) [500,600) don't chain... still 3?
+    # gap=100: 0..100, 250..350, 500..600 → no overlap → 3 sessions
+    assert sum(len(s) for s in vec.table.values()) == 3
+    # true bridges
+    vec.process_batch(np.array([7, 7]), np.array([80, 170]))
+    # 0..100 + 80..180 + 170..270 + 250..350 all chain → one [0,350)
+    sessions = [s for lst in vec.table.values() for s in lst]
+    assert len(sessions) == 2  # merged chain + [500,600)
+    merged = min(sessions, key=lambda s: s.start)
+    assert (merged.start, merged.end) == (0, 350)
+    vec.advance_watermark(2**62)
+    got = sorted((int(r), s, e) for _, r, s, e in vec.emitted)
+    assert got == [(1, 500, 600), (4, 0, 350)]
+
+
+def test_sessions_hll_distinct_across_merge():
+    agg = HyperLogLogAggregate(11)
+    vec = VectorizedSessionWindows(agg, 1000, initial_capacity=16)
+    users = np.arange(2000, dtype=np.uint64)
+    # two halves of the same session arrive in separate batches with
+    # overlapping user populations
+    vec.process_batch(np.zeros(1000, np.int64), np.full(1000, 0),
+                      users[:1000])
+    vec.process_batch(np.zeros(1500, np.int64), np.full(1500, 500),
+                      users[500:2000])
+    vec.advance_watermark(2**62)
+    assert len(vec.emitted) == 1
+    _, est, s, e = vec.emitted[0]
+    assert (s, e) == (0, 1500)
+    assert abs(est - 2000) / 2000 < 0.05  # merged, not double-counted
+
+
+def test_sessions_late_drop_and_post_merge_leniency():
+    vec = VectorizedSessionWindows(_KVCount(), 100)
+    vec.process_batch(np.array([1]), np.array([1000]))
+    vec.advance_watermark(500)
+    # ts=100: solo window [100,200) ends before wm=500 and overlaps
+    # nothing live → late
+    vec.process_batch(np.array([1]), np.array([100]))
+    assert vec.num_late_dropped == 1
+    # ts=950: solo window [950,1050) would be late... but 1050 > 500,
+    # and it overlaps the live [1000,1100) session → merges
+    vec.process_batch(np.array([1]), np.array([950]))
+    assert vec.num_late_dropped == 1
+    vec.advance_watermark(2**62)
+    assert [(int(r), s, e) for _, r, s, e in vec.emitted] == [(2, 950, 1100)]
+
+
+def test_sessions_slot_reuse():
+    vec = VectorizedSessionWindows(_KVCount(), 100, initial_capacity=8)
+    for round_i in range(20):
+        base = round_i * 10_000
+        vec.process_batch(np.arange(4), np.full(4, base))
+        vec.advance_watermark(base + 5000)
+    assert len(vec.emitted) == 80
+    # slots recycled: capacity stayed small
+    assert vec.capacity <= 16
