@@ -537,6 +537,13 @@ class WindowedStream:
         self._evictor = None
         self._allowed_lateness = 0
         self._late_tag = None
+        self._device_enabled = True
+
+    def disable_device_operator(self) -> "WindowedStream":
+        """Force the scalar WindowOperator even for device-eligible
+        aggregates (debugging / semantics comparison)."""
+        self._device_enabled = False
+        return self
 
     def trigger(self, trigger) -> "WindowedStream":
         self._trigger = trigger
@@ -580,7 +587,27 @@ class WindowedStream:
     # ---- terminal ops -----------------------------------------------
     def aggregate(self, aggregate_function: AggregateFunction,
                   window_function=None, name: str = "window_aggregate") -> DataStream:
-        """(ref: WindowedStream.aggregate :687-716)"""
+        """(ref: WindowedStream.aggregate :687-716).  Device-eligible
+        aggregates (DeviceAggregateFunction + event-time tumbling/
+        sliding/session, default trigger, no evictor, lateness 0) run
+        on the vectorized TPU engines via DeviceWindowOperator; the
+        rest stay on the scalar WindowOperator."""
+        from flink_tpu.streaming.device_window_operator import (
+            DeviceWindowOperator,
+            is_device_eligible,
+        )
+        if (self._device_enabled
+                and self._keyed.env.time_characteristic == "event"
+                and is_device_eligible(
+                    self._assigner, aggregate_function, self._trigger,
+                    self._evictor, self._allowed_lateness, self._late_tag,
+                    window_function)):
+            assigner = self._assigner
+
+            def factory():
+                return DeviceWindowOperator(assigner, aggregate_function,
+                                            window_function)
+            return self._keyed._add_keyed_op(name, factory, chaining="head")
         return self._build(
             name,
             AggregatingStateDescriptor("window-contents", aggregate_function),

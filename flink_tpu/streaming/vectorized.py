@@ -703,3 +703,82 @@ class VectorizedSlidingWindows(_ScratchMergeMixin, VectorizedTumblingWindows):
             if len(slots):
                 self._clear_tiled(slots)
                 self.arena.release(slots)
+
+
+# ---------------------------------------------------------------------
+# engine snapshots (checkpoint integration for DeviceWindowOperator)
+# ---------------------------------------------------------------------
+
+def _snapshot_arena(arena: _SlotArena) -> dict:
+    return {"capacity": arena.capacity, "next": arena.next,
+            "free": [np.array(a, np.int64) for a in arena.free]}
+
+
+def _restore_arena(snap: dict) -> _SlotArena:
+    arena = _SlotArena(snap["capacity"])
+    arena.next = snap["next"]
+    arena.free = [np.array(a, np.int64) for a in snap["free"]]
+    return arena
+
+
+def _snapshot_shard(sh: _WindowShard) -> dict:
+    return {"start": sh.start, "keys": list(sh.keys),
+            "slots": sh.all_slots().copy(), "hashes": sh.all_hashes().copy(),
+            "index_hash": sh.index.table_hash.copy(),
+            "index_slot": sh.index.table_slot.copy(),
+            "index_n": sh.index.n}
+
+
+def _restore_shard(snap: dict) -> _WindowShard:
+    sh = _WindowShard(snap["start"])
+    sh.keys = list(snap["keys"])
+    sh.slot_list = [np.array(snap["slots"], np.int64)]
+    sh.hash_list = [np.array(snap["hashes"], np.uint64)]
+    idx = VectorizedSlotIndex.__new__(VectorizedSlotIndex)
+    idx.table_hash = np.array(snap["index_hash"], np.uint64)
+    idx.table_slot = np.array(snap["index_slot"], np.int64)
+    idx.cap = len(idx.table_hash)
+    idx.n = snap["index_n"]
+    sh.index = idx
+    return sh
+
+
+def _tumbling_snapshot(self) -> dict:
+    """Device state lands as host numpy (the device→host DMA half of
+    the checkpoint, SURVEY §5 checkpoint row); host-side indexes ride
+    along as plain arrays."""
+    self.flush()
+    return {
+        "state": {k: np.asarray(v) for k, v in self.state.items()},
+        "capacity": self.capacity,
+        "arena": _snapshot_arena(self.arena),
+        "watermark": self.watermark,
+        "num_late_dropped": self.num_late_dropped,
+        "windows": {int(s): _snapshot_shard(sh)
+                    for s, sh in self.windows.items()},
+        "fired_horizon": getattr(self, "_fired_horizon", None),
+        "scratch": getattr(self, "_scratch_slot_id", None),
+    }
+
+
+def _tumbling_restore(self, snap: dict) -> None:
+    self.capacity = snap["capacity"]
+    self.state = {k: jnp.asarray(v) for k, v in snap["state"].items()}
+    self.arena = _restore_arena(snap["arena"])
+    self.watermark = snap["watermark"]
+    self.num_late_dropped = snap["num_late_dropped"]
+    self.windows = {int(s): _restore_shard(sh)
+                    for s, sh in snap["windows"].items()}
+    if snap.get("fired_horizon") is not None:
+        self._fired_horizon = snap["fired_horizon"]
+    if snap.get("scratch") is not None:
+        self._scratch_slot_id = snap["scratch"]
+    self._p_slots.clear()
+    self._p_values.clear()
+    self._p_hi.clear()
+    self._p_lo.clear()
+    self._p_count = 0
+
+
+VectorizedTumblingWindows.snapshot = _tumbling_snapshot
+VectorizedTumblingWindows.restore = _tumbling_restore

@@ -248,3 +248,31 @@ class VectorizedSessionWindows(_ScratchMergeMixin):
 
     def block_until_ready(self) -> None:
         jax.tree_util.tree_map(lambda a: a.block_until_ready(), self.state)
+
+
+    # ---- checkpoint integration -------------------------------------
+    def snapshot(self) -> dict:
+        from flink_tpu.streaming.vectorized import _snapshot_arena
+        return {
+            "state": {k: np.asarray(v) for k, v in self.state.items()},
+            "capacity": self.capacity,
+            "arena": _snapshot_arena(self.arena),
+            "watermark": self.watermark,
+            "num_late_dropped": self.num_late_dropped,
+            "table": {kh: [(s.start, s.end, s.slot, s.key) for s in lst]
+                      for kh, lst in self.table.items()},
+            "scratch": self._scratch_slot_id,
+        }
+
+    def restore(self, snap: dict) -> None:
+        from flink_tpu.streaming.vectorized import _restore_arena
+        self.capacity = snap["capacity"]
+        self.state = {k: jnp.asarray(v) for k, v in snap["state"].items()}
+        self.arena = _restore_arena(snap["arena"])
+        self.watermark = snap["watermark"]
+        self.num_late_dropped = snap["num_late_dropped"]
+        self.table = {kh: [_Session(s, e, slot, key)
+                           for (s, e, slot, key) in lst]
+                      for kh, lst in snap["table"].items()}
+        if snap.get("scratch") is not None:
+            self._scratch_slot_id = snap["scratch"]
