@@ -66,7 +66,13 @@ class MemoryCheckpointStorage(CheckpointStorage):
         }
         for cid in sorted(self._store)[:-self.retain]:
             del self._store[cid]
-        return None  # in-memory: size not measured
+        # the reference MemoryStateBackend also serializes (handles are
+        # byte arrays), so measuring here is faithful, not extra cost
+        try:
+            return len(pickle.dumps(task_snapshots,
+                                    protocol=pickle.HIGHEST_PROTOCOL))
+        except Exception:  # noqa: BLE001 — unpicklable state: size unknown
+            return None
 
     def latest(self):
         if not self._store:

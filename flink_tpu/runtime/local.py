@@ -46,6 +46,11 @@ from flink_tpu.runtime.checkpoints import (
     make_checkpoint_storage,
     make_restart_strategy,
 )
+from flink_tpu.runtime.metrics import (
+    LatencyStats,
+    MetricRegistry,
+    TaskIOMetricGroup,
+)
 from flink_tpu.state.loader import load_state_backend
 from flink_tpu.state.operator_state import OperatorStateBackend
 from flink_tpu.streaming.elements import (
@@ -130,12 +135,17 @@ class _RouterOutput(Output):
     def __init__(self):
         #: (partitioner, channels: List[_InputChannel], side_tag)
         self.routes: List[Tuple[Any, List["_InputChannel"], Any]] = []
+        #: numRecordsOut counter, set by the task layer when metrics
+        #: are enabled (ref: RecordWriterOutput's outputs counter)
+        self.records_out_counter = None
 
     def add_route(self, partitioner, channels, side_tag=None):
         partitioner.setup(len(channels))
         self.routes.append((partitioner, channels, side_tag))
 
     def collect(self, record):
+        if self.records_out_counter is not None:
+            self.records_out_counter.count += 1
         for partitioner, channels, side_tag in self.routes:
             if side_tag is not None:
                 continue
@@ -213,7 +223,8 @@ class SubtaskInstance:
     def __init__(self, vertex: JobVertex, subtask_index: int,
                  state_backend_name: str, max_parallelism: int,
                  processing_time_service,
-                 channel_capacity: int = DEFAULT_CHANNEL_CAPACITY):
+                 channel_capacity: int = DEFAULT_CHANNEL_CAPACITY,
+                 metrics_group=None, latency_stats=None):
         self.vertex = vertex
         self.subtask_index = subtask_index
         self.task_key = (vertex.id, subtask_index)
@@ -256,9 +267,18 @@ class SubtaskInstance:
         self._thread: Optional[threading.Thread] = None
         self.thread_error: Optional[BaseException] = None
 
+        # metrics (ref: TaskMetricGroup / TaskIOMetricGroup wiring in
+        # Task + StreamInputProcessor.java:182)
+        self.metrics_group = metrics_group
+        self.latency_stats = latency_stats
+        self.io_metrics = (TaskIOMetricGroup(metrics_group)
+                           if metrics_group is not None else None)
+
         # build the chain, tail first so outputs exist when wiring heads
         chain = vertex.chain
         self.router = _RouterOutput()
+        if self.io_metrics is not None:
+            self.router.records_out_counter = self.io_metrics.num_records_out
         ops_by_node: Dict[int, StreamOperator] = {}
         for node in reversed(chain):
             out_edge = next((e for e in vertex.chain_edges
@@ -287,6 +307,8 @@ class SubtaskInstance:
                 subtask_index=subtask_index,
                 num_subtasks=vertex.parallelism,
             )
+            if metrics_group is not None:
+                op.metrics = metrics_group.add_group(node.uid)
             ops_by_node[node.id] = op
         # operators in chain order (head first)
         self.operators = [ops_by_node[n.id] for n in chain]
@@ -451,6 +473,10 @@ class SubtaskInstance:
         elif isinstance(element, EndOfStream):
             self._on_end_of_stream(ch)
         elif element.is_latency_marker:
+            if self.latency_stats is not None:
+                self.latency_stats.record(
+                    element, self.head.operator_id,
+                    _time.time() * 1000.0 - element.marked_time)
             self.head.process_latency_marker(element)
 
     # ---- barrier handling -------------------------------------------
@@ -520,6 +546,8 @@ class SubtaskInstance:
 
     # ---- input path (ref: StreamInputProcessor.processInput :176) ---
     def process_record(self, input_index: int, record: StreamRecord):
+        if self.io_metrics is not None:
+            self.io_metrics.num_records_in.count += 1
         head = self.head
         if isinstance(head, TwoInputStreamOperator):
             if input_index == 0:
@@ -686,21 +714,30 @@ class LocalExecutor:
     def __init__(self, state_backend: str = "heap", max_parallelism: int = 128,
                  restart_strategy: Optional[dict] = None,
                  processing_time_service=None,
-                 channel_capacity: int = DEFAULT_CHANNEL_CAPACITY):
+                 channel_capacity: int = DEFAULT_CHANNEL_CAPACITY,
+                 metric_registry=None,
+                 latency_interval_ms: Optional[int] = None):
         self.state_backend = state_backend
         self.max_parallelism = max_parallelism
         self.restart_strategy_config = restart_strategy or {"strategy": "none"}
         self.pts = processing_time_service or TestProcessingTimeService()
         self.channel_capacity = channel_capacity
+        self.metrics = metric_registry or MetricRegistry()
+        self.latency_interval_ms = latency_interval_ms
 
     # ---- graph → subtasks ------------------------------------------
     def build_subtasks(self, job_graph: JobGraph) -> Dict[int, List[SubtaskInstance]]:
+        job_group = self.metrics.job_group(job_graph.job_name)
+        latency_stats = LatencyStats(job_group)
         subtasks: Dict[int, List[SubtaskInstance]] = {}
         for vid, vertex in job_graph.vertices.items():
+            vertex_group = job_group.add_group(f"{vid}_{vertex.name}")
             subtasks[vid] = [
                 SubtaskInstance(vertex, i, self.state_backend,
                                 self.max_parallelism, self.pts,
-                                self.channel_capacity)
+                                self.channel_capacity,
+                                metrics_group=vertex_group.add_group(str(i)),
+                                latency_stats=latency_stats)
                 for i in range(vertex.parallelism)
             ]
         # wire edges: all-to-all for shuffling partitioners; contiguous
@@ -823,6 +860,22 @@ class LocalExecutor:
                 notify_complete=notify_complete,
                 min_pause_ms=cfg.get("min_pause", 0),
             )
+            # checkpoint gauges (ref: CheckpointStatsTracker metrics)
+            cp_group = self.metrics.job_group(
+                job_graph.job_name).add_group("checkpointing")
+            co = coordinator
+            cp_group.gauge("numberOfCompletedCheckpoints",
+                           lambda: co.completed_count)
+            cp_group.gauge("lastCompletedCheckpointId",
+                           lambda: co.latest_completed_id)
+            cp_group.gauge(
+                "lastCheckpointDuration",
+                lambda: (co.stats[co.latest_completed_id].duration_ms
+                         if co.latest_completed_id in co.stats else None))
+            cp_group.gauge(
+                "lastCheckpointSize",
+                lambda: (co.stats[co.latest_completed_id].state_bytes
+                         if co.latest_completed_id in co.stats else None))
             # continue the id sequence across restarts
             ids = storage.checkpoint_ids()
             if ids:
@@ -864,10 +917,26 @@ class LocalExecutor:
               sources, coop_sources, threaded_sources, non_sources):
         pts = self.pts
         pts_poll = getattr(pts, "fire_due", None)
+        last_latency_emit = _time.monotonic()
         while True:
             if client.cancel_requested:
                 raise JobCancelledException()
             progress = 0
+
+            # periodic latency markers from sources (ref: the
+            # latencyMarksInterval emission in StreamSource.run)
+            if self.latency_interval_ms is not None:
+                now = _time.monotonic()
+                if (now - last_latency_emit) * 1000.0 >= self.latency_interval_ms:
+                    last_latency_emit = now
+                    now_ms = _time.time() * 1000.0
+                    for s in sources:
+                        if s.finished:
+                            continue
+                        marker = LatencyMarker(now_ms, s.head.operator_id,
+                                               s.subtask_index)
+                        with s.emission_lock:
+                            s.head.output.emit_latency_marker(marker)
 
             # 0. trigger before sources step, so a due checkpoint's
             # barrier rides ahead of this iteration's records

@@ -1,0 +1,408 @@
+"""Metrics: counters, gauges, histograms, meters, hierarchical groups,
+registry + reporters, latency tracking, checkpoint stats.
+
+Re-designs the reference metrics stack (flink-metrics-core `Metric`,
+`Counter`, `Gauge`, `Histogram`, `Meter`;
+flink-runtime/.../metrics/MetricRegistryImpl.java; hierarchical groups
+flink-runtime/.../metrics/groups/{TaskManagerMetricGroup,
+TaskMetricGroup,OperatorMetricGroup,TaskIOMetricGroup}.java; scope
+formats .../metrics/scope/ScopeFormat.java; latency tracking
+LatencyStats; checkpoint stats
+flink-runtime/.../checkpoint/CheckpointStatsTracker.java; reporters
+flink-metrics/flink-metrics-{prometheus,slf4j}/...).
+
+Design notes (TPU-first runtime, single-owner loop): metrics are
+updated only from the owning executor loop (or under the source
+emission lock), so none of them need atomics; `dump()` may race a
+concurrent reader but only ever reads plain ints/floats, which is the
+same monitoring-read contract the reference accepts.
+"""
+
+from __future__ import annotations
+
+import bisect
+import json
+import math
+import time as _time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+
+# ---------------------------------------------------------------------------
+# metric types (ref: flink-metrics-core)
+# ---------------------------------------------------------------------------
+
+class Counter:
+    """(ref: flink-metrics-core Counter / SimpleCounter)"""
+
+    __slots__ = ("count",)
+
+    def __init__(self):
+        self.count = 0
+
+    def inc(self, n: int = 1) -> None:
+        self.count += n
+
+    def dec(self, n: int = 1) -> None:
+        self.count -= n
+
+    def get_count(self) -> int:
+        return self.count
+
+
+class Gauge:
+    """Wraps a supplier (ref: flink-metrics-core Gauge<T>)."""
+
+    __slots__ = ("_fn",)
+
+    def __init__(self, fn: Callable[[], Any]):
+        self._fn = fn
+
+    def get_value(self) -> Any:
+        return self._fn()
+
+
+class Histogram:
+    """Sliding-reservoir histogram over the last `window` updates
+    (ref: DescriptiveStatisticsHistogram in flink-metrics-dropwizard /
+    runtime latency histograms)."""
+
+    def __init__(self, window: int = 1024):
+        self.window = window
+        self._values: List[float] = []
+        self._pos = 0
+        self.total_count = 0
+
+    def update(self, value: float) -> None:
+        self.total_count += 1
+        if len(self._values) < self.window:
+            self._values.append(float(value))
+        else:
+            self._values[self._pos] = float(value)
+            self._pos = (self._pos + 1) % self.window
+
+    def get_count(self) -> int:
+        return self.total_count
+
+    def get_statistics(self) -> "HistogramStatistics":
+        return HistogramStatistics(list(self._values))
+
+
+class HistogramStatistics:
+    def __init__(self, values: List[float]):
+        self._sorted = sorted(values)
+
+    @property
+    def count(self) -> int:
+        return len(self._sorted)
+
+    @property
+    def min(self) -> float:
+        return self._sorted[0] if self._sorted else float("nan")
+
+    @property
+    def max(self) -> float:
+        return self._sorted[-1] if self._sorted else float("nan")
+
+    @property
+    def mean(self) -> float:
+        return (sum(self._sorted) / len(self._sorted)
+                if self._sorted else float("nan"))
+
+    @property
+    def stddev(self) -> float:
+        n = len(self._sorted)
+        if n < 2:
+            return 0.0 if n else float("nan")
+        m = self.mean
+        return math.sqrt(sum((v - m) ** 2 for v in self._sorted) / (n - 1))
+
+    def quantile(self, q: float) -> float:
+        if not self._sorted:
+            return float("nan")
+        idx = min(len(self._sorted) - 1, int(q * len(self._sorted)))
+        return self._sorted[idx]
+
+
+class Meter:
+    """Event-rate meter: count + rate over a sliding minute
+    (ref: flink-metrics-core Meter / MeterView's 60s update window)."""
+
+    def __init__(self, clock: Callable[[], float] = _time.monotonic,
+                 window_s: float = 60.0):
+        self._clock = clock
+        self._window_s = window_s
+        self.count = 0
+        self._events: List[Tuple[float, int]] = []  # (t, cumulative)
+
+    def mark_event(self, n: int = 1) -> None:
+        self.count += n
+        now = self._clock()
+        self._events.append((now, self.count))
+        cutoff = now - self._window_s
+        drop = bisect.bisect_left(self._events, (cutoff, -1))
+        if drop:
+            del self._events[:drop]
+
+    def get_count(self) -> int:
+        return self.count
+
+    def get_rate(self) -> float:
+        if not self._events:
+            return 0.0
+        now = self._clock()
+        cutoff = now - self._window_s
+        i = bisect.bisect_left(self._events, (cutoff, -1))
+        base = self._events[i - 1][1] if i else (
+            self._events[0][1] - 1)  # approximate pre-window base
+        span = min(self._window_s, now - self._events[0][0]) or 1e-9
+        return (self.count - base) / span
+
+
+# ---------------------------------------------------------------------------
+# groups + registry
+# ---------------------------------------------------------------------------
+
+class MetricGroup:
+    """A node in the metric scope tree (ref: AbstractMetricGroup /
+    scope formats <host>.<job>.<task>.<operator>.<metric>)."""
+
+    def __init__(self, registry: "MetricRegistry",
+                 scope: Tuple[str, ...]):
+        self._registry = registry
+        self.scope = scope
+        self.metrics: Dict[str, Any] = {}
+        self._children: Dict[str, "MetricGroup"] = {}
+
+    # -- construction --------------------------------------------------
+    def add_group(self, name: str) -> "MetricGroup":
+        g = self._children.get(name)
+        if g is None:
+            g = MetricGroup(self._registry, self.scope + (str(name),))
+            self._children[name] = g
+        return g
+
+    def _register(self, name: str, metric) :
+        existing = self.metrics.get(name)
+        if existing is not None:
+            return existing
+        self.metrics[name] = metric
+        self._registry._on_register(self, name, metric)
+        return metric
+
+    def counter(self, name: str) -> Counter:
+        return self._register(name, Counter())
+
+    def gauge(self, name: str, fn: Callable[[], Any]) -> Gauge:
+        # gauges re-register on restart attempts: the new supplier
+        # must win (it closes over the live coordinator/operator)
+        g = Gauge(fn)
+        self.metrics[name] = g
+        self._registry._on_register(self, name, g)
+        return g
+
+    def histogram(self, name: str, window: int = 1024) -> Histogram:
+        return self._register(name, Histogram(window))
+
+    def meter(self, name: str) -> Meter:
+        return self._register(name, Meter())
+
+    # -- introspection -------------------------------------------------
+    def scope_string(self, delimiter: str = ".") -> str:
+        return delimiter.join(self.scope)
+
+    def dump(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {}
+        prefix = self.scope_string()
+        for name, m in self.metrics.items():
+            key = f"{prefix}.{name}" if prefix else name
+            out[key] = _metric_value(m)
+        for child in self._children.values():
+            out.update(child.dump())
+        return out
+
+
+def _metric_value(m) -> Any:
+    if isinstance(m, Counter):
+        return m.count
+    if isinstance(m, Gauge):
+        try:
+            return m.get_value()
+        except Exception:  # noqa: BLE001 — a broken gauge must not kill reporting
+            return None
+    if isinstance(m, Meter):
+        return {"count": m.count, "rate": round(m.get_rate(), 3)}
+    if isinstance(m, Histogram):
+        s = m.get_statistics()
+        if not s.count:
+            return {"count": m.total_count}
+        return {
+            "count": m.total_count,
+            "min": s.min, "max": s.max,
+            "mean": round(s.mean, 3),
+            "p50": s.quantile(0.50),
+            "p95": s.quantile(0.95),
+            "p99": s.quantile(0.99),
+        }
+    return repr(m)
+
+
+class MetricReporter:
+    """(ref: flink-metrics-core MetricReporter SPI)"""
+
+    def notify_of_added_metric(self, metric, name: str,
+                               group: MetricGroup) -> None:  # noqa: B027
+        pass
+
+    def report(self, snapshot: Dict[str, Any]) -> None:  # noqa: B027
+        pass
+
+
+class JsonLinesReporter(MetricReporter):
+    """Writes one JSON object per report to a file or stream (the
+    slf4j-reporter analogue; ref: flink-metrics-slf4j Slf4jReporter)."""
+
+    def __init__(self, path: Optional[str] = None, stream=None):
+        self._path = path
+        self._stream = stream
+
+    def report(self, snapshot: Dict[str, Any]) -> None:
+        line = json.dumps({"ts": _time.time(), "metrics": snapshot},
+                          default=str)
+        if self._path is not None:
+            with open(self._path, "a") as f:
+                f.write(line + "\n")
+        if self._stream is not None:
+            self._stream.write(line + "\n")
+
+
+class PrometheusTextReporter(MetricReporter):
+    """Renders the Prometheus text exposition format on demand
+    (ref: flink-metrics-prometheus PrometheusReporter — ours renders
+    to a string the caller serves however it likes)."""
+
+    def __init__(self):
+        self._last: Dict[str, Any] = {}
+
+    def report(self, snapshot: Dict[str, Any]) -> None:
+        self._last = snapshot
+
+    @staticmethod
+    def _sanitize(key: str) -> str:
+        return "".join(c if (c.isalnum() or c == "_") else "_" for c in key)
+
+    def render(self) -> str:
+        lines: List[str] = []
+        for key, value in sorted(self._last.items()):
+            name = "flink_tpu_" + self._sanitize(key)
+            if isinstance(value, dict):
+                for sub, v in value.items():
+                    if isinstance(v, (int, float)) and not isinstance(v, bool):
+                        lines.append(f"{name}_{self._sanitize(sub)} {v}")
+            elif isinstance(value, (int, float)) and not isinstance(value, bool):
+                lines.append(f"{name} {value}")
+        return "\n".join(lines) + ("\n" if lines else "")
+
+
+class MetricRegistry:
+    """Root of the metric tree + reporter fan-out
+    (ref: MetricRegistryImpl.java)."""
+
+    def __init__(self):
+        self.root = MetricGroup(self, ())
+        self.reporters: List[MetricReporter] = []
+
+    def add_reporter(self, reporter: MetricReporter) -> MetricReporter:
+        self.reporters.append(reporter)
+        return reporter
+
+    def _on_register(self, group: MetricGroup, name: str, metric) -> None:
+        for r in self.reporters:
+            r.notify_of_added_metric(metric, name, group)
+
+    # scope helpers (ref: TaskManagerMetricGroup.addTaskForJob chain)
+    def job_group(self, job_name: str) -> MetricGroup:
+        return self.root.add_group(job_name)
+
+    def dump(self) -> Dict[str, Any]:
+        return self.root.dump()
+
+    def report(self) -> Dict[str, Any]:
+        snapshot = self.dump()
+        for r in self.reporters:
+            r.report(snapshot)
+        return snapshot
+
+
+# ---------------------------------------------------------------------------
+# task-level helpers
+# ---------------------------------------------------------------------------
+
+class TaskIOMetricGroup:
+    """Built-in per-subtask IO metrics (ref: TaskIOMetricGroup.java:
+    numRecordsIn/Out, numRecordsInPerSecond via MeterView)."""
+
+    def __init__(self, task_group: MetricGroup):
+        self.group = task_group
+        self.num_records_in = task_group.counter("numRecordsIn")
+        self.num_records_out = task_group.counter("numRecordsOut")
+        self.num_bytes_in = task_group.counter("numBytesIn")
+        self.num_bytes_out = task_group.counter("numBytesOut")
+
+
+class LatencyStats:
+    """Per (source-operator, sink-operator) latency histograms fed by
+    LatencyMarker flow (ref: AbstractStreamOperator.LatencyGauge /
+    LatencyStats in the reference; markers emitted by sources and
+    forwarded through the graph — §5 tracing row)."""
+
+    def __init__(self, group: MetricGroup, window: int = 1024):
+        self.group = group.add_group("latency")
+        self.window = window
+
+    def record(self, marker, operator_id: str, latency_ms: float) -> None:
+        h = self.group.add_group(
+            f"source_{marker.operator_id}_{marker.subtask_index}"
+        ).histogram(f"operator_{operator_id}", self.window)
+        h.update(latency_ms)
+
+
+class CheckpointStatsTracker:
+    """Checkpoint counts/durations/sizes
+    (ref: CheckpointStatsTracker.java — summary + latest)."""
+
+    def __init__(self, group: Optional[MetricGroup] = None):
+        self.completed = 0
+        self.failed = 0
+        self.in_progress: Dict[int, float] = {}  # id -> trigger monotonic
+        self.duration_hist = Histogram(256)
+        self.size_hist = Histogram(256)
+        self.latest: Optional[Dict[str, Any]] = None
+        if group is not None:
+            g = group.add_group("checkpointing")
+            g.gauge("numberOfCompletedCheckpoints", lambda: self.completed)
+            g.gauge("numberOfFailedCheckpoints", lambda: self.failed)
+            g.gauge("lastCheckpointDuration",
+                    lambda: self.latest and self.latest["duration_ms"])
+            g.gauge("lastCheckpointSize",
+                    lambda: self.latest and self.latest["size_bytes"])
+
+    def report_triggered(self, checkpoint_id: int) -> None:
+        self.in_progress[checkpoint_id] = _time.monotonic()
+
+    def report_completed(self, checkpoint_id: int,
+                         size_bytes: Optional[int] = None) -> None:
+        t0 = self.in_progress.pop(checkpoint_id, None)
+        duration_ms = (_time.monotonic() - t0) * 1000.0 if t0 else 0.0
+        self.completed += 1
+        self.duration_hist.update(duration_ms)
+        if size_bytes is not None:
+            self.size_hist.update(size_bytes)
+        self.latest = {
+            "checkpoint_id": checkpoint_id,
+            "duration_ms": duration_ms,
+            "size_bytes": size_bytes or 0,
+        }
+
+    def report_failed(self, checkpoint_id: int) -> None:
+        self.in_progress.pop(checkpoint_id, None)
+        self.failed += 1

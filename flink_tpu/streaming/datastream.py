@@ -102,6 +102,8 @@ class StreamExecutionEnvironment:
         self.processing_time_service = None  # executor default if None
         self.state_backend: str = self.config.get_string("state.backend", "heap")
         self.restart_strategy: Optional[dict] = {"strategy": "none"}
+        self.latency_tracking_interval: Optional[int] = None
+        self._last_executor = None
         self._executed = False
 
     # ---- factory ----------------------------------------------------
@@ -193,14 +195,30 @@ class StreamExecutionEnvironment:
             }
         return jg
 
+    def set_latency_tracking_interval(self, interval_ms: Optional[int]
+                                      ) -> "StreamExecutionEnvironment":
+        """Periodic LatencyMarker emission from sources (ref:
+        ExecutionConfig.setLatencyTrackingInterval / the
+        metrics.latency.interval config)."""
+        self.latency_tracking_interval = interval_ms
+        return self
+
+    def get_metric_registry(self):
+        """The registry of the last/most recent executor (populated
+        after execute()/execute_async())."""
+        return self._last_executor.metrics if self._last_executor else None
+
     def _make_executor(self):
         from flink_tpu.runtime.local import LocalExecutor
-        return LocalExecutor(
+        self._last_executor = LocalExecutor(
             state_backend=self.state_backend,
             max_parallelism=self.max_parallelism,
             restart_strategy=self.restart_strategy,
             processing_time_service=self.processing_time_service,
+            latency_interval_ms=getattr(self, "latency_tracking_interval",
+                                        None),
         )
+        return self._last_executor
 
     def execute(self, job_name: str = "job"):
         """(ref: execute :1508) — runs on the local executor."""

@@ -306,6 +306,10 @@ class WindowOperator(AbstractUdfStreamOperator):
     # ---- lifecycle --------------------------------------------------
     def open(self):
         super().open()
+        if self.metrics is not None:
+            # eager so monitoring sees the zero (ref: the counter is
+            # constructed in WindowOperator.open, not on first drop)
+            self.metrics.counter("numLateRecordsDropped")
         self.window_state = self.keyed_backend.get_or_create_keyed_state(
             self.state_descriptor)
         self.trigger_ctx = _WindowTriggerContext(self)
