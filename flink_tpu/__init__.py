@@ -22,10 +22,8 @@ Layer map (mirrors SURVEY.md §1):
   runtime/    jobgraph, local/mini-cluster execution, checkpoint
               coordination, metrics     (ref: flink-runtime)
   parallel/   device-mesh sharding of key groups, collective keyBy
-              exchange                  (ref: network stack / §2.8)
-  table/      Table API + SQL slice lowering onto the window operator
-              (ref: flink-libraries/flink-table)
-  cep/        pattern matching          (ref: flink-libraries/flink-cep)
+              exchange, mesh-sharded multi-window aggregation
+              (ref: network stack / §2.8)
   connectors/ sources/sinks             (ref: flink-connectors)
 """
 

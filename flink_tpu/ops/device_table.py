@@ -131,6 +131,78 @@ insert_or_lookup = partial(jax.jit, static_argnames=("max_probes",),
                            donate_argnums=0)(insert_or_lookup_impl)
 
 
+def insert_or_lookup_regions_impl(
+    table: DeviceHashTable,
+    h_hi: jnp.ndarray,    # [N] uint32
+    h_lo: jnp.ndarray,    # [N] uint32
+    region: jnp.ndarray,  # [N] int32 region index per record
+    mask: jnp.ndarray,    # [N] bool (False = padding)
+    region_size: int,
+    max_probes: int = 64,
+) -> Tuple[DeviceHashTable, jnp.ndarray, jnp.ndarray]:
+    """Regional insert-or-lookup: the table is partitioned into
+    same-sized regions and record i probes only inside region[i]
+    (position = region*region_size + (base + probe) % region_size).
+    One region per live window turns the multi-window state of the
+    mesh path into a single static-shape table — the namespace
+    dimension of the reference's keyed state (window = namespace,
+    WindowOperator.java:387) becomes an address offset.  Same claim
+    protocol and return contract as insert_or_lookup_impl."""
+    n = h_hi.shape[0]
+    capacity = table.key_hi.shape[0]
+    idx = jnp.arange(n, dtype=jnp.int32)
+    sentinel = jnp.int32(n)
+    base_off = region * jnp.int32(region_size)
+
+    def pos_of(probe):
+        base = fmix32(h_lo ^ (h_hi * jnp.uint32(0x9E3779B9)))
+        inner = ((base + probe.astype(jnp.uint32))
+                 % jnp.uint32(region_size)).astype(jnp.int32)
+        return base_off + inner
+
+    def cond(s: _InsertState):
+        busy = ~s.resolved & mask
+        return jnp.logical_and(busy.any(), s.round_ < max_probes)
+
+    def body(s: _InsertState):
+        pos = pos_of(s.probe)
+        active = ~s.resolved & mask
+        cur_hi = s.table.key_hi[pos]
+        cur_lo = s.table.key_lo[pos]
+        occ = s.table.occupied[pos]
+        match = active & occ & (cur_hi == h_hi) & (cur_lo == h_lo)
+        want_claim = active & ~occ
+        claim = jnp.full(capacity, sentinel, jnp.int32).at[pos].min(
+            jnp.where(want_claim, idx, sentinel))
+        won = want_claim & (claim[pos] == idx)
+        new_table = DeviceHashTable(
+            key_hi=s.table.key_hi.at[jnp.where(won, pos, capacity)].set(
+                h_hi, mode="drop"),
+            key_lo=s.table.key_lo.at[jnp.where(won, pos, capacity)].set(
+                h_lo, mode="drop"),
+            occupied=s.table.occupied.at[jnp.where(won, pos, capacity)].set(
+                True, mode="drop"),
+        )
+        resolved_now = match | won
+        slots = jnp.where(resolved_now, pos, s.slots)
+        collide = active & occ & ~match
+        probe = s.probe + jnp.where(collide, 1, 0)
+        return _InsertState(new_table, probe, slots,
+                            s.resolved | resolved_now, s.round_ + 1)
+
+    zero = (h_hi ^ h_hi).astype(jnp.int32)
+    init = _InsertState(
+        table=table,
+        probe=zero,
+        slots=zero - 1,
+        resolved=zero != 0,
+        round_=jnp.int32(0),
+    )
+    final = jax.lax.while_loop(cond, body, init)
+    ok = final.resolved | ~mask
+    return final.table, final.slots, ok
+
+
 @partial(jax.jit, donate_argnums=0)
 def clear_entries(table: DeviceHashTable, slots: jnp.ndarray) -> DeviceHashTable:
     """Free table positions (window fired).  Linear probing requires

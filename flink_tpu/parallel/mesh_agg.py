@@ -159,7 +159,8 @@ class MeshWindowAggregation:
     the mesh.  Each host shard keeps hash → original key for emission."""
 
     def __init__(self, mesh: Mesh, axis: str, agg: DeviceAggregateFunction,
-                 max_parallelism: int = 128, capacity_per_shard: int = 4096):
+                 max_parallelism: int = 128, capacity_per_shard: int = 4096,
+                 allow_overflow: bool = False):
         self.mesh = mesh
         self.axis = axis
         self.agg = agg
@@ -167,13 +168,26 @@ class MeshWindowAggregation:
         init, self._step, self._fire = make_sharded_step(
             mesh, axis, agg, max_parallelism, capacity_per_shard)
         self.state = init()
+        self.capacity_per_shard = capacity_per_shard
+        #: overflow policy: by default a full shard table is a hard
+        #: failure (silently counting dropped records is data loss);
+        #: allow_overflow=True restores the count-and-continue behavior
+        #: for capacity experiments.
+        self.allow_overflow = allow_overflow
         self.overflowed = 0
 
     def step(self, h_hi, h_lo, values, vh_hi, vh_lo, mask) -> None:
         """Process one global batch (length divisible by n_shards)."""
         self.state, overflow = self._step(
             self.state, h_hi, h_lo, values, vh_hi, vh_lo, mask)
-        self.overflowed += int(np.asarray(overflow).sum())
+        ov = int(np.asarray(overflow).sum())
+        if ov:
+            self.overflowed += ov
+            if not self.allow_overflow:
+                raise RuntimeError(
+                    f"{ov} records overflowed a shard hash table "
+                    f"(capacity_per_shard={self.capacity_per_shard}); "
+                    f"raise capacity_per_shard or shard wider")
 
     def fire(self):
         """Close the window: returns (key_hi, key_lo, results, occupied)

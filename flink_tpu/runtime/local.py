@@ -33,6 +33,7 @@ cooperative in-process dataflow:
 
 from __future__ import annotations
 
+import random as _random_mod
 import threading
 import time as _time
 from collections import deque
@@ -50,6 +51,7 @@ from flink_tpu.runtime.metrics import (
     LatencyStats,
     MetricRegistry,
     TaskIOMetricGroup,
+    register_checkpoint_gauges,
 )
 from flink_tpu.state.loader import load_state_backend
 from flink_tpu.state.operator_state import OperatorStateBackend
@@ -75,6 +77,9 @@ from flink_tpu.streaming.timers import TestProcessingTimeService
 #: soft per-channel queue bound (the exclusive-buffer count analogue,
 #: NetworkEnvironmentConfiguration.java:45-47)
 DEFAULT_CHANNEL_CAPACITY = 1024
+
+#: channel choice for latency-marker forwarding
+_rand = _random_mod.Random(0)
 
 
 class JobExecutionResult:
@@ -165,10 +170,14 @@ class _RouterOutput(Output):
                 ch.push(watermark)
 
     def emit_latency_marker(self, marker):
+        # ONE random channel per route, not a broadcast: fan-out would
+        # multiply marker traffic by parallelism at every shuffle stage
+        # (O(p^depth) at the sink) and duplicate histogram samples
+        # (ref: RecordWriterOutput forwards each marker to a single
+        # random channel for the same reason)
         for _, channels, side_tag in self.routes:
-            if side_tag is None:
-                for ch in channels:
-                    ch.push(marker)
+            if side_tag is None and channels:
+                channels[_rand.randrange(len(channels))].push(marker)
 
     def broadcast_barrier(self, barrier: CheckpointBarrier):
         """(ref: OperatorChain.broadcastCheckpointBarrier)"""
@@ -727,38 +736,9 @@ class LocalExecutor:
 
     # ---- graph → subtasks ------------------------------------------
     def build_subtasks(self, job_graph: JobGraph) -> Dict[int, List[SubtaskInstance]]:
-        job_group = self.metrics.job_group(job_graph.job_name)
-        latency_stats = LatencyStats(job_group)
-        subtasks: Dict[int, List[SubtaskInstance]] = {}
-        for vid, vertex in job_graph.vertices.items():
-            vertex_group = job_group.add_group(f"{vid}_{vertex.name}")
-            subtasks[vid] = [
-                SubtaskInstance(vertex, i, self.state_backend,
-                                self.max_parallelism, self.pts,
-                                self.channel_capacity,
-                                metrics_group=vertex_group.add_group(str(i)),
-                                latency_stats=latency_stats)
-                for i in range(vertex.parallelism)
-            ]
-        # wire edges: all-to-all for shuffling partitioners; contiguous
-        # groups for pointwise ones (forward/rescale — ref: the
-        # DistributionPattern.POINTWISE wiring in ExecutionGraph)
-        for edge in job_graph.edges:
-            ups = subtasks[edge.source_vertex_id]
-            downs = subtasks[edge.target_vertex_id]
-            for i, up in enumerate(ups):
-                if edge.partitioner.is_pointwise:
-                    n_up, n_down = len(ups), len(downs)
-                    if n_down >= n_up:
-                        targets = downs[i * n_down // n_up:(i + 1) * n_down // n_up]
-                    else:
-                        targets = [downs[i * n_down // n_up]]
-                else:
-                    targets = downs
-                channels = [d.new_channel(edge.type_number) for d in targets]
-                partitioner = _clone_partitioner(edge.partitioner)
-                up.router.add_route(partitioner, channels, edge.side_output_tag)
-        return subtasks
+        return build_and_wire_subtasks(
+            job_graph, self.state_backend, self.max_parallelism,
+            lambda vid, i: self.pts, self.channel_capacity, self.metrics)
 
     # ---- public API -------------------------------------------------
     def execute(self, job_graph: JobGraph) -> JobExecutionResult:
@@ -860,22 +840,8 @@ class LocalExecutor:
                 notify_complete=notify_complete,
                 min_pause_ms=cfg.get("min_pause", 0),
             )
-            # checkpoint gauges (ref: CheckpointStatsTracker metrics)
-            cp_group = self.metrics.job_group(
-                job_graph.job_name).add_group("checkpointing")
-            co = coordinator
-            cp_group.gauge("numberOfCompletedCheckpoints",
-                           lambda: co.completed_count)
-            cp_group.gauge("lastCompletedCheckpointId",
-                           lambda: co.latest_completed_id)
-            cp_group.gauge(
-                "lastCheckpointDuration",
-                lambda: (co.stats[co.latest_completed_id].duration_ms
-                         if co.latest_completed_id in co.stats else None))
-            cp_group.gauge(
-                "lastCheckpointSize",
-                lambda: (co.stats[co.latest_completed_id].state_bytes
-                         if co.latest_completed_id in co.stats else None))
+            register_checkpoint_gauges(self.metrics, job_graph.job_name,
+                                       coordinator)
             # continue the id sequence across restarts
             ids = storage.checkpoint_ids()
             if ids:
@@ -1024,3 +990,45 @@ class LocalExecutor:
 def _clone_partitioner(p):
     import copy
     return copy.copy(p)
+
+
+def build_and_wire_subtasks(job_graph: JobGraph, state_backend: str,
+                            max_parallelism: int, pts_selector,
+                            channel_capacity: int,
+                            metrics: MetricRegistry
+                            ) -> Dict[int, List[SubtaskInstance]]:
+    """Fan each JobVertex out to parallelism subtasks and wire edge
+    channels: all-to-all for shuffling partitioners, contiguous groups
+    for pointwise ones (ref: the DistributionPattern.POINTWISE wiring
+    in ExecutionGraph).  `pts_selector(vertex_id, subtask_index)` picks
+    the processing-time service — the MiniCluster gives each
+    TaskManager its own so timers fire on the owning worker thread."""
+    job_group = metrics.job_group(job_graph.job_name)
+    latency_stats = LatencyStats(job_group)
+    subtasks: Dict[int, List[SubtaskInstance]] = {}
+    for vid, vertex in job_graph.vertices.items():
+        vertex_group = job_group.add_group(f"{vid}_{vertex.name}")
+        subtasks[vid] = [
+            SubtaskInstance(vertex, i, state_backend,
+                            max_parallelism, pts_selector(vid, i),
+                            channel_capacity,
+                            metrics_group=vertex_group.add_group(str(i)),
+                            latency_stats=latency_stats)
+            for i in range(vertex.parallelism)
+        ]
+    for edge in job_graph.edges:
+        ups = subtasks[edge.source_vertex_id]
+        downs = subtasks[edge.target_vertex_id]
+        for i, up in enumerate(ups):
+            if edge.partitioner.is_pointwise:
+                n_up, n_down = len(ups), len(downs)
+                if n_down >= n_up:
+                    targets = downs[i * n_down // n_up:(i + 1) * n_down // n_up]
+                else:
+                    targets = [downs[i * n_down // n_up]]
+            else:
+                targets = downs
+            channels = [d.new_channel(edge.type_number) for d in targets]
+            partitioner = _clone_partitioner(edge.partitioner)
+            up.router.add_route(partitioner, channels, edge.side_output_tag)
+    return subtasks

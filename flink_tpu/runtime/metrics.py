@@ -339,7 +339,12 @@ class MetricRegistry:
 
 class TaskIOMetricGroup:
     """Built-in per-subtask IO metrics (ref: TaskIOMetricGroup.java:
-    numRecordsIn/Out, numRecordsInPerSecond via MeterView)."""
+    numRecordsIn/Out, numRecordsInPerSecond via MeterView).
+
+    Construction marks the start of an execution ATTEMPT: counters are
+    reset so post-failover numbers reflect the recovering attempt, not
+    an accumulation over replays (the reference creates a fresh
+    TaskMetricGroup per attempt)."""
 
     def __init__(self, task_group: MetricGroup):
         self.group = task_group
@@ -347,6 +352,9 @@ class TaskIOMetricGroup:
         self.num_records_out = task_group.counter("numRecordsOut")
         self.num_bytes_in = task_group.counter("numBytesIn")
         self.num_bytes_out = task_group.counter("numBytesOut")
+        for c in (self.num_records_in, self.num_records_out,
+                  self.num_bytes_in, self.num_bytes_out):
+            c.count = 0
 
 
 class LatencyStats:
@@ -366,43 +374,25 @@ class LatencyStats:
         h.update(latency_ms)
 
 
-class CheckpointStatsTracker:
-    """Checkpoint counts/durations/sizes
-    (ref: CheckpointStatsTracker.java — summary + latest)."""
-
-    def __init__(self, group: Optional[MetricGroup] = None):
-        self.completed = 0
-        self.failed = 0
-        self.in_progress: Dict[int, float] = {}  # id -> trigger monotonic
-        self.duration_hist = Histogram(256)
-        self.size_hist = Histogram(256)
-        self.latest: Optional[Dict[str, Any]] = None
-        if group is not None:
-            g = group.add_group("checkpointing")
-            g.gauge("numberOfCompletedCheckpoints", lambda: self.completed)
-            g.gauge("numberOfFailedCheckpoints", lambda: self.failed)
-            g.gauge("lastCheckpointDuration",
-                    lambda: self.latest and self.latest["duration_ms"])
-            g.gauge("lastCheckpointSize",
-                    lambda: self.latest and self.latest["size_bytes"])
-
-    def report_triggered(self, checkpoint_id: int) -> None:
-        self.in_progress[checkpoint_id] = _time.monotonic()
-
-    def report_completed(self, checkpoint_id: int,
-                         size_bytes: Optional[int] = None) -> None:
-        t0 = self.in_progress.pop(checkpoint_id, None)
-        duration_ms = (_time.monotonic() - t0) * 1000.0 if t0 else 0.0
-        self.completed += 1
-        self.duration_hist.update(duration_ms)
-        if size_bytes is not None:
-            self.size_hist.update(size_bytes)
-        self.latest = {
-            "checkpoint_id": checkpoint_id,
-            "duration_ms": duration_ms,
-            "size_bytes": size_bytes or 0,
-        }
-
-    def report_failed(self, checkpoint_id: int) -> None:
-        self.in_progress.pop(checkpoint_id, None)
-        self.failed += 1
+def register_checkpoint_gauges(metrics: MetricRegistry, job_name: str,
+                               coordinator) -> None:
+    """Publish the standard checkpoint gauges for a job's coordinator
+    (ref: CheckpointStatsTracker.java metrics).  Shared by every
+    executor (LocalExecutor, MiniCluster) so the metric surface cannot
+    diverge between them; gauges re-register per restart attempt and
+    the fresh suppliers win (they close over the live coordinator)."""
+    g = metrics.job_group(job_name).add_group("checkpointing")
+    g.gauge("numberOfCompletedCheckpoints",
+            lambda: coordinator.completed_count)
+    g.gauge("lastCompletedCheckpointId",
+            lambda: coordinator.latest_completed_id)
+    g.gauge(
+        "lastCheckpointDuration",
+        lambda: (coordinator.stats[coordinator.latest_completed_id].duration_ms
+                 if coordinator.latest_completed_id in coordinator.stats
+                 else None))
+    g.gauge(
+        "lastCheckpointSize",
+        lambda: (coordinator.stats[coordinator.latest_completed_id].state_bytes
+                 if coordinator.latest_completed_id in coordinator.stats
+                 else None))

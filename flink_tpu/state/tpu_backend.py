@@ -162,9 +162,12 @@ class DeviceAggregatingState(AggregatingState):
         else:
             slots = [slot_for(k, namespaces[i]) for i, k in enumerate(keys)]
         self._pending_slots.extend(slots)
-        extract = type(self.agg).extract_value
-        if extract is not DeviceAggregateFunction.extract_value:
-            values = [self.agg.extract_value(v) for v in values]
+        extract = self.agg.extract_value
+        # overridden on the class or per-instance (an instance-attached
+        # plain function has no __func__)
+        if getattr(extract, "__func__",
+                   None) is not DeviceAggregateFunction.extract_value:
+            values = [extract(v) for v in values]
         if self.agg.needs_value:
             self._pending_values.extend(values)
         if self.agg.needs_value_hash:

@@ -103,6 +103,11 @@ class StreamExecutionEnvironment:
         self.state_backend: str = self.config.get_string("state.backend", "heap")
         self.restart_strategy: Optional[dict] = {"strategy": "none"}
         self.latency_tracking_interval: Optional[int] = None
+        #: device mesh for sharded window aggregation (None = 1 chip)
+        self.mesh = None
+        self.mesh_axis = "kg"
+        #: None → LocalExecutor; int n → MiniCluster with n workers
+        self.num_task_managers: Optional[int] = None
         self._last_executor = None
         self._executed = False
 
@@ -143,6 +148,23 @@ class StreamExecutionEnvironment:
         self.checkpoint_storage = {"storage": storage, "retain": retain}
         if directory is not None:
             self.checkpoint_storage["dir"] = directory
+        return self
+
+    def set_mesh(self, mesh, axis: str = "kg") -> "StreamExecutionEnvironment":
+        """Shard device window aggregation over `mesh[axis]` — the
+        keyBy exchange runs as lax.all_to_all over ICI inside the
+        jitted step (flink_tpu.parallel.mesh_windows), the TPU-native
+        replacement for the reference's Netty key-group shuffle."""
+        self.mesh = mesh
+        self.mesh_axis = axis
+        return self
+
+    def use_mini_cluster(self, num_task_managers: int = 2
+                         ) -> "StreamExecutionEnvironment":
+        """Execute on the in-process multi-worker MiniCluster
+        (flink_tpu.runtime.minicluster) instead of the single-loop
+        LocalExecutor (ref: MiniCluster.java — multi-TM in one JVM)."""
+        self.num_task_managers = num_task_managers
         return self
 
     def set_restart_strategy(self, strategy: str, **kw) -> "StreamExecutionEnvironment":
@@ -209,8 +231,7 @@ class StreamExecutionEnvironment:
         return self._last_executor.metrics if self._last_executor else None
 
     def _make_executor(self):
-        from flink_tpu.runtime.local import LocalExecutor
-        self._last_executor = LocalExecutor(
+        kw = dict(
             state_backend=self.state_backend,
             max_parallelism=self.max_parallelism,
             restart_strategy=self.restart_strategy,
@@ -218,6 +239,13 @@ class StreamExecutionEnvironment:
             latency_interval_ms=getattr(self, "latency_tracking_interval",
                                         None),
         )
+        if self.num_task_managers is not None:
+            from flink_tpu.runtime.minicluster import MiniCluster
+            self._last_executor = MiniCluster(
+                num_task_managers=self.num_task_managers, **kw)
+        else:
+            from flink_tpu.runtime.local import LocalExecutor
+            self._last_executor = LocalExecutor(**kw)
         return self._last_executor
 
     def execute(self, job_name: str = "job"):
@@ -621,10 +649,26 @@ class WindowedStream:
                     self._evictor, self._allowed_lateness, self._late_tag,
                     window_function)):
             assigner = self._assigner
+            env = self._keyed.env
+            mesh, mesh_axis = env.mesh, env.mesh_axis
+            from flink_tpu.streaming.windowing import (
+                TumblingEventTimeWindows as _Tumbling,
+            )
+            if mesh is not None and not isinstance(assigner, _Tumbling):
+                mesh = None  # only tumbling has a sharded engine so far
 
             def factory():
                 return DeviceWindowOperator(assigner, aggregate_function,
-                                            window_function)
+                                            window_function,
+                                            mesh=mesh, mesh_axis=mesh_axis)
+            if mesh is not None:
+                # the mesh IS the parallelism: one host subtask drives
+                # the SPMD program over all devices; upstream edges
+                # still hash-route (to the single subtask) so the
+                # operator sees the keyed contract
+                return self._keyed._add_op(
+                    name, factory, parallelism=1,
+                    key_selector=self._keyed.key_selector, chaining="head")
             return self._keyed._add_keyed_op(name, factory, chaining="head")
         return self._build(
             name,

@@ -42,9 +42,20 @@ from flink_tpu.streaming.windowing import (
 
 
 def engine_for_assigner(assigner, agg: DeviceAggregateFunction,
-                        initial_capacity: int = 1 << 14):
-    """Assigner → engine, or None when no device engine applies."""
+                        initial_capacity: int = 1 << 14, mesh=None,
+                        mesh_axis: str = "kg", max_parallelism: int = 128):
+    """Assigner → engine, or None when no device engine applies.  With
+    a mesh, tumbling windows run on the sharded multi-window engine
+    (SPMD over the mesh axis, flink_tpu.parallel.mesh_windows); other
+    assigners fall back to the single-device engines."""
     if isinstance(assigner, TumblingEventTimeWindows) and assigner.offset == 0:
+        if mesh is not None:
+            from flink_tpu.parallel.mesh_windows import MeshTumblingWindows
+            return MeshTumblingWindows(
+                agg, assigner.size, mesh, axis=mesh_axis,
+                max_parallelism=max_parallelism,
+                capacity_per_window_shard=max(
+                    1 << 8, initial_capacity // mesh.shape[mesh_axis]))
         return VectorizedTumblingWindows(agg, assigner.size,
                                          initial_capacity=initial_capacity)
     if isinstance(assigner, SlidingEventTimeWindows):
@@ -84,13 +95,16 @@ class DeviceWindowOperator(StreamOperator):
 
     def __init__(self, assigner, aggregate_function: DeviceAggregateFunction,
                  window_function=None, flush_batch: int = 8192,
-                 initial_capacity: int = 1 << 14):
+                 initial_capacity: int = 1 << 14, mesh=None,
+                 mesh_axis: str = "kg"):
         super().__init__()
         self.assigner = assigner
         self.agg = aggregate_function
         self.window_function = window_function
         self.flush_batch = flush_batch
         self.initial_capacity = initial_capacity
+        self.mesh = mesh
+        self.mesh_axis = mesh_axis
         self.engine = None
         self._keys: List[Any] = []
         self._ts: List[int] = []
@@ -100,11 +114,19 @@ class DeviceWindowOperator(StreamOperator):
     # ---- lifecycle --------------------------------------------------
     def open(self):
         self.engine = engine_for_assigner(self.assigner, self.agg,
-                                          self.initial_capacity)
+                                          self.initial_capacity,
+                                          mesh=self.mesh,
+                                          mesh_axis=self.mesh_axis)
         if self.engine is None:
             raise ValueError(
                 f"no device engine for assigner {self.assigner!r}")
         self.collector = TimestampedCollector(self.output)
+        # metric parity with the scalar WindowOperator (ref:
+        # WindowOperator.java:138 numLateRecordsDropped); reset = this
+        # execution attempt
+        if self.metrics is not None:
+            c = self.metrics.counter("numLateRecordsDropped")
+            c.count = 0
 
     # ---- input ------------------------------------------------------
     def set_key_context(self, record):
@@ -126,9 +148,12 @@ class DeviceWindowOperator(StreamOperator):
         if not self._keys:
             return
         agg = self.agg
-        extract = type(agg).extract_value
-        if extract is not DeviceAggregateFunction.extract_value:
-            values = [agg.extract_value(v) for v in self._values]
+        extract = agg.extract_value
+        # overridden either on the class or per-instance (a plain
+        # function set on the instance has no __func__)
+        if getattr(extract, "__func__",
+                   None) is not DeviceAggregateFunction.extract_value:
+            values = [extract(v) for v in self._values]
         else:
             values = self._values
         if agg.needs_value or agg.needs_value_hash:
@@ -149,6 +174,9 @@ class DeviceWindowOperator(StreamOperator):
         self.engine.advance_watermark(watermark.timestamp)
         self._emit_from(before)
         self.num_late_records_dropped = self.engine.num_late_dropped
+        if self.metrics is not None:
+            self.metrics.counter(
+                "numLateRecordsDropped").count = self.engine.num_late_dropped
         self.current_watermark = watermark.timestamp
         self.output.emit_watermark(watermark)
 

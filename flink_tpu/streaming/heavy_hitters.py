@@ -1,0 +1,166 @@
+"""Windowed heavy hitters: Count-Min estimates + candidate tracking.
+
+The consumer of CountMinSketchAggregate.point_query promised by
+flink_tpu/ops/sketches.py: per (key, window) the device keeps a
+Count-Min sketch (frequencies of items within the key's stream), the
+host keeps the bounded set of DISTINCT (key, item) candidates seen in
+the window (a sketch can estimate but not enumerate), and at fire time
+one batched device point_query estimates every candidate's frequency;
+items with est >= phi * total (or the top-k by estimate) emit as the
+window's heavy hitters.
+
+This is the batched re-design of what the reference would express as a
+ProcessWindowFunction iterating buffered elements (there is no sketch
+library in Flink 1.5; the per-element buffering path is
+EvictingWindowOperator's ListState).  Here ingestion stays O(1) device
+work per record (CM scatter, flink_tpu.ops.sketches) and the candidate
+set costs one vectorized slot-index pass per batch — no per-record
+host loops (BASELINE.md config #4 shape).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import numpy as np
+
+from flink_tpu.ops.sketches import CountMinSketchAggregate
+from flink_tpu.streaming.vectorized import (
+    VectorizedSlotIndex,
+    VectorizedTumblingWindows,
+    hash_keys_np,
+)
+
+
+class _Candidates:
+    """Distinct (key, item) pairs of one window, vectorized dedupe."""
+
+    __slots__ = ("index", "key_hashes", "item_his", "item_los",
+                 "keys", "items", "count")
+
+    def __init__(self):
+        self.index = VectorizedSlotIndex(1 << 10)
+        self.key_hashes: List[np.ndarray] = []
+        self.item_his: List[np.ndarray] = []
+        self.item_los: List[np.ndarray] = []
+        self.keys: List[Any] = []
+        self.items: List[Any] = []
+        self.count = 0
+
+    def add_batch(self, pair_hashes, key_hashes, item_hashes, keys, items):
+        next_slot = [self.count]
+
+        def alloc(n):
+            out = np.arange(next_slot[0], next_slot[0] + n)
+            next_slot[0] += n
+            return out
+
+        _, _, first_idx = self.index.lookup_or_insert(pair_hashes, alloc)
+        self.count = next_slot[0]
+        if len(first_idx):
+            self.key_hashes.append(key_hashes[first_idx])
+            ih = item_hashes[first_idx]
+            self.item_his.append((ih >> np.uint64(32)).astype(np.uint32))
+            self.item_los.append((ih & np.uint64(0xFFFFFFFF)).astype(np.uint32))
+            self.keys.extend(np.asarray(keys, dtype=object)[first_idx].tolist())
+            self.items.extend(np.asarray(items, dtype=object)[first_idx].tolist())
+
+
+class WindowedHeavyHitters(VectorizedTumblingWindows):
+    """keyBy(key).window(Tumbling).heavy_hitters(item, phi | k).
+
+    emitted entries are (key, hitters, window_start, window_end) where
+    hitters is a list of (item, estimated_count) sorted descending.
+    """
+
+    def __init__(self, window_size_ms: int, phi: Optional[float] = None,
+                 k: Optional[int] = None, depth: int = 4, width: int = 2048,
+                 initial_capacity: int = 1 << 14,
+                 max_candidates_per_window: int = 1 << 22,
+                 microbatch: int = 1 << 17):
+        if phi is None and k is None:
+            raise ValueError("need a phi threshold or a top-k bound")
+        agg = CountMinSketchAggregate(depth=depth, width=width)
+        super().__init__(agg, window_size_ms,
+                         initial_capacity=initial_capacity,
+                         microbatch=microbatch)
+        self.phi = phi
+        self.k = k
+        self.max_candidates = max_candidates_per_window
+        self._candidates: Dict[int, _Candidates] = {}
+        self._jit_point_query = jax.jit(agg.point_query)
+        #: (key, [(item, est), ...], start, end)
+        self.hh_emitted: List[Tuple[Any, list, int, int]] = []
+
+    # ---- ingestion ---------------------------------------------------
+    def process_items(self, keys, timestamps, items,
+                      weights: Optional[np.ndarray] = None) -> None:
+        """One batch of (key, item[, weight]) records."""
+        ts = np.asarray(timestamps, np.int64)
+        kh = hash_keys_np(keys)
+        ih = hash_keys_np(items)
+        if weights is None:
+            weights = np.ones(len(ts), np.float32)
+        starts = ts - np.mod(ts, self.size)
+        live = starts + self.lateness_horizon - 1 > self.watermark
+        pair = kh * np.uint64(0x9E3779B97F4A7C15) ^ ih
+        for start in np.unique(starts[live]).tolist():
+            m = (starts == start) & live
+            cand = self._candidates.get(start)
+            if cand is None:
+                cand = _Candidates()
+                self._candidates[start] = cand
+            cand.add_batch(pair[m], kh[m], ih[m],
+                           np.asarray(keys, dtype=object)[m],
+                           np.asarray(items, dtype=object)[m])
+            if cand.count > self.max_candidates:
+                raise RuntimeError(
+                    f"window {start}: > {self.max_candidates} distinct "
+                    f"(key, item) candidates; raise "
+                    f"max_candidates_per_window or pre-aggregate")
+        self.process_batch(keys, ts, values=weights, key_hashes=kh,
+                           value_hashes=ih)
+
+    # ---- firing ------------------------------------------------------
+    def advance_watermark(self, watermark: int) -> int:
+        # query candidates of every due window BEFORE the engine fires
+        # (fire clears the device state)
+        self.flush()
+        for start in sorted(self._candidates):
+            if start + self.size - 1 > watermark:
+                continue
+            self._query_window(start, self._candidates.pop(start))
+        return super().advance_watermark(watermark)
+
+    def _query_window(self, start: int, cand: _Candidates) -> None:
+        shard = self.windows.get(start)
+        if shard is None or cand.count == 0:
+            return
+        key_hashes = (np.concatenate(cand.key_hashes)
+                      if len(cand.key_hashes) > 1 else cand.key_hashes[0])
+        ihi = (np.concatenate(cand.item_his)
+               if len(cand.item_his) > 1 else cand.item_his[0])
+        ilo = (np.concatenate(cand.item_los)
+               if len(cand.item_los) > 1 else cand.item_los[0])
+        # keys are already present in the shard index: lookup only
+        slots, _, first_idx = shard.index.lookup_or_insert(
+            key_hashes, self.arena.alloc)
+        assert len(first_idx) == 0, "candidate key missing from window index"
+        ests = np.asarray(self._jit_point_query(
+            self.state, slots.astype(np.int32), ihi, ilo))
+        totals = np.asarray(self._jit_result(
+            self.state, slots.astype(np.int32)))
+        # group candidates per key and select
+        per_key: Dict[Any, list] = {}
+        for i in range(cand.count):
+            est = float(ests[i])
+            if self.phi is not None and est < self.phi * float(totals[i]):
+                continue
+            per_key.setdefault(cand.keys[i], []).append((cand.items[i], est))
+        end = start + self.size
+        for key, hitters in per_key.items():
+            hitters.sort(key=lambda kv: -kv[1])
+            if self.k is not None:
+                hitters = hitters[:self.k]
+            self.hh_emitted.append((key, hitters, start, end))

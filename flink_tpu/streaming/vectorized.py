@@ -332,6 +332,16 @@ class VectorizedTumblingWindows:
         self._jit_update = make_masked_update(self.agg)
         self._jit_result = jax.jit(self.agg.result)
         self._jit_clear = jax.jit(self.agg.clear_slots, donate_argnums=0)
+        # fire/clear tile bounded by BYTES not slot count: a gather or
+        # clear materializes [tile, *slot_shape] intermediates, so wide
+        # per-slot state (Count-Min: depth*width ints) must shrink the
+        # tile (16GB HBM budget, ~256MB per intermediate)
+        bytes_per_slot = max(
+            sum(int(np.prod(spec.shape, dtype=np.int64)) * spec.dtype.itemsize
+                for spec in aggregate.state_specs().values()), 1)
+        budget = 256 << 20
+        tile = 1 << max(9, (budget // bytes_per_slot).bit_length() - 1)
+        self.FIRE_TILE = min(tile, type(self).FIRE_TILE)
 
     # ---- ingestion --------------------------------------------------
     def process_batch(

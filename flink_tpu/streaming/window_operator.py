@@ -308,8 +308,10 @@ class WindowOperator(AbstractUdfStreamOperator):
         super().open()
         if self.metrics is not None:
             # eager so monitoring sees the zero (ref: the counter is
-            # constructed in WindowOperator.open, not on first drop)
-            self.metrics.counter("numLateRecordsDropped")
+            # constructed in WindowOperator.open, not on first drop);
+            # reset = fresh execution attempt (restart replays must not
+            # accumulate into the previous attempt's count)
+            self.metrics.counter("numLateRecordsDropped").count = 0
         self.window_state = self.keyed_backend.get_or_create_keyed_state(
             self.state_descriptor)
         self.trigger_ctx = _WindowTriggerContext(self)

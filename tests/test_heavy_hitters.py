@@ -1,0 +1,86 @@
+"""Windowed heavy hitters: Count-Min point queries over candidates.
+
+Verifies the one-sided Count-Min guarantee end to end: no false
+negatives at threshold phi, estimates never below true counts, and
+window/key scoping (BASELINE.md config #4 shape).
+"""
+
+import collections
+
+import numpy as np
+import pytest
+
+from flink_tpu.streaming.heavy_hitters import WindowedHeavyHitters
+
+
+def _zipfish(n, n_keys, n_heavy, n_tail, seed=0, heavy_frac=0.6):
+    rng = np.random.default_rng(seed)
+    keys = rng.integers(0, n_keys, n)
+    items = np.where(rng.random(n) < heavy_frac,
+                     rng.integers(0, n_heavy, n),
+                     rng.integers(n_heavy, n_heavy + n_tail, n))
+    ts = rng.integers(0, 2000, n)
+    return keys, items, ts
+
+
+def _truth(keys, items, ts, size=1000):
+    per_item = collections.Counter()
+    per_key = collections.Counter()
+    for k, i, t in zip(keys.tolist(), items.tolist(), ts.tolist()):
+        s = t - t % size
+        per_item[(k, s, i)] += 1
+        per_key[(k, s)] += 1
+    return per_item, per_key
+
+
+def test_phi_threshold_no_false_negatives():
+    keys, items, ts = _zipfish(20000, 5, 2, 500)
+    hh = WindowedHeavyHitters(1000, phi=0.1, depth=4, width=4096)
+    hh.process_items(keys, ts, items)
+    hh.advance_watermark(1999)
+    per_item, per_key = _truth(keys, items, ts)
+    assert len(hh.hh_emitted) == 10  # 5 keys x 2 windows
+    for key, hitters, s, e in hh.hh_emitted:
+        assert e == s + 1000
+        hit_items = {i for i, _ in hitters}
+        true_heavy = {i for (k2, s2, i), c in per_item.items()
+                      if k2 == key and s2 == s
+                      and c >= 0.1 * per_key[(key, s)]}
+        assert true_heavy <= hit_items
+        for i, est in hitters:
+            assert est >= per_item[(key, s, i)]
+
+
+def test_top_k_selects_dominant_items():
+    keys, items, ts = _zipfish(30000, 3, 3, 1000, seed=2, heavy_frac=0.8)
+    hh = WindowedHeavyHitters(1000, k=3, depth=4, width=8192)
+    hh.process_items(keys, ts, items)
+    hh.advance_watermark(1999)
+    for key, hitters, s, e in hh.hh_emitted:
+        assert len(hitters) <= 3
+        # the three dominant items (0,1,2) each carry ~0.8/3 of mass vs
+        # ~0.2/1000 per tail item — top-3 must be exactly {0,1,2}
+        assert {i for i, _ in hitters} == {0, 1, 2}
+        ests = [est for _, est in hitters]
+        assert ests == sorted(ests, reverse=True)
+
+
+def test_candidate_cap_raises():
+    hh = WindowedHeavyHitters(1000, phi=0.5, max_candidates_per_window=10)
+    keys = np.zeros(100, np.int64)
+    items = np.arange(100)
+    ts = np.full(100, 10)
+    with pytest.raises(RuntimeError, match="candidates"):
+        hh.process_items(keys, ts, items)
+
+
+def test_late_records_do_not_create_candidates():
+    hh = WindowedHeavyHitters(1000, phi=0.01)
+    hh.process_items(np.array([1]), np.array([100]), np.array([7]))
+    hh.advance_watermark(999)
+    assert [(k, s) for k, _, s, _ in hh.hh_emitted] == [(1, 0)]
+    before = len(hh.hh_emitted)
+    hh.process_items(np.array([1]), np.array([200]), np.array([8]))  # late
+    hh.advance_watermark(1999)
+    assert len(hh.hh_emitted) == before
+    assert hh.num_late_dropped == 1
