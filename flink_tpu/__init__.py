@@ -24,7 +24,11 @@ Layer map (mirrors SURVEY.md §1):
   parallel/   device-mesh sharding of key groups, collective keyBy
               exchange, mesh-sharded multi-window aggregation
               (ref: network stack / §2.8)
+  table/      Table API + SQL slice lowering onto the window operator
+              (ref: flink-libraries/flink-table)
   connectors/ sources/sinks             (ref: flink-connectors)
+  native/     C++ host runtime: hashing, slot index, compiled
+              baselines (ref: the rocksdbjni native role, §2.2)
 """
 
 __version__ = "0.1.0"

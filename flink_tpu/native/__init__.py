@@ -1,0 +1,212 @@
+"""ctypes loader for the native host runtime (native/host_runtime.cpp).
+
+Compiles on first use with g++ (cached by source mtime) — the image
+has no pybind11, so the boundary is plain C ABI + numpy ctypeslib
+(environment constraint; ref for the role: the reference's one native
+component is rocksdbjni, SURVEY.md §2.2).  Everything degrades
+gracefully: `available()` is False when no compiler is present and
+callers fall back to the numpy paths.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import Optional
+
+import numpy as np
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+_SRC = os.path.join(_REPO_ROOT, "native", "host_runtime.cpp")
+_LIB = os.path.join(_REPO_ROOT, "native", "libhost_runtime.so")
+
+_lib: Optional[ctypes.CDLL] = None
+_load_error: Optional[str] = None
+
+
+def _build() -> None:
+    cmd = ["g++", "-O3", "-march=native", "-shared", "-fPIC",
+           "-o", _LIB, _SRC]
+    subprocess.run(cmd, check=True, capture_output=True, text=True)
+
+
+def _ensure_loaded() -> Optional[ctypes.CDLL]:
+    global _lib, _load_error
+    if _lib is not None or _load_error is not None:
+        return _lib
+    try:
+        if (not os.path.exists(_LIB)
+                or os.path.getmtime(_LIB) < os.path.getmtime(_SRC)):
+            _build()
+        lib = ctypes.CDLL(_LIB)
+        u64p = np.ctypeslib.ndpointer(np.uint64, flags="C_CONTIGUOUS")
+        i64p = np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")
+        i32p = np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")
+        f64p = np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS")
+        f32p = np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS")
+        c = ctypes
+        lib.ft_splitmix64.argtypes = [u64p, u64p, c.c_int64]
+        lib.ft_key_groups.argtypes = [u64p, i32p, c.c_int64, c.c_int32,
+                                      c.c_int32]
+        lib.ft_heap_tumbling_baseline.argtypes = [
+            u64p, u64p, f64p, c.c_int64, c.c_int, c.c_int, c.c_int64]
+        lib.ft_heap_tumbling_baseline.restype = c.c_double
+        lib.ft_heap_sliding_hist_baseline.argtypes = [
+            u64p, f32p, i64p, c.c_int64, c.c_int64, c.c_int64, c.c_int,
+            c.c_int64]
+        lib.ft_heap_sliding_hist_baseline.restype = c.c_double
+        lib.ft_heap_session_cm_baseline.argtypes = [
+            u64p, u64p, i64p, c.c_int64, c.c_int64, c.c_int, c.c_int,
+            c.c_int64]
+        lib.ft_heap_session_cm_baseline.restype = c.c_double
+        lib.ft_index_new.argtypes = [c.c_int64]
+        lib.ft_index_new.restype = c.c_void_p
+        lib.ft_index_free.argtypes = [c.c_void_p]
+        lib.ft_index_size.argtypes = [c.c_void_p]
+        lib.ft_index_size.restype = c.c_int64
+        lib.ft_index_probe.argtypes = [c.c_void_p, u64p, c.c_int64, i64p,
+                                       i64p]
+        lib.ft_index_probe.restype = c.c_int64
+        lib.ft_index_assign.argtypes = [c.c_void_p, i64p, c.c_int64, i64p]
+        lib.ft_index_set.argtypes = [c.c_void_p, u64p, i64p, c.c_int64]
+        lib.ft_index_export.argtypes = [c.c_void_p, u64p, i64p]
+        lib.ft_index_export.restype = c.c_int64
+        _lib = lib
+    except Exception as e:  # noqa: BLE001 — no compiler / bad env
+        _load_error = str(e)
+    return _lib
+
+
+def available() -> bool:
+    return _ensure_loaded() is not None
+
+
+def load_error() -> Optional[str]:
+    _ensure_loaded()
+    return _load_error
+
+
+# ---- hot host-path kernels -------------------------------------------------
+
+def splitmix64(x: np.ndarray) -> np.ndarray:
+    lib = _ensure_loaded()
+    x = np.ascontiguousarray(x, np.uint64)
+    out = np.empty_like(x)
+    lib.ft_splitmix64(x, out, len(x))
+    return out
+
+
+def key_groups(kh: np.ndarray, max_parallelism: int,
+               n_shards: int) -> np.ndarray:
+    lib = _ensure_loaded()
+    kh = np.ascontiguousarray(kh, np.uint64)
+    out = np.empty(len(kh), np.int32)
+    lib.ft_key_groups(kh, out, len(kh), max_parallelism, n_shards)
+    return out
+
+
+class NativeSlotIndex:
+    """hash64 → dense slot via the C++ open-addressing table — the
+    native drop-in for VectorizedSlotIndex.lookup_or_insert (same
+    two-phase contract: new keys get slots from the caller's `alloc`,
+    so the Python arena stays the one slot allocator)."""
+
+    __slots__ = ("_h",)
+
+    def __init__(self, capacity: int = 1 << 12):
+        lib = _ensure_loaded()
+        cap = 1 << max(4, (capacity - 1).bit_length())
+        self._h = lib.ft_index_new(cap)
+
+    def __del__(self):
+        if _lib is not None and getattr(self, "_h", None):
+            _lib.ft_index_free(self._h)
+            self._h = None
+
+    @property
+    def n(self) -> int:
+        return _lib.ft_index_size(self._h)
+
+    def lookup_or_insert(self, batch_hashes: np.ndarray, alloc):
+        h = np.ascontiguousarray(batch_hashes, np.uint64)
+        n = len(h)
+        slots = np.empty(n, np.int64)
+        first_idx = np.empty(n, np.int64)
+        n_new = _lib.ft_index_probe(self._h, h, n, slots, first_idx)
+        first_idx = first_idx[:n_new]
+        if n_new:
+            new_slots = np.ascontiguousarray(alloc(n_new), np.int64)
+            _lib.ft_index_assign(self._h, new_slots, n_new, slots)
+        return slots, np.ones(n_new, bool), first_idx
+
+    def set_bulk(self, hashes: np.ndarray, slots: np.ndarray) -> None:
+        hashes = np.ascontiguousarray(hashes, np.uint64)
+        slots = np.ascontiguousarray(slots, np.int64)
+        _lib.ft_index_set(self._h, hashes, slots, len(hashes))
+
+    def export(self):
+        n = self.n
+        hashes = np.empty(n, np.uint64)
+        slots = np.empty(n, np.int64)
+        k = _lib.ft_index_export(self._h, hashes, slots)
+        return hashes[:k], slots[:k]
+
+
+# ---- compiled baselines (bench.py) ----------------------------------------
+
+def _pow2_at_least(n: int) -> int:
+    return 1 << max(4, (n - 1).bit_length())
+
+
+def heap_tumbling_baseline(kh: np.ndarray, vh: Optional[np.ndarray],
+                           values: Optional[np.ndarray], kind: str,
+                           precision: int = 12,
+                           capacity: Optional[int] = None) -> float:
+    """Per-record heap-backend work, compiled.  kind: 'sum' | 'hll'.
+    Returns records/second."""
+    lib = _ensure_loaded()
+    n = len(kh)
+    kh = np.ascontiguousarray(kh, np.uint64)
+    vh = (np.ascontiguousarray(vh, np.uint64) if vh is not None
+          else np.zeros(1, np.uint64))
+    values = (np.ascontiguousarray(values, np.float64) if values is not None
+              else np.zeros(1, np.float64))
+    cap = _pow2_at_least(capacity or 2 * n)
+    elapsed = lib.ft_heap_tumbling_baseline(
+        kh, vh, values, n, 1 if kind == "hll" else 0, precision, cap)
+    return n / elapsed
+
+
+def heap_sliding_hist_baseline(kh: np.ndarray, values: np.ndarray,
+                               ts: np.ndarray, size_ms: int, slide_ms: int,
+                               n_buckets: int = 128,
+                               capacity: Optional[int] = None) -> float:
+    """Sliding-window per-record work (one state update per overlapping
+    window, as the reference does).  Returns records/second."""
+    lib = _ensure_loaded()
+    n = len(kh)
+    overlap = size_ms // slide_ms
+    cap = _pow2_at_least(capacity or 2 * n * overlap)
+    elapsed = lib.ft_heap_sliding_hist_baseline(
+        np.ascontiguousarray(kh, np.uint64),
+        np.ascontiguousarray(values, np.float32),
+        np.ascontiguousarray(ts, np.int64),
+        n, size_ms, slide_ms, n_buckets, cap)
+    return n / elapsed
+
+
+def heap_session_cm_baseline(kh: np.ndarray, vh: np.ndarray, ts: np.ndarray,
+                             gap_ms: int, depth: int = 4, width: int = 2048,
+                             capacity: Optional[int] = None) -> float:
+    """Session-window Count-Min per-record work.  Returns records/s."""
+    lib = _ensure_loaded()
+    n = len(kh)
+    cap = _pow2_at_least(capacity or 2 * n)
+    elapsed = lib.ft_heap_session_cm_baseline(
+        np.ascontiguousarray(kh, np.uint64),
+        np.ascontiguousarray(vh, np.uint64),
+        np.ascontiguousarray(ts, np.int64),
+        n, gap_ms, depth, width, cap)
+    return n / elapsed

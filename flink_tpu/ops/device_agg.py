@@ -121,36 +121,52 @@ class DeviceAggregateFunction(AggregateFunction):
         return out
 
     # ---- scalar AggregateFunction contract (heap-backend twin) ------
+    # single-record programs are jit-cached: the scalar path runs once
+    # per record (heap backend / composite SQL aggregates), so eager
+    # dispatch per op would dominate — especially through a remote
+    # device transport
+    def _scalar_jits(self):
+        jits = getattr(self, "_scalar_jit_cache", None)
+        if jits is None:
+            jits = {
+                "add": jax.jit(lambda st, v, hi, lo: self.update(
+                    st, jnp.zeros(1, jnp.int32), v, hi, lo,
+                    jnp.ones(1, bool))),
+                "result": jax.jit(lambda st: self.result(
+                    st, jnp.zeros(1, jnp.int32))),
+                "merge": jax.jit(lambda st: self.merge_slots(
+                    st, jnp.array([0], jnp.int32),
+                    jnp.array([1], jnp.int32))),
+            }
+            self._scalar_jit_cache = jits
+        return jits
+
     def create_accumulator(self):
         return {name: np.full(spec.shape if spec.shape else (1,), spec.fill, dtype=spec.dtype)
                 for name, spec in self.state_specs().items()}
 
     def add(self, value, accumulator):
-        slot = np.zeros(1, np.int32)
         state = {k: np.asarray(v)[None] if np.asarray(v).shape == ()
                  else np.asarray(v).reshape(1, *self.state_specs()[k].shape)
                  for k, v in accumulator.items()}
         vals, hi, lo = self._host_record(value)
         new = jax.tree_util.tree_map(
-            np.asarray,
-            self.update({k: jnp.asarray(v) for k, v in state.items()},
-                        jnp.asarray(slot), jnp.asarray(vals), jnp.asarray(hi),
-                        jnp.asarray(lo), jnp.ones(1, bool)))
+            np.asarray, self._scalar_jits()["add"](state, vals, hi, lo))
         return {k: np.asarray(v)[0] if self.state_specs()[k].shape == ()
                 else np.asarray(v)[0] for k, v in new.items()}
 
     def get_result(self, accumulator):
-        state = {k: jnp.asarray(np.asarray(v).reshape(1, *self.state_specs()[k].shape))
+        state = {k: np.asarray(v).reshape(1, *self.state_specs()[k].shape)
                  for k, v in accumulator.items()}
-        out = np.asarray(self.result(state, jnp.zeros(1, jnp.int32)))[0]
+        out = np.asarray(self._scalar_jits()["result"](state))[0]
         return out.item() if np.ndim(out) == 0 else out
 
     def merge(self, a, b):
         specs = self.state_specs()
-        stacked = {k: jnp.asarray(np.stack([np.asarray(a[k]).reshape(specs[k].shape),
-                                            np.asarray(b[k]).reshape(specs[k].shape)]))
+        stacked = {k: np.stack([np.asarray(a[k]).reshape(specs[k].shape),
+                                np.asarray(b[k]).reshape(specs[k].shape)])
                    for k in specs}
-        merged = self.merge_slots(stacked, jnp.array([0], jnp.int32), jnp.array([1], jnp.int32))
+        merged = self._scalar_jits()["merge"](stacked)
         return {k: np.asarray(v)[0] for k, v in merged.items()}
 
     def _host_record(self, value):

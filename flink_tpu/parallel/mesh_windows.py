@@ -183,8 +183,10 @@ class MeshTumblingWindows:
         self.ring_window: List[Optional[int]] = [None] * ring
         #: windows with device-resident data, start -> ring slot
         self.live: Dict[int, int] = {}
-        #: key-hash (uint64) -> original key, for fire-time emission
-        self.key_directory: Dict[int, Any] = {}
+        #: per-window key directory: window start -> {key_hash: key};
+        #: deleted when the window fires, so host memory is bounded by
+        #: the LIVE windows' keys (not every key ever seen)
+        self.key_directory: Dict[int, Dict[int, Any]] = {}
         #: far-future records parked until their ring slot frees:
         #: start -> list of (kh, values, vh) tuples
         self.pending: Dict[int, List[Tuple[np.ndarray, Optional[np.ndarray],
@@ -217,18 +219,24 @@ class MeshTumblingWindows:
         if self.agg.needs_value_hash and value_hashes is None:
             value_hashes = hash_keys_np(np.asarray(values))
 
-        # the host owns hash -> original key (emission needs it back)
         keys_arr = keys if isinstance(keys, np.ndarray) else np.asarray(
             keys, dtype=object)
-        for h, k in zip(kh.tolist(), keys_arr.tolist()):
-            self.key_directory.setdefault(h, k)
-
         vals = (np.asarray(values, self.agg.value_dtype)
                 if self.agg.needs_value else None)
         for start in np.unique(starts).tolist():
             m = starts == start
+            w_kh = kh[m]
+            # the host owns hash -> original key per window (emission
+            # needs it back); dict work on batch-UNIQUE hashes only —
+            # no per-record host loop on the hot path
+            wdir = self.key_directory.setdefault(int(start), {})
+            uniq, first = np.unique(w_kh, return_index=True)
+            w_keys = keys_arr[m]
+            for h, i in zip(uniq.tolist(), first.tolist()):
+                if h not in wdir:
+                    wdir[h] = w_keys[i]
             self._ingest_window(
-                int(start), kh[m],
+                int(start), w_kh,
                 None if vals is None else vals[m],
                 None if value_hashes is None else value_hashes[m])
 
@@ -314,30 +322,29 @@ class MeshTumblingWindows:
 
     # ---- firing ------------------------------------------------------
     def advance_watermark(self, watermark: int) -> int:
+        """Fire due windows, interleaved with un-parking: a fire frees
+        its ring slot, which may admit a parked window — which may
+        itself be due (the end-of-input MAX_WATERMARK fires EVERY
+        window in one call), so alternate ingest/fire until stable.
+        Parked records were on time when they arrived; they are never
+        dropped as late here."""
         self.watermark = watermark
-        self.flush()
         fired = 0
-        for start in sorted(self.live):
-            if start + self.size - 1 > watermark:
+        while True:
+            progress = False
+            for start in sorted(self.pending):
+                if self._acquire_ring_slot(start) is not None:
+                    for kh, vals, vhs in self.pending.pop(start):
+                        self._ingest_window(start, kh, vals, vhs)
+                    progress = True
+            self.flush()
+            for start in sorted(self.live):
+                if start + self.size - 1 > watermark:
+                    break
+                fired += self._fire_window(start)
+                progress = True
+            if not progress:
                 break
-            fired += self._fire_window(start)
-        # drop pending windows that became late while parked, then
-        # ingest pending windows whose ring slot freed
-        for start in sorted(self.pending):
-            if start + self.size - 1 <= watermark:
-                for kh, _, _ in self.pending.pop(start):
-                    self.num_late_dropped += len(kh)
-                continue
-            if self._acquire_ring_slot(start) is not None:
-                for kh, vals, vhs in self.pending.pop(start):
-                    r = self.live[start]
-                    self._b_kh.append(kh)
-                    self._b_ring.append(np.full(len(kh), r, np.int32))
-                    if vals is not None:
-                        self._b_val.append(vals)
-                    if vhs is not None:
-                        self._b_vh.append(vhs)
-                    self._b_count += len(kh)
         return fired
 
     def _fire_window(self, start: int) -> int:
@@ -352,12 +359,13 @@ class MeshTumblingWindows:
         res = np.asarray(res)
         res = res.reshape(res.shape[0] * res.shape[1], *res.shape[2:])
         sel = np.nonzero(occ)[0]
+        wdir = self.key_directory.pop(start, {})
         if not len(sel):
             return 0
         h64 = (hi[sel].astype(np.uint64) << np.uint64(32)) | lo[sel].astype(
             np.uint64)
         end = start + self.size
-        keys = [self.key_directory[h] for h in h64.tolist()]
+        keys = [wdir[h] for h in h64.tolist()]
         if self.emit_arrays:
             self.fired.append((keys, res[sel], start, end))
         else:
@@ -379,7 +387,8 @@ class MeshTumblingWindows:
             "num_late_dropped": self.num_late_dropped,
             "ring_window": list(self.ring_window),
             "live": dict(self.live),
-            "key_directory": dict(self.key_directory),
+            "key_directory": {s: dict(d)
+                              for s, d in self.key_directory.items()},
             "pending": {s: [(np.array(kh), None if v is None else np.array(v),
                              None if h is None else np.array(h))
                             for kh, v, h in lst]
@@ -393,7 +402,8 @@ class MeshTumblingWindows:
         self.num_late_dropped = snap["num_late_dropped"]
         self.ring_window = list(snap["ring_window"])
         self.live = dict(snap["live"])
-        self.key_directory = dict(snap["key_directory"])
+        self.key_directory = {s: dict(d)
+                              for s, d in snap["key_directory"].items()}
         self.pending = {s: list(lst) for s, lst in snap["pending"].items()}
         self._b_kh.clear()
         self._b_ring.clear()

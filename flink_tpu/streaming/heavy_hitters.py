@@ -27,9 +27,9 @@ import numpy as np
 
 from flink_tpu.ops.sketches import CountMinSketchAggregate
 from flink_tpu.streaming.vectorized import (
-    VectorizedSlotIndex,
     VectorizedTumblingWindows,
     hash_keys_np,
+    make_slot_index,
 )
 
 
@@ -40,7 +40,7 @@ class _Candidates:
                  "keys", "items", "count")
 
     def __init__(self):
-        self.index = VectorizedSlotIndex(1 << 10)
+        self.index = make_slot_index(1 << 10)
         self.key_hashes: List[np.ndarray] = []
         self.item_his: List[np.ndarray] = []
         self.item_los: List[np.ndarray] = []

@@ -177,6 +177,19 @@ class VectorizedSlotIndex:
         return out, np.ones(len(first_idx), bool), first_idx
 
 
+def make_slot_index(capacity: int = 1 << 12):
+    """Fastest available slot index: the C++ open-addressing table
+    (flink_tpu.native.NativeSlotIndex, ~10-30x the numpy passes) when
+    the native runtime built, else the numpy VectorizedSlotIndex."""
+    try:
+        import flink_tpu.native as nat
+        if nat.available():
+            return nat.NativeSlotIndex(capacity)
+    except Exception:  # noqa: BLE001
+        pass
+    return VectorizedSlotIndex(capacity)
+
+
 class _SlotArena:
     """Dense slot allocator over the device-state arrays."""
 
@@ -277,7 +290,7 @@ class _WindowShard:
 
     def __init__(self, start: int):
         self.start = start
-        self.index = VectorizedSlotIndex()
+        self.index = make_slot_index()
         self.keys: List[Any] = []
         self.slot_list: List[np.ndarray] = []
         self.hash_list: List[np.ndarray] = []
@@ -666,7 +679,7 @@ class VectorizedSlidingWindows(_ScratchMergeMixin, VectorizedTumblingWindows):
                 continue
             # union the panes' keys into fresh fire slots, merging on
             # device pane by pane
-            union_index = VectorizedSlotIndex(
+            union_index = make_slot_index(
                 sum(len(p.keys) for p in panes))
             union_keys: List[Any] = []
             union_slot_list: List[np.ndarray] = []
@@ -732,11 +745,17 @@ def _restore_arena(snap: dict) -> _SlotArena:
 
 
 def _snapshot_shard(sh: _WindowShard) -> dict:
+    # index state snapshots as occupied (hash, slot) pairs — a format
+    # both index implementations (numpy / native C++) restore from
+    if hasattr(sh.index, "export"):
+        ih, isl = sh.index.export()
+    else:
+        occ = sh.index.table_hash != _EMPTY
+        ih = sh.index.table_hash[occ].copy()
+        isl = sh.index.table_slot[occ].copy()
     return {"start": sh.start, "keys": list(sh.keys),
             "slots": sh.all_slots().copy(), "hashes": sh.all_hashes().copy(),
-            "index_hash": sh.index.table_hash.copy(),
-            "index_slot": sh.index.table_slot.copy(),
-            "index_n": sh.index.n}
+            "index_hashes": ih, "index_slots": isl}
 
 
 def _restore_shard(snap: dict) -> _WindowShard:
@@ -744,12 +763,20 @@ def _restore_shard(snap: dict) -> _WindowShard:
     sh.keys = list(snap["keys"])
     sh.slot_list = [np.array(snap["slots"], np.int64)]
     sh.hash_list = [np.array(snap["hashes"], np.uint64)]
-    idx = VectorizedSlotIndex.__new__(VectorizedSlotIndex)
-    idx.table_hash = np.array(snap["index_hash"], np.uint64)
-    idx.table_slot = np.array(snap["index_slot"], np.int64)
-    idx.cap = len(idx.table_hash)
-    idx.n = snap["index_n"]
-    sh.index = idx
+    if "index_hash" in snap:  # legacy full-table snapshot format
+        ih_t = np.array(snap["index_hash"], np.uint64)
+        occ = ih_t != _EMPTY
+        ih = ih_t[occ]
+        isl = np.array(snap["index_slot"], np.int64)[occ]
+    else:
+        ih = np.array(snap["index_hashes"], np.uint64)
+        isl = np.array(snap["index_slots"], np.int64)
+    sh.index = make_slot_index(2 * max(len(ih), 8))
+    if hasattr(sh.index, "set_bulk"):
+        sh.index.set_bulk(ih, isl)
+    else:
+        sh.index._grow(len(ih))
+        sh.index._insert_existing(ih, isl)
     return sh
 
 

@@ -1,0 +1,24 @@
+"""Table API + SQL on the streaming runtime (ref:
+flink-libraries/flink-table — TableEnvironment.scala, the
+DataStreamGroupWindowAggregate lowering; SURVEY.md §2.5)."""
+
+from flink_tpu.table.api import (
+    Session,
+    Slide,
+    StreamTableEnvironment,
+    Table,
+    Tumble,
+)
+from flink_tpu.table.expressions import col, lit
+from flink_tpu.table.sql_parser import SqlError
+
+__all__ = [
+    "StreamTableEnvironment",
+    "Table",
+    "Tumble",
+    "Slide",
+    "Session",
+    "col",
+    "lit",
+    "SqlError",
+]

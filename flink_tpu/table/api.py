@@ -1,0 +1,494 @@
+"""Table API + SQL planner: lowering onto the DataStream window path.
+
+The re-design of flink-table's planning pipeline (ref:
+TableEnvironment.scala:578 `sqlQuery`, StreamTableEnvironment
+fromDataStream/toAppendStream, and the windowed GROUP BY lowering in
+plan/nodes/datastream/DataStreamGroupWindowAggregate.scala:197-238:
+`keyBy(keySelector)` → createKeyedWindowedStream :246-298 maps SQL
+TUMBLE/HOP/SESSION onto Tumbling/Sliding/EventTimeSessionWindows →
+`.aggregate(AggregateAggFunction, ...)` :213).  Calcite + Janino
+codegen are replaced by a small parser (sql_parser) and closure
+compilation (expressions); `APPROX_COUNT_DISTINCT` — absent from the
+reference's 1.5 SQL — lowers onto the HyperLogLog device kernel and
+rides the TPU fast path when the query shape allows (BASELINE.md
+config #5).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+from flink_tpu.table.expressions import (
+    AggCall,
+    Alias,
+    Column,
+    Expr,
+    Schema,
+    WindowProp,
+    find_aggs,
+    output_name,
+    strip_alias,
+    substitute,
+)
+from flink_tpu.table.functions import (
+    UDAF_DEVICE,
+    make_builtin_agg,
+)
+from flink_tpu.table.sql_parser import Query, SqlError, WindowSpec, parse
+
+
+class Table:
+    """A (possibly derived) relational view over a DataStream.
+
+    Thin by design: transformations apply eagerly to the underlying
+    stream; windowed grouping happens through sql_query / window()."""
+
+    def __init__(self, t_env: "StreamTableEnvironment", stream,
+                 schema: Schema):
+        self.t_env = t_env
+        self.stream = stream
+        self.schema = schema
+
+    # ---- Table API (subset of ref Table.scala ops) -------------------
+    def select(self, *exprs) -> "Table":
+        exprs = [self.t_env._expr(e) for e in exprs]
+        if any(find_aggs(e) for e in exprs):
+            raise SqlError("aggregates need group_by().window() or SQL")
+        names = [output_name(e, i) for i, e in enumerate(exprs)]
+        fns = [strip_alias(e).compile(self.schema) for e in exprs]
+        out = self.stream.map(
+            lambda row, fns=fns: tuple(f(row) for f in fns),
+            name="select")
+        return Table(self.t_env, out, Schema(names))
+
+    def filter(self, predicate) -> "Table":
+        e = self.t_env._expr(predicate)
+        fn = e.compile(self.schema)
+        return Table(self.t_env,
+                     self.stream.filter(lambda row: bool(fn(row)),
+                                        name="filter"),
+                     self.schema)
+
+    where = filter
+
+    def union_all(self, other: "Table") -> "Table":
+        if other.schema.fields != self.schema.fields:
+            raise SqlError("UNION ALL requires identical schemas")
+        return Table(self.t_env, self.stream.union(other.stream),
+                     self.schema)
+
+    def group_by(self, *exprs) -> "GroupedTable":
+        return GroupedTable(self, [self.t_env._expr(e) for e in exprs])
+
+    def window(self, spec: WindowSpec) -> "WindowedTable":
+        return WindowedTable(self, spec)
+
+    # ---- sinks -------------------------------------------------------
+    def to_append_stream(self):
+        return self.stream
+
+    def execute_insert(self, sink) -> None:
+        self.stream.add_sink(sink)
+
+
+class GroupedTable:
+    def __init__(self, table: Table, keys: List[Expr]):
+        self.table = table
+        self.keys = keys
+
+    def window(self, spec: WindowSpec) -> "WindowedGroupedTable":
+        return WindowedGroupedTable(self.table, self.keys, spec)
+
+    def select(self, *exprs) -> Table:
+        """Continuous (non-windowed) grouped aggregation: emits an
+        updated result row per input record (the upsert shape of the
+        reference's GroupAggProcessFunction — toRetractStream's
+        accumulate side)."""
+        exprs = [self.table.t_env._expr(e) for e in exprs]
+        return _lower_continuous_group_agg(self.table, self.keys, exprs)
+
+
+class WindowedTable:
+    def __init__(self, table: Table, spec: WindowSpec):
+        self.table = table
+        self.spec = spec
+
+    def group_by(self, *exprs) -> "WindowedGroupedTable":
+        return WindowedGroupedTable(
+            self.table, [self.table.t_env._expr(e) for e in exprs],
+            self.spec)
+
+
+class WindowedGroupedTable:
+    def __init__(self, table: Table, keys: List[Expr], spec: WindowSpec):
+        self.table = table
+        self.keys = keys
+        self.spec = spec
+
+    def select(self, *exprs) -> Table:
+        exprs = [self.table.t_env._expr(e) for e in exprs]
+        return _lower_windowed_agg(self.table, self.keys, self.spec, exprs)
+
+
+# ---------------------------------------------------------------------
+# window spec builders (Table API twins of SQL TUMBLE/HOP/SESSION;
+# ref: org.apache.flink.table.api.{Tumble, Slide, Session})
+# ---------------------------------------------------------------------
+
+class Tumble:
+    @staticmethod
+    def over(size_ms: int):
+        return _WindowBuilder(WindowSpec("tumble", "", size_ms=size_ms))
+
+
+class Slide:
+    @staticmethod
+    def over(size_ms: int):
+        return _SlideBuilder(size_ms)
+
+
+class Session:
+    @staticmethod
+    def with_gap(gap_ms: int):
+        return _WindowBuilder(WindowSpec("session", "", gap_ms=gap_ms))
+
+
+class _SlideBuilder:
+    def __init__(self, size_ms: int):
+        self.size_ms = size_ms
+
+    def every(self, slide_ms: int):
+        return _WindowBuilder(WindowSpec("hop", "", size_ms=self.size_ms,
+                                         slide_ms=slide_ms))
+
+
+class _WindowBuilder:
+    def __init__(self, spec: WindowSpec):
+        self.spec = spec
+
+    def on(self, time_col: str) -> WindowSpec:
+        self.spec.time_col = time_col
+        return self.spec
+
+
+# ---------------------------------------------------------------------
+# environment
+# ---------------------------------------------------------------------
+
+class StreamTableEnvironment:
+    """(ref: StreamTableEnvironment.scala — create/fromDataStream/
+    registerTable/sqlQuery/toAppendStream)"""
+
+    def __init__(self, env):
+        self.env = env
+        self.tables: Dict[str, Table] = {}
+        self.udafs: Dict[str, Callable[[], Any]] = {}
+
+    @staticmethod
+    def create(env) -> "StreamTableEnvironment":
+        return StreamTableEnvironment(env)
+
+    # ---- registration -----------------------------------------------
+    def from_data_stream(self, stream, fields: Sequence[str],
+                         rowtime: Optional[str] = None) -> Table:
+        """Interpret a stream of tuples as rows.  `rowtime` names the
+        field carrying the event-time attribute — the stream must have
+        timestamps/watermarks assigned upstream (the .rowtime marker
+        of the reference)."""
+        t = Table(self, stream, Schema(fields))
+        t.rowtime = rowtime
+        return t
+
+    def register_table(self, name: str, table: Table) -> None:
+        self.tables[name] = table
+
+    def register_function(self, name: str, factory: Callable[[], Any]
+                          ) -> None:
+        """Register a UDAF: `factory()` returns a fresh
+        AggregateFunction (device aggregates ride the TPU path when
+        the query shape allows)."""
+        self.udafs[name.upper()] = factory
+
+    def scan(self, name: str) -> Table:
+        return self.tables[name]
+
+    # ---- SQL ---------------------------------------------------------
+    def sql_query(self, sql: str) -> Table:
+        q = parse(sql, udaf_names=self.udafs.keys())
+        if q.table not in self.tables:
+            raise SqlError(f"unknown table {q.table!r}")
+        src = self.tables[q.table]
+        t = src
+        if q.where is not None:
+            t = t.filter(q.where)
+        has_aggs = any(find_aggs(e) for e in q.select)
+        if q.window is not None:
+            if not has_aggs:
+                raise SqlError("group window without aggregates")
+            out = _lower_windowed_agg(t, q.group_by, q.window, q.select,
+                                      having=q.having)
+            return out
+        if q.group_by or has_aggs:
+            if q.having is not None:
+                raise SqlError(
+                    "HAVING on continuous aggregation not supported")
+            return _lower_continuous_group_agg(t, q.group_by, q.select)
+        # plain projection
+        return t.select(*q.select)
+
+    # ---- conversion --------------------------------------------------
+    def to_append_stream(self, table: Table):
+        return table.stream
+
+    def _expr(self, e) -> Expr:
+        if isinstance(e, Expr):
+            return e
+        if isinstance(e, str):
+            from flink_tpu.table.sql_parser import _parse_select_item, _Tokens
+            return _parse_select_item(_Tokens(e), set(self.udafs))
+        raise TypeError(f"not an expression: {e!r}")
+
+
+# ---------------------------------------------------------------------
+# lowering
+# ---------------------------------------------------------------------
+
+def _assigner_for(spec: WindowSpec):
+    from flink_tpu.streaming.windowing import (
+        EventTimeSessionWindows,
+        SlidingEventTimeWindows,
+        TumblingEventTimeWindows,
+    )
+    if spec.kind == "tumble":
+        return TumblingEventTimeWindows.of(spec.size_ms)
+    if spec.kind == "hop":
+        return SlidingEventTimeWindows.of(spec.size_ms, spec.slide_ms)
+    return EventTimeSessionWindows.with_gap(spec.gap_ms)
+
+
+from flink_tpu.core.functions import AggregateFunction as _AggBase
+
+
+class _CompositeAgg(_AggBase):
+    """N aggregate functions over projected inputs, one accumulator
+    tuple (the AggregateAggFunction role,
+    runtime/aggregate/AggregateAggFunction.scala)."""
+
+    def __init__(self, parts):
+        self.parts = parts  # [(agg_fn, input_fn)]
+
+    def create_accumulator(self):
+        return [a.create_accumulator() for a, _ in self.parts]
+
+    def add(self, value, acc):
+        return [a.add(f(value), sub)
+                for (a, f), sub in zip(self.parts, acc)]
+
+    def get_result(self, acc):
+        return tuple(a.get_result(sub)
+                     for (a, _), sub in zip(self.parts, acc))
+
+    def merge(self, x, y):
+        return [a.merge(sx, sy)
+                for (a, _), sx, sy in zip(self.parts, x, y)]
+
+
+def _lower_windowed_agg(table: Table, keys: List[Expr], spec: WindowSpec,
+                        select: List[Expr], having: Optional[Expr] = None
+                        ) -> Table:
+    """keyBy(group keys) → window(assigner) → aggregate(composite)
+    with the select list evaluated at fire time (the
+    DataStreamGroupWindowAggregate.scala:197-238 shape)."""
+    t_env = table.t_env
+    schema = table.schema
+    key_exprs = [strip_alias(k) for k in keys]
+    key_fns = [k.compile(schema) for k in key_exprs]
+    key_names = {k.name: i for i, k in enumerate(key_exprs)
+                 if isinstance(k, Column)}
+
+    # collect distinct agg call sites (structural identity — the same
+    # textual COUNT(*) in SELECT and HAVING shares one accumulator)
+    agg_sites: List[AggCall] = []
+    site_index: Dict[str, int] = {}
+    sources = list(select) + ([having] if having is not None else [])
+    for e in sources:
+        for a in find_aggs(e):
+            if repr(a) not in site_index:
+                site_index[repr(a)] = len(agg_sites)
+                agg_sites.append(a)
+    parts, device_single = _build_agg_parts(t_env, agg_sites, schema)
+
+    # compile each select item against the synthetic post-agg row:
+    #   [key0..km, agg0..an, wstart, wend]
+    n_keys = len(key_exprs)
+    n_aggs = len(agg_sites)
+    post_fields = ([f"__k{i}" for i in range(n_keys)]
+                   + [f"__a{i}" for i in range(n_aggs)]
+                   + ["__wstart", "__wend"])
+    post_schema = Schema(post_fields)
+
+    def remap(e):
+        if isinstance(e, AggCall):
+            return Column(f"__a{site_index[repr(e)]}")
+        if isinstance(e, WindowProp):
+            return Column("__wstart" if e.kind == "start" else "__wend")
+        if isinstance(e, Column):
+            if e.name in key_names:
+                return Column(f"__k{key_names[e.name]}")
+            if e.name.startswith("__"):
+                return None
+            raise SqlError(
+                f"column {e.name!r} must appear in GROUP BY or inside "
+                f"an aggregate")
+        return None
+
+    out_fns = [substitute(strip_alias(e), remap).compile(post_schema)
+               for e in select]
+    out_names = [output_name(e, i) for i, e in enumerate(select)]
+    having_fn = (substitute(strip_alias(having), remap).compile(post_schema)
+                 if having is not None else None)
+
+    def key_selector(row):
+        ks = tuple(f(row) for f in key_fns)
+        return ks if len(ks) != 1 else ks[0]
+
+    def window_fn(key, window, results):
+        acc_res = results[0]
+        if device_single:
+            aggs = (acc_res,)
+        else:
+            aggs = acc_res  # _CompositeAgg result tuple, one per site
+        if n_keys == 0:
+            key_t = ()
+        elif n_keys == 1:
+            key_t = (key,)
+        else:
+            key_t = key
+        row = (*key_t, *aggs, window.start, window.end)
+        if having_fn is not None and not having_fn(row):
+            return []
+        return [tuple(f(row) for f in out_fns)]
+
+    stream = table.stream
+    # rowtime: records must already carry event timestamps; the SQL
+    # window's time column names the stream's rowtime attribute
+    windowed = (stream.key_by(key_selector if key_exprs
+                              else (lambda row: 0))
+                .window(_assigner_for(spec)))
+    if device_single:
+        agg_fn = parts[0][0]
+        agg_fn.extract_value = parts[0][1]
+        out = windowed.aggregate(agg_fn, window_function=window_fn,
+                                 name="sql_window_agg")
+    else:
+        out = windowed.aggregate(_CompositeAgg(parts),
+                                 window_function=window_fn,
+                                 name="sql_window_agg")
+    return Table(t_env, out, Schema(out_names))
+
+
+def _build_agg_parts(t_env, agg_sites: List[AggCall], schema: Schema):
+    """(agg_fn, input_fn) per call site; device_single=True when the
+    single aggregate is device-eligible (rides the TPU window path)."""
+    parts = []
+    device_single = False
+    for a in agg_sites:
+        input_fn = (a.args[0].compile(schema) if a.args
+                    else (lambda row: 1))
+        if a.name in t_env.udafs:
+            agg = t_env.udafs[a.name]()
+        else:
+            agg = make_builtin_agg(a)
+        parts.append((agg, input_fn))
+    if len(agg_sites) == 1:
+        agg = parts[0][0]
+        if type(agg).__name__ in UDAF_DEVICE or _is_device_agg(agg):
+            device_single = True
+    return parts, device_single
+
+
+def _is_device_agg(agg) -> bool:
+    try:
+        from flink_tpu.ops.device_agg import DeviceAggregateFunction
+        return isinstance(agg, DeviceAggregateFunction)
+    except Exception:  # noqa: BLE001
+        return False
+
+
+def _lower_continuous_group_agg(table: Table, keys: List[Expr],
+                                select: List[Expr]) -> Table:
+    """Non-windowed GROUP BY: per input record, update the group's
+    accumulators and emit the refreshed result row (the accumulate
+    side of GroupAggProcessFunction.scala; consume via
+    to_retract_stream semantics — last row per key wins)."""
+    t_env = table.t_env
+    schema = table.schema
+    key_exprs = [strip_alias(k) for k in keys]
+    key_fns = [k.compile(schema) for k in key_exprs]
+    key_names = {k.name: i for i, k in enumerate(key_exprs)
+                 if isinstance(k, Column)}
+    agg_sites: List[AggCall] = []
+    site_index: Dict[str, int] = {}
+    for e in select:
+        for a in find_aggs(e):
+            if repr(a) not in site_index:
+                site_index[repr(a)] = len(agg_sites)
+                agg_sites.append(a)
+    parts, _ = _build_agg_parts(t_env, agg_sites, schema)
+    composite = _CompositeAgg(parts)
+
+    n_keys = len(key_exprs)
+    post_fields = ([f"__k{i}" for i in range(n_keys)]
+                   + [f"__a{i}" for i in range(len(agg_sites))])
+    post_schema = Schema(post_fields)
+
+    def remap(e):
+        if isinstance(e, AggCall):
+            return Column(f"__a{site_index[repr(e)]}")
+        if isinstance(e, Column):
+            if e.name in key_names:
+                return Column(f"__k{key_names[e.name]}")
+            raise SqlError(
+                f"column {e.name!r} must appear in GROUP BY or inside "
+                f"an aggregate")
+        return None
+
+    out_fns = [substitute(strip_alias(e), remap).compile(post_schema)
+               for e in select]
+    out_names = [output_name(e, i) for i, e in enumerate(select)]
+
+    from flink_tpu.core.state import ValueStateDescriptor
+    from flink_tpu.streaming.operators import ProcessFunction
+
+    acc_desc = ValueStateDescriptor("sql_group_acc")
+
+    class GroupAgg(ProcessFunction):
+        def process_element(self, value, ctx, out):
+            st = ctx.get_state(acc_desc)
+            acc = st.value()
+            if acc is None:
+                acc = composite.create_accumulator()
+            acc = composite.add(value, acc)
+            st.update(acc)
+            aggs = composite.get_result(acc)
+            key = ctx.get_current_key()
+            if n_keys == 0:
+                key_t = ()
+            elif n_keys == 1:
+                key_t = (key,)
+            else:
+                key_t = key
+            row = (*key_t, *aggs)
+            out.collect(tuple(f(row) for f in out_fns))
+
+    def key_selector(row):
+        ks = tuple(f(row) for f in key_fns)
+        return ks if len(ks) != 1 else ks[0]
+
+    if keys:
+        out = (table.stream.key_by(key_selector)
+               .process(GroupAgg(), name="sql_group_agg"))
+    else:
+        out = (table.stream.key_by(lambda row: 0)
+               .process(GroupAgg(), name="sql_global_agg"))
+    return Table(t_env, out, Schema(out_names))

@@ -1,0 +1,156 @@
+"""Built-in SQL aggregate functions.
+
+COUNT/SUM/MIN/MAX/AVG are the scalar AggregateFunction twins of the
+reference's codegen'd GeneratedAggregations
+(runtime/aggregate/GeneratedAggregations.scala:27 — accumulate :63,
+createAccumulators :79, mergeAccumulatorsPair :95); here they are
+plain accumulator classes (no Janino).
+
+APPROX_COUNT_DISTINCT — absent from the reference's 1.5 SQL (the
+north-star extension) — is the HyperLogLog device kernel
+(flink_tpu.ops.sketches.HyperLogLogAggregate): a DeviceAggregateFunction,
+so a query whose single aggregate is APPROX_COUNT_DISTINCT lowers onto
+the TPU window fast path (DeviceWindowOperator).  COUNT(DISTINCT x)
+maps to exact distinct counting with a set accumulator.
+"""
+
+from __future__ import annotations
+
+from flink_tpu.core.functions import AggregateFunction
+from flink_tpu.table.expressions import AggCall
+
+#: type names of registered-UDAF classes known to be device-eligible
+UDAF_DEVICE = {"HyperLogLogAggregate", "CountMinSketchAggregate",
+               "QuantileSketchAggregate", "SumAggregate",
+               "CountAggregate", "MinAggregate", "MaxAggregate",
+               "AvgAggregate"}
+
+
+class CountAgg(AggregateFunction):
+    def create_accumulator(self):
+        return 0
+
+    def add(self, value, acc):
+        return acc + (0 if value is None else 1)
+
+    def get_result(self, acc):
+        return acc
+
+    def merge(self, a, b):
+        return a + b
+
+
+class SumAgg(AggregateFunction):
+    def create_accumulator(self):
+        return None
+
+    def add(self, value, acc):
+        if value is None:
+            return acc
+        return value if acc is None else acc + value
+
+    def get_result(self, acc):
+        return acc
+
+    def merge(self, a, b):
+        if a is None:
+            return b
+        if b is None:
+            return a
+        return a + b
+
+
+class MinAgg(AggregateFunction):
+    def create_accumulator(self):
+        return None
+
+    def add(self, value, acc):
+        if value is None:
+            return acc
+        return value if acc is None else min(acc, value)
+
+    def get_result(self, acc):
+        return acc
+
+    def merge(self, a, b):
+        if a is None:
+            return b
+        if b is None:
+            return a
+        return min(a, b)
+
+
+class MaxAgg(AggregateFunction):
+    def create_accumulator(self):
+        return None
+
+    def add(self, value, acc):
+        if value is None:
+            return acc
+        return value if acc is None else max(acc, value)
+
+    def get_result(self, acc):
+        return acc
+
+    def merge(self, a, b):
+        if a is None:
+            return b
+        if b is None:
+            return a
+        return max(a, b)
+
+
+class AvgAgg(AggregateFunction):
+    def create_accumulator(self):
+        return (0.0, 0)
+
+    def add(self, value, acc):
+        if value is None:
+            return acc
+        return (acc[0] + value, acc[1] + 1)
+
+    def get_result(self, acc):
+        return acc[0] / acc[1] if acc[1] else None
+
+    def merge(self, a, b):
+        return (a[0] + b[0], a[1] + b[1])
+
+
+class DistinctCountAgg(AggregateFunction):
+    """Exact COUNT(DISTINCT x) — set accumulator (the dataview
+    MapView-backed distinct accumulator of the reference)."""
+
+    def create_accumulator(self):
+        return set()
+
+    def add(self, value, acc):
+        if value is not None:
+            acc = set(acc)
+            acc.add(value)
+        return acc
+
+    def get_result(self, acc):
+        return len(acc)
+
+    def merge(self, a, b):
+        return a | b
+
+
+def make_builtin_agg(call: AggCall):
+    name = call.name
+    if name == "COUNT":
+        if call.distinct:
+            return DistinctCountAgg()
+        return CountAgg()
+    if name == "SUM":
+        return SumAgg()
+    if name == "MIN":
+        return MinAgg()
+    if name == "MAX":
+        return MaxAgg()
+    if name == "AVG":
+        return AvgAgg()
+    if name == "APPROX_COUNT_DISTINCT":
+        from flink_tpu.ops.sketches import HyperLogLogAggregate
+        return HyperLogLogAggregate(precision=12)
+    raise ValueError(f"unknown aggregate {name}")

@@ -102,6 +102,25 @@ def test_mesh_engine_far_future_parks_and_ingests(mesh):
     assert got == {(1, 0), (2, 2000)}
 
 
+def test_mesh_engine_parked_window_fires_on_big_watermark_jump(mesh):
+    """A parked window whose due-time passes while parked must still
+    fire (one watermark jump past everything — the end-of-input
+    MAX_WATERMARK shape), not be counted late: its records arrived on
+    time."""
+    eng = MeshTumblingWindows(CountAggregate(), 1000, mesh, ring=2,
+                              capacity_per_window_shard=64, step_batch=64)
+    eng.process_batch(np.array([1]), np.array([100]))   # window 0, ring 0
+    eng.process_batch(np.array([2]), np.array([2100]))  # window 2000 parks
+    assert eng.pending
+    eng.advance_watermark(2 ** 62)  # everything due at once
+    got = {(k, s) for (k, v, s, e) in eng.emitted}
+    assert got == {(1, 0), (2, 2000)}
+    assert eng.num_late_dropped == 0
+    assert not eng.pending and not eng.live
+    # per-window key directories are cleaned up after fires
+    assert not eng.key_directory
+
+
 def test_mesh_engine_overflow_raises(mesh):
     eng = MeshTumblingWindows(CountAggregate(), 1000, mesh,
                               capacity_per_window_shard=2, step_batch=64,

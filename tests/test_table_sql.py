@@ -1,0 +1,272 @@
+"""Table API + SQL slice (ref: flink-table's sqlQuery pipeline +
+DataStreamGroupWindowAggregate lowering — SURVEY.md §2.5, BASELINE.md
+config #5)."""
+
+import collections
+
+import numpy as np
+import pytest
+
+from flink_tpu.streaming.datastream import StreamExecutionEnvironment
+from flink_tpu.streaming.sources import (
+    BoundedOutOfOrdernessTimestampExtractor,
+    CollectSink,
+)
+from flink_tpu.table import (
+    SqlError,
+    StreamTableEnvironment,
+    Tumble,
+    col,
+)
+from flink_tpu.table.sql_parser import parse
+
+
+# ---------------------------------------------------------------------
+# parser units
+# ---------------------------------------------------------------------
+
+def test_parse_select_where():
+    q = parse("SELECT a, b + 1 AS c FROM t WHERE a > 2 AND b <> 0")
+    assert q.table == "t"
+    assert len(q.select) == 2
+    assert q.where is not None
+    assert q.window is None
+
+
+def test_parse_tumble_group_by():
+    q = parse("SELECT k, COUNT(*) FROM ev "
+              "GROUP BY TUMBLE(ts, INTERVAL '1' SECOND), k")
+    assert q.window.kind == "tumble"
+    assert q.window.size_ms == 1000
+    assert q.window.time_col == "ts"
+    assert len(q.group_by) == 1
+
+
+def test_parse_hop_and_session():
+    q = parse("SELECT COUNT(*) FROM t GROUP BY "
+              "HOP(ts, INTERVAL '1' SECOND, INTERVAL '10' SECOND)")
+    assert q.window.kind == "hop"
+    assert q.window.slide_ms == 1000 and q.window.size_ms == 10000
+    q = parse("SELECT COUNT(*) FROM t GROUP BY "
+              "SESSION(ts, INTERVAL '500' MILLISECOND)")
+    assert q.window.kind == "session" and q.window.gap_ms == 500
+
+
+def test_parse_errors():
+    with pytest.raises(SqlError):
+        parse("SELECT FROM t")
+    with pytest.raises(SqlError):
+        parse("SELECT a FROM t GROUP BY TUMBLE(ts, INTERVAL '1' FORTNIGHT)")
+
+
+# ---------------------------------------------------------------------
+# end-to-end SQL jobs
+# ---------------------------------------------------------------------
+
+def _sorted_events(n=600, n_keys=10, n_users=50, horizon=3000, seed=2):
+    rng = np.random.default_rng(seed)
+    return sorted(
+        ((int(k), int(u), int(t)) for k, u, t in
+         zip(rng.integers(0, n_keys, n), rng.integers(0, n_users, n),
+             rng.integers(0, horizon, n))),
+        key=lambda e: e[2])
+
+
+def _table_env(events):
+    env = StreamExecutionEnvironment()
+    stream = env.from_collection(events)
+    stream = stream.assign_timestamps_and_watermarks(
+        BoundedOutOfOrdernessTimestampExtractor(0, lambda e: e[2]))
+    t_env = StreamTableEnvironment.create(env)
+    table = t_env.from_data_stream(stream, ["k", "u", "ts"], rowtime="ts")
+    t_env.register_table("ev", table)
+    return env, t_env
+
+
+def test_sql_projection_and_filter():
+    events = [(1, 10, 0), (2, 20, 10), (3, 30, 20)]
+    env, t_env = _table_env(events)
+    out = t_env.sql_query("SELECT k * 10, u FROM ev WHERE k <> 2")
+    sink = CollectSink()
+    out.to_append_stream().add_sink(sink)
+    env.execute("sql-proj")
+    assert sorted(sink.values) == [(10, 10), (30, 30)]
+
+
+def test_sql_tumble_count_sum(  ):
+    events = _sorted_events()
+    env, t_env = _table_env(events)
+    out = t_env.sql_query(
+        "SELECT k, COUNT(*) AS c, SUM(u) AS s, TUMBLE_START(ts) AS ws "
+        "FROM ev GROUP BY TUMBLE(ts, INTERVAL '1' SECOND), k")
+    sink = CollectSink()
+    out.to_append_stream().add_sink(sink)
+    env.execute("sql-tumble")
+
+    expect_c = collections.Counter()
+    expect_s = collections.Counter()
+    for k, u, t in events:
+        w = t - t % 1000
+        expect_c[(k, w)] += 1
+        expect_s[(k, w)] += u
+    got = {(k, ws): (c, s) for (k, c, s, ws) in sink.values}
+    assert set(got) == set(expect_c)
+    for key in expect_c:
+        assert got[key] == (expect_c[key], expect_s[key])
+
+
+def test_sql_approx_count_distinct_device_path():
+    """Config #5: APPROX_COUNT_DISTINCT GROUP BY TUMBLE lowers onto the
+    HLL device kernel (single-agg queries ride DeviceWindowOperator)."""
+    events = _sorted_events(n=4000, n_keys=6, n_users=500)
+    env, t_env = _table_env(events)
+    out = t_env.sql_query(
+        "SELECT k, APPROX_COUNT_DISTINCT(u) AS d "
+        "FROM ev GROUP BY TUMBLE(ts, INTERVAL '1' SECOND), k")
+    sink = CollectSink()
+    out.to_append_stream().add_sink(sink)
+    env.execute("sql-acd")
+
+    truth = collections.defaultdict(set)
+    for k, u, t in events:
+        truth[(k, t - t % 1000)].add(u)
+    got = collections.defaultdict(list)
+    for k, d in sink.values:
+        got[k].append(d)
+    assert sum(len(v) for v in got.values()) == len(truth)
+    # HLL accuracy: within 15% at p12
+    per_key_truth = collections.defaultdict(list)
+    for (k, w), users in sorted(truth.items()):
+        per_key_truth[k].append(len(users))
+    for k, estimates in got.items():
+        for est, exact in zip(sorted(estimates), sorted(per_key_truth[k])):
+            assert abs(est - exact) <= max(2, 0.15 * exact)
+
+    # the graph really built a DeviceWindowOperator
+    from flink_tpu.streaming.device_window_operator import (
+        DeviceWindowOperator,
+    )
+    nodes = env.graph.nodes.values()
+    ops = [n.operator_factory() for n in nodes if "sql_window_agg" in n.name]
+    assert ops and isinstance(ops[0], DeviceWindowOperator)
+
+
+def test_sql_session_window_and_having():
+    events = [(1, 5, 0), (1, 6, 100), (1, 7, 2000), (2, 8, 2100)]
+    env, t_env = _table_env(events)
+    out = t_env.sql_query(
+        "SELECT k, COUNT(*) AS c FROM ev "
+        "GROUP BY SESSION(ts, INTERVAL '500' MILLISECOND), k "
+        "HAVING COUNT(*) > 1")
+    sink = CollectSink()
+    out.to_append_stream().add_sink(sink)
+    env.execute("sql-session")
+    assert sink.values == [(1, 2)]
+
+
+def test_sql_hop_window():
+    events = [(1, 0, 500), (1, 0, 1500)]
+    env, t_env = _table_env(events)
+    out = t_env.sql_query(
+        "SELECT k, COUNT(*) AS c, TUMBLE_START(ts) AS s FROM ev "
+        "GROUP BY HOP(ts, INTERVAL '1' SECOND, INTERVAL '2' SECOND), k")
+    sink = CollectSink()
+    out.to_append_stream().add_sink(sink)
+    env.execute("sql-hop")
+    # record@500 lands in hops [-1000,1000) and [0,2000); record@1500
+    # in [0,2000) and [1000,3000)
+    got = {(s, c) for (k, c, s) in sink.values}
+    assert got == {(-1000, 1), (0, 2), (1000, 1)}
+
+
+def test_sql_continuous_group_by():
+    events = [(1, 2, 0), (1, 3, 10), (2, 5, 20)]
+    env, t_env = _table_env(events)
+    out = t_env.sql_query("SELECT k, SUM(u) AS s, COUNT(*) AS c "
+                          "FROM ev GROUP BY k")
+    sink = CollectSink()
+    out.to_append_stream().add_sink(sink)
+    env.execute("sql-cont")
+    # upsert semantics: one refreshed row per input; last per key wins
+    last = {}
+    for k, s, c in sink.values:
+        last[k] = (s, c)
+    assert last == {1: (5, 2), 2: (5, 1)}
+
+
+def test_sql_global_aggregate():
+    events = [(1, 2, 0), (2, 3, 10)]
+    env, t_env = _table_env(events)
+    out = t_env.sql_query("SELECT COUNT(*) AS c, AVG(u) AS a FROM ev")
+    sink = CollectSink()
+    out.to_append_stream().add_sink(sink)
+    env.execute("sql-global")
+    assert sink.values[-1] == (2, 2.5)
+
+
+def test_sql_udaf_registration():
+    from flink_tpu.ops.sketches import HyperLogLogAggregate
+    events = _sorted_events(n=1000, n_keys=3, n_users=200)
+    env, t_env = _table_env(events)
+    t_env.register_function("MY_DISTINCT",
+                            lambda: HyperLogLogAggregate(precision=11))
+    out = t_env.sql_query(
+        "SELECT k, MY_DISTINCT(u) AS d FROM ev "
+        "GROUP BY TUMBLE(ts, INTERVAL '1' SECOND), k")
+    sink = CollectSink()
+    out.to_append_stream().add_sink(sink)
+    env.execute("sql-udaf")
+    assert sink.values and all(d > 0 for _, d in sink.values)
+
+
+def test_sql_count_distinct_exact():
+    events = [(1, 5, 0), (1, 5, 10), (1, 6, 20)]
+    env, t_env = _table_env(events)
+    out = t_env.sql_query(
+        "SELECT k, COUNT(DISTINCT u) AS d FROM ev "
+        "GROUP BY TUMBLE(ts, INTERVAL '1' SECOND), k")
+    sink = CollectSink()
+    out.to_append_stream().add_sink(sink)
+    env.execute("sql-distinct")
+    assert sink.values == [(1, 2)]
+
+
+# ---------------------------------------------------------------------
+# fluent Table API
+# ---------------------------------------------------------------------
+
+def test_table_api_fluent_windowed():
+    events = _sorted_events(n=300, n_keys=4)
+    env, t_env = _table_env(events)
+    table = t_env.scan("ev")
+    out = (table.filter(col("k") < 3)
+           .window(Tumble.over(1000).on("ts"))
+           .group_by(col("k"))
+           .select("k", "COUNT(*) AS c"))
+    sink = CollectSink()
+    out.to_append_stream().add_sink(sink)
+    env.execute("table-fluent")
+    expect = collections.Counter()
+    for k, u, t in events:
+        if k < 3:
+            expect[(k, t - t % 1000)] += 1
+    got_total = collections.Counter()
+    for k, c in sink.values:
+        got_total[k] += c
+    want_total = collections.Counter()
+    for (k, w), c in expect.items():
+        want_total[k] += c
+    assert got_total == want_total
+
+
+def test_table_api_select_expressions():
+    env = StreamExecutionEnvironment()
+    t_env = StreamTableEnvironment.create(env)
+    stream = env.from_collection([(1, 2), (3, 4)])
+    table = t_env.from_data_stream(stream, ["a", "b"])
+    out = table.select((col("a") + col("b")).alias("s"), "a * 2 AS d")
+    sink = CollectSink()
+    out.to_append_stream().add_sink(sink)
+    env.execute("table-select")
+    assert sorted(sink.values) == [(3, 2), (7, 6)]
+    assert out.schema.fields == ["s", "d"]
