@@ -1,0 +1,8 @@
+"""Complex event processing: pattern matching on keyed streams
+(ref: flink-libraries/flink-cep — SURVEY.md §2.5)."""
+
+from flink_tpu.cep.cep import CEP, PatternStream
+from flink_tpu.cep.nfa import NFA
+from flink_tpu.cep.pattern import Pattern
+
+__all__ = ["CEP", "Pattern", "PatternStream", "NFA"]

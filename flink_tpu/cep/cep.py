@@ -1,0 +1,149 @@
+"""CEP on DataStreams: CEP.pattern(stream, pattern).select(...)
+(ref: flink-cep CEP.java + operator/AbstractKeyedCEPPatternOperator
+.java — NFA state in keyed state, event-time buffering in a MapState
+priority queue, processed in timestamp order on watermark advance).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional
+
+from flink_tpu.cep.nfa import NFA
+from flink_tpu.cep.pattern import Pattern
+from flink_tpu.core.state import MapStateDescriptor, ValueStateDescriptor
+from flink_tpu.streaming.operators import OutputTag, ProcessFunction
+
+
+class CEP:
+    @staticmethod
+    def pattern(stream, pattern: Pattern) -> "PatternStream":
+        pattern.validate()
+        return PatternStream(stream, pattern)
+
+
+class PatternStream:
+    def __init__(self, stream, pattern: Pattern):
+        self.stream = stream
+        self.pattern = pattern
+        #: side-output tag for timed-out partial matches
+        self.timeout_tag: Optional[OutputTag] = None
+        self._timeout_fn: Optional[Callable] = None
+
+    def with_timeout_side_output(self, tag: OutputTag,
+                                 timeout_fn: Optional[Callable] = None
+                                 ) -> "PatternStream":
+        """Timed-out partials go to `tag` as
+        `timeout_fn(partial_events, timeout_ts)` (default: the partial
+        map itself) — ref: PatternStream.select's timeout overloads."""
+        self.timeout_tag = tag
+        self._timeout_fn = timeout_fn
+        return self
+
+    def select(self, fn: Callable[[Dict[str, List[Any]]], Any],
+               name: str = "cep") -> Any:
+        return self._build(lambda m: [fn(m)], name)
+
+    def flat_select(self, fn: Callable[[Dict[str, List[Any]]], Any],
+                    name: str = "cep") -> Any:
+        return self._build(lambda m: list(fn(m) or []), name)
+
+    def _build(self, emit_fn, name: str):
+        stream = self.stream
+        keyed = hasattr(stream, "key_selector") and stream.key_selector
+        if not keyed:
+            stream = stream.key_by(lambda e: 0)
+        op = _CepProcessFunction(self.pattern, emit_fn, self.timeout_tag,
+                                 self._timeout_fn)
+        return stream.process(op, name=name)
+
+
+_NFA_STATE = ValueStateDescriptor("cep_nfa_runs")
+_BUFFER_STATE = MapStateDescriptor("cep_event_buffer")
+_NEXT_TIMEOUT = ValueStateDescriptor("cep_next_timeout")
+
+
+class _CepProcessFunction(ProcessFunction):
+    """Keyed NFA host: out-of-order events buffer in a MapState keyed
+    by timestamp and replay in time order when the watermark passes
+    them (the priority-queue discipline of the reference operator);
+    processing-time / untimestamped events advance the NFA directly."""
+
+    def __init__(self, pattern: Pattern, emit_fn, timeout_tag,
+                 timeout_fn):
+        self.pattern = pattern
+        self.emit_fn = emit_fn
+        self.timeout_tag = timeout_tag
+        self.timeout_fn = timeout_fn or (lambda events, ts: events)
+
+    # ---- input -------------------------------------------------------
+    def process_element(self, value, ctx, out):
+        ts = ctx.timestamp()
+        if ts is None:
+            # processing-time stream: NFA time = wall clock, so
+            # within()/timeouts stay meaningful; timeout timers arm in
+            # the processing-time domain
+            now = ctx.current_processing_time()
+            nfa = self._load_nfa(ctx)
+            self._advance(nfa, value, now, ctx, out)
+            self._arm_timeout_timer(nfa, ctx, processing_time=True)
+            self._store_nfa(ctx, nfa)
+            return
+        buf = ctx.get_state(_BUFFER_STATE)
+        pending = buf.get(ts)
+        buf.put(ts, (pending or []) + [value])
+        ctx.register_event_time_timer(ts)
+
+    def on_timer(self, timestamp, ctx, out):
+        nfa = self._load_nfa(ctx)
+        buf = ctx.get_state(_BUFFER_STATE)
+        due = sorted(t for t in buf.keys() if t <= timestamp)
+        for t in due:
+            for event in buf.get(t):
+                self._advance(nfa, event, t, ctx, out)
+            buf.remove(t)
+        # pure-timeout firing (no event at this ts)
+        if not due:
+            matches: List[dict] = []
+            timeouts = nfa.advance_time(timestamp, matches)
+            self._emit(matches, timeouts, ctx, out)
+        self._arm_timeout_timer(nfa, ctx)
+        self._store_nfa(ctx, nfa)
+
+    # ---- NFA plumbing ------------------------------------------------
+    def _advance(self, nfa: NFA, event, ts, ctx, out):
+        matches, timeouts = nfa.advance(event, ts)
+        self._emit(matches, timeouts, ctx, out)
+
+    def _emit(self, matches, timeouts, ctx, out):
+        for m in matches:
+            for r in self.emit_fn(m):
+                out.collect(r)
+        if self.timeout_tag is not None:
+            for partial, start_ts in timeouts:
+                ctx.output(self.timeout_tag,
+                           self.timeout_fn(partial, start_ts))
+
+    def _arm_timeout_timer(self, nfa: NFA, ctx,
+                           processing_time: bool = False):
+        """One timer at the earliest within()-horizon so absences and
+        timeouts fire even if no further events arrive for the key."""
+        if self.pattern.within_ms is None or not nfa.runs:
+            return
+        horizon = min(r.start_ts for r in nfa.runs) + self.pattern.within_ms
+        st = ctx.get_state(_NEXT_TIMEOUT)
+        if st.value() != horizon:
+            st.update(horizon)
+            if processing_time:
+                ctx.register_processing_time_timer(horizon)
+            else:
+                ctx.register_event_time_timer(horizon)
+
+    def _load_nfa(self, ctx) -> NFA:
+        nfa = NFA(self.pattern)
+        snap = ctx.get_state(_NFA_STATE).value()
+        if snap is not None:
+            nfa.restore(snap)
+        return nfa
+
+    def _store_nfa(self, ctx, nfa: NFA) -> None:
+        ctx.get_state(_NFA_STATE).update(nfa.snapshot())

@@ -1,0 +1,236 @@
+"""NFA execution for CEP patterns (ref: flink-cep nfa/NFA.java:88,
+process :202-221, with SharedBuffer.java's versioned match storage).
+
+Re-design, not a translation: the reference compiles patterns into
+state/transition objects and keeps partial matches as versioned paths
+in a shared buffer (Dewey numbers).  Here the normalized Stage chain
+(flink_tpu.cep.pattern) is interpreted directly over a list of Run
+records — each run owns its matched-events map, which is simpler,
+checkpoint-friendly (plain dicts), and equivalent for linear patterns
+(the only kind the builder can express).
+
+Semantics implemented:
+- contiguity: STRICT (next) kills a run on a non-matching event;
+  SKIP_TILL_NEXT ignores it; SKIP_TILL_ANY additionally keeps the
+  pre-take run alive after a take so later events can also take.
+- quantifiers: times(n[, to]), oneOrMore/timesOrMore (branching runs:
+  absorb-more vs proceed), optional, greedy (a greedy loop defers
+  proceeding until a non-matching event, producing maximal matches).
+- negation: notNext checks exactly the next event; notFollowedBy
+  poisons the run if a matching event appears before the following
+  stage matches; a TRAILING notFollowedBy completes at the within()
+  horizon (absence can only be concluded by time).
+- within(ms): runs older than the horizon either time out (partials)
+  or complete (trailing negation satisfied).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+from flink_tpu.cep.pattern import (
+    SKIP_TILL_ANY,
+    SKIP_TILL_NEXT,
+    STRICT,
+    Pattern,
+    Stage,
+)
+
+
+class Run:
+    __slots__ = ("stage", "events", "count", "start_ts")
+
+    def __init__(self, stage: int, events: Dict[str, List[Any]],
+                 count: int, start_ts: int):
+        self.stage = stage
+        #: stage name -> matched events (insertion order preserved)
+        self.events = events
+        #: matches absorbed by the CURRENT stage's quantifier loop
+        self.count = count
+        self.start_ts = start_ts
+
+    def branch(self) -> "Run":
+        return Run(self.stage,
+                   {k: list(v) for k, v in self.events.items()},
+                   self.count, self.start_ts)
+
+    def snapshot(self) -> dict:
+        return {"stage": self.stage, "events": self.events,
+                "count": self.count, "start_ts": self.start_ts}
+
+    @staticmethod
+    def restore(snap: dict) -> "Run":
+        return Run(snap["stage"], snap["events"], snap["count"],
+                   snap["start_ts"])
+
+
+class NFA:
+    """One key's pattern-matching state."""
+
+    def __init__(self, pattern: Pattern):
+        pattern.validate()
+        self.pattern = pattern
+        self.stages = pattern.stages
+        self.runs: List[Run] = []
+
+    # ---- event processing -------------------------------------------
+    def advance(self, event, timestamp: int
+                ) -> Tuple[List[Dict[str, List[Any]]],
+                           List[Tuple[Dict[str, List[Any]], int]]]:
+        """Feed one event (events must arrive in time order per key).
+        Returns (matches, timeouts): completed match maps, and timed-
+        out partials as (partial_events, start_ts)."""
+        matches: List[Dict[str, List[Any]]] = []
+        timeouts = self.advance_time(timestamp, matches)
+
+        new_runs: List[Run] = []
+        # a fresh run may begin at every event (NO_SKIP after-match)
+        candidates = self.runs + [Run(0, {}, 0, timestamp)]
+        for run in candidates:
+            new_runs.extend(self._step(run, event, timestamp, matches))
+        self.runs = self._dedup(new_runs)
+        return matches, timeouts
+
+    def advance_time(self, now: int, matches=None
+                     ) -> List[Tuple[Dict[str, List[Any]], int]]:
+        """Expire runs past the within() horizon; a run waiting ONLY on
+        a trailing negation completes instead of timing out.  Also
+        releases greedy-loop matches that the horizon concludes."""
+        if matches is None:
+            matches = []
+        if self.pattern.within_ms is None:
+            return []
+        timeouts: List[Tuple[Dict[str, List[Any]], int]] = []
+        kept: List[Run] = []
+        for run in self.runs:
+            if now - run.start_ts < self.pattern.within_ms:
+                kept.append(run)
+                continue
+            if (run.stage == len(self.stages) - 1
+                    and self.stages[run.stage].negated):
+                matches.append(run.events)       # absence concluded
+            elif (run.stage == len(self.stages) - 1
+                  and self.stages[run.stage].greedy
+                  and run.count >= self.stages[run.stage].min_times):
+                matches.append(run.events)       # maximal greedy loop
+            elif run.events:
+                timeouts.append((run.events, run.start_ts))
+            # runs with no matched events expire silently
+        self.runs = kept
+        return timeouts
+
+    # ---- transition function ----------------------------------------
+    def _step(self, run: Run, event, ts: int,
+              matches: List[Dict[str, List[Any]]]) -> List[Run]:
+        """All successor runs of `run` after consuming `event`."""
+        out: List[Run] = []
+        stage = self.stages[run.stage]
+
+        if stage.negated:
+            poisoned = stage.accepts(event, run.events)
+            if stage.contiguity == STRICT:       # notNext
+                if poisoned:
+                    return []                    # killed
+                nxt = run.branch()
+                nxt.stage += 1
+                nxt.count = 0
+                if nxt.stage >= len(self.stages):
+                    matches.append(nxt.events)
+                    return []
+                return self._step(nxt, event, ts, matches)
+            # notFollowedBy: the poison window stays open until the
+            # FOLLOWING stage matches, so the run stays parked here and
+            # advances only on an event the next stage takes (avoiding
+            # duplicate watcher branches)
+            if poisoned:
+                return []
+            if run.stage == len(self.stages) - 1:
+                return [run]                     # waiting on the horizon
+            nxt = run.branch()
+            nxt.stage += 1
+            nxt.count = 0
+            if self.stages[nxt.stage].accepts(event, nxt.events):
+                return self._step(nxt, event, ts, matches)
+            return [run]                         # keep watching
+
+        took = False
+        if stage.accepts(event, run.events):
+            took = True
+            taken = run.branch()
+            taken.events.setdefault(stage.name, []).append(event)
+            taken.count += 1
+            can_loop = (stage.max_times is None
+                        or taken.count < stage.max_times)
+            done_enough = taken.count >= stage.min_times
+            if done_enough:
+                if taken.stage == len(self.stages) - 1:
+                    if stage.greedy and can_loop:
+                        out.append(taken)        # defer: maximal match
+                    else:
+                        matches.append(taken.events)
+                        if can_loop:             # 1..n extensions
+                            out.append(taken)
+                else:
+                    if not stage.greedy:
+                        nxt = taken.branch()
+                        nxt.stage += 1
+                        nxt.count = 0
+                        out.append(nxt)
+                    if can_loop:
+                        out.append(taken)
+                    elif stage.greedy:
+                        nxt = taken.branch()
+                        nxt.stage += 1
+                        nxt.count = 0
+                        out.append(nxt)
+            else:
+                out.append(taken)                # need more
+            if stage.contiguity == SKIP_TILL_ANY:
+                out.append(run)                  # later events may take
+        if not took:
+            # greedy loop concluded by a non-matching event: proceed now
+            if (stage.greedy and run.count >= stage.min_times):
+                nxt = run.branch()
+                nxt.stage += 1
+                nxt.count = 0
+                if nxt.stage >= len(self.stages):
+                    matches.append(nxt.events)
+                else:
+                    out.extend(self._step(nxt, event, ts, matches))
+            elif stage.optional and run.count == 0:
+                nxt = run.branch()
+                nxt.stage += 1
+                nxt.count = 0
+                if nxt.stage < len(self.stages):
+                    out.extend(self._step(nxt, event, ts, matches))
+            if stage.contiguity == STRICT:
+                if run.count == 0 and not stage.optional:
+                    return out                   # fresh runs just die
+                return out                       # strict break: killed
+            if run.events:
+                out.append(run)                  # skip-till: survive
+            # an EMPTY stage-0 run dies here: advance() starts a fresh
+            # run at every event anyway, so keeping empty survivors
+            # would duplicate every later match and grow per-key state
+            # by one run per non-matching event
+        return out
+
+    @staticmethod
+    def _dedup(runs: List[Run]) -> List[Run]:
+        seen = set()
+        out = []
+        for r in runs:
+            key = (r.stage, r.count, r.start_ts,
+                   tuple((k, tuple(map(id, v))) for k, v in
+                         sorted(r.events.items())))
+            if key not in seen:
+                seen.add(key)
+                out.append(r)
+        return out
+
+    # ---- checkpoint --------------------------------------------------
+    def snapshot(self) -> list:
+        return [r.snapshot() for r in self.runs]
+
+    def restore(self, snap: list) -> None:
+        self.runs = [Run.restore(s) for s in snap]

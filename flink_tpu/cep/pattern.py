@@ -1,0 +1,152 @@
+"""CEP pattern specification (ref: flink-cep pattern/Pattern.java —
+begin :123, next :256, notNext :267, followedBy, notFollowedBy,
+followedByAny, quantifiers times/oneOrMore/optional/greedy, where/or
+conditions, within :239).
+
+A Pattern is a linear chain of stages; each stage carries its
+conditions, a contiguity (how it relates to the PREVIOUS stage:
+STRICT for next, SKIP_TILL_NEXT for followedBy, SKIP_TILL_ANY for
+followedByAny), a quantifier, and an optional negation
+(notNext/notFollowedBy).  The NFA (flink_tpu.cep.nfa) interprets the
+chain directly — the compiler stage of the reference
+(NFACompiler.java) collapses into this normalized form.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional
+
+STRICT = "strict"               # next
+SKIP_TILL_NEXT = "skip_next"    # followedBy
+SKIP_TILL_ANY = "skip_any"      # followedByAny
+
+
+class Stage:
+    def __init__(self, name: str, contiguity: str, negated: bool = False):
+        self.name = name
+        self.contiguity = contiguity
+        self.negated = negated
+        #: AND-groups of OR'd conditions: [[c1 OR c2] AND [c3]]
+        self.conditions: List[List[Callable]] = []
+        self.min_times = 1
+        self.max_times = 1          # None = unbounded (oneOrMore)
+        self.optional = False
+        self.greedy = False
+
+    def accepts(self, event, partial_events) -> bool:
+        """All AND-groups satisfied (each group = OR of conditions).
+        Conditions may be unary `cond(event)` or binary
+        `cond(event, partial)` where partial maps stage name -> events
+        so far (the IterativeCondition context)."""
+        for group in self.conditions:
+            ok = False
+            for cond in group:
+                try:
+                    r = cond(event, partial_events)
+                except TypeError:
+                    r = cond(event)
+                if r:
+                    ok = True
+                    break
+            if not ok:
+                return False
+        return True
+
+    def __repr__(self):
+        return (f"Stage({self.name}, {self.contiguity}"
+                f"{', neg' if self.negated else ''}, "
+                f"x[{self.min_times},{self.max_times}])")
+
+
+class Pattern:
+    """Fluent builder (ref: Pattern.java)."""
+
+    def __init__(self, stages: List[Stage], within_ms: Optional[int] = None):
+        self.stages = stages
+        self.within_ms = within_ms
+
+    # ---- construction ------------------------------------------------
+    @staticmethod
+    def begin(name: str) -> "Pattern":
+        return Pattern([Stage(name, SKIP_TILL_NEXT)])
+
+    def next(self, name: str) -> "Pattern":
+        return self._append(Stage(name, STRICT))
+
+    def followed_by(self, name: str) -> "Pattern":
+        return self._append(Stage(name, SKIP_TILL_NEXT))
+
+    def followed_by_any(self, name: str) -> "Pattern":
+        return self._append(Stage(name, SKIP_TILL_ANY))
+
+    def not_next(self, name: str) -> "Pattern":
+        return self._append(Stage(name, STRICT, negated=True))
+
+    def not_followed_by(self, name: str) -> "Pattern":
+        return self._append(Stage(name, SKIP_TILL_NEXT, negated=True))
+
+    def _append(self, stage: Stage) -> "Pattern":
+        if self.stages and self.stages[-1].negated and stage.negated:
+            raise ValueError("consecutive negative stages not supported")
+        return Pattern(self.stages + [stage], self.within_ms)
+
+    # ---- conditions (apply to the LAST stage) ------------------------
+    def where(self, condition) -> "Pattern":
+        self._last.conditions.append([condition])
+        return self
+
+    def or_(self, condition) -> "Pattern":
+        if not self._last.conditions:
+            raise ValueError("or_() before any where()")
+        self._last.conditions[-1].append(condition)
+        return self
+
+    # ---- quantifiers -------------------------------------------------
+    def times(self, n: int, to: Optional[int] = None) -> "Pattern":
+        self._last.min_times = n
+        self._last.max_times = to if to is not None else n
+        return self
+
+    def one_or_more(self) -> "Pattern":
+        self._last.min_times = 1
+        self._last.max_times = None
+        return self
+
+    def times_or_more(self, n: int) -> "Pattern":
+        self._last.min_times = n
+        self._last.max_times = None
+        return self
+
+    def optional(self) -> "Pattern":
+        self._last.optional = True
+        return self
+
+    def greedy(self) -> "Pattern":
+        self._last.greedy = True
+        return self
+
+    def within(self, ms: int) -> "Pattern":
+        self.within_ms = ms
+        return self
+
+    @property
+    def _last(self) -> Stage:
+        if self.stages[-1].negated and self.stages[-1].conditions:
+            pass
+        return self.stages[-1]
+
+    def validate(self) -> None:
+        if self.stages[0].negated:
+            raise ValueError("pattern cannot begin with a negation")
+        if self.stages[-1].negated and self.within_ms is None:
+            raise ValueError(
+                "a trailing notFollowedBy needs within() (only a time "
+                "bound can ever conclude the absence)")
+        for s in self.stages:
+            if s.negated and (s.min_times != 1 or s.max_times != 1
+                              or s.optional):
+                raise ValueError(
+                    f"negative stage {s.name} cannot carry quantifiers")
+
+    def __repr__(self):
+        return f"Pattern({self.stages}, within={self.within_ms})"

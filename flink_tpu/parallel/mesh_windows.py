@@ -402,8 +402,13 @@ class MeshTumblingWindows:
         self.num_late_dropped = snap["num_late_dropped"]
         self.ring_window = list(snap["ring_window"])
         self.live = dict(snap["live"])
-        self.key_directory = {s: dict(d)
-                              for s, d in snap["key_directory"].items()}
+        kd = snap["key_directory"]
+        if kd and not isinstance(next(iter(kd.values())), dict):
+            # legacy flat {key_hash: key} snapshot (pre per-window
+            # directories): every live window may draw on the full map
+            self.key_directory = {s: dict(kd) for s in snap["live"]}
+        else:
+            self.key_directory = {s: dict(d) for s, d in kd.items()}
         self.pending = {s: list(lst) for s, lst in snap["pending"].items()}
         self._b_kh.clear()
         self._b_ring.clear()

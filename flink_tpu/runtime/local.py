@@ -140,12 +140,19 @@ class _RouterOutput(Output):
     def __init__(self):
         #: (partitioner, channels: List[_InputChannel], side_tag)
         self.routes: List[Tuple[Any, List["_InputChannel"], Any]] = []
+        #: routes that are iteration back edges (records/watermarks
+        #: flow; EOS and barriers do not — iterations sit outside the
+        #: exactly-once guarantee, as in the reference)
+        self.feedback_routes: set = set()
         #: numRecordsOut counter, set by the task layer when metrics
         #: are enabled (ref: RecordWriterOutput's outputs counter)
         self.records_out_counter = None
 
-    def add_route(self, partitioner, channels, side_tag=None):
+    def add_route(self, partitioner, channels, side_tag=None,
+                  feedback: bool = False):
         partitioner.setup(len(channels))
+        if feedback:
+            self.feedback_routes.add(len(self.routes))
         self.routes.append((partitioner, channels, side_tag))
 
     def collect(self, record):
@@ -181,12 +188,16 @@ class _RouterOutput(Output):
 
     def broadcast_barrier(self, barrier: CheckpointBarrier):
         """(ref: OperatorChain.broadcastCheckpointBarrier)"""
-        for _, channels, _ in self.routes:
+        for i, (_, channels, _) in enumerate(self.routes):
+            if i in self.feedback_routes:
+                continue
             for ch in channels:
                 ch.push(barrier)
 
     def broadcast_end_of_stream(self):
-        for _, channels, _ in self.routes:
+        for i, (_, channels, _) in enumerate(self.routes):
+            if i in self.feedback_routes:
+                continue
             for ch in channels:
                 ch.push(END_OF_STREAM)
 
@@ -206,7 +217,7 @@ class _InputChannel:
     StreamElements (ref: InputChannel + its queued buffers)."""
 
     __slots__ = ("subtask", "input_index", "channel_id", "queue",
-                 "capacity", "blocked", "eos")
+                 "capacity", "blocked", "eos", "is_feedback")
 
     def __init__(self, subtask: "SubtaskInstance", input_index: int,
                  channel_id: int, capacity: int = DEFAULT_CHANNEL_CAPACITY):
@@ -219,6 +230,8 @@ class _InputChannel:
         #: for the rest — ref: BarrierBuffer blocked channels)
         self.blocked = False
         self.eos = False
+        #: iteration back edge: exempt from EOS and barrier alignment
+        self.is_feedback = False
 
     def push(self, element) -> None:
         self.queue.append(element)
@@ -490,7 +503,8 @@ class SubtaskInstance:
 
     # ---- barrier handling -------------------------------------------
     def _live_channel_ids(self) -> Set[int]:
-        return {c.channel_id for c in self.input_channels if not c.eos}
+        return {c.channel_id for c in self.input_channels
+                if not c.eos and not c.is_feedback}
 
     def _on_barrier(self, ch: _InputChannel, barrier: CheckpointBarrier):
         if barrier.options.get("mode") == "at_least_once":
@@ -546,7 +560,7 @@ class SubtaskInstance:
         ch.eos = True
         ch.blocked = False
         self._maybe_complete_alignment()
-        if all(c.eos for c in self.input_channels):
+        if all(c.eos for c in self.input_channels if not c.is_feedback):
             self.finished = True
             self.router.broadcast_end_of_stream()
 
@@ -1029,6 +1043,10 @@ def build_and_wire_subtasks(job_graph: JobGraph, state_backend: str,
             else:
                 targets = downs
             channels = [d.new_channel(edge.type_number) for d in targets]
+            feedback = getattr(edge, "is_feedback", False)
+            for ch in channels:
+                ch.is_feedback = feedback
             partitioner = _clone_partitioner(edge.partitioner)
-            up.router.add_route(partitioner, channels, edge.side_output_tag)
+            up.router.add_route(partitioner, channels, edge.side_output_tag,
+                                feedback=feedback)
     return subtasks

@@ -1,0 +1,92 @@
+"""Queryable state: external point lookups into live keyed state.
+
+The read path the r1 stub lacked (ref: flink-queryable-state —
+KvStateServerImpl.java serving lookups over netty, KvStateRegistry /
+KvStateLocationRegistry locating which operator instance owns a key,
+and the client proxy; registration hook
+AbstractKeyedStateBackend.java:382-389).  In-process rebuild: backends
+register their queryable states with a registry; the client routes a
+key through the SAME key-group arithmetic the runtime partitions by
+(key → key group → owning backend's range) and reads the value.
+
+Reads are dirty (no checkpoint consistency) — exactly the reference's
+contract for queryable state.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional, Tuple
+
+from flink_tpu.core.keygroups import assign_to_key_group
+from flink_tpu.state.backend import VOID_NAMESPACE
+
+
+class KvStateRegistry:
+    """(ref: KvStateRegistry.java + KvStateLocationRegistry.java)"""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        #: state_name -> [(key_group_range, backend, descriptor)]
+        self._entries: Dict[str, List[Tuple[Any, Any, Any]]] = {}
+
+    def register(self, state_name: str, key_group_range, backend,
+                 descriptor) -> None:
+        with self._lock:
+            entries = self._entries.setdefault(state_name, [])
+            # a restart re-registers the same range with a new backend:
+            # the newest wins (the old execution is gone)
+            entries[:] = [(r, b, d) for (r, b, d) in entries
+                          if r != key_group_range]
+            entries.append((key_group_range, backend, descriptor))
+
+    def unregister_all(self, state_name: Optional[str] = None) -> None:
+        with self._lock:
+            if state_name is None:
+                self._entries.clear()
+            else:
+                self._entries.pop(state_name, None)
+
+    def locate(self, state_name: str, key) -> Tuple[Any, Any]:
+        with self._lock:
+            entries = list(self._entries.get(state_name, ()))
+        if not entries:
+            raise KeyError(f"no queryable state {state_name!r} registered")
+        for rng, backend, desc in entries:
+            kg = assign_to_key_group(key, backend.max_parallelism)
+            if rng.contains(kg):
+                return backend, desc
+        raise KeyError(
+            f"no instance of {state_name!r} owns the key group of {key!r}")
+
+
+#: process-wide default (the single-process stand-in for the TM-side
+#: KvStateServer + JM location service)
+DEFAULT_REGISTRY = KvStateRegistry()
+
+
+class QueryableStateClient:
+    """(ref: QueryableStateClient in
+    flink-queryable-state-client-java — getKvState)"""
+
+    def __init__(self, registry: Optional[KvStateRegistry] = None):
+        self.registry = registry or DEFAULT_REGISTRY
+
+    def get_kv_state(self, state_name: str, key, namespace=VOID_NAMESPACE):
+        """Dirty-read the current value of `state_name` for `key`.
+
+        The read goes STRAIGHT to the state table by key — it must not
+        touch the backend's current_key, which belongs to the owner
+        task thread (mutating it from here would corrupt in-flight
+        writes, not just read stale data)."""
+        backend, desc = self.registry.locate(state_name, key)
+        state = backend.get_partitioned_state(namespace, desc)
+        table = getattr(state, "_table", None)
+        if table is None:
+            raise NotImplementedError(
+                f"queryable reads need a table-backed state "
+                f"(heap backend); {type(state).__name__} is not")
+        value = table.get(key, namespace)
+        if value is None and hasattr(desc, "get_default_value"):
+            return desc.get_default_value()
+        return value

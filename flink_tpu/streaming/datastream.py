@@ -367,8 +367,15 @@ class DataStream:
     def shuffle(self) -> "DataStream":
         return DataStream(self.env, self.node, ShufflePartitioner())
 
-    def broadcast(self) -> "DataStream":
-        return DataStream(self.env, self.node, BroadcastPartitioner())
+    def broadcast(self, *broadcast_state_descriptors) -> "DataStream":
+        """Without arguments: broadcast-partitioned stream (every
+        record to every downstream subtask).  With MapStateDescriptors:
+        a BroadcastStream for the broadcast state pattern
+        (ref: DataStream.broadcast :395-410)."""
+        bs = DataStream(self.env, self.node, BroadcastPartitioner())
+        if broadcast_state_descriptors:
+            return BroadcastStream(bs, broadcast_state_descriptors)
+        return bs
 
     def global_(self) -> "DataStream":
         return DataStream(self.env, self.node, GlobalPartitioner())
@@ -402,7 +409,39 @@ class DataStream:
                 s.node.id, node.id, s._edge_partitioner(node.parallelism), 0))
         return DataStream(self.env, node)
 
-    def connect(self, other: "DataStream") -> "ConnectedStreams":
+    def split(self, output_selector) -> "SplitStream":
+        """(ref: DataStream.split :238 — deprecated there in favor of
+        side outputs, kept for API parity).  `output_selector(value)`
+        returns an iterable of route names."""
+        return SplitStream(self.env, self.node, output_selector,
+                           partitioner=self._partitioner,
+                           side_tag=self._side_tag)
+
+    def join(self, other: "DataStream"):
+        """(ref: DataStream.join :709) —
+        .where(k1).equal_to(k2).window(w).apply(fn)."""
+        from flink_tpu.streaming.joining import JoinedStreams
+        return JoinedStreams(self, other)
+
+    def co_group(self, other: "DataStream"):
+        """(ref: DataStream.coGroup :701)."""
+        from flink_tpu.streaming.joining import CoGroupedStreams
+        return CoGroupedStreams(self, other)
+
+    def iterate(self) -> "IterativeStream":
+        """(ref: DataStream.iterate :514) — returns the iteration head;
+        call close_with(feedback) to wire the loop.  Records on the
+        feedback edge bypass EOS/barrier propagation (iterations are
+        outside the exactly-once guarantee, as in the reference)."""
+        head = self._add_op("iteration_head",
+                            _op_factory(StreamMap,
+                                        lambda: as_map_function(lambda v: v)),
+                            chaining="never")
+        return IterativeStream(self.env, head.node)
+
+    def connect(self, other) -> "ConnectedStreams":
+        if isinstance(other, BroadcastStream):
+            return BroadcastConnectedStream(self.env, self, other)
         return ConnectedStreams(self.env, self, other)
 
     # ---- windows over non-keyed streams -----------------------------
@@ -513,17 +552,19 @@ class KeyedStream(DataStream):
             ws._evictor = CountEvictor.of(size)
         return ws
 
-    def connect(self, other: DataStream) -> "ConnectedStreams":
+    def connect(self, other) -> "ConnectedStreams":
+        if isinstance(other, BroadcastStream):
+            return BroadcastConnectedStream(self.env, self, other)
         return ConnectedStreams(self.env, self, other)
 
     def as_queryable_state(self, name: str, descriptor=None):
         """(ref: KeyedStream.asQueryableState :745-788) — registers the
-        rolling reduce state as externally queryable."""
+        stream's latest value per key as externally queryable; read it
+        with flink_tpu.runtime.queryable.QueryableStateClient
+        .get_kv_state(name, key) while the job runs (dirty reads, the
+        reference's contract)."""
         from flink_tpu.core.state import ValueStateDescriptor
-
-        class _QueryableSink:
-            def __init__(self, state_name):
-                self.state_name = state_name
+        from flink_tpu.runtime.queryable import DEFAULT_REGISTRY
 
         desc = descriptor or ValueStateDescriptor(name)
         desc.set_queryable(name)
@@ -532,6 +573,10 @@ class KeyedStream(DataStream):
             def open(self):
                 super().open()
                 self._qstate = self.keyed_backend.get_or_create_keyed_state(desc)
+                # the AbstractKeyedStateBackend.java:382-389 hook
+                DEFAULT_REGISTRY.register(
+                    name, self.keyed_backend.key_group_range,
+                    self.keyed_backend, desc)
 
             def process_element(self, record):
                 from flink_tpu.state.backend import VOID_NAMESPACE
@@ -795,3 +840,98 @@ class ConnectedStreams:
             self.env,
             self.first.key_by(key_selector1),
             self.second.key_by(key_selector2))
+
+
+class SplitStream(DataStream):
+    """(ref: SplitStream.java) — route names from the output selector;
+    select(names) keeps records routed to any of them."""
+
+    def __init__(self, env, node, output_selector, partitioner=None,
+                 side_tag=None):
+        super().__init__(env, node, partitioner, side_tag)
+        self._selector = output_selector
+
+    def select(self, *names: str) -> DataStream:
+        wanted = set(names)
+        selector = self._selector
+
+        def keep(value):
+            routes = selector(value)
+            return any(r in wanted for r in (routes or ()))
+
+        return self.filter(keep, name=f"select[{','.join(names)}]")
+
+
+class IterativeStream(DataStream):
+    """(ref: IterativeStream.java) — the iteration head; downstream
+    transforms consume it like any stream, and close_with(feedback)
+    adds the back edge."""
+
+    def close_with(self, feedback: DataStream) -> DataStream:
+        partitioner = (ForwardPartitioner()
+                       if feedback.node.parallelism == self.node.parallelism
+                       else RebalancePartitioner())
+        edge = StreamEdge(feedback.node.id, self.node.id, partitioner,
+                          type_number=0)
+        edge.is_feedback = True
+        self.env.graph.add_edge(edge)
+        return feedback
+
+
+class BroadcastStream:
+    """A broadcast-partitioned stream plus the broadcast state
+    descriptors its elements update (ref: BroadcastStream.java)."""
+
+    def __init__(self, stream: DataStream, descriptors):
+        self.stream = stream
+        self.descriptors = tuple(descriptors)
+
+
+class BroadcastConnectedStream:
+    """(ref: BroadcastConnectedStream.java) — process with a
+    (Keyed)BroadcastProcessFunction; input 1 is the data side, input 2
+    the broadcast side updating broadcast state on every instance."""
+
+    def __init__(self, env, data_stream: DataStream,
+                 broadcast: BroadcastStream):
+        self.env = env
+        self.data = data_stream
+        self.broadcast = broadcast
+
+    def process(self, fn, name: str = "broadcast_process") -> DataStream:
+        from flink_tpu.streaming.operators import CoBroadcastOperator
+        ks = getattr(self.data, "key_selector", None)
+        return self.data._add_op(
+            name, lambda: CoBroadcastOperator(fn),
+            key_selector=ks,
+            extra_inputs=[self.broadcast.stream],  # broadcast-partitioned
+            chaining="never")
+
+
+class AsyncDataStream:
+    """(ref: AsyncDataStream.java — orderedWait/unorderedWait)."""
+
+    @staticmethod
+    def ordered_wait(stream: DataStream, async_function,
+                     timeout_ms: Optional[int] = None,
+                     capacity: int = 100) -> DataStream:
+        return AsyncDataStream._wait(stream, async_function, timeout_ms,
+                                     capacity, ordered=True)
+
+    @staticmethod
+    def unordered_wait(stream: DataStream, async_function,
+                       timeout_ms: Optional[int] = None,
+                       capacity: int = 100) -> DataStream:
+        return AsyncDataStream._wait(stream, async_function, timeout_ms,
+                                     capacity, ordered=False)
+
+    @staticmethod
+    def _wait(stream, fn, timeout_ms, capacity, ordered):
+        from flink_tpu.streaming.operators import AsyncWaitOperator
+        mode = "ordered" if ordered else "unordered"
+        return stream._add_op(
+            f"async_wait_{mode}",
+            lambda: AsyncWaitOperator(fn, capacity=capacity,
+                                      timeout_ms=timeout_ms,
+                                      ordered=ordered),
+            chaining="head")

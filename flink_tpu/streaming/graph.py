@@ -64,6 +64,9 @@ class StreamEdge:
         #: which logical input of the target (0 = first/only, 1 = second)
         self.type_number = type_number
         self.side_output_tag = side_output_tag
+        #: iteration back edge (DataStream.iterate): excluded from EOS
+        #: and barrier propagation and from chaining
+        self.is_feedback = False
 
     def __repr__(self):
         return (f"StreamEdge({self.source_id}->{self.target_id} "
@@ -135,7 +138,8 @@ class JobVertex:
 class JobEdge:
     def __init__(self, source_vertex_id: int, target_vertex_id: int,
                  partitioner: StreamPartitioner, type_number: int = 0,
-                 side_output_tag=None, source_node_id: int = -1):
+                 side_output_tag=None, source_node_id: int = -1,
+                 is_feedback: bool = False):
         self.source_vertex_id = source_vertex_id
         self.target_vertex_id = target_vertex_id
         self.partitioner = partitioner
@@ -143,6 +147,7 @@ class JobEdge:
         self.side_output_tag = side_output_tag
         #: which node inside the source chain emits this edge
         self.source_node_id = source_node_id
+        self.is_feedback = is_feedback
 
 
 class JobGraph:
@@ -169,7 +174,8 @@ class JobGraph:
                 return
             visited.add(vid)
             for e in self.in_edges(vid):
-                visit(e.source_vertex_id)
+                if not e.is_feedback:   # back edges would cycle
+                    visit(e.source_vertex_id)
             order.append(self.vertices[vid])
 
         for vid in self.vertices:
@@ -184,6 +190,7 @@ def is_chainable(edge: StreamEdge, graph: StreamGraph) -> bool:
     down = graph.nodes[edge.target_id]
     return (
         isinstance(edge.partitioner, ForwardPartitioner)
+        and not edge.is_feedback
         and up.parallelism == down.parallelism
         and len(graph.in_edges(down.id)) == 1
         and down.chaining_strategy == "always"
@@ -242,5 +249,5 @@ def create_job_graph(stream_graph: StreamGraph) -> JobGraph:
         jg.edges.append(JobEdge(
             node_to_vertex[e.source_id], node_to_vertex[e.target_id],
             e.partitioner, e.type_number, e.side_output_tag,
-            source_node_id=e.source_id))
+            source_node_id=e.source_id, is_feedback=e.is_feedback))
     return jg

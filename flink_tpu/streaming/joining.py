@@ -1,0 +1,107 @@
+"""Windowed join / coGroup (ref: DataStream.join :709 / coGroup :701 +
+api/datastream/{JoinedStreams,CoGroupedStreams}.java).
+
+Same construction as the reference: both inputs map into tagged
+carriers, union, key by the respective key selectors, and a window
+apply over the buffered window contents splits the tags back apart
+(CoGroupedStreams.java's TaggedUnion + UnionKeySelector).  join =
+coGroup with a cartesian pairing of the two groups
+(JoinedStreams.java's FlatJoinCoGroupFunction).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+from flink_tpu.core.functions import as_key_selector
+
+
+class JoinedStreams:
+    """stream1.join(stream2).where(k1).equal_to(k2).window(w).apply(f)"""
+
+    def __init__(self, first, second, cogroup: bool = False):
+        self.first = first
+        self.second = second
+        self._cogroup = cogroup
+
+    def where(self, key_selector) -> "_Where":
+        return _Where(self, as_key_selector(key_selector))
+
+
+class CoGroupedStreams(JoinedStreams):
+    def __init__(self, first, second):
+        super().__init__(first, second, cogroup=True)
+
+
+class _Where:
+    def __init__(self, joined: JoinedStreams, ks1):
+        self.joined = joined
+        self.ks1 = ks1
+
+    def equal_to(self, key_selector) -> "_EqualTo":
+        return _EqualTo(self.joined, self.ks1,
+                        as_key_selector(key_selector))
+
+
+class _EqualTo:
+    def __init__(self, joined, ks1, ks2):
+        self.joined = joined
+        self.ks1 = ks1
+        self.ks2 = ks2
+
+    def window(self, assigner) -> "_WithWindow":
+        return _WithWindow(self.joined, self.ks1, self.ks2, assigner)
+
+
+class _WithWindow:
+    def __init__(self, joined, ks1, ks2, assigner):
+        self.joined = joined
+        self.ks1 = ks1
+        self.ks2 = ks2
+        self.assigner = assigner
+        self._trigger = None
+        self._evictor = None
+        self._lateness = 0
+
+    def trigger(self, trigger) -> "_WithWindow":
+        self._trigger = trigger
+        return self
+
+    def evictor(self, evictor) -> "_WithWindow":
+        self._evictor = evictor
+        return self
+
+    def allowed_lateness(self, lateness) -> "_WithWindow":
+        self._lateness = lateness
+        return self
+
+    def apply(self, fn: Callable[..., Any], name: str = None):
+        """join: fn(left, right) per pair; coGroup: fn(lefts, rights)
+        returning an iterable of outputs."""
+        joined = self.joined
+        ks1, ks2 = self.ks1, self.ks2
+        tagged1 = joined.first.map(lambda v: (0, v), name="join_tag_left")
+        tagged2 = joined.second.map(lambda v: (1, v), name="join_tag_right")
+        unioned = tagged1.union(tagged2)
+        keyed = unioned.key_by(
+            lambda tv: ks1.get_key(tv[1]) if tv[0] == 0
+            else ks2.get_key(tv[1]))
+        win = keyed.window(self.assigner)
+        if self._trigger is not None:
+            win = win.trigger(self._trigger)
+        if self._evictor is not None:
+            win = win.evictor(self._evictor)
+        if self._lateness:
+            win = win.allowed_lateness(self._lateness)
+        cogroup = joined._cogroup
+
+        def window_fn(key, window, elements):
+            lefts = [v for t, v in elements if t == 0]
+            rights = [v for t, v in elements if t == 1]
+            if cogroup:
+                out = fn(lefts, rights)
+                return list(out) if out is not None else []
+            return [fn(a, b) for a in lefts for b in rights]
+
+        return win.apply(window_fn,
+                         name=name or ("co_group" if cogroup else "join"))

@@ -12,6 +12,8 @@ task layer (ref: OperatorChain.java).
 from __future__ import annotations
 
 import abc
+import threading
+import time as _time_mod
 from typing import Any, Callable, Generic, Iterable, List, Optional, TypeVar
 
 from flink_tpu.core.functions import (
@@ -603,3 +605,276 @@ class CoProcessOperator(TwoInputStreamOperator, AbstractUdfStreamOperator):
         ctx = OnTimerContext(timer, self, "processing")
         if hasattr(self.user_function, "on_timer"):
             self.user_function.on_timer(timer.timestamp, ctx, self._collector)
+
+
+# ---------------------------------------------------------------------
+# Broadcast-connected operators (ref: api/operators/co/
+# CoBroadcastWithKeyedOperator.java / CoBroadcastWithNonKeyedOperator.java
+# + the broadcast state pattern)
+# ---------------------------------------------------------------------
+
+class BroadcastProcessFunction(abc.ABC):
+    """(ref: api/functions/co/BroadcastProcessFunction.java;
+    the keyed variant adds timers — KeyedBroadcastProcessFunction)."""
+
+    @abc.abstractmethod
+    def process_element(self, value, ctx, out) -> None: ...
+
+    @abc.abstractmethod
+    def process_broadcast_element(self, value, ctx, out) -> None: ...
+
+    def on_timer(self, timestamp: int, ctx, out) -> None:  # noqa: B027
+        pass
+
+
+KeyedBroadcastProcessFunction = BroadcastProcessFunction
+
+
+class _ReadOnlyBroadcastState:
+    """Read view of a BroadcastState (the non-broadcast side must not
+    write — ref: ReadOnlyBroadcastState.java)."""
+
+    def __init__(self, state):
+        self._s = state
+
+    def get(self, key):
+        return self._s.get(key)
+
+    def contains(self, key):
+        return self._s.contains(key)
+
+    def immutable_entries(self):
+        return self._s.immutable_entries()
+
+    def keys(self):
+        return self._s.keys()
+
+
+class _BroadcastBaseContext(ProcessFunctionContext):
+    def __init__(self, record, op, writable: bool):
+        super().__init__(record, op)
+        self._writable = writable
+
+    def get_broadcast_state(self, descriptor_or_name):
+        name = getattr(descriptor_or_name, "name", descriptor_or_name)
+        state = self._op.operator_state_backend.get_broadcast_state(name)
+        return state if self._writable else _ReadOnlyBroadcastState(state)
+
+
+class _BroadcastReadOnlyContext(_BroadcastBaseContext):
+    """Keyed-side context: read-only broadcast state + keyed state +
+    timers (when the data side is keyed)."""
+
+    def __init__(self, record, op):
+        super().__init__(record, op, writable=False)
+
+    def get_current_key(self):
+        return self._op.keyed_backend.current_key
+
+    def get_state(self, descriptor):
+        return self._op.keyed_backend.get_partitioned_state(
+            VOID_NAMESPACE, descriptor)
+
+    def register_event_time_timer(self, timestamp):
+        self._op.timer_service.register_event_time_timer(
+            VOID_NAMESPACE, timestamp)
+
+    def register_processing_time_timer(self, timestamp):
+        self._op.timer_service.register_processing_time_timer(
+            VOID_NAMESPACE, timestamp)
+
+
+class CoBroadcastOperator(TwoInputStreamOperator, AbstractUdfStreamOperator):
+    """Input 1 = the (possibly keyed) data stream; input 2 = the
+    broadcast stream whose elements update broadcast state on EVERY
+    parallel instance (the broadcast partitioner delivers to all)."""
+
+    def __init__(self, fn: BroadcastProcessFunction):
+        AbstractUdfStreamOperator.__init__(self, fn)
+
+    def open(self):
+        super().open()
+        self._collector = TimestampedCollector(self.output)
+
+    def process_element1(self, record):
+        self._collector.set_absolute_timestamp(record.timestamp)
+        ctx = _BroadcastReadOnlyContext(record, self)
+        self.user_function.process_element(record.value, ctx,
+                                           self._collector)
+
+    def process_element2(self, record):
+        self._collector.set_absolute_timestamp(record.timestamp)
+        ctx = _BroadcastBaseContext(record, self, writable=True)
+        self.user_function.process_broadcast_element(record.value, ctx,
+                                                     self._collector)
+
+    def on_event_time(self, timer):
+        self._collector.set_absolute_timestamp(timer.timestamp)
+        ctx = OnTimerContext(timer, self, "event")
+        self.user_function.on_timer(timer.timestamp, ctx, self._collector)
+
+    def on_processing_time(self, timer):
+        self._collector.set_absolute_timestamp(None)
+        ctx = OnTimerContext(timer, self, "processing")
+        self.user_function.on_timer(timer.timestamp, ctx, self._collector)
+
+
+# ---------------------------------------------------------------------
+# Async I/O (ref: api/operators/async/AsyncWaitOperator.java + the
+# ordered/unordered stream element queues under queue/)
+# ---------------------------------------------------------------------
+
+class AsyncFunction(abc.ABC):
+    """(ref: api/functions/async/AsyncFunction.java).  async_invoke
+    runs ON A POOL THREAD here (Python has no JVM-style callback
+    futures baked in), so a blocking client call inside it overlaps
+    with other records' calls — the same throughput effect the
+    reference gets from callback-style clients."""
+
+    @abc.abstractmethod
+    def async_invoke(self, value, result_future: "ResultFuture") -> None:
+        ...
+
+    def timeout(self, value, result_future: "ResultFuture") -> None:
+        result_future.complete_exceptionally(
+            TimeoutError(f"async I/O timed out for {value!r}"))
+
+
+class ResultFuture:
+    """(ref: api/functions/async/ResultFuture.java)"""
+
+    __slots__ = ("_results", "_error", "_done")
+
+    def __init__(self):
+        self._results = None
+        self._error = None
+        self._done = threading.Event()
+
+    def complete(self, results) -> None:
+        self._results = list(results)
+        self._done.set()
+
+    def complete_exceptionally(self, error: BaseException) -> None:
+        self._error = error
+        self._done.set()
+
+    @property
+    def done(self) -> bool:
+        return self._done.is_set()
+
+
+class AsyncWaitOperator(AbstractUdfStreamOperator):
+    """Bounded in-flight async requests with ordered or unordered
+    result emission.  Watermarks act as order barriers: every pending
+    request drains before the watermark forwards, in BOTH modes (the
+    reference's unordered queue also never reorders across
+    watermarks)."""
+
+    def __init__(self, fn: AsyncFunction, capacity: int = 100,
+                 timeout_ms: Optional[int] = None, ordered: bool = True):
+        super().__init__(fn)
+        self.capacity = capacity
+        self.timeout_ms = timeout_ms
+        self.ordered = ordered
+        self._pending = None  # deque of (record, ResultFuture, deadline)
+
+    def open(self):
+        super().open()
+        from collections import deque as _deque
+        from concurrent.futures import ThreadPoolExecutor
+        self._pending = _deque()
+        self._pool = ThreadPoolExecutor(
+            max_workers=min(self.capacity, 64),
+            thread_name_prefix="async-io")
+
+    def process_element(self, record):
+        while len(self._pending) >= self.capacity:
+            self._drain(block_one=True)
+        rf = ResultFuture()
+        value = record.value
+        deadline = (None if self.timeout_ms is None
+                    else _time_mod.monotonic() + self.timeout_ms / 1000.0)
+        self._pool.submit(self._invoke, value, rf)
+        self._pending.append((record, rf, deadline, value))
+        self._drain()
+
+    def _invoke(self, value, rf):
+        try:
+            self.user_function.async_invoke(value, rf)
+        except BaseException as e:  # noqa: BLE001
+            rf.complete_exceptionally(e)
+
+    def _drain(self, block_one: bool = False, block_all: bool = False):
+        """Emit completed results; ordered mode emits only from the
+        head, unordered emits any completed entry."""
+        while self._pending:
+            if self.ordered:
+                entry = self._pending[0]
+                if not self._entry_ready(entry, block_one or block_all):
+                    if not (block_one or block_all):
+                        return
+                self._pending.popleft()
+                self._emit(entry)
+            else:
+                ready = [e for e in self._pending if e[1].done
+                         or self._expired(e)]
+                if not ready and (block_one or block_all):
+                    entry = self._pending[0]
+                    self._entry_ready(entry, True)
+                    ready = [entry]
+                if not ready:
+                    return
+                for entry in ready:
+                    self._pending.remove(entry)
+                    self._emit(entry)
+            if block_one and not block_all:
+                return
+
+    def _entry_ready(self, entry, block: bool) -> bool:
+        record, rf, deadline, value = entry
+        if rf.done:
+            return True
+        if self._expired(entry):
+            return True
+        if not block:
+            return False
+        while not rf.done and not self._expired(entry):
+            rf._done.wait(0.005)
+        return True
+
+    def _expired(self, entry) -> bool:
+        _, rf, deadline, _ = entry
+        return (deadline is not None and not rf.done
+                and _time_mod.monotonic() > deadline)
+
+    def _emit(self, entry):
+        record, rf, deadline, value = entry
+        if not rf.done and self._expired(entry):
+            self.user_function.timeout(value, rf)
+            rf._done.wait(1.0)
+        if rf._error is not None:
+            raise rf._error
+        for v in rf._results or []:
+            self.output.collect(record.replace(v))
+
+    def process_watermark(self, watermark):
+        self._drain(block_all=True)
+        super().process_watermark(watermark)
+
+    def snapshot_state(self, checkpoint_id=None):
+        # a barrier must not leave records in flight: upstream will not
+        # replay records consumed before it, so drain-and-emit before
+        # the snapshot (the reference instead persists its queue; a
+        # full drain gives the same exactly-once guarantee at some
+        # checkpoint-latency cost)
+        self._drain(block_all=True)
+        return super().snapshot_state(checkpoint_id)
+
+    def finish(self):
+        self._drain(block_all=True)
+        super().finish()
+
+    def close(self):
+        if getattr(self, "_pool", None) is not None:
+            self._pool.shutdown(wait=False)
+        super().close()

@@ -116,24 +116,42 @@ class AvgAgg(AggregateFunction):
         return (a[0] + b[0], a[1] + b[1])
 
 
-class DistinctCountAgg(AggregateFunction):
-    """Exact COUNT(DISTINCT x) — set accumulator (the dataview
-    MapView-backed distinct accumulator of the reference)."""
+class DistinctAgg(AggregateFunction):
+    """DISTINCT modifier: deduplicate inputs in a set accumulator,
+    apply the inner aggregate over the distinct values at result time
+    (the dataview MapView-backed distinct accumulator role).  The set
+    mutates in place — accumulators are owned by the state entry, and
+    an O(n) copy per record would make large groups quadratic."""
+
+    def __init__(self, inner: AggregateFunction):
+        self.inner = inner
 
     def create_accumulator(self):
         return set()
 
     def add(self, value, acc):
         if value is not None:
-            acc = set(acc)
             acc.add(value)
         return acc
 
     def get_result(self, acc):
-        return len(acc)
+        inner_acc = self.inner.create_accumulator()
+        for v in acc:
+            inner_acc = self.inner.add(v, inner_acc)
+        return self.inner.get_result(inner_acc)
 
     def merge(self, a, b):
         return a | b
+
+
+class DistinctCountAgg(DistinctAgg):
+    """Exact COUNT(DISTINCT x)."""
+
+    def __init__(self):
+        super().__init__(CountAgg())
+
+    def get_result(self, acc):
+        return len(acc)
 
 
 def make_builtin_agg(call: AggCall):
@@ -142,14 +160,11 @@ def make_builtin_agg(call: AggCall):
         if call.distinct:
             return DistinctCountAgg()
         return CountAgg()
-    if name == "SUM":
-        return SumAgg()
-    if name == "MIN":
-        return MinAgg()
-    if name == "MAX":
-        return MaxAgg()
-    if name == "AVG":
-        return AvgAgg()
+    plain = {"SUM": SumAgg, "MIN": MinAgg, "MAX": MaxAgg,
+             "AVG": AvgAgg}.get(name)
+    if plain is not None:
+        agg = plain()
+        return DistinctAgg(agg) if call.distinct else agg
     if name == "APPROX_COUNT_DISTINCT":
         from flink_tpu.ops.sketches import HyperLogLogAggregate
         return HyperLogLogAggregate(precision=12)

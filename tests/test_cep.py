@@ -1,0 +1,265 @@
+"""CEP: pattern builder + NFA semantics + stream integration
+(ref: flink-cep NFAITCase/CEPITCase shapes — SURVEY.md §2.5, §2.9)."""
+
+import pytest
+
+from flink_tpu.cep import CEP, NFA, Pattern
+from flink_tpu.streaming.datastream import StreamExecutionEnvironment
+from flink_tpu.streaming.operators import OutputTag
+from flink_tpu.streaming.sources import CollectSink
+
+
+def _nfa(pattern):
+    pattern.validate()
+    return NFA(pattern)
+
+
+def feed(nfa, events):
+    """events: [(value, ts)] in time order → (matches, timeouts)."""
+    all_m, all_t = [], []
+    for v, t in events:
+        m, to = nfa.advance(v, t)
+        all_m.extend(m)
+        all_t.extend(to)
+    return all_m, all_t
+
+
+def is_type(t):
+    return lambda e: e[0] == t
+
+
+# ---------------------------------------------------------------------
+# NFA semantics
+# ---------------------------------------------------------------------
+
+def test_strict_next():
+    p = (Pattern.begin("a").where(is_type("A"))
+         .next("b").where(is_type("B")))
+    m, _ = feed(_nfa(p), [(("A", 1), 0), (("B", 2), 1)])
+    assert m == [{"a": [("A", 1)], "b": [("B", 2)]}]
+    # an intervening event breaks strict contiguity
+    m, _ = feed(_nfa(p), [(("A", 1), 0), (("C", 9), 1), (("B", 2), 2)])
+    assert m == []
+
+
+def test_followed_by_skips():
+    p = (Pattern.begin("a").where(is_type("A"))
+         .followed_by("b").where(is_type("B")))
+    m, _ = feed(_nfa(p), [(("A", 1), 0), (("C", 9), 1), (("B", 2), 2)])
+    assert m == [{"a": [("A", 1)], "b": [("B", 2)]}]
+    # skip-till-NEXT: only the first b completes a given a-run
+    m, _ = feed(_nfa(p), [(("A", 1), 0), (("B", 2), 1), (("B", 3), 2)])
+    assert len(m) == 1
+
+
+def test_followed_by_any_matches_all():
+    p = (Pattern.begin("a").where(is_type("A"))
+         .followed_by_any("b").where(is_type("B")))
+    m, _ = feed(_nfa(p), [(("A", 1), 0), (("B", 2), 1), (("B", 3), 2)])
+    assert len(m) == 2
+
+
+def test_conditions_and_or():
+    p = (Pattern.begin("x")
+         .where(lambda e: e[1] > 10)
+         .or_(lambda e: e[0] == "VIP")
+         .where(lambda e: e[0] != "D"))
+    nfa = _nfa(p)
+    m, _ = feed(nfa, [(("C", 50), 0), (("VIP", 0), 1), (("D", 99), 2),
+                      (("C", 5), 3)])
+    assert len(m) == 2  # ("C",50) and ("VIP",0); D fails AND, C5 fails OR
+
+
+def test_times_exact():
+    p = (Pattern.begin("a").where(is_type("A")).times(2)
+         .followed_by("b").where(is_type("B")))
+    m, _ = feed(_nfa(p), [(("A", 1), 0), (("A", 2), 1), (("B", 3), 2)])
+    assert m == [{"a": [("A", 1), ("A", 2)], "b": [("B", 3)]}]
+
+
+def test_one_or_more_emits_every_extension():
+    p = Pattern.begin("a").where(is_type("A")).one_or_more()
+    m, _ = feed(_nfa(p), [(("A", 1), 0), (("A", 2), 1)])
+    # [A1], [A2], [A1 A2]
+    assert len(m) == 3
+
+
+def test_greedy_loop_concludes_on_break():
+    p = (Pattern.begin("a").where(is_type("A")).one_or_more().greedy()
+         .followed_by("b").where(is_type("B")))
+    m, _ = feed(_nfa(p), [(("A", 1), 0), (("A", 2), 1), (("B", 3), 2)])
+    # greedy: the run from A1 absorbs maximally ([A1, A2]); no [A1]-only
+    # match exists.  A separate run starting at A2 still matches (the
+    # NO_SKIP after-match strategy starts a run at every event).
+    assert {"a": [("A", 1), ("A", 2)], "b": [("B", 3)]} in m
+    assert {"a": [("A", 1)], "b": [("B", 3)]} not in m
+
+
+def test_optional_stage():
+    p = (Pattern.begin("a").where(is_type("A"))
+         .followed_by("m").where(is_type("M")).optional()
+         .followed_by("b").where(is_type("B")))
+    m, _ = feed(_nfa(p), [(("A", 1), 0), (("B", 2), 1)])
+    assert m == [{"a": [("A", 1)], "b": [("B", 2)]}]
+    m, _ = feed(_nfa(p), [(("A", 1), 0), (("M", 9), 1), (("B", 2), 2)])
+    assert {"a": [("A", 1)], "m": [("M", 9)], "b": [("B", 2)]} in m
+
+
+def test_not_next():
+    p = (Pattern.begin("a").where(is_type("A"))
+         .not_next("nb").where(is_type("B"))
+         .followed_by("c").where(is_type("C")))
+    m, _ = feed(_nfa(p), [(("A", 1), 0), (("B", 9), 1), (("C", 2), 2)])
+    assert m == []
+    m, _ = feed(_nfa(p), [(("A", 1), 0), (("X", 9), 1), (("C", 2), 2)])
+    assert len(m) == 1
+
+
+def test_not_followed_by_poisons():
+    p = (Pattern.begin("a").where(is_type("A"))
+         .not_followed_by("nb").where(is_type("B"))
+         .followed_by("c").where(is_type("C")))
+    m, _ = feed(_nfa(p), [(("A", 1), 0), (("B", 9), 1), (("C", 2), 2)])
+    assert m == []
+    m, _ = feed(_nfa(p), [(("A", 1), 0), (("X", 9), 1), (("C", 2), 2)])
+    assert len(m) == 1
+
+
+def test_trailing_not_followed_by_needs_within():
+    p = (Pattern.begin("a").where(is_type("A"))
+         .not_followed_by("nb").where(is_type("B")))
+    with pytest.raises(ValueError):
+        p.validate()
+
+
+def test_trailing_absence_concludes_at_horizon():
+    p = (Pattern.begin("a").where(is_type("A"))
+         .not_followed_by("nb").where(is_type("B"))
+         .within(1000))
+    nfa = _nfa(p)
+    m, _ = feed(nfa, [(("A", 1), 0)])
+    assert m == []
+    matches = []
+    nfa.advance_time(2000, matches)
+    assert matches == [{"a": [("A", 1)]}]
+    # poisoned variant: B arrives inside the window
+    nfa2 = _nfa(p)
+    feed(nfa2, [(("A", 1), 0), (("B", 5), 10)])
+    matches = []
+    nfa2.advance_time(2000, matches)
+    assert matches == []
+
+
+def test_within_timeout_returns_partial():
+    p = (Pattern.begin("a").where(is_type("A"))
+         .followed_by("b").where(is_type("B")).within(100))
+    nfa = _nfa(p)
+    m, t = feed(nfa, [(("A", 1), 0), (("B", 2), 500)])
+    # run from A@0 timed out before B@500; B may still start a new run
+    assert m == []
+    assert t == [({"a": [("A", 1)]}, 0)]
+
+
+def test_iterative_condition_sees_partial():
+    # b must exceed every a seen so far
+    p = (Pattern.begin("a").where(is_type("A")).times(2)
+         .followed_by("b").where(
+             lambda e, partial: e[0] == "B"
+             and all(e[1] > a[1] for a in partial.get("a", []))))
+    m, _ = feed(_nfa(p), [(("A", 3), 0), (("A", 7), 1), (("B", 9), 2)])
+    assert len(m) == 1
+    m, _ = feed(_nfa(p), [(("A", 3), 0), (("A", 7), 1), (("B", 5), 2)])
+    assert m == []
+
+
+def test_nfa_snapshot_restore():
+    p = (Pattern.begin("a").where(is_type("A"))
+         .followed_by("b").where(is_type("B")))
+    nfa = _nfa(p)
+    feed(nfa, [(("A", 1), 0)])
+    snap = nfa.snapshot()
+    nfa2 = _nfa(p)
+    nfa2.restore(snap)
+    m, _ = feed(nfa2, [(("B", 2), 1)])
+    assert len(m) == 1
+
+
+def test_no_duplicate_matches_after_nonmatching_prefix():
+    """Empty stage-0 runs must not survive non-matching events — each
+    match emits exactly once and per-key run state stays bounded."""
+    p = (Pattern.begin("a").where(is_type("A"))
+         .followed_by("b").where(is_type("B")))
+    nfa = _nfa(p)
+    m, _ = feed(nfa, [(("X", 0), 0), (("X", 0), 1), (("X", 0), 2),
+                      (("A", 1), 3), (("B", 2), 4)])
+    assert len(m) == 1
+    # run state bounded: nothing left but nothing-started
+    assert len(nfa.runs) <= 2
+
+
+# ---------------------------------------------------------------------
+# stream integration
+# ---------------------------------------------------------------------
+
+def _run_cep_job(events, pattern, keyed=True, timeout_tag=None):
+    env = StreamExecutionEnvironment()
+    stream = env.from_collection(events, timestamped=True)
+    if keyed:
+        stream = stream.key_by(lambda e: e[0])
+    ps = CEP.pattern(stream, pattern)
+    if timeout_tag is not None:
+        ps = ps.with_timeout_side_output(timeout_tag)
+    sink = CollectSink()
+    out = ps.select(lambda m: {k: [e for e in v] for k, v in m.items()})
+    out.add_sink(sink)
+    result_streams = {"main": sink}
+    if timeout_tag is not None:
+        to_sink = CollectSink()
+        out_node = out  # side outputs hang off the cep operator's stream
+        env_stream = ps  # unused
+        # side output must be taken from the operator's stream: re-run
+    env.execute("cep-job")
+    return sink.values
+
+
+def test_cep_on_keyed_stream():
+    # per key: login_fail x2 then success within the stream
+    events = [
+        (("u1", "fail"), 0), (("u2", "fail"), 5), (("u1", "fail"), 10),
+        (("u1", "ok"), 20), (("u2", "ok"), 25),
+    ]
+    p = (Pattern.begin("f").where(lambda e: e[1] == "fail").times(2)
+         .followed_by("s").where(lambda e: e[1] == "ok"))
+    got = _run_cep_job(events, p)
+    # only u1 had two fails before ok
+    assert len(got) == 1
+    assert got[0]["f"][0][0] == "u1" and len(got[0]["f"]) == 2
+
+
+def test_cep_out_of_order_events_replay_in_time_order():
+    events = [
+        (("k", "B"), 20), (("k", "A"), 10),  # B arrives first, A earlier ts
+    ]
+    p = (Pattern.begin("a").where(lambda e: e[1] == "A")
+         .next("b").where(lambda e: e[1] == "B"))
+    got = _run_cep_job(events, p)
+    assert len(got) == 1  # time-order replay: A then B
+
+
+def test_cep_timeout_side_output():
+    env = StreamExecutionEnvironment()
+    events = [(("k", "A"), 0), (("k", "X"), 5000)]
+    stream = env.from_collection(events, timestamped=True)
+    stream = stream.key_by(lambda e: e[0])
+    tag = OutputTag("cep-timeouts")
+    p = (Pattern.begin("a").where(lambda e: e[1] == "A")
+         .followed_by("b").where(lambda e: e[1] == "B").within(1000))
+    ps = CEP.pattern(stream, p).with_timeout_side_output(tag)
+    out = ps.select(lambda m: m)
+    main_sink, to_sink = CollectSink(), CollectSink()
+    out.add_sink(main_sink)
+    out.get_side_output(tag).add_sink(to_sink)
+    env.execute("cep-timeout")
+    assert main_sink.values == []
+    assert len(to_sink.values) == 1
+    assert to_sink.values[0] == {"a": [("k", "A")]}

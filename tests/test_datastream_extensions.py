@@ -1,0 +1,257 @@
+"""DataStream API extensions: join, coGroup, split/select, iterate,
+broadcast state pattern, async I/O (the §2.9 contract gaps from
+VERDICT r1 — ref: DataStream.java:238,514,701,709, broadcast :395-410,
+AsyncWaitOperator)."""
+
+import time
+
+import pytest
+
+from flink_tpu.core.state import MapStateDescriptor
+from flink_tpu.streaming.datastream import (
+    AsyncDataStream,
+    StreamExecutionEnvironment,
+)
+from flink_tpu.streaming.operators import (
+    AsyncFunction,
+    KeyedBroadcastProcessFunction,
+)
+from flink_tpu.streaming.sources import CollectSink
+from flink_tpu.streaming.windowing import TumblingEventTimeWindows
+
+
+def _env():
+    return StreamExecutionEnvironment()
+
+
+# ---------------------------------------------------------------------
+# split / select
+# ---------------------------------------------------------------------
+
+def test_split_select():
+    env = _env()
+    stream = env.from_collection(range(10))
+    split = stream.split(lambda v: ["even"] if v % 2 == 0 else ["odd"])
+    evens, odds = CollectSink(), CollectSink()
+    split.select("even").add_sink(evens)
+    split.select("odd").add_sink(odds)
+    env.execute("split")
+    assert sorted(evens.values) == [0, 2, 4, 6, 8]
+    assert sorted(odds.values) == [1, 3, 5, 7, 9]
+
+
+def test_split_multi_route():
+    env = _env()
+    stream = env.from_collection(range(6))
+    split = stream.split(
+        lambda v: (["small"] if v < 4 else []) + (["even"] if v % 2 == 0 else []))
+    both = CollectSink()
+    split.select("small", "even").add_sink(both)
+    env.execute("split-multi")
+    assert sorted(both.values) == [0, 1, 2, 3, 4]
+
+
+# ---------------------------------------------------------------------
+# join / coGroup
+# ---------------------------------------------------------------------
+
+def _two_timestamped_streams(env):
+    orders = env.from_collection(
+        [(("o1", "k1", 10), 100), (("o2", "k2", 20), 200),
+         (("o3", "k1", 30), 1500)], timestamped=True)
+    users = env.from_collection(
+        [(("k1", "alice"), 150), (("k2", "bob"), 250)], timestamped=True)
+    return orders, users
+
+
+def test_windowed_join():
+    env = _env()
+    orders, users = _two_timestamped_streams(env)
+    sink = CollectSink()
+    (orders.join(users)
+        .where(lambda o: o[1])
+        .equal_to(lambda u: u[0])
+        .window(TumblingEventTimeWindows.of(1000))
+        .apply(lambda o, u: (o[0], u[1]))
+        .add_sink(sink))
+    env.execute("join")
+    # window [0,1000): o1/k1 x alice, o2/k2 x bob; o3 in [1000,2000) has
+    # no matching user in that window
+    assert sorted(sink.values) == [("o1", "alice"), ("o2", "bob")]
+
+
+def test_windowed_cogroup_includes_unmatched():
+    env = _env()
+    orders, users = _two_timestamped_streams(env)
+    sink = CollectSink()
+    (orders.co_group(users)
+        .where(lambda o: o[1])
+        .equal_to(lambda u: u[0])
+        .window(TumblingEventTimeWindows.of(1000))
+        .apply(lambda lefts, rights: [(len(lefts), len(rights))])
+        .add_sink(sink))
+    env.execute("cogroup")
+    # [0,1000): (1,1) for k1, (1,1) for k2; [1000,2000): (1,0) for k1
+    assert sorted(sink.values) == [(1, 0), (1, 1), (1, 1)]
+
+
+# ---------------------------------------------------------------------
+# iterate
+# ---------------------------------------------------------------------
+
+def test_iterate_collatz_style_loop():
+    """Values circulate until they drop below a threshold — the
+    iterate() quickstart shape (halve until < 2)."""
+    env = _env()
+    source = env.from_collection([8, 5, 3])
+    it = source.iterate()
+    stepped = it.map(lambda v: v // 2 if v % 2 == 0 else 3 * v + 1,
+                     name="step")
+    still_big = stepped.filter(lambda v: v >= 2, name="feedback_filter")
+    done = stepped.filter(lambda v: v < 2, name="exit_filter")
+    it.close_with(still_big)
+    sink = CollectSink()
+    done.add_sink(sink)
+    env.execute("iterate")
+    assert sorted(sink.values) == [1, 1, 1]
+
+
+def test_iterate_with_parallel_ops():
+    env = _env()
+    source = env.from_collection([10, 20])
+    it = source.iterate()
+    dec = it.map(lambda v: v - 7, name="dec")
+    it.close_with(dec.filter(lambda v: v > 0, name="fb"))
+    sink = CollectSink()
+    dec.filter(lambda v: v <= 0, name="out").add_sink(sink)
+    env.execute("iterate-2")
+    assert sorted(sink.values) == [-4, -1]
+
+
+# ---------------------------------------------------------------------
+# broadcast state pattern
+# ---------------------------------------------------------------------
+
+RULES = MapStateDescriptor("rules")
+
+
+class Enricher(KeyedBroadcastProcessFunction):
+    def process_element(self, value, ctx, out):
+        rule = ctx.get_broadcast_state(RULES).get(value[0])
+        out.collect((value[0], value[1], rule))
+
+    def process_broadcast_element(self, value, ctx, out):
+        ctx.get_broadcast_state(RULES).put(value[0], value[1])
+
+
+def test_keyed_broadcast_connect():
+    env = _env()
+    # broadcast rules first (time-ordered collection interleave is not
+    # guaranteed across sources, so give data a dedicated rule key set)
+    rules = env.from_collection([("k1", "GOLD"), ("k2", "SILVER")])
+    data = env.from_collection([("k1", 1), ("k2", 2), ("k1", 3)])
+    sink = CollectSink()
+    (data.key_by(lambda v: v[0])
+        .connect(rules.broadcast(RULES))
+        .process(Enricher())
+        .add_sink(sink))
+    env.execute("broadcast-state")
+    got = sorted(sink.values)
+    assert len(got) == 3
+    # every record was enriched from broadcast state (rules source is
+    # finite and the executor steps sources fairly, so by job end all
+    # emissions carry a rule or None-before-arrival; assert total shape
+    for k, v, rule in got:
+        assert rule in ("GOLD", "SILVER", None)
+    assert any(rule is not None for _, _, rule in got)
+
+
+def test_broadcast_state_reaches_all_parallel_instances():
+    env = _env()
+    rules = env.from_collection([("r", 42)])
+    data = env.from_collection(list(range(20)))
+    sink = CollectSink()
+
+    class ReadRule(KeyedBroadcastProcessFunction):
+        def process_element(self, value, ctx, out):
+            out.collect((value, ctx.get_broadcast_state(RULES).get("r")))
+
+        def process_broadcast_element(self, value, ctx, out):
+            ctx.get_broadcast_state(RULES).put(value[0], value[1])
+
+    (data.rebalance().map(lambda v: v, name="spread").set_parallelism(3)
+        .key_by(lambda v: v % 5)
+        .connect(rules.broadcast(RULES))
+        .process(ReadRule())
+        .add_sink(sink))
+    env.execute("broadcast-parallel")
+    assert len(sink.values) == 20
+
+
+# ---------------------------------------------------------------------
+# async I/O
+# ---------------------------------------------------------------------
+
+class SlowDouble(AsyncFunction):
+    def __init__(self, delay_s=0.01):
+        self.delay_s = delay_s
+
+    def async_invoke(self, value, result_future):
+        time.sleep(self.delay_s)
+        result_future.complete([value * 2])
+
+
+def test_async_ordered_preserves_order():
+    env = _env()
+    stream = env.from_collection(list(range(50)))
+    sink = CollectSink()
+    AsyncDataStream.ordered_wait(stream, SlowDouble(0.002),
+                                 capacity=8).add_sink(sink)
+    env.execute("async-ordered")
+    assert sink.values == [v * 2 for v in range(50)]
+
+
+def test_async_unordered_delivers_all():
+    env = _env()
+    stream = env.from_collection(list(range(50)))
+    sink = CollectSink()
+    AsyncDataStream.unordered_wait(stream, SlowDouble(0.002),
+                                   capacity=8).add_sink(sink)
+    env.execute("async-unordered")
+    assert sorted(sink.values) == [v * 2 for v in range(50)]
+
+
+def test_async_concurrency_beats_serial():
+    env = _env()
+    n, delay = 30, 0.02
+    stream = env.from_collection(list(range(n)))
+    sink = CollectSink()
+    AsyncDataStream.unordered_wait(stream, SlowDouble(delay),
+                                   capacity=16).add_sink(sink)
+    t0 = time.perf_counter()
+    env.execute("async-concurrent")
+    elapsed = time.perf_counter() - t0
+    assert len(sink.values) == n
+    assert elapsed < n * delay * 0.8, f"no overlap: {elapsed:.2f}s"
+
+
+def test_async_timeout_raises():
+    env = _env()
+    stream = env.from_collection([1])
+    sink = CollectSink()
+    AsyncDataStream.ordered_wait(stream, SlowDouble(1.0), timeout_ms=30,
+                                 capacity=2).add_sink(sink)
+    with pytest.raises(TimeoutError):
+        env.execute("async-timeout")
+
+
+def test_async_error_propagates():
+    class Boom(AsyncFunction):
+        def async_invoke(self, value, result_future):
+            raise RuntimeError("client blew up")
+
+    env = _env()
+    AsyncDataStream.ordered_wait(env.from_collection([1]), Boom()
+                                 ).add_sink(CollectSink())
+    with pytest.raises(RuntimeError, match="client blew up"):
+        env.execute("async-error")

@@ -1,0 +1,104 @@
+"""Queryable state: write side + the external read path
+(ref: flink-queryable-state — KvStateServerImpl/QueryableStateClient,
+registration via AbstractKeyedStateBackend.java:382-389)."""
+
+import time
+
+import pytest
+
+from flink_tpu.runtime.queryable import (
+    DEFAULT_REGISTRY,
+    KvStateRegistry,
+    QueryableStateClient,
+)
+from flink_tpu.streaming.datastream import StreamExecutionEnvironment
+from flink_tpu.streaming.sources import SourceFunction
+
+
+@pytest.fixture(autouse=True)
+def _clean_registry():
+    DEFAULT_REGISTRY.unregister_all()
+    yield
+    DEFAULT_REGISTRY.unregister_all()
+
+
+def test_query_after_finite_job():
+    env = StreamExecutionEnvironment()
+    (env.from_collection([("a", 1), ("b", 5), ("a", 3)])
+        .key_by(lambda v: v[0])
+        .as_queryable_state("latest"))
+    env.execute("queryable-finite")
+    client = QueryableStateClient()
+    assert client.get_kv_state("latest", "a") == ("a", 3)
+    assert client.get_kv_state("latest", "b") == ("b", 5)
+
+
+def test_query_unknown_state_or_key():
+    client = QueryableStateClient()
+    with pytest.raises(KeyError):
+        client.get_kv_state("nope", "k")
+    env = StreamExecutionEnvironment()
+    (env.from_collection([("a", 1)])
+        .key_by(lambda v: v[0])
+        .as_queryable_state("s1"))
+    env.execute("queryable-2")
+    assert client.get_kv_state("s1", "never-seen") is None
+
+
+def test_query_live_unbounded_job():
+    """The real shape: query while the job is running."""
+
+    class Counter(SourceFunction):
+        def __init__(self):
+            self._running = True
+
+        def run(self, ctx):
+            i = 0
+            while self._running:
+                ctx.collect(("k", i))
+                i += 1
+                time.sleep(0.001)
+
+        def cancel(self):
+            self._running = False
+
+    env = StreamExecutionEnvironment()
+    (env.add_source(Counter())
+        .key_by(lambda v: v[0])
+        .as_queryable_state("live"))
+    client = env.execute_async("queryable-live")
+    q = QueryableStateClient()
+    deadline = time.time() + 10
+    seen = None
+    while time.time() < deadline:
+        try:
+            seen = q.get_kv_state("live", "k")
+            if seen is not None and seen[1] > 10:
+                break
+        except KeyError:
+            pass
+        time.sleep(0.01)
+    client.cancel()
+    client.wait(timeout=10)
+    assert seen is not None and seen[1] > 10
+
+
+def test_parallel_instances_route_by_key_group():
+    env = StreamExecutionEnvironment()
+    (env.from_collection([(f"k{i}", i) for i in range(40)])
+        .rebalance()
+        .map(lambda v: v, name="spread")
+        .set_parallelism(4)
+        .key_by(lambda v: v[0])
+        .as_queryable_state("sharded"))
+    env.execute("queryable-sharded")
+    client = QueryableStateClient()
+    for i in range(40):
+        assert client.get_kv_state("sharded", f"k{i}") == (f"k{i}", i)
+
+
+def test_custom_registry_isolated():
+    reg = KvStateRegistry()
+    client = QueryableStateClient(reg)
+    with pytest.raises(KeyError):
+        client.get_kv_state("anything", 1)

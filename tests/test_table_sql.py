@@ -219,6 +219,18 @@ def test_sql_udaf_registration():
     assert sink.values and all(d > 0 for _, d in sink.values)
 
 
+def test_sql_sum_distinct():
+    events = [(1, 5, 0), (1, 5, 10), (1, 2, 20)]
+    env, t_env = _table_env(events)
+    out = t_env.sql_query(
+        "SELECT k, SUM(DISTINCT u) AS s, SUM(u) AS t FROM ev "
+        "GROUP BY TUMBLE(ts, INTERVAL '1' SECOND), k")
+    sink = CollectSink()
+    out.to_append_stream().add_sink(sink)
+    env.execute("sql-sum-distinct")
+    assert sink.values == [(1, 7, 12)]
+
+
 def test_sql_count_distinct_exact():
     events = [(1, 5, 0), (1, 5, 10), (1, 6, 20)]
     env, t_env = _table_env(events)
