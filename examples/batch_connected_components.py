@@ -1,0 +1,35 @@
+"""Batch delta-iteration connected components (ref:
+flink-examples-batch ConnectedComponents — the canonical delta
+iteration)."""
+
+from flink_tpu.batch import ExecutionEnvironment
+
+
+def main():
+    env = ExecutionEnvironment.get_execution_environment()
+    vertices = [(i, i) for i in range(1, 9)]
+    edges = [(1, 2), (2, 3), (3, 4), (5, 6), (7, 8)]
+    edges = edges + [(b, a) for a, b in edges]
+
+    solution = env.from_collection(vertices)
+    workset = env.from_collection(vertices)
+    edges_ds = env.from_collection(edges)
+    it = solution.iterate_delta(workset, 20, lambda v: v[0])
+
+    candidates = (it.workset
+                  .join(edges_ds).where(lambda v: v[0])
+                  .equal_to(lambda e: e[0])
+                  .apply(lambda v, e: (e[1], v[1])))
+    updates = (candidates.co_group(it.solution_set)
+               .where(lambda c: c[0]).equal_to(lambda s: s[0])
+               .apply(lambda cs, ss: (
+                   [(ss[0][0], min(c[1] for c in cs))]
+                   if cs and ss and min(c[1] for c in cs) < ss[0][1]
+                   else [])))
+    components = it.close_with(updates, updates)
+    for vertex, component in sorted(components.collect()):
+        print(f"vertex {vertex} -> component {component}")
+
+
+if __name__ == "__main__":
+    main()

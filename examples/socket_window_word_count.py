@@ -1,0 +1,35 @@
+"""SocketWindowWordCount — BASELINE.md config #1 (ref:
+flink-examples-streaming/.../socket/SocketWindowWordCount.java:70-84).
+
+    nc -lk 9999                    # in one terminal, type words
+    python examples/socket_window_word_count.py --port 9999
+"""
+
+import argparse
+
+from flink_tpu.streaming.datastream import StreamExecutionEnvironment
+from flink_tpu.streaming.windowing import Time
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--host", default="localhost")
+    ap.add_argument("--port", type=int, default=9999)
+    args = ap.parse_args()
+
+    env = StreamExecutionEnvironment.get_execution_environment()
+    env.set_stream_time_characteristic("processing")
+    env.enable_checkpointing(5000)
+
+    text = env.socket_text_stream(args.host, args.port)
+    counts = (text
+              .flat_map(lambda line: [(w, 1) for w in line.split()])
+              .key_by(lambda wc: wc[0])
+              .time_window(Time.seconds(5))
+              .reduce(lambda a, b: (a[0], a[1] + b[1])))
+    counts.print_()
+    env.execute("socket-window-word-count")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,40 @@
+"""SQL windowed APPROX_COUNT_DISTINCT — BASELINE.md config #5 (ref:
+the DataStreamGroupWindowAggregate lowering; the HLL UDAF rides the
+TPU device path for single-aggregate queries)."""
+
+import numpy as np
+
+from flink_tpu.streaming.datastream import StreamExecutionEnvironment
+from flink_tpu.streaming.sources import (
+    BoundedOutOfOrdernessTimestampExtractor,
+)
+from flink_tpu.table import StreamTableEnvironment
+
+
+def main():
+    rng = np.random.default_rng(1)
+    n = 20_000
+    events = sorted(
+        zip(rng.integers(0, 10, n).tolist(),
+            rng.integers(0, 2_000, n).tolist(),
+            rng.integers(0, 5_000, n).tolist()), key=lambda e: e[2])
+
+    env = StreamExecutionEnvironment.get_execution_environment()
+    stream = env.from_collection(events)
+    stream = stream.assign_timestamps_and_watermarks(
+        BoundedOutOfOrdernessTimestampExtractor(0, lambda e: e[2]))
+
+    t_env = StreamTableEnvironment.create(env)
+    t_env.register_table("pageviews", t_env.from_data_stream(
+        stream, ["page", "user_id", "ts"], rowtime="ts"))
+
+    result = t_env.sql_query(
+        "SELECT page, APPROX_COUNT_DISTINCT(user_id) AS uv, "
+        "COUNT(*) AS pv, TUMBLE_START(ts) AS win "
+        "FROM pageviews GROUP BY TUMBLE(ts, INTERVAL '1' SECOND), page")
+    result.to_append_stream().print_("uv")
+    env.execute("sql-unique-visitors")
+
+
+if __name__ == "__main__":
+    main()

@@ -26,9 +26,17 @@ Layer map (mirrors SURVEY.md §1):
               (ref: network stack / §2.8)
   table/      Table API + SQL slice lowering onto the window operator
               (ref: flink-libraries/flink-table)
+  cep/        pattern matching: Pattern builder + NFA + keyed operator
+              (ref: flink-libraries/flink-cep)
+  batch/      DataSet API + plan optimizer (ref: flink-java /
+              flink-optimizer)
   connectors/ sources/sinks             (ref: flink-connectors)
   native/     C++ host runtime: hashing, slot index, compiled
               baselines (ref: the rocksdbjni native role, §2.2)
+
+Plus: cli.py (`python -m flink_tpu run|info|bench`, ref: CliFrontend),
+runtime/rest.py (web monitor), runtime/queryable.py (queryable state
+client), examples/ (runnable quickstarts incl. SocketWindowWordCount).
 """
 
 __version__ = "0.1.0"

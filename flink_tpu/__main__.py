@@ -1,0 +1,3 @@
+from flink_tpu.cli import main
+
+raise SystemExit(main())

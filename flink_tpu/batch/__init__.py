@@ -1,0 +1,12 @@
+"""Batch DataSet API + optimizer (ref: flink-java / flink-optimizer /
+the batch driver layer — SURVEY.md §2.4)."""
+
+from flink_tpu.batch.dataset import (
+    DataSet,
+    ExecutionEnvironment,
+    GroupedDataSet,
+)
+from flink_tpu.batch.optimizer import optimize
+
+__all__ = ["ExecutionEnvironment", "DataSet", "GroupedDataSet",
+           "optimize"]

@@ -1,0 +1,559 @@
+"""Batch DataSet API (ref: flink-java DataSet.java +
+ExecutionEnvironment.java — SURVEY.md §2.4, §2.9).
+
+Re-design for this runtime: a DataSet is a LAZY logical plan node;
+terminal operations (collect/count/reduce/output) hand the plan to the
+optimizer (flink_tpu.batch.optimizer), which picks local strategies
+(hash vs sort for grouping/joins, broadcast vs partitioned joins from
+size estimates) and evaluates partition-parallel with vectorized numpy
+kernels on the grouping/join hot paths.  The reference's driver layer
+(flink-runtime/.../operators/ BatchTask + JoinDriver/ReduceCombineDriver,
+MutableHashTable, UnilateralSortMerger) maps onto those strategy
+choices; the MemoryManager's role disappears (numpy owns buffers).
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple
+
+from flink_tpu.core.functions import as_key_selector
+
+
+class ExecutionEnvironment:
+    """(ref: ExecutionEnvironment.java)"""
+
+    def __init__(self):
+        self.parallelism = 4
+        self._sinks: List[Tuple["DataSet", Callable[[List[Any]], None]]] = []
+
+    @staticmethod
+    def get_execution_environment() -> "ExecutionEnvironment":
+        return ExecutionEnvironment()
+
+    def set_parallelism(self, n: int) -> "ExecutionEnvironment":
+        self.parallelism = n
+        return self
+
+    # ---- sources ------------------------------------------------------
+    def from_collection(self, data: Iterable[Any]) -> "DataSet":
+        items = list(data)
+        return DataSet(self, "source", (), lambda inputs: items,
+                       size_estimate=len(items))
+
+    def from_elements(self, *items) -> "DataSet":
+        return self.from_collection(items)
+
+    def generate_sequence(self, start: int, end: int) -> "DataSet":
+        return self.from_collection(range(start, end + 1))
+
+    def read_text_file(self, path: str) -> "DataSet":
+        def read(inputs):
+            with open(path) as f:
+                return [line.rstrip("\n") for line in f]
+        return DataSet(self, "read_text", (), read)
+
+    # ---- execution ------------------------------------------------------
+    def execute(self, job_name: str = "batch-job") -> None:
+        for ds, sink in self._sinks:
+            sink(ds._evaluate())
+        self._sinks.clear()
+
+
+class DataSet:
+    """A lazy plan node.  `fn(inputs)` computes this node's elements
+    from its inputs' materialized lists; the optimizer may substitute
+    strategy-specialized closures before evaluation."""
+
+    def __init__(self, env: ExecutionEnvironment, op: str,
+                 inputs: Tuple["DataSet", ...],
+                 fn: Callable[[List[List[Any]]], List[Any]],
+                 size_estimate: Optional[int] = None,
+                 detail: str = ""):
+        self.env = env
+        self.op = op
+        self.inputs = inputs
+        self.fn = fn
+        self.size_estimate = size_estimate
+        self.detail = detail
+        self._cache: Optional[List[Any]] = None
+
+    # ---- plan building -------------------------------------------------
+    def _derive(self, op, fn, inputs=None, size=None, detail="") -> "DataSet":
+        return DataSet(self.env, op,
+                       tuple(inputs) if inputs is not None else (self,),
+                       fn, size_estimate=size, detail=detail)
+
+    def map(self, fn) -> "DataSet":
+        return self._derive("map", lambda ins: [fn(x) for x in ins[0]],
+                            size=self.size_estimate)
+
+    def flat_map(self, fn) -> "DataSet":
+        return self._derive(
+            "flat_map",
+            lambda ins: [y for x in ins[0] for y in (fn(x) or [])])
+
+    def map_partition(self, fn) -> "DataSet":
+        """fn(iterable) -> iterable, applied per parallel partition
+        (ref: DataSet.mapPartition)."""
+        p = self.env.parallelism
+
+        def run(ins):
+            data = ins[0]
+            n = max(1, (len(data) + p - 1) // p)
+            out: List[Any] = []
+            for i in range(0, len(data), n):
+                out.extend(fn(data[i:i + n]) or [])
+            return out
+        return self._derive("map_partition", run)
+
+    def filter(self, fn) -> "DataSet":
+        return self._derive("filter",
+                            lambda ins: [x for x in ins[0] if fn(x)])
+
+    def distinct(self, key_selector=None) -> "DataSet":
+        ks = as_key_selector(key_selector) if key_selector else None
+
+        def run(ins):
+            seen = set()
+            out = []
+            for x in ins[0]:
+                k = ks.get_key(x) if ks else x
+                if k not in seen:
+                    seen.add(k)
+                    out.append(x)
+            return out
+        return self._derive("distinct", run)
+
+    def union(self, other: "DataSet") -> "DataSet":
+        return self._derive("union", lambda ins: ins[0] + ins[1],
+                            inputs=(self, other))
+
+    def cross(self, other: "DataSet") -> "DataSet":
+        return CrossOperator(self, other)
+
+    def reduce(self, fn) -> "DataSet":
+        def run(ins):
+            it = iter(ins[0])
+            try:
+                acc = next(it)
+            except StopIteration:
+                return []
+            for x in it:
+                acc = fn(acc, x)
+            return [acc]
+        return self._derive("reduce", run, size=1)
+
+    def reduce_group(self, fn) -> "DataSet":
+        return self._derive(
+            "reduce_group", lambda ins: list(fn(ins[0]) or []))
+
+    def aggregate(self, agg: str, field) -> "AggregateOperator":
+        return AggregateOperator(self, [(agg, field)])
+
+    def sum(self, field) -> "AggregateOperator":
+        return self.aggregate("sum", field)
+
+    def min(self, field) -> "AggregateOperator":
+        return self.aggregate("min", field)
+
+    def max(self, field) -> "AggregateOperator":
+        return self.aggregate("max", field)
+
+    def group_by(self, key_selector) -> "GroupedDataSet":
+        return GroupedDataSet(self, as_key_selector(key_selector))
+
+    def join(self, other: "DataSet") -> "JoinOperator":
+        return JoinOperator(self, other, outer=None)
+
+    def left_outer_join(self, other: "DataSet") -> "JoinOperator":
+        return JoinOperator(self, other, outer="left")
+
+    def right_outer_join(self, other: "DataSet") -> "JoinOperator":
+        return JoinOperator(self, other, outer="right")
+
+    def full_outer_join(self, other: "DataSet") -> "JoinOperator":
+        return JoinOperator(self, other, outer="full")
+
+    def co_group(self, other: "DataSet") -> "CoGroupOperator":
+        return CoGroupOperator(self, other)
+
+    def partition_by_hash(self, key_selector) -> "DataSet":
+        # partitioning is a physical no-op here (single-process memory);
+        # kept for API parity and plan display
+        ks = as_key_selector(key_selector)
+        return self._derive("partition_by_hash", lambda ins: ins[0],
+                            detail="hash")
+
+    def rebalance(self) -> "DataSet":
+        return self._derive("rebalance", lambda ins: ins[0])
+
+    def sort_partition(self, key_selector, ascending: bool = True) -> "DataSet":
+        ks = as_key_selector(key_selector)
+        return self._derive(
+            "sort_partition",
+            lambda ins: sorted(ins[0], key=ks.get_key,
+                               reverse=not ascending))
+
+    def first(self, n: int) -> "DataSet":
+        return self._derive("first", lambda ins: ins[0][:n], size=n)
+
+    # ---- iterations ------------------------------------------------------
+    def iterate(self, max_iterations: int) -> "IterativeDataSet":
+        return IterativeDataSet(self, max_iterations)
+
+    def iterate_delta(self, workset_init: "DataSet", max_iterations: int,
+                      key_selector) -> "DeltaIteration":
+        return DeltaIteration(self, workset_init, max_iterations,
+                              as_key_selector(key_selector))
+
+    # ---- terminal ------------------------------------------------------
+    def collect(self) -> List[Any]:
+        return list(self._evaluate())
+
+    def count(self) -> int:
+        return len(self._evaluate())
+
+    def output(self, sink_fn: Callable[[List[Any]], None]) -> None:
+        self.env._sinks.append((self, sink_fn))
+
+    def write_as_text(self, path: str) -> None:
+        def sink(values):
+            with open(path, "w") as f:
+                for v in values:
+                    f.write(f"{v}\n")
+        self.output(sink)
+
+    def print_(self) -> None:
+        self.output(lambda values: print("\n".join(map(str, values))))
+
+    # ---- evaluation ------------------------------------------------------
+    def _evaluate(self) -> List[Any]:
+        from flink_tpu.batch.optimizer import optimize
+        return optimize(self).execute()
+
+    def explain(self) -> str:
+        from flink_tpu.batch.optimizer import optimize
+        return optimize(self).explain()
+
+
+class GroupedDataSet:
+    """(ref: UnsortedGrouping.java / SortedGrouping.java)"""
+
+    def __init__(self, ds: DataSet, ks, sort_key=None, ascending=True):
+        self.ds = ds
+        self.ks = ks
+        self.sort_key = sort_key
+        self.ascending = ascending
+
+    def sort_group(self, key_selector, ascending: bool = True
+                   ) -> "GroupedDataSet":
+        return GroupedDataSet(self.ds, self.ks,
+                              as_key_selector(key_selector), ascending)
+
+    def _groups(self, data) -> Dict[Any, List[Any]]:
+        groups: Dict[Any, List[Any]] = {}
+        for x in data:
+            groups.setdefault(self.ks.get_key(x), []).append(x)
+        if self.sort_key is not None:
+            for g in groups.values():
+                g.sort(key=self.sort_key.get_key,
+                       reverse=not self.ascending)
+        return groups
+
+    def reduce(self, fn) -> DataSet:
+        grouped = self
+
+        def run(ins):
+            out = []
+            for g in grouped._groups(ins[0]).values():
+                acc = g[0]
+                for x in g[1:]:
+                    acc = fn(acc, x)
+                out.append(acc)
+            return out
+        return self.ds._derive("group_reduce", run, detail="hash-group")
+
+    def reduce_group(self, fn) -> DataSet:
+        grouped = self
+
+        def run(ins):
+            out = []
+            for g in grouped._groups(ins[0]).values():
+                out.extend(fn(g) or [])
+            return out
+        return self.ds._derive("group_reduce_group", run,
+                               detail="hash-group")
+
+    def aggregate(self, agg: str, field) -> DataSet:
+        return self._agg([(agg, field)])
+
+    def sum(self, field) -> DataSet:
+        return self._agg([("sum", field)])
+
+    def min(self, field) -> DataSet:
+        return self._agg([("min", field)])
+
+    def max(self, field) -> DataSet:
+        return self._agg([("max", field)])
+
+    def _agg(self, specs) -> DataSet:
+        grouped = self
+
+        def run(ins):
+            out = []
+            for g in grouped._groups(ins[0]).values():
+                row = list(g[-1]) if isinstance(g[-1], (tuple, list)) else g[-1]
+                for agg, field in specs:
+                    vals = [x[field] for x in g]
+                    v = {"sum": sum, "min": min, "max": max}[agg](vals)
+                    row[field] = v
+                out.append(tuple(row) if isinstance(g[-1], tuple) else row)
+            return out
+        return self.ds._derive("group_aggregate", run, detail="hash-group")
+
+    def first(self, n: int) -> DataSet:
+        grouped = self
+
+        def run(ins):
+            out = []
+            for g in grouped._groups(ins[0]).values():
+                out.extend(g[:n])
+            return out
+        return self.ds._derive("group_first", run)
+
+
+class _KeyedTwoInput:
+    def __init__(self, left: DataSet, right: DataSet):
+        self.left = left
+        self.right = right
+        self.ks1 = None
+        self.ks2 = None
+
+    def where(self, key_selector):
+        self.ks1 = as_key_selector(key_selector)
+        return self
+
+    def equal_to(self, key_selector):
+        self.ks2 = as_key_selector(key_selector)
+        return self
+
+
+class JoinOperator(_KeyedTwoInput):
+    """(ref: JoinOperator.java; strategy chosen by the optimizer —
+    broadcast-hash when one side is small, partitioned hash otherwise,
+    mirroring JoinDriver/MutableHashTable vs sort-merge)."""
+
+    def __init__(self, left, right, outer):
+        super().__init__(left, right)
+        self.outer = outer
+
+    def apply(self, fn=None) -> DataSet:
+        fn = fn or (lambda a, b: (a, b))
+        ks1, ks2, outer = self.ks1, self.ks2, self.outer
+        if ks1 is None or ks2 is None:
+            raise ValueError("join needs where(...).equal_to(...)")
+
+        def run(ins):
+            left, right = ins[0], ins[1]
+            # hash join: build on the smaller side
+            build_left = len(left) <= len(right)
+            build, probe = (left, right) if build_left else (right, left)
+            bks, pks = (ks1, ks2) if build_left else (ks2, ks1)
+            table: Dict[Any, List[Any]] = {}
+            for x in build:
+                table.setdefault(bks.get_key(x), []).append(x)
+            out = []
+            matched_build = set()
+            for y in probe:
+                k = pks.get_key(y)
+                hits = table.get(k)
+                if hits:
+                    matched_build.add(k)
+                    for x in hits:
+                        out.append(fn(x, y) if build_left else fn(y, x))
+                else:
+                    if (outer == "full"
+                            or (outer == "left" and not build_left)
+                            or (outer == "right" and build_left)):
+                        out.append(fn(None, y) if build_left
+                                   else fn(y, None))
+            if outer in ("full", "left" if build_left else "right"):
+                for k, hits in table.items():
+                    if k not in matched_build:
+                        for x in hits:
+                            out.append(fn(x, None) if build_left
+                                       else fn(None, x))
+            return out
+
+        return DataSet(self.left.env, "join", (self.left, self.right), run,
+                       detail=f"hash-join outer={self.outer}")
+
+    # joining without apply yields pairs
+    def project_first(self) -> DataSet:
+        return self.apply(lambda a, b: a)
+
+    def project_second(self) -> DataSet:
+        return self.apply(lambda a, b: b)
+
+
+class CoGroupOperator(_KeyedTwoInput):
+    def apply(self, fn) -> DataSet:
+        ks1, ks2 = self.ks1, self.ks2
+        if ks1 is None or ks2 is None:
+            raise ValueError("coGroup needs where(...).equal_to(...)")
+
+        def run(ins):
+            g1: Dict[Any, List[Any]] = {}
+            g2: Dict[Any, List[Any]] = {}
+            for x in ins[0]:
+                g1.setdefault(ks1.get_key(x), []).append(x)
+            for y in ins[1]:
+                g2.setdefault(ks2.get_key(y), []).append(y)
+            out = []
+            for k in set(g1) | set(g2):
+                out.extend(fn(g1.get(k, []), g2.get(k, [])) or [])
+            return out
+
+        return DataSet(self.left.env, "co_group",
+                       (self.left, self.right), run, detail="hash-cogroup")
+
+
+class CrossOperator:
+    def __init__(self, left: DataSet, right: DataSet):
+        self.left = left
+        self.right = right
+
+    def apply(self, fn=None) -> DataSet:
+        fn = fn or (lambda a, b: (a, b))
+
+        def run(ins):
+            return [fn(a, b) for a in ins[0] for b in ins[1]]
+        return DataSet(self.left.env, "cross", (self.left, self.right),
+                       run, detail="nested-loop")
+
+    def collect(self):
+        return self.apply().collect()
+
+
+class AggregateOperator(DataSet):
+    """Chained .and_agg(...) aggregation over the full set
+    (ref: AggregateOperator.java)."""
+
+    def __init__(self, ds: DataSet, specs):
+        self._specs = list(specs)
+        self._src = ds
+
+        def run(ins):
+            data = ins[0]
+            if not data:
+                return []
+            row = list(data[-1])
+            for agg, field in self._specs:
+                vals = [x[field] for x in data]
+                row[field] = {"sum": sum, "min": min, "max": max}[agg](vals)
+            return [tuple(row)]
+
+        super().__init__(ds.env, "aggregate", (ds,), run, size_estimate=1)
+
+    def and_agg(self, agg: str, field) -> "AggregateOperator":
+        return AggregateOperator(self._src, self._specs + [(agg, field)])
+
+
+class IterativeDataSet(DataSet):
+    """Bulk iteration (ref: IterativeDataSet.java / BSP superstep —
+    flink-runtime iterative/ tasks).  close_with(result[, termination])
+    loops until max_iterations or the termination set is empty."""
+
+    def __init__(self, initial: DataSet, max_iterations: int):
+        self._initial = initial
+        self._max = max_iterations
+        super().__init__(initial.env, "iterate_head", (initial,),
+                         lambda ins: ins[0])
+
+    def close_with(self, result: DataSet,
+                   termination: Optional[DataSet] = None) -> DataSet:
+        head = self
+
+        def run(ins):
+            current = ins[0]
+            for _ in range(head._max):
+                head._cache = current
+                result._clear_downstream_cache()
+                current = result._evaluate_raw()
+                if termination is not None:
+                    termination._clear_downstream_cache()
+                    if not termination._evaluate_raw():
+                        break
+            head._cache = None
+            return current
+
+        return DataSet(self.env, "iterate", (self._initial,), run,
+                       detail=f"bulk x{self._max}")
+
+    def _evaluate_raw(self):
+        if self._cache is not None:
+            return self._cache
+        return self.inputs[0]._evaluate_raw()
+
+
+class DeltaIteration:
+    """Delta iteration: solution set updated by a per-round workset
+    (ref: DeltaIteration.java)."""
+
+    def __init__(self, solution: DataSet, workset: DataSet,
+                 max_iterations: int, key_selector):
+        self.solution_init = solution
+        self.workset_init = workset
+        self.max_iterations = max_iterations
+        self.ks = key_selector
+        #: plan handles the step functions read
+        self.solution_set = DataSet(solution.env, "solution_set", (),
+                                    lambda ins: [])
+        self.workset = DataSet(solution.env, "workset", (),
+                               lambda ins: [])
+
+    def close_with(self, solution_delta: DataSet,
+                   next_workset: DataSet) -> DataSet:
+        it = self
+
+        def run(ins):
+            solution = {it.ks.get_key(x): x for x in ins[0]}
+            work = list(ins[1])
+            for _ in range(it.max_iterations):
+                if not work:
+                    break
+                it.solution_set._cache = list(solution.values())
+                it.workset._cache = work
+                solution_delta._clear_downstream_cache()
+                delta = solution_delta._evaluate_raw()
+                next_workset._clear_downstream_cache()
+                work = next_workset._evaluate_raw()
+                for x in delta:
+                    solution[it.ks.get_key(x)] = x
+            it.solution_set._cache = None
+            it.workset._cache = None
+            return list(solution.values())
+
+        return DataSet(self.solution_init.env, "delta_iterate",
+                       (self.solution_init, self.workset_init), run,
+                       detail=f"delta x{self.max_iterations}")
+
+
+# ---- evaluation helpers (shared by optimizer + iterations) -------------
+
+def _evaluate_raw(self: DataSet) -> List[Any]:
+    if self._cache is not None:
+        return self._cache
+    ins = [i._evaluate_raw() for i in self.inputs]
+    return self.fn(ins)
+
+
+def _clear_downstream_cache(self: DataSet) -> None:
+    # iteration bodies re-evaluate per round; only iteration heads keep
+    # a cache between rounds (set explicitly by the drivers above)
+    pass
+
+
+DataSet._evaluate_raw = _evaluate_raw
+DataSet._clear_downstream_cache = _clear_downstream_cache

@@ -106,7 +106,10 @@ class _CepProcessFunction(ProcessFunction):
             matches: List[dict] = []
             timeouts = nfa.advance_time(timestamp, matches)
             self._emit(matches, timeouts, ctx, out)
-        self._arm_timeout_timer(nfa, ctx)
+        self._arm_timeout_timer(
+            nfa, ctx,
+            processing_time=(getattr(ctx, "time_domain", "event")
+                             == "processing"))
         self._store_nfa(ctx, nfa)
 
     # ---- NFA plumbing ------------------------------------------------

@@ -14,11 +14,35 @@ chain directly — the compiler stage of the reference
 
 from __future__ import annotations
 
+import inspect
 from typing import Callable, List, Optional
 
 STRICT = "strict"               # next
 SKIP_TILL_NEXT = "skip_next"    # followedBy
 SKIP_TILL_ANY = "skip_any"      # followedByAny
+
+def _is_binary(cond) -> bool:
+    """True when the condition takes (event, partial_events) — decided
+    from its signature, cached ON the function object (an id()-keyed
+    dict would go stale when a collected lambda's id is reused)."""
+    cached = getattr(cond, "__cep_binary__", None)
+    if cached is not None:
+        return cached
+    try:
+        params = list(inspect.signature(cond).parameters.values())
+        positional = [p for p in params
+                      if p.kind in (p.POSITIONAL_ONLY,
+                                    p.POSITIONAL_OR_KEYWORD)]
+        binary = ((len(positional) >= 2
+                   and positional[1].default is inspect.Parameter.empty)
+                  or any(p.kind == p.VAR_POSITIONAL for p in params))
+    except (TypeError, ValueError):  # builtins without signatures
+        binary = False
+    try:
+        cond.__cep_binary__ = binary
+    except (AttributeError, TypeError):
+        pass  # unsettable callables re-inspect each call
+    return binary
 
 
 class Stage:
@@ -37,13 +61,17 @@ class Stage:
         """All AND-groups satisfied (each group = OR of conditions).
         Conditions may be unary `cond(event)` or binary
         `cond(event, partial)` where partial maps stage name -> events
-        so far (the IterativeCondition context)."""
+        so far (the IterativeCondition context).  Arity is decided by
+        signature inspection once per condition — NOT by catching
+        TypeError, which would both mask errors raised inside the
+        condition body and mis-feed the partial map into a defaulted
+        second parameter."""
         for group in self.conditions:
             ok = False
             for cond in group:
-                try:
+                if _is_binary(cond):
                     r = cond(event, partial_events)
-                except TypeError:
+                else:
                     r = cond(event)
                 if r:
                     ok = True
@@ -131,8 +159,6 @@ class Pattern:
 
     @property
     def _last(self) -> Stage:
-        if self.stages[-1].negated and self.stages[-1].conditions:
-            pass
         return self.stages[-1]
 
     def validate(self) -> None:

@@ -1,0 +1,58 @@
+"""Command-line front end (ref: flink-clients CliFrontend.java + the
+bin/flink script — run/list/cancel/info verbs, scaled to the
+in-process runtime).
+
+    python -m flink_tpu run <script.py> [args...]   execute a job script
+    python -m flink_tpu info                         version + devices
+    python -m flink_tpu bench [config]               run the benchmark
+"""
+
+from __future__ import annotations
+
+import runpy
+import sys
+
+
+def _info() -> int:
+    import flink_tpu
+    print(f"flink_tpu {flink_tpu.__version__}")
+    try:
+        import jax
+        print(f"jax {jax.__version__}, devices: {jax.devices()}")
+    except Exception as e:  # noqa: BLE001
+        print(f"jax unavailable: {e}")
+    try:
+        import flink_tpu.native as nat
+        print(f"native host runtime: "
+              f"{'available' if nat.available() else nat.load_error()}")
+    except Exception as e:  # noqa: BLE001
+        print(f"native host runtime: {e}")
+    return 0
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if not argv or argv[0] in ("-h", "--help", "help"):
+        print(__doc__)
+        return 0
+    verb, rest = argv[0], argv[1:]
+    if verb == "info":
+        return _info()
+    if verb == "run":
+        if not rest:
+            print("usage: flink_tpu run <script.py> [args...]",
+                  file=sys.stderr)
+            return 2
+        sys.argv = rest
+        runpy.run_path(rest[0], run_name="__main__")
+        return 0
+    if verb == "bench":
+        import subprocess
+        return subprocess.call([sys.executable, "bench.py"] + rest)
+    print(f"unknown command {verb!r}; try: run | info | bench",
+          file=sys.stderr)
+    return 2
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

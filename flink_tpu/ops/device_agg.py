@@ -128,15 +128,25 @@ class DeviceAggregateFunction(AggregateFunction):
     def _scalar_jits(self):
         jits = getattr(self, "_scalar_jit_cache", None)
         if jits is None:
+            # pinned to the CPU backend: single-record accumulators are
+            # tiny, and dispatching them to a (possibly remote) TPU per
+            # record costs milliseconds each — the scalar path exists
+            # exactly where per-record semantics are required, so it
+            # must stay a microsecond-scale host call
+            try:
+                kw = {"backend": "cpu"}
+                jax.jit(lambda x: x, **kw)  # probe support
+            except TypeError:  # pragma: no cover — very old jax
+                kw = {}
             jits = {
                 "add": jax.jit(lambda st, v, hi, lo: self.update(
                     st, jnp.zeros(1, jnp.int32), v, hi, lo,
-                    jnp.ones(1, bool))),
+                    jnp.ones(1, bool)), **kw),
                 "result": jax.jit(lambda st: self.result(
-                    st, jnp.zeros(1, jnp.int32))),
+                    st, jnp.zeros(1, jnp.int32)), **kw),
                 "merge": jax.jit(lambda st: self.merge_slots(
                     st, jnp.array([0], jnp.int32),
-                    jnp.array([1], jnp.int32))),
+                    jnp.array([1], jnp.int32)), **kw),
             }
             self._scalar_jit_cache = jits
         return jits

@@ -34,10 +34,17 @@ class KvStateRegistry:
                  descriptor) -> None:
         with self._lock:
             entries = self._entries.setdefault(state_name, [])
-            # a restart re-registers the same range with a new backend:
-            # the newest wins (the old execution is gone)
+            # a restart or a new job re-registers ranges that OVERLAP
+            # the old layout (possibly at different parallelism): the
+            # newest registration wins for every key group it covers,
+            # so evict any overlapping stale entry
+            def overlaps(r):
+                lo = max(r.start_key_group,
+                         key_group_range.start_key_group)
+                hi = min(r.end_key_group, key_group_range.end_key_group)
+                return lo <= hi
             entries[:] = [(r, b, d) for (r, b, d) in entries
-                          if r != key_group_range]
+                          if not overlaps(r)]
             entries.append((key_group_range, backend, descriptor))
 
     def unregister_all(self, state_name: Optional[str] = None) -> None:

@@ -1,0 +1,105 @@
+"""REST monitoring endpoint (ref: flink-runtime rest/RestServerEndpoint
+.java + the web monitor handlers — SURVEY.md §2.2 REST row).
+
+A small threaded HTTP server over the live MetricRegistry and job
+clients: `/jobs` (status per tracked job), `/jobs/<name>/metrics`
+(scoped dump), `/metrics` (full dump), `/metrics/prometheus`
+(text exposition via PrometheusTextReporter).  JSON out, stdlib only.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, Optional
+
+from flink_tpu.runtime.metrics import MetricRegistry, PrometheusTextReporter
+
+
+class WebMonitor:
+    def __init__(self, registry: MetricRegistry, port: int = 0):
+        self.registry = registry
+        self.prometheus = PrometheusTextReporter()
+        #: job name -> JobClient
+        self.jobs: Dict[str, object] = {}
+        monitor = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args):  # quiet
+                pass
+
+            def do_GET(self):
+                try:
+                    body, ctype = monitor._route(self.path)
+                except KeyError:
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                payload = (body if isinstance(body, (bytes, str))
+                           else json.dumps(body, default=str))
+                if isinstance(payload, str):
+                    payload = payload.encode()
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(payload)))
+                self.end_headers()
+                self.wfile.write(payload)
+
+        self._server = ThreadingHTTPServer(("127.0.0.1", port), Handler)
+        self.port = self._server.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+
+    # ---- lifecycle ---------------------------------------------------
+    def start(self) -> "WebMonitor":
+        self._thread = threading.Thread(target=self._server.serve_forever,
+                                        daemon=True, name="web-monitor")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+
+    def track_job(self, name: str, client) -> None:
+        self.jobs[name] = client
+
+    # ---- routing -----------------------------------------------------
+    def _route(self, path: str):
+        if path in ("/", "/overview"):
+            return {"jobs": len(self.jobs),
+                    "metrics": len(self.registry.dump())}, "application/json"
+        if path == "/jobs":
+            return {name: self._job_status(c)
+                    for name, c in self.jobs.items()}, "application/json"
+        if path == "/metrics":
+            return self.registry.dump(), "application/json"
+        if path == "/metrics/prometheus":
+            self.prometheus.report(self.registry.dump())
+            return self.prometheus.render(), "text/plain; version=0.0.4"
+        if path.startswith("/jobs/") and path.endswith("/metrics"):
+            job = path[len("/jobs/"):-len("/metrics")]
+            dump = {k: v for k, v in self.registry.dump().items()
+                    if k.startswith(job + ".")}
+            if not dump and job not in self.jobs:
+                raise KeyError(path)
+            return dump, "application/json"
+        if path.startswith("/jobs/"):
+            job = path[len("/jobs/"):]
+            if job not in self.jobs:
+                raise KeyError(path)
+            return self._job_status(self.jobs[job]), "application/json"
+        raise KeyError(path)
+
+    @staticmethod
+    def _job_status(client) -> dict:
+        done = getattr(client, "done", None)
+        status = "RUNNING"
+        if done:
+            status = "FINISHED"
+            if getattr(client, "_error", None) is not None:
+                status = "FAILED"
+            elif getattr(client, "_result", None) is not None and \
+                    getattr(client._result, "cancelled", False):
+                status = "CANCELED"
+        return {"status": status}

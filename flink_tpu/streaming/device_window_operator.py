@@ -26,7 +26,8 @@ from typing import Any, List, Optional
 import numpy as np
 
 from flink_tpu.ops.device_agg import DeviceAggregateFunction
-from flink_tpu.streaming.elements import StreamRecord, Watermark
+from flink_tpu.streaming.elements import (MAX_TIMESTAMP,
+    StreamRecord, Watermark)
 from flink_tpu.streaming.operators import StreamOperator, TimestampedCollector
 from flink_tpu.streaming.vectorized import (
     VectorizedSlidingWindows,
@@ -109,6 +110,7 @@ class DeviceWindowOperator(StreamOperator):
         self._keys: List[Any] = []
         self._ts: List[int] = []
         self._values: List[Any] = []
+        self._last_fireable = None
         self.num_late_records_dropped = 0  # metric parity
 
     # ---- lifecycle --------------------------------------------------
@@ -169,16 +171,40 @@ class DeviceWindowOperator(StreamOperator):
         self._values.clear()
 
     def process_watermark(self, watermark: Watermark):
+        # Fires only happen when the watermark crosses a window-end
+        # boundary (multiples of size/slide for the aligned engines).
+        # Upstreams may emit a watermark per ELEMENT; paying a device
+        # flush + advance for each would serialize the pipeline on
+        # per-record device dispatches.  Between boundaries nothing can
+        # fire, so the watermark forwards without touching the engine.
+        wm = watermark.timestamp
+        grid = self._fire_grid()
+        if grid is not None and wm != MAX_TIMESTAMP:
+            fireable = ((wm + 1) // grid) * grid if wm >= 0 else None
+            if fireable is not None and fireable == self._last_fireable:
+                self.current_watermark = wm
+                self.output.emit_watermark(watermark)
+                return
+            self._last_fireable = fireable
         self._flush_buffer()
         before = len(self.engine.emitted)
-        self.engine.advance_watermark(watermark.timestamp)
+        self.engine.advance_watermark(wm)
         self._emit_from(before)
         self.num_late_records_dropped = self.engine.num_late_dropped
         if self.metrics is not None:
             self.metrics.counter(
                 "numLateRecordsDropped").count = self.engine.num_late_dropped
-        self.current_watermark = watermark.timestamp
+        self.current_watermark = wm
         self.output.emit_watermark(watermark)
+
+    def _fire_grid(self):
+        """Window-end alignment grid of the assigner, or None when
+        fires can happen at arbitrary times (sessions)."""
+        if isinstance(self.assigner, SlidingEventTimeWindows):
+            return self.assigner.slide
+        if isinstance(self.assigner, TumblingEventTimeWindows):
+            return self.assigner.size
+        return None
 
     def _emit_from(self, start_idx: int):
         emitted = self.engine.emitted

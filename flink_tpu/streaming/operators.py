@@ -743,20 +743,26 @@ class AsyncFunction(abc.ABC):
 class ResultFuture:
     """(ref: api/functions/async/ResultFuture.java)"""
 
-    __slots__ = ("_results", "_error", "_done")
+    __slots__ = ("_results", "_error", "_done", "_notify")
 
-    def __init__(self):
+    def __init__(self, notify=None):
         self._results = None
         self._error = None
         self._done = threading.Event()
+        #: operator-level "any completion" event (wait-any support)
+        self._notify = notify
 
     def complete(self, results) -> None:
         self._results = list(results)
         self._done.set()
+        if self._notify is not None:
+            self._notify.set()
 
     def complete_exceptionally(self, error: BaseException) -> None:
         self._error = error
         self._done.set()
+        if self._notify is not None:
+            self._notify.set()
 
     @property
     def done(self) -> bool:
@@ -783,6 +789,7 @@ class AsyncWaitOperator(AbstractUdfStreamOperator):
         from collections import deque as _deque
         from concurrent.futures import ThreadPoolExecutor
         self._pending = _deque()
+        self._any_done = threading.Event()
         self._pool = ThreadPoolExecutor(
             max_workers=min(self.capacity, 64),
             thread_name_prefix="async-io")
@@ -790,7 +797,7 @@ class AsyncWaitOperator(AbstractUdfStreamOperator):
     def process_element(self, record):
         while len(self._pending) >= self.capacity:
             self._drain(block_one=True)
-        rf = ResultFuture()
+        rf = ResultFuture(notify=self._any_done)
         value = record.value
         deadline = (None if self.timeout_ms is None
                     else _time_mod.monotonic() + self.timeout_ms / 1000.0)
@@ -816,12 +823,16 @@ class AsyncWaitOperator(AbstractUdfStreamOperator):
                 self._pending.popleft()
                 self._emit(entry)
             else:
-                ready = [e for e in self._pending if e[1].done
-                         or self._expired(e)]
-                if not ready and (block_one or block_all):
-                    entry = self._pending[0]
-                    self._entry_ready(entry, True)
-                    ready = [entry]
+                # wait-any: a blocked unordered drain must wake on ANY
+                # completion, not poll the head (head-of-line blocking
+                # is exactly what unordered mode exists to avoid)
+                while True:
+                    ready = [e for e in self._pending if e[1].done
+                             or self._expired(e)]
+                    if ready or not (block_one or block_all):
+                        break
+                    self._any_done.clear()
+                    self._any_done.wait(0.005)
                 if not ready:
                     return
                 for entry in ready:

@@ -1,0 +1,198 @@
+"""Batch DataSet API + optimizer (ref: flink-java DataSet contract,
+SURVEY.md §2.4/§2.9; optimizer strategy choice ref: Optimizer.java)."""
+
+import pytest
+
+from flink_tpu.batch import DataSet, ExecutionEnvironment
+
+
+def _env():
+    return ExecutionEnvironment.get_execution_environment()
+
+
+def test_map_filter_flatmap_collect():
+    env = _env()
+    out = (env.from_collection(range(10))
+           .map(lambda x: x * 2)
+           .filter(lambda x: x % 4 == 0)
+           .flat_map(lambda x: [x, x + 1])
+           .collect())
+    assert out == [0, 1, 4, 5, 8, 9, 12, 13, 16, 17]
+
+
+def test_map_partition():
+    env = _env().set_parallelism(3)
+    out = (env.from_collection(range(9))
+           .map_partition(lambda part: [sum(part)])
+           .collect())
+    assert sum(out) == sum(range(9))
+    assert len(out) == 3
+
+
+def test_reduce_and_aggregate():
+    env = _env()
+    assert env.from_collection([1, 2, 3, 4]).reduce(
+        lambda a, b: a + b).collect() == [10]
+    data = [(1, 10.0), (2, 5.0), (3, 7.5)]
+    agg = (env.from_collection(data).sum(1).and_agg("max", 0).collect())
+    assert agg == [(3, 22.5)]
+
+
+def test_group_by_reduce():
+    env = _env()
+    words = ["a", "b", "a", "c", "b", "a"]
+    out = (env.from_collection([(w, 1) for w in words])
+           .group_by(lambda t: t[0])
+           .reduce(lambda a, b: (a[0], a[1] + b[1]))
+           .collect())
+    assert sorted(out) == [("a", 3), ("b", 2), ("c", 1)]
+
+
+def test_group_by_sorted_group_reduce():
+    env = _env()
+    data = [("k", 3), ("k", 1), ("k", 2), ("j", 9)]
+    out = (env.from_collection(data)
+           .group_by(lambda t: t[0])
+           .sort_group(lambda t: t[1])
+           .reduce_group(lambda g: [tuple(x[1] for x in g)])
+           .collect())
+    assert sorted(out) == [(1, 2, 3), (9,)]
+
+
+def test_distinct_union_first():
+    env = _env()
+    a = env.from_collection([1, 2, 2, 3])
+    b = env.from_collection([3, 4])
+    assert sorted(a.distinct().union(b).collect()) == [1, 2, 3, 3, 4]
+    assert env.from_collection(range(100)).first(3).collect() == [0, 1, 2]
+
+
+def test_inner_and_outer_joins():
+    env = _env()
+    left = env.from_collection([(1, "a"), (2, "b"), (3, "c")])
+    right = env.from_collection([(1, "x"), (3, "y"), (4, "z")])
+    inner = (left.join(right).where(lambda l: l[0])
+             .equal_to(lambda r: r[0])
+             .apply(lambda l, r: (l[0], l[1], r[1])).collect())
+    assert sorted(inner) == [(1, "a", "x"), (3, "c", "y")]
+
+    louter = (left.left_outer_join(right).where(lambda l: l[0])
+              .equal_to(lambda r: r[0])
+              .apply(lambda l, r: (l[0], r[1] if r else None)).collect())
+    assert sorted(louter, key=str) == [(1, "x"), (2, None), (3, "y")]
+
+    fouter = (left.full_outer_join(right).where(lambda l: l[0])
+              .equal_to(lambda r: r[0])
+              .apply(lambda l, r: ((l or r)[0],)).collect())
+    assert sorted(fouter) == [(1,), (2,), (3,), (4,)]
+
+
+def test_cogroup_and_cross():
+    env = _env()
+    a = env.from_collection([(1, "a"), (1, "b"), (2, "c")])
+    b = env.from_collection([(1, "x")])
+    cg = (a.co_group(b).where(lambda l: l[0]).equal_to(lambda r: r[0])
+          .apply(lambda ls, rs: [(len(ls), len(rs))]).collect())
+    assert sorted(cg) == [(1, 0), (2, 1)]
+    cr = (env.from_collection([1, 2]).cross(env.from_collection(["a"]))
+          .apply().collect())
+    assert cr == [(1, "a"), (2, "a")]
+
+
+def test_sort_partition_and_sequence():
+    env = _env()
+    out = (env.generate_sequence(1, 5)
+           .sort_partition(lambda x: -x).collect())
+    assert out == [5, 4, 3, 2, 1]
+
+
+def test_bulk_iteration():
+    """x -> x+1 for 10 rounds (the classic pi-estimation shape)."""
+    env = _env()
+    it = env.from_collection([0, 100]).iterate(10)
+    result = it.close_with(it.map(lambda x: x + 1))
+    assert sorted(result.collect()) == [10, 110]
+
+
+def test_bulk_iteration_with_termination():
+    env = _env()
+    it = env.from_collection([16]).iterate(100)
+    stepped = it.map(lambda x: x // 2)
+    result = it.close_with(stepped, stepped.filter(lambda x: x > 1))
+    # halves until the termination criterion (values > 1) is empty
+    assert result.collect() == [1]
+
+
+def test_delta_iteration_connected_components():
+    """The canonical delta-iteration example: propagate min component
+    id along edges (ref: flink-examples ConnectedComponents)."""
+    env = _env()
+    vertices = [(i, i) for i in range(1, 6)]       # (id, component)
+    edges = [(1, 2), (2, 3), (4, 5)]
+    edges = edges + [(b, a) for a, b in edges]
+    solution = env.from_collection(vertices)
+    workset = env.from_collection(vertices)
+    edges_ds = env.from_collection(edges)
+    delta_it = solution.iterate_delta(workset, 10, lambda v: v[0])
+
+    candidates = (delta_it.workset
+                  .join(edges_ds).where(lambda v: v[0])
+                  .equal_to(lambda e: e[0])
+                  .apply(lambda v, e: (e[1], v[1])))
+    updates = (candidates
+               .co_group(delta_it.solution_set)
+               .where(lambda c: c[0]).equal_to(lambda s: s[0])
+               .apply(lambda cs, ss: (
+                   [(ss[0][0], min(c[1] for c in cs))]
+                   if cs and ss and min(c[1] for c in cs) < ss[0][1]
+                   else [])))
+    result = delta_it.close_with(updates, updates)
+    got = dict(result.collect())
+    assert got == {1: 1, 2: 1, 3: 1, 4: 4, 5: 4}
+
+
+def test_output_and_execute(tmp_path):
+    env = _env()
+    p = tmp_path / "out.txt"
+    env.from_collection([3, 1, 2]).sort_partition(lambda x: x)\
+       .write_as_text(str(p))
+    env.execute("write")
+    assert p.read_text().splitlines() == ["1", "2", "3"]
+
+
+def test_optimizer_explain_and_strategies():
+    env = _env()
+    big = env.from_collection(range(20000))
+    small = env.from_collection(range(5))
+    plan = (big.map(lambda x: (x, x))
+            .join(small.map(lambda x: (x, -x)))
+            .where(lambda t: t[0]).equal_to(lambda t: t[0])
+            .apply(lambda a, b: a))
+    text = plan.explain()
+    assert "broadcast-hash-join" in text
+    assert "source" in text
+    grouped = (big.map(lambda x: (x % 10, x))
+               .group_by(lambda t: t[0]).reduce(lambda a, b: a))
+    assert "hash-group" in grouped.explain()
+
+
+def test_optimizer_eliminates_physical_noops():
+    env = _env()
+    ds = (env.from_collection([1, 2])
+          .partition_by_hash(lambda x: x)
+          .rebalance()
+          .map(lambda x: x))
+    text = ds.explain()
+    assert "partition_by_hash" not in text and "rebalance" not in text
+    assert ds.collect() == [1, 2]
+
+
+def test_common_subplan_evaluated_once():
+    env = _env()
+    calls = []
+    src = env.from_collection([1, 2, 3]).map(
+        lambda x: calls.append(x) or x)
+    joined = (src.join(src).where(lambda x: x).equal_to(lambda x: x)
+              .apply(lambda a, b: a))
+    assert sorted(joined.collect()) == [1, 2, 3]
+    assert len(calls) == 3  # memoized, not re-evaluated per input

@@ -201,23 +201,15 @@ def test_no_duplicate_matches_after_nonmatching_prefix():
 # stream integration
 # ---------------------------------------------------------------------
 
-def _run_cep_job(events, pattern, keyed=True, timeout_tag=None):
+def _run_cep_job(events, pattern, keyed=True):
     env = StreamExecutionEnvironment()
     stream = env.from_collection(events, timestamped=True)
     if keyed:
         stream = stream.key_by(lambda e: e[0])
-    ps = CEP.pattern(stream, pattern)
-    if timeout_tag is not None:
-        ps = ps.with_timeout_side_output(timeout_tag)
     sink = CollectSink()
-    out = ps.select(lambda m: {k: [e for e in v] for k, v in m.items()})
+    out = CEP.pattern(stream, pattern).select(
+        lambda m: {k: [e for e in v] for k, v in m.items()})
     out.add_sink(sink)
-    result_streams = {"main": sink}
-    if timeout_tag is not None:
-        to_sink = CollectSink()
-        out_node = out  # side outputs hang off the cep operator's stream
-        env_stream = ps  # unused
-        # side output must be taken from the operator's stream: re-run
     env.execute("cep-job")
     return sink.values
 

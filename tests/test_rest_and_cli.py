@@ -1,0 +1,82 @@
+"""REST web monitor + CLI front end (ref: RestServerEndpoint /
+WebMonitorEndpoint and CliFrontend — SURVEY.md §2.2/§2.7)."""
+
+import json
+import time
+import urllib.request
+
+from flink_tpu.cli import main as cli_main
+from flink_tpu.runtime.rest import WebMonitor
+from flink_tpu.streaming.datastream import StreamExecutionEnvironment
+from flink_tpu.streaming.sources import CollectSink, SourceFunction
+
+
+def _get(port, path):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=5) as r:
+        ctype = r.headers.get("Content-Type", "")
+        body = r.read().decode()
+    return (json.loads(body) if "json" in ctype else body), ctype
+
+
+def test_monitor_serves_metrics_and_jobs():
+    class Slowish(SourceFunction):
+        def __init__(self):
+            self._running = True
+
+        def run(self, ctx):
+            for i in range(2000):
+                if not self._running:
+                    return
+                ctx.collect(i)
+                time.sleep(0.0005)
+
+        def cancel(self):
+            self._running = False
+
+    env = StreamExecutionEnvironment()
+    env.enable_checkpointing(20)
+    sink = CollectSink()
+    env.add_source(Slowish()).map(lambda v: v + 1).add_sink(sink)
+    client = env.execute_async("monitored-job")
+
+    monitor = WebMonitor(env.get_metric_registry()).start()
+    try:
+        monitor.track_job("monitored-job", client)
+        time.sleep(0.3)
+        jobs, _ = _get(monitor.port, "/jobs")
+        assert jobs["monitored-job"]["status"] == "RUNNING"
+        metrics, _ = _get(monitor.port, "/metrics")
+        assert any("numRecordsIn" in k for k in metrics)
+        scoped, _ = _get(monitor.port, "/jobs/monitored-job/metrics")
+        assert scoped and all(k.startswith("monitored-job.")
+                              for k in scoped)
+        text, ctype = _get(monitor.port, "/metrics/prometheus")
+        assert "flink_tpu_" in text and "text/plain" in ctype
+        client.cancel()
+        client.wait(timeout=10)
+        status, _ = _get(monitor.port, "/jobs/monitored-job")
+        assert status["status"] == "CANCELED"
+        try:
+            _get(monitor.port, "/jobs/nope")
+            assert False, "expected 404"
+        except urllib.error.HTTPError as e:
+            assert e.code == 404
+    finally:
+        monitor.stop()
+
+
+def test_cli_info_and_run(tmp_path, capsys):
+    assert cli_main(["info"]) == 0
+    out = capsys.readouterr().out
+    assert "flink_tpu" in out
+
+    script = tmp_path / "job.py"
+    script.write_text(
+        "from flink_tpu.batch import ExecutionEnvironment\n"
+        "env = ExecutionEnvironment.get_execution_environment()\n"
+        "print(sum(env.from_collection(range(10)).collect()))\n")
+    assert cli_main(["run", str(script)]) == 0
+    assert "45" in capsys.readouterr().out
+    assert cli_main(["nope"]) == 2
+    assert cli_main([]) == 0
