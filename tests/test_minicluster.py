@@ -26,6 +26,7 @@ from flink_tpu.streaming.datastream import StreamExecutionEnvironment
 from flink_tpu.streaming.sources import (
     BoundedOutOfOrdernessTimestampExtractor,
     CollectSink,
+    FromCollectionSource,
 )
 from flink_tpu.streaming.windowing import Time, TumblingEventTimeWindows
 
@@ -226,6 +227,32 @@ class FailOnceAfterCheckpoint(MapFunction):
         return value
 
 
+class GatedCollectionSource(FromCollectionSource):
+    """Deterministic fault-tolerance source (the
+    StreamFaultToleranceTestBase pattern, SURVEY.md §4.4): once most
+    records are out, trickle the tail one record per step until the
+    induced failure has happened, so the checkpoint trigger → barrier →
+    ack → notify round trip always completes while records still flow
+    through the failing mapper.  The gate rides on a CLASS attribute
+    because the source factory deep-copies the function per subtask —
+    instance references would be cloned away from the shared failer."""
+
+    gate = None  # shared FailOnceAfterCheckpoint, set by the test
+    HOLD = 600   # tail records reserved for the trickle phase
+
+    def emit_step(self, ctx, max_records):
+        gate = type(self).gate
+        free_until = len(self.items) - self.HOLD
+        if (gate is not None and not gate.failed
+                and self.offset >= free_until):
+            if self.offset >= len(self.items):
+                return False  # runway exhausted — finish, let asserts fail
+            import time as _t
+            _t.sleep(0.001)
+            return super().emit_step(ctx, 1)
+        return super().emit_step(ctx, max_records)
+
+
 def test_minicluster_exactly_once_recovery():
     """Worker fails mid-stream after a checkpoint; the master restarts
     the job from the latest snapshot (the multi-worker
@@ -233,11 +260,13 @@ def test_minicluster_exactly_once_recovery():
     records = _records(n_keys=6, per_key=300)
     sink = CollectSink()
     failer = FailOnceAfterCheckpoint()
+    GatedCollectionSource.gate = failer
     env = StreamExecutionEnvironment()
     env.use_mini_cluster(2)
     env.enable_checkpointing(10)
     env.set_restart_strategy("fixed_delay", restart_attempts=3, delay_ms=0)
-    (env.from_collection(records, timestamped=True)
+    (env.add_source(GatedCollectionSource(records, timestamped=True),
+                    name="gated_source")
         .map(failer, name="failer")
         .key_by(lambda v: v[0])
         .time_window(Time.milliseconds_of(1000))
