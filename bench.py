@@ -71,7 +71,7 @@ def synth(n, n_keys, t_span, seed):
 
 
 def run_engine(engine, kh, ts, values, vhs, horizon, chunk=1 << 20,
-               warm_shift=10_000_000, reps=2):
+               warm_shift=10_000_000, reps=2, chunk_watermarks=False):
     """Feed an engine in chunks; watermark+fire at the end; D2H-synced
     timing.  Warmup runs ONE full chunk far in the past (compiling the
     ingest, flush, and fire shapes) so the timed region sees only
@@ -84,6 +84,9 @@ def run_engine(engine, kh, ts, values, vhs, horizon, chunk=1 << 20,
                          None if values is None else values[:warm],
                          key_hashes=kh[:warm],
                          value_hashes=None if vhs is None else vhs[:warm])
+    if chunk_watermarks:
+        flush()
+        engine.advance_watermark(int(ts[warm - 1]) - warm_shift - 1)
     flush()
     engine.advance_watermark(horizon - warm_shift)
     engine.block_until_ready()
@@ -102,6 +105,15 @@ def run_engine(engine, kh, ts, values, vhs, horizon, chunk=1 << 20,
                                  None if values is None else values[sl],
                                  key_hashes=kh[sl],
                                  value_hashes=None if vhs is None else vhs[sl])
+            if chunk_watermarks:
+                # streaming watermark cadence: retire completed windows
+                # as the event time advances, so live state stays
+                # bounded (without this, a session run keeps EVERY
+                # (key, session) slot live until the end — 8 GB at
+                # config #4 scale).  Input is time-sorted, so the
+                # chunk max is a safe watermark.
+                flush()
+                engine.advance_watermark(int(ts[sl][-1]) + shift - 1)
         flush()
         engine.advance_watermark(horizon + shift)
         engine.block_until_ready()
@@ -196,17 +208,24 @@ def bench_session_cm(n_events=1 << 21, n_keys=100_000):
     keys, ts, users = synth(n_events, n_keys, 30_000, seed=11)
     kh = nat.splitmix64(keys)
     vh = nat.splitmix64(users)
-    depth, width = 4, 1024
+    # width 256 keeps the device table at capacity * depth * width * 4B
+    # = 0.5 GB (width 1024 at 2^18 slots = 4.3 GB OOMed the chip);
+    # the baseline uses the identical sketch geometry
+    depth, width = 4, 256
 
     base_rate = best_of(lambda: nat.heap_session_cm_baseline(
         kh[:1 << 20], vh[:1 << 20], ts[:1 << 20], 1000,
         depth=depth, width=width, capacity=2 * n_keys))
 
     agg = CountMinSketchAggregate(depth=depth, width=width)
-    eng = VectorizedSessionWindows(agg, 1000, initial_capacity=1 << 18)
+    eng = VectorizedSessionWindows(agg, 1000, initial_capacity=1 << 17)
+    # chunk sized so one chunk's worth of live (key, session) slots
+    # fits the table without a grow: 2^17 events span ~1.9s of event
+    # time here -> ~1.3 slots/key live at the per-chunk watermark
     tpu_rate = run_engine(eng, kh, ts,
                           np.ones(n_events, np.float32), vh,
-                          horizon=60_000, chunk=1 << 19)
+                          horizon=60_000, chunk=1 << 17,
+                          chunk_watermarks=True)
     assert eng.emitted, "no sessions fired"
     return tpu_rate, base_rate
 
@@ -272,7 +291,14 @@ def main():
             continue
         log(f"[bench] running {name} ...")
         t0 = time.perf_counter()
-        tpu_rate, base_rate = fn()
+        try:
+            tpu_rate, base_rate = fn()
+        except Exception as e:  # noqa: BLE001 — one config must never
+            # take down the suite (the driver needs the headline line)
+            log(f"[bench] {name} FAILED: {type(e).__name__}: {e}")
+            results[name] = {"error": f"{type(e).__name__}: {e}",
+                             "wall_s": round(time.perf_counter() - t0, 1)}
+            continue
         results[name] = {
             "tpu_events_per_sec": round(tpu_rate),
             "baseline_events_per_sec": round(base_rate),
@@ -287,7 +313,13 @@ def main():
         json.dump(results, f, indent=2)
     log(f"[bench] report: {json.dumps(results)}")
 
-    head = results.get("hll") or next(iter(results.values()))
+    ok = {n: r for n, r in results.items() if "error" not in r}
+    head = ok.get("hll") or (next(iter(ok.values())) if ok else None)
+    if head is None:
+        print(json.dumps({"metric": "windowed_hll_events_per_sec",
+                          "value": 0, "unit": "events/s",
+                          "vs_baseline": 0.0}))
+        sys.exit(1)
     print(json.dumps({
         "metric": "windowed_hll_events_per_sec",
         "value": head["tpu_events_per_sec"],

@@ -5,6 +5,10 @@ in-process runtime).
     python -m flink_tpu run <script.py> [args...]   execute a job script
     python -m flink_tpu info                         version + devices
     python -m flink_tpu bench [config]               run the benchmark
+    python -m flink_tpu jobmanager [--port P]        start a cluster master
+                                                     (Dispatcher + RM + blob)
+    python -m flink_tpu taskmanager --master H:P     start a worker process
+                                   [--slots N]
 """
 
 from __future__ import annotations
@@ -49,9 +53,60 @@ def main(argv=None) -> int:
     if verb == "bench":
         import subprocess
         return subprocess.call([sys.executable, "bench.py"] + rest)
-    print(f"unknown command {verb!r}; try: run | info | bench",
+    if verb == "jobmanager":
+        return _jobmanager(rest)
+    if verb == "taskmanager":
+        return _taskmanager(rest)
+    print(f"unknown command {verb!r}; "
+          f"try: run | info | bench | jobmanager | taskmanager",
           file=sys.stderr)
     return 2
+
+
+def _jobmanager(rest) -> int:
+    """Cluster entry point (ref: StandaloneSessionClusterEntrypoint)."""
+    import argparse
+    import time
+
+    from flink_tpu.runtime.cluster import JobManagerProcess
+
+    ap = argparse.ArgumentParser(prog="flink_tpu jobmanager")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=6123)
+    args = ap.parse_args(rest)
+    jm = JobManagerProcess(args.host, args.port)
+    print(f"jobmanager listening at {jm.address}", flush=True)
+    try:
+        while True:
+            time.sleep(1.0)
+    except KeyboardInterrupt:
+        jm.stop()
+    return 0
+
+
+def _taskmanager(rest) -> int:
+    """Worker entry point (ref: TaskManagerRunner main)."""
+    import argparse
+    import time
+
+    from flink_tpu.runtime.cluster import TaskManagerProcess
+
+    ap = argparse.ArgumentParser(prog="flink_tpu taskmanager")
+    ap.add_argument("--master", required=True, help="jobmanager host:port")
+    ap.add_argument("--slots", type=int, default=2)
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--tm-id", default=None)
+    args = ap.parse_args(rest)
+    tm = TaskManagerProcess(args.master, args.slots, args.host, args.tm_id)
+    print(f"taskmanager {tm.tm_id} registered with {args.master} "
+          f"(rpc {tm.rpc.address}, data {tm.data_server.address})",
+          flush=True)
+    try:
+        while True:
+            time.sleep(1.0)
+    except KeyboardInterrupt:
+        tm.stop()
+    return 0
 
 
 if __name__ == "__main__":

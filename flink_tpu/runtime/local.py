@@ -999,6 +999,37 @@ class LocalExecutor:
                     t.step(1 << 30)
         except Exception as e:  # noqa: BLE001
             raise SuppressRestartsException(e) from e
+        gather_accumulators(all_tasks, result.accumulators)
+
+
+def merge_accumulators(into: Dict[str, Any], accs: Dict[str, Any]) -> None:
+    """Lists concatenate, numbers add, anything else last-wins (the
+    Accumulator.merge contract, flink-core/.../accumulators/)."""
+    for name, value in accs.items():
+        if name in into and isinstance(into[name], list) \
+                and isinstance(value, list):
+            into[name] = into[name] + value
+        elif name in into and isinstance(into[name], (int, float)) \
+                and isinstance(value, (int, float)):
+            into[name] = into[name] + value
+        else:
+            into[name] = value
+
+
+def gather_accumulators(all_tasks, into: Dict[str, Any]) -> None:
+    """Collect user-function accumulators into the job result (ref:
+    the accumulator snapshot returned with the final ExecutionState).
+    Deduplicated by function INSTANCE: parallel subtasks of an
+    operator whose function is not per-subtask-copied (sinks) share
+    one instance, which must contribute exactly once."""
+    seen: Set[int] = set()
+    for st in all_tasks:
+        for op in st.operators:
+            fn = getattr(op, "user_function", None)
+            get_accs = getattr(fn, "accumulators", None)
+            if callable(get_accs) and id(fn) not in seen:
+                seen.add(id(fn))
+                merge_accumulators(into, get_accs())
 
 
 def _clone_partitioner(p):

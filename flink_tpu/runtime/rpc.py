@@ -1,0 +1,553 @@
+"""Location-transparent RPC framework.
+
+The rebuild of the reference's Akka-based RPC layer
+(flink-runtime/.../rpc/: RpcEndpoint, RpcService, RpcGateway;
+AkkaRpcService.java:80 — connect :149, startServer :190;
+AkkaInvocationHandler.java:58-61,125,190 — gateway method call →
+invocation message → endpoint's single main thread;
+FencedRpcEndpoint for leader-session fencing), TPU-host flavored:
+plain TCP + length-prefixed cloudpickle frames instead of Akka remoting
+(SURVEY.md §2.8: "host-side Python asyncio/gRPC for the control
+plane" — stdlib sockets keep the zero-dependency constraint).
+
+Discipline preserved exactly:
+
+- **Single-threaded endpoints.** Every `RpcEndpoint` owns a mailbox
+  drained by one dedicated main thread; all handler invocations,
+  scheduled calls (`call_async`), and lifecycle transitions run there
+  (the AkkaRpcActor main-thread rule — MainThreadValidatorUtil's
+  invariant).  Handlers never race with themselves.
+- **Gateways are proxies.** `RpcService.connect(address, name)`
+  returns a dynamic proxy; attribute access produces a callable that
+  ships an invocation frame and returns an `RpcFuture` (or blocks when
+  invoked via `.sync`).
+- **Fencing.**  A `FencedRpcEndpoint` carries a fencing token
+  (leader session id); invocations bearing a stale token are rejected
+  with `FencingTokenException` (ref: FencedRpcEndpoint.java).
+
+Wire format: 4-byte big-endian length + cloudpickle payload.  Frames
+are dicts: {kind: "call"|"result"|"error", id, endpoint, method, args,
+kwargs, token}.
+"""
+
+from __future__ import annotations
+
+import itertools
+import queue
+import socket
+import struct
+import threading
+import traceback
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import cloudpickle
+
+_LEN = struct.Struct(">I")
+
+#: max frame size (guards against corrupt length prefixes)
+MAX_FRAME = 1 << 30
+
+
+class RpcException(Exception):
+    pass
+
+
+class RpcTimeoutException(RpcException):
+    pass
+
+
+class FencingTokenException(RpcException):
+    pass
+
+
+class EndpointNotFoundException(RpcException):
+    pass
+
+
+# ---------------------------------------------------------------------
+# framing
+# ---------------------------------------------------------------------
+
+def send_frame(sock: socket.socket, obj: Any) -> None:
+    payload = cloudpickle.dumps(obj)
+    sock.sendall(_LEN.pack(len(payload)) + payload)
+
+
+def recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            return None
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+def recv_frame(sock: socket.socket) -> Optional[Any]:
+    header = recv_exact(sock, _LEN.size)
+    if header is None:
+        return None
+    (length,) = _LEN.unpack(header)
+    if length > MAX_FRAME:
+        raise RpcException(f"frame too large: {length}")
+    payload = recv_exact(sock, length)
+    if payload is None:
+        return None
+    return cloudpickle.loads(payload)
+
+
+# ---------------------------------------------------------------------
+# futures
+# ---------------------------------------------------------------------
+
+class RpcFuture:
+    """Completion handle for one invocation (the CompletableFuture the
+    Akka invocation handler returns)."""
+
+    def __init__(self):
+        self._event = threading.Event()
+        self._result: Any = None
+        self._error: Optional[BaseException] = None
+        self._callbacks = []
+        self._lock = threading.Lock()
+
+    def complete(self, result: Any) -> None:
+        with self._lock:
+            self._result = result
+            self._event.set()
+            cbs, self._callbacks = self._callbacks, []
+        for cb in cbs:
+            cb(self)
+
+    def fail(self, error: BaseException) -> None:
+        with self._lock:
+            self._error = error
+            self._event.set()
+            cbs, self._callbacks = self._callbacks, []
+        for cb in cbs:
+            cb(self)
+
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def get(self, timeout: Optional[float] = None) -> Any:
+        if not self._event.wait(timeout):
+            raise RpcTimeoutException("rpc call timed out")
+        if self._error is not None:
+            raise self._error
+        return self._result
+
+    def on_complete(self, callback: Callable[["RpcFuture"], None]) -> None:
+        with self._lock:
+            if not self._event.is_set():
+                self._callbacks.append(callback)
+                return
+        callback(self)
+
+
+# ---------------------------------------------------------------------
+# endpoints
+# ---------------------------------------------------------------------
+
+class RpcEndpoint:
+    """An actor-style endpoint: public `rpc_*`-free methods are NOT
+    exposed; any method listed in `RPC_METHODS` (or, by default, any
+    public method not starting with '_') is callable remotely.  All
+    invocations run on the endpoint's single main thread."""
+
+    #: optional explicit allowlist of remotely callable method names
+    RPC_METHODS: Optional[Tuple[str, ...]] = None
+
+    def __init__(self, name: str):
+        self.name = name
+        self._mailbox: "queue.Queue" = queue.Queue()
+        self._main: Optional[threading.Thread] = None
+        self._running = False
+        self._main_thread_id: Optional[int] = None
+
+    # -- lifecycle ----------------------------------------------------
+    def start(self) -> None:
+        if self._running:
+            return
+        self._running = True
+        self._main = threading.Thread(target=self._main_loop, daemon=True,
+                                      name=f"rpc-main-{self.name}")
+        self._main.start()
+        self.run_async(self.on_start)
+
+    def stop(self) -> None:
+        if not self._running:
+            return
+
+        def _shutdown():
+            self.on_stop()
+            self._running = False
+
+        self._mailbox.put((_shutdown, (), {}, None))
+        if self._main is not None:
+            self._main.join(timeout=5.0)
+
+    def on_start(self) -> None:  # noqa: B027
+        pass
+
+    def on_stop(self) -> None:  # noqa: B027
+        pass
+
+    # -- main thread --------------------------------------------------
+    def _main_loop(self) -> None:
+        self._main_thread_id = threading.get_ident()
+        while self._running:
+            try:
+                fn, args, kwargs, future = self._mailbox.get(timeout=0.1)
+            except queue.Empty:
+                continue
+            try:
+                result = fn(*args, **kwargs)
+                if future is not None:
+                    future.complete(result)
+            except BaseException as e:  # noqa: BLE001
+                if future is not None:
+                    future.fail(e)
+                else:
+                    self.on_uncaught(e)
+
+    def on_uncaught(self, error: BaseException) -> None:
+        traceback.print_exception(type(error), error, error.__traceback__)
+
+    def validate_main_thread(self) -> None:
+        """(ref: MainThreadValidatorUtil.isRunningInExpectedThread)"""
+        assert threading.get_ident() == self._main_thread_id, \
+            f"not on {self.name}'s main thread"
+
+    def run_async(self, fn: Callable, *args, **kwargs) -> RpcFuture:
+        """Schedule a callable onto the main thread."""
+        future = RpcFuture()
+        self._mailbox.put((fn, args, kwargs, future))
+        return future
+
+    def call_async(self, fn: Callable, *args, **kwargs) -> RpcFuture:
+        return self.run_async(fn, *args, **kwargs)
+
+    # -- invocation entry (from the service's IO threads) -------------
+    def _invoke(self, method: str, args, kwargs, token) -> RpcFuture:
+        self._check_token(token)
+        allowed = (self.RPC_METHODS if self.RPC_METHODS is not None
+                   else None)
+        if method.startswith("_") or (allowed is not None
+                                      and method not in allowed):
+            f = RpcFuture()
+            f.fail(RpcException(f"method not exposed: {method}"))
+            return f
+        fn = getattr(self, method, None)
+        if fn is None or not callable(fn):
+            f = RpcFuture()
+            f.fail(RpcException(f"no such method: {self.name}.{method}"))
+            return f
+        return self.run_async(fn, *args, **kwargs)
+
+    def _check_token(self, token) -> None:  # noqa: B027
+        pass
+
+
+class FencedRpcEndpoint(RpcEndpoint):
+    """Endpoint whose invocations must carry the current fencing token
+    (leader session id — ref: FencedRpcEndpoint.java)."""
+
+    def __init__(self, name: str, token: Any = None):
+        super().__init__(name)
+        self.fencing_token = token
+
+    def _check_token(self, token) -> None:
+        if self.fencing_token is not None and token != self.fencing_token:
+            raise FencingTokenException(
+                f"fencing token mismatch at {self.name}: "
+                f"got {token!r}, expected {self.fencing_token!r}")
+
+
+# ---------------------------------------------------------------------
+# service
+# ---------------------------------------------------------------------
+
+class RpcService:
+    """Hosts endpoints on one TCP server and connects gateways to
+    remote ones (ref: AkkaRpcService).  Address = "host:port"."""
+
+    def __init__(self, bind_host: str = "127.0.0.1", port: int = 0):
+        self._endpoints: Dict[str, RpcEndpoint] = {}
+        self._lock = threading.Lock()
+        self._server = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._server.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._server.bind((bind_host, port))
+        self._server.listen(128)
+        self.host, self.port = self._server.getsockname()
+        self.address = f"{self.host}:{self.port}"
+        self._running = True
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, daemon=True,
+            name=f"rpc-accept-{self.port}")
+        self._accept_thread.start()
+        #: client connection pool: address -> _ClientConnection
+        self._clients: Dict[str, "_ClientConnection"] = {}
+
+    # -- server side --------------------------------------------------
+    def start_server(self, endpoint: RpcEndpoint) -> str:
+        with self._lock:
+            self._endpoints[endpoint.name] = endpoint
+        endpoint.start()
+        return f"{self.address}/{endpoint.name}"
+
+    def stop_server(self, endpoint: RpcEndpoint) -> None:
+        with self._lock:
+            self._endpoints.pop(endpoint.name, None)
+        endpoint.stop()
+
+    def _accept_loop(self) -> None:
+        while self._running:
+            try:
+                conn, _ = self._server.accept()
+            except OSError:
+                return
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            t = threading.Thread(target=self._serve_conn, args=(conn,),
+                                 daemon=True, name="rpc-serve")
+            t.start()
+
+    def _serve_conn(self, conn: socket.socket) -> None:
+        write_lock = threading.Lock()
+        try:
+            while True:
+                frame = recv_frame(conn)
+                if frame is None:
+                    return
+                self._handle_frame(frame, conn, write_lock)
+        except (OSError, EOFError):
+            return
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _handle_frame(self, frame: dict, conn, write_lock) -> None:
+        call_id = frame.get("id")
+
+        def reply(kind, payload):
+            try:
+                with write_lock:
+                    send_frame(conn, {"kind": kind, "id": call_id,
+                                      "payload": payload})
+            except OSError:
+                pass
+            except Exception as e:  # noqa: BLE001 — unpicklable
+                # result/exception: the caller must still get an
+                # answer, not a timeout + dead serve thread
+                try:
+                    with write_lock:
+                        send_frame(conn, {
+                            "kind": "error", "id": call_id,
+                            "payload": RpcException(
+                                f"unserializable {kind}: "
+                                f"{payload!r} ({e!r})")})
+                except OSError:
+                    pass
+
+        if frame.get("kind") != "call":
+            return
+        with self._lock:
+            endpoint = self._endpoints.get(frame["endpoint"])
+        if endpoint is None:
+            reply("error", EndpointNotFoundException(frame["endpoint"]))
+            return
+        if frame.get("oneway"):
+            try:
+                endpoint._invoke(frame["method"], frame["args"],
+                                 frame["kwargs"], frame.get("token"))
+            except RpcException:
+                pass
+            return
+        try:
+            fut = endpoint._invoke(frame["method"], frame["args"],
+                                   frame["kwargs"], frame.get("token"))
+        except RpcException as e:
+            reply("error", e)
+            return
+
+        def on_done(f: RpcFuture):
+            if f._error is not None:
+                reply("error", f._error)
+            else:
+                reply("result", f._result)
+
+        fut.on_complete(on_done)
+
+    # -- client side --------------------------------------------------
+    def connect(self, address: str, endpoint_name: str,
+                token: Any = None, timeout: float = 10.0) -> "RpcGateway":
+        return RpcGateway(self._client(address), endpoint_name, token,
+                          timeout)
+
+    def _client(self, address: str) -> "_ClientConnection":
+        with self._lock:
+            client = self._clients.get(address)
+            if client is None or client.dead:
+                client = _ClientConnection(address)
+                self._clients[address] = client
+            return client
+
+    def stop(self) -> None:
+        self._running = False
+        with self._lock:
+            endpoints = list(self._endpoints.values())
+            clients = list(self._clients.values())
+            self._clients.clear()
+        for ep in endpoints:
+            ep.stop()
+        for c in clients:
+            c.close()
+        try:
+            self._server.close()
+        except OSError:
+            pass
+
+
+class _ClientConnection:
+    """One multiplexed TCP connection to a remote RpcService; pending
+    calls matched to responses by id."""
+
+    def __init__(self, address: str):
+        host, port = address.rsplit(":", 1)
+        self.address = address
+        self._sock = socket.create_connection((host, int(port)), timeout=10.0)
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._sock.settimeout(None)
+        self._write_lock = threading.Lock()
+        self._pending: Dict[int, RpcFuture] = {}
+        self._pending_lock = threading.Lock()
+        self._ids = itertools.count(1)
+        self.dead = False
+        self._reader = threading.Thread(target=self._read_loop, daemon=True,
+                                        name=f"rpc-client-{address}")
+        self._reader.start()
+
+    def call(self, endpoint: str, method: str, args, kwargs, token,
+             oneway: bool = False) -> Optional[RpcFuture]:
+        call_id = next(self._ids)
+        frame = {"kind": "call", "id": call_id, "endpoint": endpoint,
+                 "method": method, "args": args, "kwargs": kwargs,
+                 "token": token, "oneway": oneway}
+        future: Optional[RpcFuture] = None
+        if not oneway:
+            future = RpcFuture()
+            with self._pending_lock:
+                self._pending[call_id] = future
+        try:
+            with self._write_lock:
+                send_frame(self._sock, frame)
+        except OSError as e:
+            self._fail_all(RpcException(f"connection to {self.address} "
+                                        f"lost: {e}"))
+            if future is not None:
+                return future
+            raise RpcException(str(e)) from e
+        return future
+
+    def _read_loop(self) -> None:
+        try:
+            while True:
+                frame = recv_frame(self._sock)
+                if frame is None:
+                    break
+                with self._pending_lock:
+                    future = self._pending.pop(frame.get("id"), None)
+                if future is None:
+                    continue
+                if frame["kind"] == "error":
+                    future.fail(frame["payload"])
+                else:
+                    future.complete(frame["payload"])
+        except (OSError, EOFError):
+            pass
+        finally:
+            self._fail_all(RpcException(
+                f"connection to {self.address} closed"))
+
+    def _fail_all(self, error: BaseException) -> None:
+        self.dead = True
+        with self._pending_lock:
+            pending, self._pending = self._pending, {}
+        for fut in pending.values():
+            fut.fail(error)
+
+    def close(self) -> None:
+        self.dead = True
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+class RpcGateway:
+    """Dynamic proxy: `gateway.method(*a)` → RpcFuture;
+    `gateway.sync.method(*a)` → blocking result;
+    `gateway.tell.method(*a)` → fire-and-forget
+    (ref: AkkaInvocationHandler ask/tell)."""
+
+    def __init__(self, client: _ClientConnection, endpoint: str,
+                 token: Any, timeout: float):
+        self._client = client
+        self._endpoint = endpoint
+        self._token = token
+        self._timeout = timeout
+
+    @property
+    def sync(self) -> "_SyncProxy":
+        return _SyncProxy(self)
+
+    @property
+    def tell(self) -> "_TellProxy":
+        return _TellProxy(self)
+
+    @property
+    def alive(self) -> bool:
+        return not self._client.dead
+
+    def __getattr__(self, method: str):
+        if method.startswith("_"):
+            raise AttributeError(method)
+
+        def invoke(*args, **kwargs) -> RpcFuture:
+            return self._client.call(self._endpoint, method, args, kwargs,
+                                     self._token)
+
+        return invoke
+
+
+class _SyncProxy:
+    def __init__(self, gw: RpcGateway):
+        self._gw = gw
+
+    def __getattr__(self, method: str):
+        if method.startswith("_"):
+            raise AttributeError(method)
+
+        def invoke(*args, **kwargs):
+            fut = self._gw._client.call(self._gw._endpoint, method, args,
+                                        kwargs, self._gw._token)
+            return fut.get(self._gw._timeout)
+
+        return invoke
+
+
+class _TellProxy:
+    def __init__(self, gw: RpcGateway):
+        self._gw = gw
+
+    def __getattr__(self, method: str):
+        if method.startswith("_"):
+            raise AttributeError(method)
+
+        def invoke(*args, **kwargs) -> None:
+            self._gw._client.call(self._gw._endpoint, method, args, kwargs,
+                                  self._gw._token, oneway=True)
+
+        return invoke

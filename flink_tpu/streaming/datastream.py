@@ -108,6 +108,8 @@ class StreamExecutionEnvironment:
         self.mesh_axis = "kg"
         #: None → LocalExecutor; int n → MiniCluster with n workers
         self.num_task_managers: Optional[int] = None
+        #: "host:port" of a running Dispatcher → RemoteExecutor
+        self.remote_address: Optional[str] = None
         self._last_executor = None
         self._executed = False
 
@@ -165,6 +167,16 @@ class StreamExecutionEnvironment:
         (flink_tpu.runtime.minicluster) instead of the single-loop
         LocalExecutor (ref: MiniCluster.java — multi-TM in one JVM)."""
         self.num_task_managers = num_task_managers
+        return self
+
+    def use_remote_cluster(self, jm_address: str
+                           ) -> "StreamExecutionEnvironment":
+        """Submit to a running cluster's Dispatcher at
+        "host:port" (ref: RemoteStreamEnvironment /
+        ClusterClient.run — flink_tpu.runtime.cluster).  The job graph
+        is cloudpickled and shipped via the blob server; results come
+        back through accumulators."""
+        self.remote_address = jm_address
         return self
 
     def set_restart_strategy(self, strategy: str, **kw) -> "StreamExecutionEnvironment":
@@ -239,7 +251,11 @@ class StreamExecutionEnvironment:
             latency_interval_ms=getattr(self, "latency_tracking_interval",
                                         None),
         )
-        if self.num_task_managers is not None:
+        if self.remote_address is not None:
+            from flink_tpu.runtime.cluster import RemoteExecutor
+            kw.pop("processing_time_service", None)
+            self._last_executor = RemoteExecutor(self.remote_address, **kw)
+        elif self.num_task_managers is not None:
             from flink_tpu.runtime.minicluster import MiniCluster
             self._last_executor = MiniCluster(
                 num_task_managers=self.num_task_managers, **kw)
@@ -299,7 +315,11 @@ class DataStream:
                 key_selector=None, type_number: int = 0,
                 extra_inputs: Optional[List["DataStream"]] = None,
                 chaining: str = "always") -> "DataStream":
-        p = parallelism if parallelism is not None else self.node.parallelism
+        # default = the ENVIRONMENT parallelism (ref: every
+        # StreamTransformation is created with env.getParallelism and
+        # overridden per-operator via setParallelism), not the upstream
+        # node's — matching StreamExecutionEnvironment.setParallelism
+        p = parallelism if parallelism is not None else self.env.parallelism
         node = self.env.graph.add_node(StreamNode(
             self.env.graph.new_node_id(), name, operator_factory,
             parallelism=p,

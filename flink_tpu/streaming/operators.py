@@ -270,8 +270,15 @@ class AbstractUdfStreamOperator(StreamOperator):
 
     def setup(self, *args, **kwargs):
         super().setup(*args, **kwargs)
-        if (self.COPY_UDF_PER_SUBTASK and self.num_subtasks > 1
-                and isinstance(self.user_function, RichFunction)):
+        # EVERY function is per-subtask at parallelism > 1, not just
+        # RichFunctions — the reference deserializes a fresh instance
+        # per task, and any stateful function (e.g. a periodic
+        # watermark assigner's running max) silently corrupts its
+        # siblings when shared across worker threads.  Sinks opt out
+        # (COPY_UDF_PER_SUBTASK=False): tests/drivers read a shared
+        # CollectSink buffer, and accumulator gathering dedupes by
+        # instance.
+        if self.COPY_UDF_PER_SUBTASK and self.num_subtasks > 1:
             import copy
             self.user_function = copy.deepcopy(self.user_function)
 
@@ -351,6 +358,11 @@ class StreamFilter(AbstractUdfStreamOperator):
 
 class StreamSink(AbstractUdfStreamOperator):
     """(ref: StreamSink.java) — user_function is a SinkFunction."""
+
+    #: parallel sink subtasks in one process share the instance:
+    #: tests/drivers read a CollectSink's buffer directly, and
+    #: accumulator gathering dedupes by instance identity
+    COPY_UDF_PER_SUBTASK = False
 
     def process_element(self, record):
         self.user_function.invoke(record.value,

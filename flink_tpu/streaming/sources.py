@@ -322,13 +322,23 @@ class FileTextSource(SourceFunction):
 # ---------------------------------------------------------------------
 
 class CollectSink(SinkFunction):
-    """Accumulates into a shared list (test/driver use)."""
+    """Accumulates into a shared list (test/driver use).  On a
+    distributed cluster the sink instance lives in a TaskExecutor
+    process, so the collected values travel back through the
+    accumulator channel (ref: DataStreamUtils.collect /
+    accumulator-backed collect in the reference); they land in
+    `JobExecutionResult.accumulators[accumulator_name]`."""
 
-    def __init__(self, target: Optional[List[Any]] = None):
+    def __init__(self, target: Optional[List[Any]] = None,
+                 accumulator_name: str = "collected"):
         self.values: List[Any] = target if target is not None else []
+        self.accumulator_name = accumulator_name
 
     def invoke(self, value, context=None):
         self.values.append(value)
+
+    def accumulators(self):
+        return {self.accumulator_name: list(self.values)}
 
 
 class PrintSink(SinkFunction):

@@ -115,6 +115,26 @@ class PolledProcessingTimeService(ProcessingTimeService):
             fired += 1
         return fired
 
+    def fire_all_pending(self) -> None:
+        """End-of-input drain for finite jobs: fire every timer
+        registered at entry regardless of wall clock, bounded by the
+        entry horizon so self-re-arming timers (continuous triggers)
+        terminate — same contract as TestProcessingTimeService."""
+        with self._lock:
+            if not self._queue:
+                return
+            horizon = max(ts for ts, _, _ in self._queue)
+        while True:
+            with self._lock:
+                if not self._queue or self._queue[0][0] > horizon:
+                    return
+                ts, _, cb = heapq.heappop(self._queue)
+            cb(ts)
+
+    def has_pending(self) -> bool:
+        with self._lock:
+            return bool(self._queue)
+
 
 class TestProcessingTimeService(ProcessingTimeService):
     """Manually advanced clock for harness tests

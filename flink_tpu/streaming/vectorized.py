@@ -39,12 +39,26 @@ from flink_tpu.ops.hashing import split_hash64_np
 def hash_keys_np(keys) -> np.ndarray:
     """Vectorized stable 64-bit key hashing: integer arrays go through
     splitmix64 in one numpy pass; object arrays fall back to per-key
-    stable_hash64 (paid once per record batch, not per state access)."""
+    stable_hash64 (paid once per record batch, not per state access).
+    Uniform numeric TUPLES (composite keys / distinct-count over
+    composites) arrive as a 2-D array — per-column hashes combine
+    order-sensitively into one 64-bit hash per row."""
     arr = np.asarray(keys)
+    if arr.dtype.kind == "f" and arr.size \
+            and np.all(arr == arr.astype(np.int64)):
+        arr = arr.astype(np.int64)
     if arr.dtype.kind in "iu":
-        return splitmix64_np(arr.astype(np.uint64))
-    if arr.dtype.kind == "f" and np.all(arr == arr.astype(np.int64)):
-        return splitmix64_np(arr.astype(np.int64).astype(np.uint64))
+        if arr.ndim == 1:
+            return splitmix64_np(arr.astype(np.uint64))
+        h = np.zeros(len(arr), np.uint64)
+        for j in range(arr.shape[1]):
+            h = splitmix64_np(
+                h ^ splitmix64_np(arr[:, j].astype(np.uint64))
+                ^ np.uint64(0x9E3779B97F4A7C15 * (j + 1) & (2**64 - 1)))
+        return h
+    if arr.ndim > 1:
+        return np.fromiter((stable_hash64(tuple(r)) for r in arr),
+                           dtype=np.uint64, count=len(arr))
     return np.fromiter((stable_hash64(k) for k in arr),
                        dtype=np.uint64, count=len(arr))
 

@@ -272,6 +272,7 @@ def test_parallel_rich_function_gets_own_subtask_context():
     log = InMemoryPartitionedLog(4)
     _fill_log(log, 100)
     env = StreamExecutionEnvironment()
+    env.set_parallelism(2)  # operators default to the env parallelism
     (env.add_source(ReplayableLogSource(log, bounded=True), parallelism=2)
         .map(IndexRecorder())  # parallelism 2, chained with the source
         .add_sink(CollectSink()))

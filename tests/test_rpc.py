@@ -1,0 +1,128 @@
+"""RPC framework tests (ref: the RpcEndpoint/AkkaRpcService contracts,
+flink-runtime/src/test/.../rpc/RpcEndpointTest.java et al.)."""
+
+import threading
+import time
+
+import pytest
+
+from flink_tpu.runtime.rpc import (
+    FencedRpcEndpoint,
+    FencingTokenException,
+    RpcEndpoint,
+    RpcException,
+    RpcService,
+    RpcTimeoutException,
+)
+
+
+class Counter(RpcEndpoint):
+    def __init__(self, name="counter"):
+        super().__init__(name)
+        self.value = 0
+        self.thread_ids = set()
+
+    def add(self, n):
+        self.validate_main_thread()
+        self.thread_ids.add(threading.get_ident())
+        self.value += n
+        return self.value
+
+    def get(self):
+        return self.value
+
+    def boom(self):
+        raise ValueError("intentional")
+
+    def slow(self, seconds):
+        time.sleep(seconds)
+        return "done"
+
+
+@pytest.fixture
+def service():
+    svc = RpcService()
+    yield svc
+    svc.stop()
+
+
+def test_local_roundtrip_and_single_thread(service):
+    ep = Counter()
+    service.start_server(ep)
+    gw = service.connect(service.address, "counter")
+    futures = [gw.add(1) for _ in range(50)]
+    results = [f.get(5.0) for f in futures]
+    # every invocation ran on ONE main thread, in order
+    assert ep.value == 50
+    assert len(ep.thread_ids) == 1
+    assert sorted(results) == list(range(1, 51))
+
+
+def test_sync_proxy_and_exception_propagation(service):
+    service.start_server(Counter())
+    gw = service.connect(service.address, "counter")
+    assert gw.sync.add(5) == 5
+    with pytest.raises(ValueError, match="intentional"):
+        gw.sync.boom()
+    # the endpoint survives a handler exception
+    assert gw.sync.add(1) == 6
+
+
+def test_unknown_endpoint_and_method(service):
+    service.start_server(Counter())
+    gw = service.connect(service.address, "nope")
+    with pytest.raises(RpcException):
+        gw.sync.add(1)
+    gw2 = service.connect(service.address, "counter")
+    with pytest.raises(RpcException, match="no such method"):
+        gw2.sync.missing()
+
+
+def test_timeout(service):
+    service.start_server(Counter())
+    gw = service.connect(service.address, "counter", timeout=0.2)
+    with pytest.raises(RpcTimeoutException):
+        gw.slow(2.0).get(0.2)
+
+
+def test_tell_fire_and_forget(service):
+    ep = Counter()
+    service.start_server(ep)
+    gw = service.connect(service.address, "counter")
+    gw.tell.add(7)
+    deadline = time.monotonic() + 5.0
+    while ep.value != 7 and time.monotonic() < deadline:
+        time.sleep(0.005)
+    assert ep.value == 7
+
+
+def test_fencing(service):
+    class Fenced(FencedRpcEndpoint):
+        def touch(self):
+            return "ok"
+
+    service.start_server(Fenced("fenced", token="leader-1"))
+    good = service.connect(service.address, "fenced", token="leader-1")
+    assert good.sync.touch() == "ok"
+    stale = service.connect(service.address, "fenced", token="leader-0")
+    with pytest.raises(FencingTokenException):
+        stale.sync.touch()
+
+
+def test_cross_service(service):
+    """Two services (processes-in-miniature) talking over TCP."""
+    other = RpcService()
+    try:
+        other.start_server(Counter("remote-counter"))
+        gw = service.connect(other.address, "remote-counter")
+        assert gw.sync.add(3) == 3
+    finally:
+        other.stop()
+
+
+def test_run_async_schedules_on_main_thread(service):
+    ep = Counter()
+    service.start_server(ep)
+    fut = ep.run_async(ep.add, 9)
+    assert fut.get(5.0) == 9
+    assert len(ep.thread_ids) == 1
