@@ -30,13 +30,23 @@ Layer map (mirrors SURVEY.md §1):
               (ref: flink-libraries/flink-cep)
   batch/      DataSet API + plan optimizer (ref: flink-java /
               flink-optimizer)
+  graph/      graph library: Graph API, scatter-gather/GSA/pregel
+              supersteps as jitted segment ops, PageRank/CC/SSSP/
+              triangles/label-propagation/HITS (ref: flink-gelly)
+  ml/         ML pipelines: scalers, linear regression, SVM, KNN, ALS,
+              distance metrics — fits as jitted device loops
+              (ref: flink-libraries/flink-ml)
   connectors/ sources/sinks             (ref: flink-connectors)
   native/     C++ host runtime: hashing, slot index, compiled
               baselines (ref: the rocksdbjni native role, §2.2)
 
-Plus: cli.py (`python -m flink_tpu run|info|bench`, ref: CliFrontend),
-runtime/rest.py (web monitor), runtime/queryable.py (queryable state
-client), examples/ (runnable quickstarts incl. SocketWindowWordCount).
+Plus: cli.py (`python -m flink_tpu run|info|bench|jobmanager|
+taskmanager`, ref: CliFrontend + cluster entrypoints), runtime/rpc.py +
+runtime/netchannel.py + runtime/cluster.py (distributed control plane:
+Dispatcher/JobMaster/ResourceManager/TaskExecutor over TCP with
+credit-based data-plane flow control), runtime/rest.py (web monitor),
+runtime/queryable.py (queryable state client), examples/ (runnable
+quickstarts incl. SocketWindowWordCount).
 """
 
 __version__ = "0.1.0"

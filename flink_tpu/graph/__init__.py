@@ -1,0 +1,34 @@
+"""Graph processing library (the flink-gelly analogue,
+flink-libraries/flink-gelly/: Graph.java, spargel/ scatter-gather,
+gsa/ gather-sum-apply, pregel/ vertex-centric, library/ algorithms),
+re-designed TPU-first: the reference iterates per-vertex user
+functions over DataSet delta iterations; here a graph is dense arrays
+(vertex ids -> contiguous indices, edges as (src, dst, value)
+columns) and one superstep is a jitted `segment_*` propagation over
+every edge at once — the message passing that Gelly does record-by-
+record through the batch runtime becomes a single device gather +
+segment-combine per superstep."""
+
+from flink_tpu.graph.graph import Edge, Graph, Vertex
+from flink_tpu.graph.iterations import (
+    GatherSumApplyIteration,
+    PregelIteration,
+    ScatterGatherIteration,
+)
+from flink_tpu.graph.library import (
+    CommunityDetection,
+    ConnectedComponents,
+    HITS,
+    LabelPropagation,
+    PageRank,
+    SingleSourceShortestPaths,
+    TriangleCount,
+)
+
+__all__ = [
+    "Edge", "Graph", "Vertex",
+    "ScatterGatherIteration", "GatherSumApplyIteration",
+    "PregelIteration",
+    "PageRank", "ConnectedComponents", "SingleSourceShortestPaths",
+    "TriangleCount", "LabelPropagation", "CommunityDetection", "HITS",
+]

@@ -1,0 +1,142 @@
+"""Graph iteration models, device-vectorized.
+
+The reference's three models (flink-libraries/flink-gelly/):
+
+- scatter-gather (spargel/ScatterGatherIteration.java): per superstep,
+  each vertex SCATTERS messages along its edges, then each vertex
+  GATHERS its messages and updates its value;
+- gather-sum-apply (gsa/GatherSumApplyIteration.java): GATHER a value
+  per edge, SUM per target vertex, APPLY to update;
+- pregel (pregel/VertexCentricIteration.java): compute function sees
+  the vertex + combined messages, emits new value + messages.
+
+All three are message-combine-update loops, which is exactly one
+`gather(values, src) -> combine-by-dst (segment_min/sum/max) ->
+elementwise update` on dense arrays.  The reference runs them as
+DataSet delta iterations with per-record UDF calls; here one
+superstep is ONE jitted device program over every edge (the MXU/VPU
+replaces the per-vertex call), and convergence ("no vertex changed")
+is the delta-iteration empty-workset condition, checked with a device
+reduction.
+
+User functions are EDGE-WISE NUMERIC callables on arrays —
+`gather(src_values, edge_values)`, `apply(old, combined)` — composed
+into the jitted step; `combine` picks the segment reduction
+("sum" | "min" | "max").
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _segment_combine(kind: str):
+    if kind == "sum":
+        return jax.ops.segment_sum
+    if kind == "min":
+        return jax.ops.segment_min
+    if kind == "max":
+        return jax.ops.segment_max
+    raise ValueError(f"unknown combine {kind!r}")
+
+
+class GatherSumApplyIteration:
+    """(ref: gsa/GatherSumApplyIteration.java)  One superstep =
+    gather per edge -> segment-combine per target -> apply per vertex;
+    runs until values stop changing or max_iterations.
+
+    Vertices with no in-edges receive the segment reduction's identity
+    (0 for sum, the dtype max/min for min/max) as their combined
+    message — `apply` must treat that as "no message" (the library
+    algorithms all use monotone applies like `minimum(old, combined)`,
+    which do)."""
+
+    def __init__(self, gather: Callable, combine: str, apply: Callable,
+                 max_iterations: int = 100):
+        self.gather = gather
+        self.combine = combine
+        self.apply = apply
+        self.max_iterations = max_iterations
+
+    def run_arrays(self, values: np.ndarray, src: np.ndarray,
+                   dst: np.ndarray, edge_values: np.ndarray) -> np.ndarray:
+        n = len(values)
+        seg = _segment_combine(self.combine)
+        gather, apply = self.gather, self.apply
+
+        @jax.jit
+        def step(vals, src, dst, ev):
+            msgs = gather(vals[src], ev)
+            combined = seg(msgs, dst, num_segments=n)
+            new = apply(vals, combined)
+            changed = jnp.any(new != vals)
+            return new, changed
+
+        vals = jnp.asarray(values)
+        src_j = jnp.asarray(src)
+        dst_j = jnp.asarray(dst)
+        ev_j = jnp.asarray(edge_values)
+        for _ in range(self.max_iterations):
+            vals, changed = step(vals, src_j, dst_j, ev_j)
+            if not bool(changed):
+                break
+        return np.asarray(vals)
+
+    def run(self, graph):
+        new_vals = self.run_arrays(
+            np.asarray(graph.vertex_values), graph.edge_src,
+            graph.edge_dst, graph.edge_values)
+        from flink_tpu.graph.graph import Graph
+        return Graph(graph.vertex_ids, new_vals, graph.edge_src,
+                     graph.edge_dst, graph.edge_values)
+
+
+class ScatterGatherIteration(GatherSumApplyIteration):
+    """(ref: spargel/ScatterGatherIteration.java)  The scatter-gather
+    model reduces to gather-sum-apply on the reversed message
+    direction: `scatter(vertex, edge)` producing the message is the
+    gather callable here."""
+
+
+class PregelIteration:
+    """(ref: pregel/VertexCentricIteration.java)  compute(vals,
+    combined_messages, superstep) -> (new_vals, messages_per_edge
+    callable).  Simplified vertex-centric form: the message a vertex
+    sends along each out-edge is a function of its value and the edge
+    value; halting = values unchanged."""
+
+    def __init__(self, message: Callable, combine: str, compute: Callable,
+                 max_iterations: int = 100):
+        self.message = message
+        self.combine = combine
+        self.compute = compute
+        self.max_iterations = max_iterations
+
+    def run(self, graph):
+        n = graph.number_of_vertices()
+        seg = _segment_combine(self.combine)
+        message, compute = self.message, self.compute
+
+        @jax.jit
+        def step(vals, src, dst, ev, superstep):
+            msgs = message(vals[src], ev)
+            combined = seg(msgs, dst, num_segments=n)
+            new = compute(vals, combined, superstep)
+            return new, jnp.any(new != vals)
+
+        vals = jnp.asarray(np.asarray(graph.vertex_values))
+        src = jnp.asarray(graph.edge_src)
+        dst = jnp.asarray(graph.edge_dst)
+        ev = jnp.asarray(graph.edge_values)
+        for superstep in range(self.max_iterations):
+            vals, changed = step(vals, src, dst, ev,
+                                 jnp.int32(superstep))
+            if not bool(changed):
+                break
+        from flink_tpu.graph.graph import Graph
+        return Graph(graph.vertex_ids, np.asarray(vals), graph.edge_src,
+                     graph.edge_dst, graph.edge_values)

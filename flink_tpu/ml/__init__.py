@@ -1,0 +1,37 @@
+"""Machine-learning library (the flink-ml analogue,
+flink-libraries/flink-ml/src/main/scala/org/apache/flink/ml/:
+pipeline/ Estimator-Transformer-Predictor, preprocessing/
+StandardScaler MinMaxScaler PolynomialFeatures, regression/
+MultipleLinearRegression, classification/ SVM + KNN, recommendation/
+ALS, optimization/ GradientDescent, metrics/ distances) —
+re-designed TPU-first: the reference trains with per-record DataSet
+iterations; here every fit is a jitted full-batch device program
+(gradient steps and normal-equation solves are MXU matmuls)."""
+
+from flink_tpu.ml.pipeline import Estimator, Pipeline, Predictor, Transformer
+from flink_tpu.ml.preprocessing import (
+    MinMaxScaler,
+    PolynomialFeatures,
+    StandardScaler,
+)
+from flink_tpu.ml.regression import MultipleLinearRegression
+from flink_tpu.ml.classification import KNN, SVM
+from flink_tpu.ml.recommendation import ALS
+from flink_tpu.ml.metrics import (
+    chebyshev_distance,
+    cosine_distance,
+    euclidean_distance,
+    manhattan_distance,
+    minkowski_distance,
+    squared_euclidean_distance,
+    tanimoto_distance,
+)
+
+__all__ = [
+    "Estimator", "Transformer", "Predictor", "Pipeline",
+    "StandardScaler", "MinMaxScaler", "PolynomialFeatures",
+    "MultipleLinearRegression", "SVM", "KNN", "ALS",
+    "euclidean_distance", "squared_euclidean_distance",
+    "cosine_distance", "chebyshev_distance", "manhattan_distance",
+    "minkowski_distance", "tanimoto_distance",
+]

@@ -1,0 +1,88 @@
+"""Pipeline abstractions (ref: flink-ml pipeline/Estimator.scala,
+Transformer.scala, Predictor.scala, and the chainable pipeline built
+by `transformer.chainTransformer(...)` / `chainPredictor(...)`).
+
+Data is numpy arrays (features [n, d]; labels [n]) — the DataSet[
+LabeledVector] of the reference collapsed to columns, ready for
+device programs."""
+
+from __future__ import annotations
+
+import abc
+from typing import Any, List, Optional, Tuple
+
+import numpy as np
+
+
+class Transformer(abc.ABC):
+    """fit(X) learns transformation state; transform(X) applies it."""
+
+    def fit(self, X, y=None) -> "Transformer":  # noqa: B027
+        return self
+
+    @abc.abstractmethod
+    def transform(self, X) -> np.ndarray: ...
+
+    def fit_transform(self, X, y=None) -> np.ndarray:
+        return self.fit(X, y).transform(X)
+
+    def chain_transformer(self, other: "Transformer") -> "Pipeline":
+        return Pipeline([self, other])
+
+    def chain_predictor(self, predictor: "Predictor") -> "Pipeline":
+        return Pipeline([self, predictor])
+
+
+class Estimator(abc.ABC):
+    @abc.abstractmethod
+    def fit(self, X, y=None) -> Any: ...
+
+
+class Predictor(Estimator):
+    """fit(X, y) trains; predict(X) scores."""
+
+    @abc.abstractmethod
+    def predict(self, X) -> np.ndarray: ...
+
+
+class Pipeline(Predictor, Transformer):
+    """Chained transformers with an optional terminal predictor
+    (ref: the ChainedTransformer/ChainedPredictor pair)."""
+
+    def __init__(self, stages: List[Any]):
+        self.stages = list(stages)
+
+    def fit(self, X, y=None) -> "Pipeline":
+        data = np.asarray(X)
+        for i, stage in enumerate(self.stages):
+            last = i == len(self.stages) - 1
+            if isinstance(stage, Transformer):
+                data = stage.fit(data, y).transform(data)
+            elif last:
+                stage.fit(data, y)
+            else:
+                raise TypeError(
+                    "non-terminal pipeline stages must be Transformers")
+        return self
+
+    def _apply_transformers(self, X) -> Tuple[np.ndarray, Optional[Any]]:
+        data = np.asarray(X)
+        terminal = None
+        for i, stage in enumerate(self.stages):
+            if isinstance(stage, Transformer) and not (
+                    i == len(self.stages) - 1
+                    and isinstance(stage, Predictor)):
+                data = stage.transform(data)
+            else:
+                terminal = stage
+        return data, terminal
+
+    def transform(self, X) -> np.ndarray:
+        data, _ = self._apply_transformers(X)
+        return data
+
+    def predict(self, X) -> np.ndarray:
+        data, terminal = self._apply_transformers(X)
+        if terminal is None or not isinstance(terminal, Predictor):
+            raise TypeError("pipeline has no terminal predictor")
+        return terminal.predict(data)
