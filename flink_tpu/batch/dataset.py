@@ -188,12 +188,27 @@ class DataSet:
     def rebalance(self) -> "DataSet":
         return self._derive("rebalance", lambda ins: ins[0])
 
+    #: records above which sort_partition spills through the external
+    #: sorter (the managed-memory budget analogue)
+    SORT_MEMORY_BUDGET = 1 << 20
+
     def sort_partition(self, key_selector, ascending: bool = True) -> "DataSet":
         ks = as_key_selector(key_selector)
-        return self._derive(
-            "sort_partition",
-            lambda ins: sorted(ins[0], key=ks.get_key,
-                               reverse=not ascending))
+        budget = self.SORT_MEMORY_BUDGET
+
+        def run(ins):
+            data = ins[0]
+            if len(data) <= budget:
+                return sorted(data, key=ks.get_key, reverse=not ascending)
+            # beyond the memory budget: external merge sort with
+            # spilled runs (flink_tpu.batch.sorter — the
+            # UnilateralSortMerger analogue)
+            from flink_tpu.batch.sorter import external_sorted
+            return external_sorted(data, key=ks.get_key,
+                                   reverse=not ascending,
+                                   memory_budget=budget)
+
+        return self._derive("sort_partition", run)
 
     def first(self, n: int) -> "DataSet":
         return self._derive("first", lambda ins: ins[0][:n], size=n)

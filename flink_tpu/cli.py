@@ -73,8 +73,11 @@ def _jobmanager(rest) -> int:
     ap = argparse.ArgumentParser(prog="flink_tpu jobmanager")
     ap.add_argument("--host", default="127.0.0.1")
     ap.add_argument("--port", type=int, default=6123)
+    ap.add_argument("--archive-dir", default=None,
+                    help="archive finished jobs here (history server)")
     args = ap.parse_args(rest)
-    jm = JobManagerProcess(args.host, args.port)
+    jm = JobManagerProcess(args.host, args.port,
+                           archive_dir=args.archive_dir)
     print(f"jobmanager listening at {jm.address}", flush=True)
     try:
         while True:

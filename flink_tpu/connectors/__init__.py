@@ -1,6 +1,7 @@
 """Connectors: replayable sources and transactional sinks
-(the flink-connectors/ tier, reduced to the Kafka-shaped contract the
-framework's exactly-once story runs through)."""
+(the flink-connectors/ tier: the Kafka-shaped partitioned-log contract
+the framework's exactly-once story runs through, plus the exactly-once
+bucketing filesystem sink of flink-connector-filesystem)."""
 
 from flink_tpu.connectors.partitioned_log import (
     FilePartitionedLog,
@@ -11,6 +12,7 @@ from flink_tpu.connectors.log_connector import (
     ReplayableLogSource,
     TransactionalLogSink,
 )
+from flink_tpu.connectors.bucketing_sink import BucketingFileSink
 
 __all__ = [
     "FilePartitionedLog",
@@ -18,4 +20,5 @@ __all__ = [
     "PartitionedLog",
     "ReplayableLogSource",
     "TransactionalLogSink",
+    "BucketingFileSink",
 ]

@@ -23,6 +23,13 @@ import numpy as np
 T = TypeVar("T")
 
 
+class StateMigrationException(Exception):
+    """A restored state's recorded serializer configuration is not
+    readable by the currently registered serializer (ref:
+    flink-runtime/.../state/StateMigrationException.java + the
+    TypeSerializerConfigSnapshot compatibility contract)."""
+
+
 class TypeSerializer(Generic[T], abc.ABC):
     """(ref: flink-core/.../typeutils/TypeSerializer.java)"""
 

@@ -26,7 +26,9 @@ from __future__ import annotations
 import os
 import pickle
 import shutil
+import threading
 import time as _time
+from collections import deque
 from typing import Any, Callable, Dict, List, Optional, Set, Tuple
 
 
@@ -193,6 +195,56 @@ class CheckpointStats:
         return self.complete_ms - self.trigger_ms
 
 
+class SavepointRequest:
+    """A user-triggered savepoint (ref: savepoint/SavepointV2.java +
+    the `flink savepoint [-d]` / `cancel -s` CLI verbs).  Completed
+    savepoints are written OUTSIDE the retained-checkpoint rotation, to
+    `directory/savepoint-<id>`; the caller blocks on `wait()`."""
+
+    def __init__(self, directory: str):
+        self.directory = directory
+        self._event = threading.Event()
+        self.path: Optional[str] = None
+        self.error: Optional[BaseException] = None
+
+    def complete(self, path: str) -> None:
+        self.path = path
+        self._event.set()
+
+    def fail(self, error: BaseException) -> None:
+        self.error = error
+        self._event.set()
+
+    def wait(self, timeout: Optional[float] = None) -> str:
+        if not self._event.wait(timeout):
+            raise TimeoutError("savepoint did not complete in time")
+        if self.error is not None:
+            raise self.error
+        return self.path
+
+
+def write_savepoint(directory: str, checkpoint_id: int, metadata: dict,
+                    task_snapshots: Dict[Tuple[int, int], dict],
+                    parallelisms: Dict[int, int]) -> str:
+    """Atomic single-file savepoint: {checkpoint_id, metadata, tasks,
+    parallelisms} — parallelisms (vertex_id -> subtask count at
+    snapshot time) let restore detect rescale."""
+    os.makedirs(directory, exist_ok=True)
+    path = os.path.join(directory, f"savepoint-{checkpoint_id}")
+    payload = {"checkpoint_id": checkpoint_id, "metadata": metadata,
+               "tasks": task_snapshots, "parallelisms": parallelisms}
+    tmp = path + ".part"
+    with open(tmp, "wb") as f:
+        pickle.dump(payload, f, protocol=pickle.HIGHEST_PROTOCOL)
+    os.replace(tmp, path)
+    return path
+
+
+def load_savepoint(path: str) -> dict:
+    with open(path, "rb") as f:
+        return pickle.load(f)
+
+
 class CheckpointCoordinator:
     """Periodic barrier-checkpoint driver (ref:
     CheckpointCoordinator.java).  `trigger_sources` is a callback that
@@ -229,15 +281,32 @@ class CheckpointCoordinator:
         self.stats: Dict[int, CheckpointStats] = {}
         self.STATS_RETAIN = 128
         self.stopped = False
+        #: queued SavepointRequests (thread-safe append from clients)
+        self._savepoint_queue: deque = deque()
+        #: in-flight savepoint checkpoints: cid -> request
+        self._savepoint_cids: Dict[int, SavepointRequest] = {}
+        #: vertex_id -> parallelism, recorded into savepoints
+        self.vertex_parallelisms: Dict[int, int] = {}
 
     # ---- trigger ----------------------------------------------------
     def maybe_trigger(self) -> Optional[int]:
         """Called from the executor loop; triggers when the interval has
         elapsed (ref: the coordinator's ScheduledTrigger)."""
-        if self.stopped or self.interval_ms is None:
+        if self.stopped:
             return None
         now = self._clock()
         if len(self.pending) >= self.max_concurrent:
+            return None
+        # user savepoint requests bypass the periodic gating (ref:
+        # triggerSavepoint — props force a trigger regardless of timers)
+        if self._savepoint_queue:
+            request = self._savepoint_queue.popleft()
+            cid = self.trigger(savepoint=request)
+            if cid is None:
+                request.fail(RuntimeError(
+                    "savepoint declined: a source already finished"))
+            return cid
+        if self.interval_ms is None:
             return None
         if now - self._last_triggered_at < self.interval_ms:
             return None
@@ -245,7 +314,8 @@ class CheckpointCoordinator:
             return None
         return self.trigger()
 
-    def trigger(self) -> Optional[int]:
+    def trigger(self, savepoint: Optional[SavepointRequest] = None
+                ) -> Optional[int]:
         """(ref: triggerCheckpoint :394).  Returns None when sources
         refuse the trigger (e.g. a task already finished)."""
         self._id_counter += 1
@@ -257,12 +327,32 @@ class CheckpointCoordinator:
         self.stats[cid] = CheckpointStats(cid, now)
         for old in sorted(self.stats)[:-self.STATS_RETAIN]:
             del self.stats[old]
-        ok = self._trigger_sources(cid, int(now), {"mode": self.mode})
+        options = {"mode": self.mode}
+        if savepoint is not None:
+            # savepoints always use aligned exactly-once barriers
+            options = {"mode": "exactly_once", "savepoint": True}
+            self._savepoint_cids[cid] = savepoint
+        ok = self._trigger_sources(cid, int(now), options)
         if ok is False:
             del self.pending[cid]
             self.stats.pop(cid, None)
+            self._savepoint_cids.pop(cid, None)
             return None
         return cid
+
+    def trigger_savepoint(self, directory: str) -> SavepointRequest:
+        """Thread-safe entry for clients: the request is serviced on
+        the executor loop's next maybe_trigger."""
+        request = SavepointRequest(directory)
+        self._savepoint_queue.append(request)
+        return request
+
+    def fail_pending_savepoints(self, error: BaseException) -> None:
+        while self._savepoint_queue:
+            self._savepoint_queue.popleft().fail(error)
+        for req in self._savepoint_cids.values():
+            req.fail(error)
+        self._savepoint_cids.clear()
 
     # ---- acks -------------------------------------------------------
     def acknowledge(self, task_key: Tuple[int, int], checkpoint_id: int,
@@ -278,6 +368,10 @@ class CheckpointCoordinator:
     def decline(self, checkpoint_id: int) -> None:
         """(ref: CheckpointDeclineReason / abortDeclined)"""
         self.pending.pop(checkpoint_id, None)
+        req = self._savepoint_cids.pop(checkpoint_id, None)
+        if req is not None:
+            req.fail(RuntimeError(
+                "savepoint declined: a source already finished"))
 
     def abort_all_pending(self) -> None:
         self.pending.clear()
@@ -297,6 +391,18 @@ class CheckpointCoordinator:
         if st is not None:
             st.complete_ms = now
             st.state_bytes = state_bytes if state_bytes is not None else -1
+        req = self._savepoint_cids.pop(pc.checkpoint_id, None)
+        if req is not None:
+            try:
+                path = write_savepoint(
+                    req.directory, pc.checkpoint_id,
+                    {"timestamp": pc.timestamp, "savepoint": True},
+                    pc.acks, dict(self.vertex_parallelisms))
+                req.complete(path)
+            except Exception as e:  # noqa: BLE001 — IO or pickling:
+                # the waiting client must get the error, not a timeout,
+                # and the job must not fail over a savepoint write
+                req.fail(e)
         # commit signal (ref: notifyCheckpointComplete :883)
         self._notify_complete(pc.checkpoint_id)
 

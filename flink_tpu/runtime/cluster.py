@@ -64,7 +64,9 @@ from flink_tpu.runtime.local import (
     SubtaskInstance,
     SuppressRestartsException,
     _clone_partitioner,
+    compute_restore_assignments,
     gather_accumulators,
+    initial_restore_point,
     merge_accumulators,
 )
 from flink_tpu.runtime.metrics import MetricRegistry
@@ -258,10 +260,15 @@ class Dispatcher(RpcEndpoint):
     RPC_METHODS = ("submit_job", "request_job_status", "request_job_result",
                    "cancel_job", "list_jobs")
 
-    def __init__(self, rpc_service: RpcService, blob: BlobServer):
+    def __init__(self, rpc_service: RpcService, blob: BlobServer,
+                 archive_dir: Optional[str] = None):
         super().__init__(DISPATCHER)
         self._rpc = rpc_service
         self._blob = blob
+        #: finished jobs also archive to disk for the HistoryServer
+        #: (ref: FsJobArchivist wired into the dispatcher's terminal
+        #: path; key jobmanager.archive.fs.dir)
+        self.archive_dir = archive_dir
         self._masters: Dict[str, "JobMaster"] = {}
         #: terminal jobs: final status snapshots (the history-server
         #: retention tier — the live JobMaster endpoint/thread and the
@@ -284,9 +291,19 @@ class Dispatcher(RpcEndpoint):
         master = self._masters.pop(job_id, None)
         if master is None:
             return
-        self._archived[job_id] = master.status_snapshot()
+        snapshot = master.status_snapshot()
+        self._archived[job_id] = snapshot
         self._rpc.stop_server(master)
         self._blob.delete_blob(master.blob_key)
+        if self.archive_dir is not None:
+            from flink_tpu.runtime.history import FsJobArchivist
+            FsJobArchivist.archive(self.archive_dir, job_id, {
+                "job_name": snapshot.get("job_name"),
+                "state": snapshot.get("state"),
+                "restarts": snapshot.get("restarts"),
+                "checkpoints_completed":
+                    snapshot.get("checkpoints_completed"),
+            })
 
     def request_job_status(self, job_id: str) -> dict:
         master = self._masters.get(job_id)
@@ -403,7 +420,9 @@ class JobMaster(RpcEndpoint):
         restart = make_restart_strategy(
             cfg.get("restart_strategy") or {"strategy": "none"})
         rm = self._rpc.connect(cfg["rm_address"], RESOURCE_MANAGER)
-        restore_from = None
+        # execute-from-savepoint (env.set_savepoint_restore): the same
+        # entry the local executors honor, incl. rescale re-split
+        restore_from = initial_restore_point(self.job_graph)
         self.state = "RUNNING"
         try:
             while True:
@@ -474,7 +493,11 @@ class JobMaster(RpcEndpoint):
         source_tms = sorted({locations[(vid, i)]
                              for vid, v in jg.vertices.items() if v.is_source
                              for i in range(v.parallelism)})
-        task_snaps = restore_from["tasks"] if restore_from else None
+        restore_map = None
+        if restore_from is not None:
+            restore_map = compute_restore_assignments(
+                {vid: v.parallelism for vid, v in jg.vertices.items()},
+                restore_from)
 
         # deploy (Execution.deploy :488 → TaskExecutor.submitTask :383)
         cleanup_tms: List[dict] = []
@@ -483,10 +506,10 @@ class JobMaster(RpcEndpoint):
                 if not entry["assignments"]:
                     continue
                 restore = None
-                if task_snaps is not None:
-                    restore = {tk: task_snaps[tk]
+                if restore_map is not None:
+                    restore = {tk: restore_map[tk]
                                for tk in map(tuple, entry["assignments"])
-                               if tk in task_snaps}
+                               if tk in restore_map}
                 tdd = {
                     "job_id": self.job_id, "attempt": attempt,
                     "blob_key": self.blob_key,
@@ -862,10 +885,10 @@ class TaskExecutor(RpcEndpoint):
             st.open()
         restore = tdd.get("restore")
         if restore:
-            for tk, snap in restore.items():
+            for tk, snaps in restore.items():
                 st = att.by_key.get(tuple(tk))
                 if st is not None:
-                    st.restore([snap])
+                    st.restore(list(snaps))
 
         jm = att.jm_gateway
 
@@ -1057,11 +1080,12 @@ class JobManagerProcess:
     """ResourceManager + Dispatcher + BlobServer on one RpcService
     (the SessionClusterEntrypoint shape)."""
 
-    def __init__(self, bind_host: str = "127.0.0.1", port: int = 0):
+    def __init__(self, bind_host: str = "127.0.0.1", port: int = 0,
+                 archive_dir: Optional[str] = None):
         self.rpc = RpcService(bind_host, port)
         self.blob = BlobServer()
         self.resource_manager = ResourceManager(self.rpc)
-        self.dispatcher = Dispatcher(self.rpc, self.blob)
+        self.dispatcher = Dispatcher(self.rpc, self.blob, archive_dir)
         self.rpc.start_server(self.blob)
         self.rpc.start_server(self.resource_manager)
         self.rpc.start_server(self.dispatcher)

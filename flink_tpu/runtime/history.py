@@ -1,0 +1,143 @@
+"""History server: archive finished jobs, serve them after the fact.
+
+Rebuilds the reference's finished-job history pair
+(flink-runtime/.../history/FsJobArchivist.java — writes a finished
+job's REST responses to an archive directory — and
+flink-runtime-web/.../webmonitor/history/HistoryServer.java — a
+standalone process that scans archive directories and serves them
+over HTTP).  Here:
+
+- `FsJobArchivist.archive(path, job_summary)` writes one JSON file
+  per finished job (atomic rename);
+- `HistoryServer` scans one or more archive directories, caches the
+  summaries, and serves `/jobs`, `/jobs/<id>`, `/overview` over a
+  threaded HTTP server — the same route shapes as the live
+  WebMonitor (runtime/rest.py), so dashboards can point at either.
+
+Executors archive automatically when `history.archive.dir` is set on
+the environment's Configuration (CheckpointingOptions-style typed
+key, core/config.py).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time as _time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List, Optional
+
+
+class FsJobArchivist:
+    """(ref: FsJobArchivist.java — archiveJob writes the JSON bundle
+    to `<dir>/<job-id>`)."""
+
+    @staticmethod
+    def archive(directory: str, job_id: str, summary: dict) -> str:
+        os.makedirs(directory, exist_ok=True)
+        path = os.path.join(directory, job_id)
+        tmp = path + ".part"
+        with open(tmp, "w") as f:
+            json.dump({"job_id": job_id, "archived_at": _time.time(),
+                       **summary}, f)
+        os.replace(tmp, path)
+        return path
+
+    @staticmethod
+    def load_all(directory: str) -> List[dict]:
+        if not os.path.isdir(directory):
+            return []
+        out = []
+        for name in sorted(os.listdir(directory)):
+            if name.endswith(".part"):
+                continue
+            try:
+                with open(os.path.join(directory, name)) as f:
+                    out.append(json.load(f))
+            except (OSError, json.JSONDecodeError):
+                continue
+        return out
+
+
+class HistoryServer:
+    """(ref: HistoryServer.java — refresh-interval directory scan +
+    cached responses)."""
+
+    def __init__(self, archive_dirs: List[str], port: int = 0,
+                 refresh_interval_s: float = 2.0):
+        self.archive_dirs = list(archive_dirs)
+        self.refresh_interval_s = refresh_interval_s
+        self._jobs: Dict[str, dict] = {}
+        self._lock = threading.Lock()
+        self._running = False
+        self._refresher: Optional[threading.Thread] = None
+
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args):  # quiet
+                pass
+
+            def do_GET(self):
+                try:
+                    body = server._route(self.path)
+                except KeyError:
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                payload = json.dumps(body).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(payload)))
+                self.end_headers()
+                self.wfile.write(payload)
+
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", port), Handler)
+        self.port = self._httpd.server_address[1]
+
+    # ---- lifecycle --------------------------------------------------
+    def start(self) -> "HistoryServer":
+        self._running = True
+        self.refresh()
+        threading.Thread(target=self._httpd.serve_forever, daemon=True,
+                         name="history-http").start()
+        self._refresher = threading.Thread(target=self._refresh_loop,
+                                           daemon=True,
+                                           name="history-refresh")
+        self._refresher.start()
+        return self
+
+    def stop(self) -> None:
+        self._running = False
+        self._httpd.shutdown()
+
+    # ---- refresh ----------------------------------------------------
+    def _refresh_loop(self) -> None:
+        while self._running:
+            _time.sleep(self.refresh_interval_s)
+            self.refresh()
+
+    def refresh(self) -> None:
+        jobs: Dict[str, dict] = {}
+        for directory in self.archive_dirs:
+            for job in FsJobArchivist.load_all(directory):
+                jobs[job["job_id"]] = job
+        with self._lock:
+            self._jobs = jobs
+
+    # ---- routes -----------------------------------------------------
+    def _route(self, path: str):
+        with self._lock:
+            jobs = dict(self._jobs)
+        if path in ("/", "/overview"):
+            return {"jobs_finished": len(jobs)}
+        if path == "/jobs":
+            return {"jobs": [
+                {"job_id": jid, "job_name": j.get("job_name"),
+                 "state": j.get("state")} for jid, j in jobs.items()]}
+        if path.startswith("/jobs/"):
+            jid = path[len("/jobs/"):]
+            if jid in jobs:
+                return jobs[jid]
+        raise KeyError(path)

@@ -715,6 +715,41 @@ class JobClient:
             raise self._error
         return self._result
 
+    # ---- savepoints (ref: the `flink savepoint` / `cancel -s` CLI
+    # verbs on ClusterClient.triggerSavepoint / cancelWithSavepoint) --
+    def trigger_savepoint(self, directory: str,
+                          timeout: float = 60.0) -> str:
+        """Blocks until the savepoint is written; returns its path."""
+        # the executor thread publishes executor_state during attempt
+        # setup — an immediate post-submit request must wait for it
+        deadline = _time.monotonic() + min(timeout, 5.0)
+        coordinator = None
+        while _time.monotonic() < deadline and not self.done:
+            coordinator = (self.executor_state or {}).get("coordinator")
+            if coordinator is not None:
+                break
+            _time.sleep(0.002)
+        if coordinator is None:
+            if self.done:
+                raise RuntimeError(
+                    "cannot savepoint: the job is no longer running")
+            raise RuntimeError(
+                "savepoints require checkpointing to be enabled "
+                "(env.enable_checkpointing)")
+        return coordinator.trigger_savepoint(directory).wait(timeout)
+
+    def stop_with_savepoint(self, directory: str,
+                            timeout: float = 60.0) -> str:
+        """Savepoint, then cancel (ref: cancel -s).  The cancellation
+        lands after the savepoint completes — records processed in the
+        window between are at-least-once for external side effects, as
+        with the reference's cancelWithSavepoint (vs the later
+        stop-with-savepoint's drain)."""
+        path = self.trigger_savepoint(directory, timeout)
+        self.cancel()
+        self._done.wait(timeout)
+        return path
+
     @property
     def done(self) -> bool:
         return self._done.is_set()
@@ -775,7 +810,7 @@ class LocalExecutor:
         cp_config = job_graph.checkpoint_config
         storage = make_checkpoint_storage(cp_config) if cp_config else None
         restart = make_restart_strategy(self.restart_strategy_config)
-        restore_from = None
+        restore_from = initial_restore_point(job_graph)
         try:
             while True:
                 try:
@@ -819,13 +854,9 @@ class LocalExecutor:
         for st in all_tasks:
             st.open()
         if restore_from is not None:
-            task_snaps: Dict[Tuple[int, int], dict] = restore_from["tasks"]
-            # restarts rebuild from the same JobGraph, so task keys
-            # always match one-to-one (rescale-on-restore is a
-            # savepoint operation, not a failover one)
-            for st in all_tasks:
-                if st.task_key in task_snaps:
-                    st.restore([task_snaps[st.task_key]])
+            # failover restores one-to-one; savepoint restore handles
+            # rescale (key-group re-split + operator-state round robin)
+            assign_restore_snapshots(job_graph, restore_from, subtasks)
 
         # checkpoint coordination
         ack_queue: deque = deque()
@@ -854,6 +885,8 @@ class LocalExecutor:
                 notify_complete=notify_complete,
                 min_pause_ms=cfg.get("min_pause", 0),
             )
+            coordinator.vertex_parallelisms = {
+                vid: v.parallelism for vid, v in job_graph.vertices.items()}
             register_checkpoint_gauges(self.metrics, job_graph.job_name,
                                        coordinator)
             # continue the id sequence across restarts
@@ -885,6 +918,9 @@ class LocalExecutor:
                     getattr(result, "_cp_base", 0) + coordinator.completed_count)
                 result._cp_base = result.checkpoints_completed
                 coordinator.stopped = True
+                coordinator.fail_pending_savepoints(
+                    RuntimeError("job attempt ended before the savepoint "
+                                 "completed"))
             for s in sources:
                 s.cancel_source()
             for s in threaded_sources:
@@ -1014,6 +1050,98 @@ def merge_accumulators(into: Dict[str, Any], accs: Dict[str, Any]) -> None:
             into[name] = into[name] + value
         else:
             into[name] = value
+
+
+def compute_restore_assignments(vertex_parallelisms: Dict[int, int],
+                                restore_from: dict
+                                ) -> Dict[Tuple[int, int], List[dict]]:
+    """Map a checkpoint/savepoint's task snapshots onto (possibly
+    rescaled) subtasks (ref: StateAssignmentOperation.java — key-group
+    range re-split on rescale).  Returns task_key -> snapshot list.
+
+    Same parallelism → one-to-one.  Parallelism changed:
+    - keyed state + timers go to every new subtask (backends and timer
+      services filter by their key-group range);
+    - operator list state re-splits round-robin
+      (RoundRobinOperatorStateRepartitioner);
+    - CheckpointedFunction ('function') state assigns each OLD
+      subtask's state to exactly ONE new subtask, round-robin — never
+      broadcast (a 2PC sink's pending transactions must recover
+      exactly once; scale-down hands several states to one subtask,
+      whose restore hook runs once per state)."""
+    from flink_tpu.state.operator_state import OperatorStateSnapshot
+
+    task_snaps: Dict[Tuple[int, int], dict] = restore_from["tasks"]
+    # old parallelism: recorded by savepoints; derived from snapshot
+    # keys otherwise
+    old_par: Dict[int, int] = dict(restore_from.get("parallelisms") or {})
+    for (vid, idx) in task_snaps:
+        old_par[vid] = max(old_par.get(vid, 0), idx + 1)
+    out: Dict[Tuple[int, int], List[dict]] = {}
+    for vid, new_p in vertex_parallelisms.items():
+        if old_par.get(vid, 0) == 0:
+            continue  # vertex had no snapshot (e.g. newly added)
+        if old_par[vid] == new_p:
+            for i in range(new_p):
+                if (vid, i) in task_snaps:
+                    out[(vid, i)] = [task_snaps[(vid, i)]]
+            continue
+        # rescale: split out operator + function state, broadcast the
+        # keyed/timer remainder
+        vsnaps = [task_snaps[(vid, i)] for i in range(old_par[vid])
+                  if (vid, i) in task_snaps]
+        stripped = []
+        op_state_parts: Dict[str, List] = {}
+        fn_states: Dict[str, List] = {}
+        for snap in vsnaps:
+            ops = {}
+            for op_id, opsnap in snap.get("operators", {}).items():
+                cp = {k: v for k, v in opsnap.items()
+                      if k not in ("operator", "function")}
+                ops[op_id] = cp
+                if "operator" in opsnap:
+                    op_state_parts.setdefault(op_id, []).append(
+                        opsnap["operator"])
+                if "function" in opsnap:
+                    fn_states.setdefault(op_id, []).append(
+                        opsnap["function"])
+            stripped.append({"operators": ops})
+        redistributed = {
+            op_id: OperatorStateSnapshot.redistribute(parts, new_p)
+            for op_id, parts in op_state_parts.items()}
+        for i in range(new_p):
+            extras = [{"operators": {
+                op_id: {"operator": parts[i]}
+                for op_id, parts in redistributed.items()}}]
+            for op_id, states in fn_states.items():
+                for fstate in states[i::new_p]:
+                    extras.append({"operators": {op_id:
+                                                 {"function": fstate}}})
+            out[(vid, i)] = stripped + extras
+    return out
+
+
+def assign_restore_snapshots(job_graph: JobGraph, restore_from: dict,
+                             subtasks: Dict[int, List["SubtaskInstance"]]
+                             ) -> None:
+    mapping = compute_restore_assignments(
+        {vid: v.parallelism for vid, v in job_graph.vertices.items()},
+        restore_from)
+    for sts in subtasks.values():
+        for st in sts:
+            snaps = mapping.get(st.task_key)
+            if snaps:
+                st.restore(snaps)
+
+
+def initial_restore_point(job_graph: JobGraph) -> Optional[dict]:
+    """A savepoint path attached to the job graph (execute-from-
+    savepoint, the `flink run -s <path>` contract)."""
+    path = getattr(job_graph, "savepoint_restore_path", None)
+    if path is None:
+        return None
+    from flink_tpu.runtime.checkpoints import load_savepoint
+    return load_savepoint(path)
 
 
 def gather_accumulators(all_tasks, into: Dict[str, Any]) -> None:

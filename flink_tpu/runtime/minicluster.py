@@ -51,7 +51,10 @@ from flink_tpu.runtime.local import (
     JobExecutionResult,
     SubtaskInstance,
     SuppressRestartsException,
+    assign_restore_snapshots,
     build_and_wire_subtasks,
+    gather_accumulators,
+    initial_restore_point,
 )
 from flink_tpu.runtime.metrics import (
     MetricRegistry,
@@ -222,7 +225,7 @@ class MiniCluster:
         cp_config = job_graph.checkpoint_config
         storage = make_checkpoint_storage(cp_config) if cp_config else None
         restart = make_restart_strategy(self.restart_strategy_config)
-        restore_from = None
+        restore_from = initial_restore_point(job_graph)
         try:
             while True:
                 try:
@@ -277,10 +280,7 @@ class MiniCluster:
         for st in all_tasks:
             st.open()
         if restore_from is not None:
-            task_snaps: Dict[Tuple[int, int], dict] = restore_from["tasks"]
-            for st in all_tasks:
-                if st.task_key in task_snaps:
-                    st.restore([task_snaps[st.task_key]])
+            assign_restore_snapshots(job_graph, restore_from, subtasks)
 
         ack_queue: deque = deque()
         coordinator = None
@@ -308,6 +308,8 @@ class MiniCluster:
                 notify_complete=notify_complete,
                 min_pause_ms=cfg.get("min_pause", 0),
             )
+            coordinator.vertex_parallelisms = {
+                vid: v.parallelism for vid, v in job_graph.vertices.items()}
             register_checkpoint_gauges(self.metrics, job_graph.job_name,
                                        coordinator)
             ids = storage.checkpoint_ids()
@@ -334,6 +336,7 @@ class MiniCluster:
             self._master_loop(client, coordinator, ack_queue, tms,
                               all_tasks, sources, non_sources,
                               threaded_sources)
+            gather_accumulators(all_tasks, result.accumulators)
         finally:
             if coordinator is not None:
                 result.checkpoints_completed = (
@@ -341,6 +344,9 @@ class MiniCluster:
                     + coordinator.completed_count)
                 result._cp_base = result.checkpoints_completed
                 coordinator.stopped = True
+                coordinator.fail_pending_savepoints(
+                    RuntimeError("job attempt ended before the savepoint "
+                                 "completed"))
             for tm in tms:
                 tm.stop()
             for s in sources:

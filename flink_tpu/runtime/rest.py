@@ -77,6 +77,14 @@ class WebMonitor:
         if path == "/metrics/prometheus":
             self.prometheus.report(self.registry.dump())
             return self.prometheus.render(), "text/plain; version=0.0.4"
+        if path.startswith("/jobs/") and path.endswith("/backpressure"):
+            job = path[len("/jobs/"):-len("/backpressure")]
+            if job not in self.jobs:
+                raise KeyError(path)
+            from flink_tpu.runtime.backpressure import sample_client
+            stats = sample_client(self.jobs[job])
+            return ({str(vid): s for vid, s in stats.items()},
+                    "application/json")
         if path.startswith("/jobs/") and path.endswith("/metrics"):
             job = path[len("/jobs/"):-len("/metrics")]
             dump = {k: v for k, v in self.registry.dump().items()

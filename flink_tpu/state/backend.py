@@ -80,6 +80,9 @@ class KeyedStateBackend(abc.ABC):
         self._states: Dict[str, Any] = {}
         #: name → descriptor it was bound with (compatibility checks)
         self._descriptors: Dict[str, StateDescriptor] = {}
+        #: serializer configs recorded by restored snapshots — checked
+        #: at bind time for states registered after restore
+        self._restored_serializer_cfgs: Dict[str, Any] = {}
         #: queryable-state registrations (ref: :382-389)
         self.queryable_states: Dict[str, Any] = {}
 
@@ -100,6 +103,7 @@ class KeyedStateBackend(abc.ABC):
     def get_or_create_keyed_state(self, descriptor: StateDescriptor):
         state = self._states.get(descriptor.name)
         if state is None:
+            self._check_serializer_against_restored(descriptor)
             state = self._create_state(descriptor)
             self._states[descriptor.name] = state
             self._descriptors[descriptor.name] = descriptor
@@ -171,6 +175,43 @@ class KeyedStateBackend(abc.ABC):
     def num_registered_states(self) -> int:
         return len(self._states)
 
+    # ---- serializer compatibility (ref: the
+    # TypeSerializerConfigSnapshot contract — a snapshot records the
+    # serializer configuration per state, and restore refuses a
+    # serializer that cannot read it, StateMigrationException) --------
+    def serializer_config_snapshots(self) -> dict:
+        out = {}
+        for name, d in self._descriptors.items():
+            ser = getattr(d, "serializer", None)
+            if ser is not None:
+                out[name] = ser.snapshot_configuration()
+        return out
+
+    def check_serializer_compatibility(self, snapshots) -> None:
+        for snap in snapshots:
+            recorded = (snap.meta or {}).get("serializers", {})
+            for name, cfg in recorded.items():
+                # remembered for states bound AFTER restore (the
+                # late-bind path restore-before-bind supports)
+                self._restored_serializer_cfgs[name] = cfg
+                d = self._descriptors.get(name)
+                if d is not None:
+                    self._check_serializer_against_restored(d)
+
+    def _check_serializer_against_restored(self,
+                                           descriptor: StateDescriptor
+                                           ) -> None:
+        from flink_tpu.core.serialization import StateMigrationException
+        cfg = self._restored_serializer_cfgs.get(descriptor.name)
+        ser = getattr(descriptor, "serializer", None)
+        if cfg is not None and ser is not None \
+                and not ser.ensure_compatibility(cfg):
+            raise StateMigrationException(
+                f"state '{descriptor.name}' was written with serializer "
+                f"{cfg.serializer_name!r}; the registered serializer "
+                f"{type(ser).__name__!r} cannot read it (ref: "
+                f"TypeSerializerConfigSnapshot compatibility)")
+
     # ---- snapshot / restore (ref: Snapshotable) ---------------------
     @abc.abstractmethod
     def snapshot(self) -> KeyedStateSnapshot:
@@ -180,7 +221,8 @@ class KeyedStateBackend(abc.ABC):
     def restore(self, snapshots: Iterable[KeyedStateSnapshot]) -> None:
         """Restore from one or more snapshots' chunks that intersect
         this backend's key-group range (rescale = pass the snapshots of
-        all old subtasks; chunks outside the range are skipped)."""
+        all old subtasks; chunks outside the range are skipped).
+        Implementations call `check_serializer_compatibility` first."""
 
     def dispose(self) -> None:
         self._states.clear()

@@ -332,10 +332,12 @@ class HeapKeyedStateBackend(KeyedStateBackend):
         return KeyedStateSnapshot(
             {kg: pickle.dumps(entries, protocol=pickle.HIGHEST_PROTOCOL)
              for kg, entries in per_kg.items()},
-            meta={"backend": self.name},
+            meta={"backend": self.name,
+                  "serializers": self.serializer_config_snapshots()},
         )
 
     def restore(self, snapshots) -> None:
+        self.check_serializer_compatibility(snapshots)
         # clear in place: bound state objects hold table references
         for table in self._tables.values():
             table.by_namespace.clear()

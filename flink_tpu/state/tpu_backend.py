@@ -411,10 +411,12 @@ class TpuKeyedStateBackend(KeyedStateBackend):
         return KeyedStateSnapshot(
             {kg: pickle.dumps(chunk, protocol=pickle.HIGHEST_PROTOCOL)
              for kg, chunk in per_kg.items()},
-            meta={"backend": self.name},
+            meta={"backend": self.name,
+                  "serializers": self.serializer_config_snapshots()},
         )
 
     def restore(self, snapshots) -> None:
+        self.check_serializer_compatibility(snapshots)
         # clear in place: bound state objects hold table references
         for table in self._tables.values():
             table.by_namespace.clear()

@@ -185,6 +185,15 @@ class StreamExecutionEnvironment:
         self.restart_strategy = {"strategy": strategy, **kw}
         return self
 
+    def set_savepoint_restore(self, path: str) -> "StreamExecutionEnvironment":
+        """Start the next execution from a savepoint — the
+        `flink run -s <path>` contract.  Restoring at a different
+        parallelism re-splits keyed state by key-group range and
+        operator list state round-robin (ref: SavepointRestoreSettings
+        + StateAssignmentOperation)."""
+        self.savepoint_restore_path = path
+        return self
+
     # ---- sources ----------------------------------------------------
     def add_source(self, source_function: SourceFunction,
                    name: str = "source", parallelism: int = 1) -> "DataStream":
@@ -227,6 +236,8 @@ class StreamExecutionEnvironment:
                 "mode": self.checkpoint_mode,
                 **self.checkpoint_storage,
             }
+        jg.savepoint_restore_path = getattr(
+            self, "savepoint_restore_path", None)
         return jg
 
     def set_latency_tracking_interval(self, interval_ms: Optional[int]

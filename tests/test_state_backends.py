@@ -462,3 +462,50 @@ def test_restore_before_bind_then_late_bind():
         AggregatingStateDescriptor("lb", SumAggregate(np.float32)))
     tpu.set_current_key("x")
     assert st.get() == pytest.approx(5.0)
+
+
+# ---------------------------------------------------------------------
+# serializer config snapshots + migration compatibility
+# (ref: TypeSerializerConfigSnapshot / StateMigrationException)
+# ---------------------------------------------------------------------
+
+def test_serializer_compatibility_roundtrip():
+    from flink_tpu.core.serialization import LongSerializer
+
+    b1 = make_backend("heap")
+    st = b1.get_or_create_keyed_state(
+        ValueStateDescriptor("v", serializer=LongSerializer()))
+    b1.set_current_key("k")
+    st.update(7)
+    snap = b1.snapshot()
+    assert "serializers" in snap.meta
+    assert snap.meta["serializers"]["v"].serializer_name == "LongSerializer"
+
+    # same serializer: restores fine
+    b2 = make_backend("heap")
+    st2 = b2.get_or_create_keyed_state(
+        ValueStateDescriptor("v", serializer=LongSerializer()))
+    b2.restore([snap])
+    b2.set_current_key("k")
+    assert st2.value() == 7
+
+
+def test_serializer_incompatibility_raises():
+    from flink_tpu.core.serialization import (
+        DoubleSerializer,
+        LongSerializer,
+        StateMigrationException,
+    )
+
+    b1 = make_backend("heap")
+    st = b1.get_or_create_keyed_state(
+        ValueStateDescriptor("v", serializer=LongSerializer()))
+    b1.set_current_key("k")
+    st.update(1)
+    snap = b1.snapshot()
+
+    b2 = make_backend("heap")
+    b2.get_or_create_keyed_state(
+        ValueStateDescriptor("v", serializer=DoubleSerializer()))
+    with pytest.raises(StateMigrationException, match="'v'"):
+        b2.restore([snap])
