@@ -9,6 +9,8 @@ in-process runtime).
                                                      (Dispatcher + RM + blob)
     python -m flink_tpu taskmanager --master H:P     start a worker process
                                    [--slots N]
+    python -m flink_tpu config-docs                  render the config-option
+                                                     reference (flink-docs)
 """
 
 from __future__ import annotations
@@ -53,6 +55,9 @@ def main(argv=None) -> int:
     if verb == "bench":
         import subprocess
         return subprocess.call([sys.executable, "bench.py"] + rest)
+    if verb == "config-docs":
+        from flink_tpu.core.config_docs import main as docs_main
+        return docs_main()
     if verb == "jobmanager":
         return _jobmanager(rest)
     if verb == "taskmanager":
@@ -75,9 +80,13 @@ def _jobmanager(rest) -> int:
     ap.add_argument("--port", type=int, default=6123)
     ap.add_argument("--archive-dir", default=None,
                     help="archive finished jobs here (history server)")
+    ap.add_argument("--secret", default=None,
+                    help="shared cluster secret (rejects unauthenticated "
+                         "RPC frames)")
     args = ap.parse_args(rest)
     jm = JobManagerProcess(args.host, args.port,
-                           archive_dir=args.archive_dir)
+                           archive_dir=args.archive_dir,
+                           secret=args.secret)
     print(f"jobmanager listening at {jm.address}", flush=True)
     try:
         while True:
@@ -99,8 +108,10 @@ def _taskmanager(rest) -> int:
     ap.add_argument("--slots", type=int, default=2)
     ap.add_argument("--host", default="127.0.0.1")
     ap.add_argument("--tm-id", default=None)
+    ap.add_argument("--secret", default=None)
     args = ap.parse_args(rest)
-    tm = TaskManagerProcess(args.master, args.slots, args.host, args.tm_id)
+    tm = TaskManagerProcess(args.master, args.slots, args.host, args.tm_id,
+                            secret=args.secret)
     print(f"taskmanager {tm.tm_id} registered with {args.master} "
           f"(rpc {tm.rpc.address}, data {tm.data_server.address})",
           flush=True)

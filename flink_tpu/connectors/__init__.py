@@ -13,6 +13,11 @@ from flink_tpu.connectors.log_connector import (
     TransactionalLogSink,
 )
 from flink_tpu.connectors.bucketing_sink import BucketingFileSink
+from flink_tpu.connectors.jdbc import (
+    JdbcInputFormat,
+    JdbcOutputFormat,
+    JdbcSink,
+)
 
 __all__ = [
     "FilePartitionedLog",
@@ -21,4 +26,7 @@ __all__ = [
     "ReplayableLogSource",
     "TransactionalLogSink",
     "BucketingFileSink",
+    "JdbcInputFormat",
+    "JdbcOutputFormat",
+    "JdbcSink",
 ]

@@ -1,0 +1,65 @@
+"""Config-options documentation generator.
+
+The flink-docs analogue
+(flink-docs/.../ConfigOptionsDocGenerator.java — walks the grouped
+`*Options` classes reflectively and emits the docs' configuration
+tables).  `generate_config_docs()` discovers every ConfigOption
+declared on the option classes in flink_tpu.core.config and renders
+one markdown table per group; the CLI exposes it as
+`python -m flink_tpu config-docs`."""
+
+from __future__ import annotations
+
+import inspect
+from typing import List, Tuple
+
+from flink_tpu.core import config as _config
+from flink_tpu.core.config import ConfigOption
+
+
+def collect_options() -> List[Tuple[str, List[Tuple[str, ConfigOption]]]]:
+    """[(group_class_name, [(attr_name, option), ...]), ...]"""
+    groups = []
+    for name, cls in sorted(vars(_config).items()):
+        if not inspect.isclass(cls) or not name.endswith("Options"):
+            continue
+        if name == "ConfigOptions":  # the builder, not a group
+            continue
+        opts = [(attr, val) for attr, val in sorted(vars(cls).items())
+                if isinstance(val, ConfigOption)]
+        if opts:
+            groups.append((name, opts))
+    return groups
+
+
+def generate_config_docs() -> str:
+    lines = ["# Configuration options", "",
+             "Generated from the option groups in "
+             "`flink_tpu/core/config.py` "
+             "(the ConfigOptionsDocGenerator analogue).", ""]
+    for group, opts in collect_options():
+        lines.append(f"## {group}")
+        lines.append("")
+        lines.append("| Key | Default | Type |")
+        lines.append("|---|---|---|")
+        for _attr, opt in opts:
+            default = getattr(opt, "default", None)
+            has_default = opt.has_default() if callable(
+                getattr(opt, "has_default", None)) else default is not None
+            default_str = repr(default) if has_default else "(none)"
+            vtype = getattr(opt, "value_type", None)
+            tname = getattr(vtype, "__name__", "") if vtype else ""
+            if not tname and default is not None:
+                tname = type(default).__name__
+            lines.append(f"| `{opt.key}` | {default_str} | {tname} |")
+        lines.append("")
+    return "\n".join(lines)
+
+
+def main() -> int:
+    print(generate_config_docs())
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

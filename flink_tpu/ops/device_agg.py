@@ -106,6 +106,17 @@ class DeviceAggregateFunction(AggregateFunction):
         (device twin of AggregateFunction.getResult)."""
         ...
 
+    def result_dense(self, state: Dict[str, jnp.ndarray]) -> jnp.ndarray:
+        """Finalize EVERY row of an already-sliced state block —
+        the gather-free fire path for contiguous slot ranges (XLA
+        gathers run ~2.5M rows/s on this hardware; a dynamic_slice +
+        dense reduction runs at memory bandwidth).  Default falls back
+        through `result` with iota slots; subclasses override to skip
+        the indexing entirely."""
+        first = next(iter(state.values()))
+        return self.result(state, jnp.arange(first.shape[0],
+                                             dtype=jnp.int32))
+
     def merge_slots(
         self, state: Dict[str, jnp.ndarray], dst: jnp.ndarray, src: jnp.ndarray
     ) -> Dict[str, jnp.ndarray]:
@@ -220,6 +231,9 @@ class SumAggregate(DeviceAggregateFunction):
     def result(self, state, slots):
         return state["sum"][slots]
 
+    def result_dense(self, state):
+        return state["sum"]
+
     def merge_slots(self, state, dst, src):
         return {**state, "sum": state["sum"].at[dst].add(state["sum"][src])}
 
@@ -233,6 +247,9 @@ class CountAggregate(DeviceAggregateFunction):
 
     def result(self, state, slots):
         return state["count"][slots]
+
+    def result_dense(self, state):
+        return state["count"]
 
     def merge_slots(self, state, dst, src):
         return {**state, "count": state["count"].at[dst].add(state["count"][src])}

@@ -25,6 +25,7 @@ from __future__ import annotations
 import math
 from typing import Dict, Sequence, Tuple
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -89,10 +90,23 @@ class HyperLogLogAggregate(DeviceAggregateFunction):
                 "regs": state["regs"].at[slots.astype(jnp.int32), reg].max(rank)}
 
     def result(self, state, slots):
-        regs = state["regs"][slots].astype(jnp.float32)        # [S, m]
+        return self._estimate(state["regs"][slots])
+
+    def result_dense(self, state):
+        # gather-free fire for contiguous slot ranges: the estimate is
+        # one dense [S, m] reduction at memory bandwidth
+        return self._estimate(state["regs"])
+
+    def _estimate(self, regs_u8):                              # [S, m]
+        # 2^-r built directly in the float32 exponent field
+        # ((127 - r) << 23 bitcast to f32 — exact for integer ranks
+        # 0..~60, no denormals) — integer ops fuse into the reduction
+        # where a transcendental exp2 dominates the fire
+        bits = (jnp.uint32(127) - regs_u8.astype(jnp.uint32)) << 23
+        inv = jax.lax.bitcast_convert_type(bits, jnp.float32)
         m = jnp.float32(self.m)
-        est = self.alpha * m * m / jnp.sum(jnp.exp2(-regs), axis=-1)
-        zeros = jnp.sum(regs == 0, axis=-1).astype(jnp.float32)
+        est = self.alpha * m * m / jnp.sum(inv, axis=-1)
+        zeros = jnp.sum(regs_u8 == 0, axis=-1).astype(jnp.float32)
         linear = m * (jnp.log(m) - jnp.log(jnp.maximum(zeros, 1.0)))
         use_linear = (est <= 2.5 * m) & (zeros > 0)
         return jnp.where(use_linear, linear, est)

@@ -1081,8 +1081,9 @@ class JobManagerProcess:
     (the SessionClusterEntrypoint shape)."""
 
     def __init__(self, bind_host: str = "127.0.0.1", port: int = 0,
-                 archive_dir: Optional[str] = None):
-        self.rpc = RpcService(bind_host, port)
+                 archive_dir: Optional[str] = None,
+                 secret: Optional[str] = None):
+        self.rpc = RpcService(bind_host, port, secret=secret)
         self.blob = BlobServer()
         self.resource_manager = ResourceManager(self.rpc)
         self.dispatcher = Dispatcher(self.rpc, self.blob, archive_dir)
@@ -1100,9 +1101,10 @@ class TaskManagerProcess:
     registered with the ResourceManager."""
 
     def __init__(self, jm_address: str, num_slots: int = 2,
-                 bind_host: str = "127.0.0.1", tm_id: Optional[str] = None):
+                 bind_host: str = "127.0.0.1", tm_id: Optional[str] = None,
+                 secret: Optional[str] = None):
         self.tm_id = tm_id or f"tm-{uuid.uuid4().hex[:8]}"
-        self.rpc = RpcService(bind_host, 0)
+        self.rpc = RpcService(bind_host, 0, secret=secret)
         self.data_server = DataServer(bind_host, 0)
         self.task_executor = TaskExecutor(self.tm_id, self.rpc,
                                           self.data_server, num_slots)
@@ -1135,14 +1137,15 @@ class RemoteExecutor:
                  restart_strategy: Optional[dict] = None,
                  processing_time_service=None,
                  channel_capacity: int = DEFAULT_CHANNEL_CAPACITY,
-                 metric_registry=None, latency_interval_ms=None):
+                 metric_registry=None, latency_interval_ms=None,
+                 secret: Optional[str] = None):
         self.jm_address = jm_address
         self.state_backend = state_backend
         self.max_parallelism = max_parallelism
         self.restart_strategy_config = restart_strategy or {"strategy": "none"}
         self.channel_capacity = channel_capacity
         self.metrics = metric_registry or MetricRegistry()
-        self._rpc = RpcService()
+        self._rpc = RpcService(secret=secret)
 
     def execute(self, job_graph: JobGraph) -> JobExecutionResult:
         job_id = self.submit(job_graph)

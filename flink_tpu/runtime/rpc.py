@@ -268,11 +268,24 @@ class FencedRpcEndpoint(RpcEndpoint):
 # service
 # ---------------------------------------------------------------------
 
+class AuthenticationException(RpcException):
+    pass
+
+
 class RpcService:
     """Hosts endpoints on one TCP server and connects gateways to
-    remote ones (ref: AkkaRpcService).  Address = "host:port"."""
+    remote ones (ref: AkkaRpcService).  Address = "host:port".
 
-    def __init__(self, bind_host: str = "127.0.0.1", port: int = 0):
+    `secret` enables cluster authentication: every frame must carry
+    the shared secret or the call is rejected (the shared-secret role
+    of the reference's security layer — SecurityUtils.java wires
+    Kerberos/SSL, which need a KDC/CA; a pre-shared cluster token is
+    the transport-appropriate equivalent here, set via
+    `--secret` on the jobmanager/taskmanager entry points)."""
+
+    def __init__(self, bind_host: str = "127.0.0.1", port: int = 0,
+                 secret: Optional[str] = None):
+        self.secret = secret
         self._endpoints: Dict[str, RpcEndpoint] = {}
         self._lock = threading.Lock()
         self._server = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
@@ -353,6 +366,10 @@ class RpcService:
 
         if frame.get("kind") != "call":
             return
+        if self.secret is not None and frame.get("secret") != self.secret:
+            reply("error", AuthenticationException(
+                "invalid or missing cluster secret"))
+            return
         with self._lock:
             endpoint = self._endpoints.get(frame["endpoint"])
         if endpoint is None:
@@ -384,7 +401,7 @@ class RpcService:
     def connect(self, address: str, endpoint_name: str,
                 token: Any = None, timeout: float = 10.0) -> "RpcGateway":
         return RpcGateway(self._client(address), endpoint_name, token,
-                          timeout)
+                          timeout, secret=self.secret)
 
     def _client(self, address: str) -> "_ClientConnection":
         with self._lock:
@@ -430,11 +447,12 @@ class _ClientConnection:
         self._reader.start()
 
     def call(self, endpoint: str, method: str, args, kwargs, token,
-             oneway: bool = False) -> Optional[RpcFuture]:
+             oneway: bool = False,
+             secret: Optional[str] = None) -> Optional[RpcFuture]:
         call_id = next(self._ids)
         frame = {"kind": "call", "id": call_id, "endpoint": endpoint,
                  "method": method, "args": args, "kwargs": kwargs,
-                 "token": token, "oneway": oneway}
+                 "token": token, "oneway": oneway, "secret": secret}
         future: Optional[RpcFuture] = None
         if not oneway:
             future = RpcFuture()
@@ -493,11 +511,13 @@ class RpcGateway:
     (ref: AkkaInvocationHandler ask/tell)."""
 
     def __init__(self, client: _ClientConnection, endpoint: str,
-                 token: Any, timeout: float):
+                 token: Any, timeout: float,
+                 secret: Optional[str] = None):
         self._client = client
         self._endpoint = endpoint
         self._token = token
         self._timeout = timeout
+        self._secret = secret
 
     @property
     def sync(self) -> "_SyncProxy":
@@ -517,7 +537,7 @@ class RpcGateway:
 
         def invoke(*args, **kwargs) -> RpcFuture:
             return self._client.call(self._endpoint, method, args, kwargs,
-                                     self._token)
+                                     self._token, secret=self._secret)
 
         return invoke
 
@@ -532,7 +552,8 @@ class _SyncProxy:
 
         def invoke(*args, **kwargs):
             fut = self._gw._client.call(self._gw._endpoint, method, args,
-                                        kwargs, self._gw._token)
+                                        kwargs, self._gw._token,
+                                        secret=self._gw._secret)
             return fut.get(self._gw._timeout)
 
         return invoke
@@ -548,6 +569,7 @@ class _TellProxy:
 
         def invoke(*args, **kwargs) -> None:
             self._gw._client.call(self._gw._endpoint, method, args, kwargs,
-                                  self._gw._token, oneway=True)
+                                  self._gw._token, oneway=True,
+                                  secret=self._gw._secret)
 
         return invoke

@@ -37,6 +37,11 @@ def load_state_backend(
 ) -> KeyedStateBackend:
     if isinstance(config_or_name, Configuration):
         name = config_or_name.get_string(STATE_BACKEND_KEY, "heap")
+        # HBM budget: beyond it, cold device slots spill to host RAM
+        cap = config_or_name.get_integer(
+            "state.backend.tpu.max-device-slots", 0)
+        if cap and "max_device_slots" not in kwargs:
+            kwargs["max_device_slots"] = cap
     elif config_or_name is None:
         name = "heap"
     else:

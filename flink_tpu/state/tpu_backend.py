@@ -83,7 +83,8 @@ class DeviceAggregatingState(AggregatingState):
     def __init__(self, backend: "TpuKeyedStateBackend",
                  descriptor: AggregatingStateDescriptor,
                  initial_capacity: int = DEFAULT_INITIAL_CAPACITY,
-                 microbatch: int = DEFAULT_MICROBATCH):
+                 microbatch: int = DEFAULT_MICROBATCH,
+                 max_device_slots: Optional[int] = None):
         agg = descriptor.aggregate_function
         assert isinstance(agg, DeviceAggregateFunction)
         self._backend = backend
@@ -98,6 +99,19 @@ class DeviceAggregatingState(AggregatingState):
         self.slot_meta: List[Optional[Tuple[Any, Any]]] = [None] * initial_capacity
         self._free: List[int] = list(range(initial_capacity - 1, -1, -1))
         self.microbatch = microbatch
+        # ---- host-RAM spill tier (SURVEY §7 hard-part: state > HBM;
+        # the role RocksDB's disk residency plays in the reference) ----
+        #: device-slot budget; None = unbounded (grow-on-demand)
+        self.max_device_slots = max_device_slots
+        #: (key, namespace) → {component: numpy row} for entries
+        #: evicted out of HBM; promoted back on access
+        self.host_tier: Dict[Tuple[Any, Any], Dict[str, np.ndarray]] = {}
+        #: per-slot last-access stamps (approximate LRU clock)
+        self._access_stamp: List[int] = [0] * initial_capacity
+        self._clock = 0
+        #: observability: spill/promotion counters
+        self.evictions = 0
+        self.promotions = 0
         self._pending_slots: List[int] = []
         self._pending_values: List[Any] = []
         self._pending_hi: List[int] = []
@@ -105,6 +119,10 @@ class DeviceAggregatingState(AggregatingState):
         # jit-compiled entry points (cached per state object; XLA caches
         # per padded batch shape)
         self._jit_update = jax.jit(self._update_fn, donate_argnums=0)
+        self._jit_upload = jax.jit(
+            lambda st, slot, row: {k: st[k].at[slot].set(row[k])
+                                   for k in st},
+            donate_argnums=0)
         self._jit_merge = jax.jit(self.agg.merge_slots, donate_argnums=0)
         self._jit_clear = jax.jit(self.agg.clear_slots, donate_argnums=0)
         self._jit_result = jax.jit(self.agg.result)
@@ -120,18 +138,87 @@ class DeviceAggregatingState(AggregatingState):
     def _slot_for(self, key, namespace, create: bool = True) -> Optional[int]:
         entry = (key, namespace)
         slot = self.slot_index.get(entry)
+        if slot is None and entry in self.host_tier:
+            slot = self._promote(entry)
         if slot is None and create:
             if not self._free:
-                self._grow(self.capacity * 2)
+                self._make_room()
             slot = self._free.pop()
             self.slot_index[entry] = slot
             self.slot_meta[slot] = entry
+        if slot is not None:
+            self._clock += 1
+            self._access_stamp[slot] = self._clock
+        return slot
+
+    def _make_room(self) -> None:
+        """No free slots: grow HBM state, or — at the device budget —
+        spill the coldest quarter of slots to the host tier (the
+        RocksDB-disk-residency role; SURVEY §7 'state larger than
+        HBM')."""
+        if (self.max_device_slots is None
+                or self.capacity * 2 <= self.max_device_slots):
+            self._grow(self.capacity * 2)
+            return
+        self._evict_cold(max(1, self.capacity // 4))
+
+    def _evict_cold(self, n: int) -> None:
+        self._flush()
+        # never evict recently touched slots: a batch mid-assembly
+        # references up to `microbatch` freshly assigned slots (the
+        # chunked add_batch/get_batch bound), and a merge mid-flight
+        # re-stamps its sources just before allocating the target —
+        # the +16 margin covers the merge's source set
+        protected = self._clock - (2 * self.microbatch + 16)
+        candidates = [(self._access_stamp[s], s)
+                      for s, meta in enumerate(self.slot_meta)
+                      if meta is not None
+                      and self._access_stamp[s] < protected]
+        if not candidates:
+            # everything is hot: grow past the budget rather than
+            # corrupt in-flight batches (soft cap)
+            self._grow(self.capacity * 2)
+            return
+        candidates.sort()
+        victims = [s for _, s in candidates[:n]]
+        idx = np.array(victims, np.int32)
+        host_rows = {name: np.asarray(arr[jnp.asarray(idx)])
+                     for name, arr in self.device_state.items()}
+        for i, s in enumerate(victims):
+            entry = self.slot_meta[s]
+            self.host_tier[entry] = {name: host_rows[name][i]
+                                     for name in host_rows}
+            del self.slot_index[entry]
+            self.slot_meta[s] = None
+        self.device_state = self._jit_clear(self.device_state,
+                                            jnp.asarray(idx))
+        self._free.extend(victims)
+        self.evictions += len(victims)
+
+    def _promote(self, entry) -> int:
+        """Host-tier entry accessed: lift its row back into HBM
+        (donated single-row upload — in-place, no full-array copy)."""
+        row = self.host_tier.pop(entry)
+        if not self._free:
+            self._make_room()
+        slot = self._free.pop()
+        self.slot_index[entry] = slot
+        self.slot_meta[slot] = entry
+        # freshly promoted slots are HOT: stamp them or a later
+        # promotion in the same batch could evict them right back
+        self._clock += 1
+        self._access_stamp[slot] = self._clock
+        self.device_state = self._jit_upload(
+            self.device_state, jnp.int32(slot),
+            {name: jnp.asarray(val) for name, val in row.items()})
+        self.promotions += 1
         return slot
 
     def _grow(self, new_capacity: int) -> None:
         self._flush()
         self.device_state = self.agg.grow_state(self.device_state, new_capacity)
         self._free.extend(range(new_capacity - 1, self.capacity - 1, -1))
+        self._access_stamp.extend([0] * (new_capacity - self.capacity))
         self.slot_meta.extend([None] * (new_capacity - self.capacity))
         self.capacity = new_capacity
 
@@ -156,6 +243,21 @@ class DeviceAggregatingState(AggregatingState):
         batch (a window tuple is a single namespace); pass a parallel
         sequence via `namespaces=` to override per record.  `values` is
         a sequence/ndarray parallel to keys."""
+        keys = list(keys)
+        if self.max_device_slots is not None \
+                and len(keys) > self.microbatch:
+            # capped backend: resolve slots in microbatch-sized chunks
+            # so an eviction triggered late in the loop can never take
+            # a slot resolved earlier in the SAME chunk (chunk size <=
+            # the eviction-protected stamp window)
+            for i in range(0, len(keys), self.microbatch):
+                sl = slice(i, i + self.microbatch)
+                self.add_batch(
+                    keys[sl], namespace,
+                    values[sl] if values is not None else None,
+                    namespaces=None if namespaces is None
+                    else namespaces[sl])
+            return
         slot_for = self._slot_for
         if namespaces is None:
             slots = [slot_for(k, namespace) for k in keys]
@@ -211,7 +313,8 @@ class DeviceAggregatingState(AggregatingState):
 
     # ---- read path --------------------------------------------------
     def get(self):
-        slot = self.slot_index.get((self._backend.current_key, self._namespace))
+        slot = self._slot_for(self._backend.current_key, self._namespace,
+                              create=False)
         if slot is None:
             return None
         self._flush()
@@ -223,11 +326,27 @@ class DeviceAggregatingState(AggregatingState):
         """Gather results for many (key, namespace) pairs in one device
         call; returns (results, found_mask).  Namespace semantics as in
         `add_batch`."""
+        keys = list(keys)
+        if self.max_device_slots is not None \
+                and len(keys) > self.microbatch:
+            # chunked for the same eviction-window reason as add_batch:
+            # a promotion late in the loop must not evict a slot
+            # resolved earlier in the same chunk
+            outs, founds = [], []
+            for i in range(0, len(keys), self.microbatch):
+                sl = slice(i, i + self.microbatch)
+                r, f = self.get_batch(
+                    keys[sl], namespace,
+                    namespaces=None if namespaces is None
+                    else namespaces[sl])
+                outs.append(r)
+                founds.append(f)
+            return np.concatenate(outs), np.concatenate(founds)
         slots = []
         found = []
         for i, k in enumerate(keys):
             ns = namespace if namespaces is None else namespaces[i]
-            s = self.slot_index.get((k, ns))
+            s = self._slot_for(k, ns, create=False)
             found.append(s is not None)
             slots.append(s if s is not None else 0)
         self._flush()
@@ -238,6 +357,7 @@ class DeviceAggregatingState(AggregatingState):
     # ---- lifecycle --------------------------------------------------
     def clear(self) -> None:
         entry = (self._backend.current_key, self._namespace)
+        self.host_tier.pop(entry, None)
         slot = self.slot_index.pop(entry, None)
         if slot is None:
             return
@@ -251,6 +371,7 @@ class DeviceAggregatingState(AggregatingState):
         slots = []
         for i, k in enumerate(keys):
             ns = namespace if namespaces is None else namespaces[i]
+            self.host_tier.pop((k, ns), None)
             s = self.slot_index.pop((k, ns), None)
             if s is not None:
                 slots.append(s)
@@ -271,18 +392,33 @@ class DeviceAggregatingState(AggregatingState):
         WindowOperator.java:338 / MergingWindowSet.java:156)."""
         key = self._backend.current_key
         self._flush()
+        # spilled sources participate in the merge: promote them first
+        for src in sources:
+            if (key, src) in self.host_tier:
+                self._promote((key, src))
+        if (key, target) in self.host_tier:
+            self._promote((key, target))
+        # touch every source slot BEFORE any allocation below: the
+        # target slot allocation may need to make room, and eviction
+        # must not take a slot this merge still references (fresh
+        # stamps fall inside _evict_cold's protected window; slots
+        # stay fully registered in slot_index/slot_meta until after
+        # the allocation, so eviction bookkeeping stays consistent)
+        live_sources = []
+        for src in sources:
+            s = self.slot_index.get((key, src))
+            if s is not None:
+                self._clock += 1
+                self._access_stamp[s] = self._clock
+                live_sources.append((src, s))
         # don't materialize a target slot unless some source has state
         # (matches heap: merging all-empty namespaces leaves no state)
-        popped = []
-        for src in sources:
-            s = self.slot_index.pop((key, src), None)
-            if s is not None:
-                popped.append(s)
-        if not popped:
+        if not live_sources:
             return  # nothing to fold in; target (if any) stays as-is
         dst = self._slot_for(key, target)
         src_slots = []
-        for s in popped:
+        for src, s in live_sources:
+            del self.slot_index[(key, src)]
             if s != dst:
                 src_slots.append(s)
                 self.slot_meta[s] = None
@@ -306,12 +442,27 @@ class DeviceAggregatingState(AggregatingState):
             kg = assign_to_key_group(key, mp)
             row = {name: host[name][slot] for name in host}
             per_kg[kg].append((key, namespace, row))
+        # spilled entries are part of the state too
+        for (key, namespace), row in self.host_tier.items():
+            kg = assign_to_key_group(key, mp)
+            per_kg[kg].append((key, namespace, dict(row)))
         return per_kg
 
     def restore_entries(self, entries: List[Tuple[Any, Any, Dict[str, np.ndarray]]]) -> None:
         if not entries:
             return
         needed = len(self.slot_index) + len(entries)
+        if self.max_device_slots is not None \
+                and needed > self.max_device_slots:
+            # beyond the device budget: the overflow restores straight
+            # into the host tier (promoted lazily on first access)
+            budget = max(self.max_device_slots - len(self.slot_index), 0)
+            for key, namespace, row in entries[budget:]:
+                self.host_tier[(key, namespace)] = dict(row)
+            entries = entries[:budget]
+            if not entries:
+                return
+            needed = len(self.slot_index) + len(entries)
         if needed > self.capacity - len(self._pending_slots):
             self._grow(max(self.capacity * 2, _round_up_pow2(needed)))
         slots = []
@@ -329,7 +480,8 @@ class DeviceAggregatingState(AggregatingState):
         self.device_state = new_state
 
     def active_entries(self) -> Iterable[Tuple[Any, Any]]:
-        return self.slot_index.keys()
+        yield from self.slot_index.keys()
+        yield from self.host_tier.keys()
 
 
 class TpuKeyedStateBackend(KeyedStateBackend):
@@ -340,12 +492,16 @@ class TpuKeyedStateBackend(KeyedStateBackend):
 
     def __init__(self, key_group_range: KeyGroupRange, max_parallelism: int,
                  initial_capacity: int = DEFAULT_INITIAL_CAPACITY,
-                 microbatch: int = DEFAULT_MICROBATCH):
+                 microbatch: int = DEFAULT_MICROBATCH,
+                 max_device_slots: Optional[int] = None):
         super().__init__(key_group_range, max_parallelism)
         self._tables: Dict[str, StateTable] = {}
         self._device_states: Dict[str, DeviceAggregatingState] = {}
         self.initial_capacity = initial_capacity
         self.microbatch = microbatch
+        #: per-state HBM slot budget; beyond it cold entries spill to
+        #: host RAM (config key state.backend.tpu.max-device-slots)
+        self.max_device_slots = max_device_slots
 
     def _table(self, name: str) -> StateTable:
         t = self._tables.get(name)
@@ -367,7 +523,8 @@ class TpuKeyedStateBackend(KeyedStateBackend):
     def create_aggregating_state(self, d: AggregatingStateDescriptor):
         if isinstance(d.aggregate_function, DeviceAggregateFunction):
             st = DeviceAggregatingState(
-                self, d, self.initial_capacity, self.microbatch)
+                self, d, self.initial_capacity, self.microbatch,
+                max_device_slots=self.max_device_slots)
             self._device_states[d.name] = st
             # a restore() that ran before this descriptor was bound
             # parked this state's accumulators in a host table (it had

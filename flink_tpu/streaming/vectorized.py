@@ -359,6 +359,34 @@ class VectorizedTumblingWindows:
         self._jit_update = make_masked_update(self.agg)
         self._jit_result = jax.jit(self.agg.result)
         self._jit_clear = jax.jit(self.agg.clear_slots, donate_argnums=0)
+        # contiguous fire fast path: slots handed out by the arena are
+        # dense, so a full tile of consecutive slots fires as ONE
+        # dynamic_slice + dense reduction instead of a row gather
+        # (XLA gathers ~2.5M rows/s vs memory-bandwidth slicing)
+        agg = self.agg
+
+        def _result_contig(state, start, tile):
+            sub = {k: jax.lax.dynamic_slice_in_dim(v, start, tile, 0)
+                   for k, v in state.items()}
+            return agg.result_dense(sub)
+
+        self._jit_result_contig = jax.jit(_result_contig,
+                                          static_argnums=(2,))
+
+        specs = agg.state_specs()
+
+        def _clear_contig(state, start, tile):
+            out = dict(state)
+            for name, spec in specs.items():
+                fill = jnp.full((tile, *spec.shape), spec.fill,
+                                dtype=spec.dtype)
+                out[name] = jax.lax.dynamic_update_slice_in_dim(
+                    out[name], fill, start, 0)
+            return out
+
+        self._jit_clear_contig = jax.jit(_clear_contig,
+                                         static_argnums=(2,),
+                                         donate_argnums=0)
         # fire/clear tile bounded by BYTES not slot count: a gather or
         # clear materializes [tile, *slot_shape] intermediates, so wide
         # per-slot state (Count-Min: depth*width ints) must shrink the
@@ -522,20 +550,38 @@ class VectorizedTumblingWindows:
                 self.arena.release(slots)
         return fired
 
+    def _is_contiguous_tile(self, chunk: np.ndarray, tile: int) -> bool:
+        """Full tile of strictly consecutive slots, fully inside the
+        current capacity — eligible for dynamic_slice fire/clear."""
+        return (len(chunk) == tile
+                and int(chunk[0]) + tile <= self.capacity
+                and int(chunk[-1]) - int(chunk[0]) == tile - 1
+                and np.array_equal(
+                    chunk, np.arange(chunk[0], chunk[0] + tile,
+                                     dtype=chunk.dtype)))
+
+    def _fire_tile_future(self, chunk: np.ndarray, tile: int):
+        """One tile's result future: contiguous full tiles take the
+        dynamic-slice path; ragged/unordered tiles gather."""
+        if self._is_contiguous_tile(chunk, tile):
+            return self._jit_result_contig(self.state,
+                                           np.int32(chunk[0]), tile)
+        if len(chunk) < tile:
+            padded = np.full(tile, chunk[0], np.int32)
+            padded[:len(chunk)] = chunk
+        else:
+            padded = chunk.astype(np.int32)
+        return self._jit_result(self.state, jnp.asarray(padded))
+
     def _gather_tiled(self, slots: np.ndarray) -> list:
         n = len(slots)
         tile = self.FIRE_TILE
         futures = []
         for i in range(0, n, tile):
             chunk = slots[i:i + tile]
-            if len(chunk) < tile:
-                padded = np.full(tile, chunk[0], np.int32)
-                padded[:len(chunk)] = chunk
-            else:
-                padded = chunk.astype(np.int32)
             # dispatch all tiles before materializing any — transfers
             # overlap device compute on the async dispatch queue
-            futures.append((self._jit_result(self.state, jnp.asarray(padded)),
+            futures.append((self._fire_tile_future(chunk, tile),
                             len(chunk)))
         outs = [np.asarray(f)[:ln] for f, ln in futures]
         return np.concatenate(outs).tolist() if outs else []
@@ -546,12 +592,7 @@ class VectorizedTumblingWindows:
         futures = []
         for i in range(0, n, tile):
             chunk = slots[i:i + tile]
-            if len(chunk) < tile:
-                padded = np.full(tile, chunk[0], np.int32)
-                padded[:len(chunk)] = chunk
-            else:
-                padded = chunk.astype(np.int32)
-            futures.append((self._jit_result(self.state, jnp.asarray(padded)),
+            futures.append((self._fire_tile_future(chunk, tile),
                             len(chunk)))
         return np.concatenate([np.asarray(f)[:ln] for f, ln in futures])
 
@@ -560,6 +601,12 @@ class VectorizedTumblingWindows:
         tile = self.FIRE_TILE
         for i in range(0, n, tile):
             chunk = slots[i:i + tile]
+            if self._is_contiguous_tile(chunk, tile):
+                # contiguous: one dynamic_update_slice of the fill
+                # block instead of a 4KB-per-row scatter
+                self.state = self._jit_clear_contig(
+                    self.state, np.int32(chunk[0]), tile)
+                continue
             padded = np.full(tile, chunk[0], np.int32)
             padded[:len(chunk)] = chunk
             self.state = self._jit_clear(self.state, jnp.asarray(padded))

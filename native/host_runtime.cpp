@@ -15,6 +15,7 @@
 //
 // Build: g++ -O3 -march=native -shared -fPIC (flink_tpu/native loader).
 
+#include <cmath>
 #include <cstdint>
 #include <cstring>
 #include <chrono>
@@ -257,6 +258,35 @@ double ft_heap_tumbling_baseline(const uint64_t* kh, const uint64_t* vh,
       sums[s] += values[i];
     }
   }
+  // FIRE phase (both sides pay it: the reference emits getResult per
+  // key per window at the watermark — WindowOperator.emitWindowContents
+  // — and the TPU engine's fire gathers are timed):
+  // hll -> the harmonic-mean estimate over the register file per key;
+  // sum -> read + accumulate per key.
+  volatile double sink = 0.0;
+  if (kind == 1) {
+    // 2^-rank lookup table: the fast-path estimate implementation
+    // (a division per register would be artificially slow)
+    double inv_tab[64];
+    for (int j = 0; j < 64; ++j) inv_tab[j] = 1.0 / ldexp(1.0, j);
+    for (int64_t s2 = 0; s2 < table.next_slot; ++s2) {
+      const uint8_t* r = &regs[s2 * m];
+      double inv_sum = 0.0;
+      int zeros = 0;
+      for (int64_t j = 0; j < m; ++j) {
+        inv_sum += inv_tab[r[j]];
+        zeros += (r[j] == 0);
+      }
+      double alpha_m2 = 0.7213 / (1.0 + 1.079 / m) * m * (double)m;
+      double est = alpha_m2 / inv_sum;
+      if (zeros && est < 2.5 * m)
+        est = m * __builtin_log(static_cast<double>(m) / zeros);
+      sink += est;
+    }
+  } else {
+    for (int64_t s2 = 0; s2 < table.next_slot; ++s2) sink += sums[s2];
+  }
+  (void)sink;
   return now_s() - t0;
 }
 
@@ -287,6 +317,29 @@ double ft_heap_sliding_hist_baseline(const uint64_t* kh, const float* values,
       ++hist[s * n_buckets + b];
     }
   }
+  // FIRE phase: every live (key, window) emits its quantiles when the
+  // watermark passes (WindowOperator.onEventTime -> emitWindowContents
+  // per key per window).  The streaming contract pays this on both
+  // sides — the TPU engine's fire gathers are timed, so the baseline's
+  // per-window quantile scans must be too.
+  volatile float sink = 0.0f;
+  for (uint64_t pos = 0; pos < table.hash.size(); ++pos) {
+    if (table.hash[pos] == 0) continue;
+    const int32_t* row = &hist[table.slot[pos] * n_buckets];
+    int64_t total = 0;
+    for (int b2 = 0; b2 < n_buckets; ++b2) total += row[b2];
+    if (total == 0) continue;
+    // q50 + q99 scan
+    for (float q : {0.5f, 0.99f}) {
+      int64_t target = static_cast<int64_t>(q * (total - 1));
+      int64_t acc = 0;
+      for (int b2 = 0; b2 < n_buckets; ++b2) {
+        acc += row[b2];
+        if (acc > target) { sink += static_cast<float>(b2); break; }
+      }
+    }
+  }
+  (void)sink;
   return now_s() - t0;
 }
 
@@ -303,12 +356,20 @@ double ft_heap_session_cm_baseline(const uint64_t* kh, const uint64_t* vh,
   session_end.assign(capacity_pow2, INT64_MIN);
   cm.assign(capacity_pow2 * depth * width, 0);
   double t0 = now_s();
+  std::vector<int32_t> emit_buf(depth * width);
+  volatile int64_t fired = 0;
   for (int64_t i = 0; i < n; ++i) {
     int64_t s = table.get_or_insert(kh[i]);
     // session tracking (merge = extend end; new session = reset sketch)
     if (ts[i] > session_end[s]) {
-      // outside the session: a real backend would fire + clear; the
-      // baseline pays the clear (memset) like the namespace swap does
+      // session expired: FIRE (getResult = hand the merged sketch to
+      // the emit path — modeled as the copy the reference's
+      // serialization boundary pays) then clear
+      if (session_end[s] != INT64_MIN) {
+        std::memcpy(emit_buf.data(), &cm[s * depth * width],
+                    sizeof(int32_t) * depth * width);
+        ++fired;
+      }
       std::memset(&cm[s * depth * width], 0,
                   sizeof(int32_t) * depth * width);
     }

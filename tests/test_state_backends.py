@@ -509,3 +509,77 @@ def test_serializer_incompatibility_raises():
         ValueStateDescriptor("v", serializer=DoubleSerializer()))
     with pytest.raises(StateMigrationException, match="'v'"):
         b2.restore([snap])
+
+
+# ---------------------------------------------------------------------
+# host-RAM spill tier (state > HBM — SURVEY §7 hard part; the
+# disk-residency role RocksDB plays in the reference)
+# ---------------------------------------------------------------------
+
+def _mk_capped_device_state(cap=64, initial=16, microbatch=4):
+    from flink_tpu.ops.device_agg import SumAggregate
+    from flink_tpu.state.tpu_backend import TpuKeyedStateBackend
+
+    b = TpuKeyedStateBackend(FULL_RANGE, MAX_PAR, initial_capacity=initial,
+                             microbatch=microbatch, max_device_slots=cap)
+    st = b.get_or_create_keyed_state(
+        AggregatingStateDescriptor("agg", SumAggregate()))
+    return b, st
+
+
+def test_spill_tier_evicts_and_promotes():
+    b, st = _mk_capped_device_state(cap=64, initial=16, microbatch=4)
+    n_keys = 300  # far beyond the 64-slot device budget
+    for k in range(n_keys):
+        b.set_current_key(f"k{k}")
+        st.add(float(k))
+    st._flush()
+    assert st.evictions > 0, "budget never triggered a spill"
+    assert st.capacity <= 128  # soft cap: at most one emergency grow
+    assert len(st.host_tier) > 0
+    # every value readable — spilled entries promote transparently
+    for k in range(n_keys):
+        b.set_current_key(f"k{k}")
+        assert st.get() == float(k)
+    assert st.promotions > 0
+    # adding to a previously spilled key keeps aggregating correctly
+    b.set_current_key("k0")
+    st.add(1000.0)
+    assert st.get() == 1000.0
+
+
+def test_spill_tier_snapshot_includes_host_tier():
+    b, st = _mk_capped_device_state(cap=32, initial=8, microbatch=4)
+    for k in range(200):
+        b.set_current_key(f"k{k}")
+        st.add(float(k))
+    st._flush()
+    assert st.host_tier, "expected spilled entries"
+    snap = b.snapshot()
+    # restore into an UNCAPPED backend: all 200 entries arrive
+    from flink_tpu.ops.device_agg import SumAggregate
+    from flink_tpu.state.tpu_backend import TpuKeyedStateBackend
+    b2 = TpuKeyedStateBackend(FULL_RANGE, MAX_PAR)
+    st2 = b2.get_or_create_keyed_state(
+        AggregatingStateDescriptor("agg", SumAggregate()))
+    b2.restore([snap])
+    for k in range(200):
+        b2.set_current_key(f"k{k}")
+        assert st2.get() == float(k)
+    # restore into a CAPPED backend: overflow lands in the host tier
+    b3, st3 = _mk_capped_device_state(cap=32, initial=8, microbatch=4)
+    b3.restore([snap])
+    assert st3.host_tier
+    for k in range(0, 200, 17):
+        b3.set_current_key(f"k{k}")
+        assert st3.get() == float(k)
+
+
+def test_spill_tier_config_key():
+    from flink_tpu.core.config import Configuration
+
+    cfg = Configuration()
+    cfg.set("state.backend", "tpu")
+    cfg.set("state.backend.tpu.max-device-slots", 4096)
+    backend = load_state_backend(cfg, FULL_RANGE, MAX_PAR)
+    assert backend.max_device_slots == 4096
