@@ -83,10 +83,13 @@ def _jobmanager(rest) -> int:
     ap.add_argument("--secret", default=None,
                     help="shared cluster secret (rejects unauthenticated "
                          "RPC frames)")
+    ap.add_argument("--ha-dir", default=None,
+                    help="shared HA directory: leader election + "
+                         "submitted-job recovery (standbys campaign)")
     args = ap.parse_args(rest)
     jm = JobManagerProcess(args.host, args.port,
                            archive_dir=args.archive_dir,
-                           secret=args.secret)
+                           secret=args.secret, ha_dir=args.ha_dir)
     print(f"jobmanager listening at {jm.address}", flush=True)
     try:
         while True:
@@ -104,15 +107,21 @@ def _taskmanager(rest) -> int:
     from flink_tpu.runtime.cluster import TaskManagerProcess
 
     ap = argparse.ArgumentParser(prog="flink_tpu taskmanager")
-    ap.add_argument("--master", required=True, help="jobmanager host:port")
+    ap.add_argument("--master", default=None, help="jobmanager host:port")
+    ap.add_argument("--ha-dir", default=None,
+                    help="discover (and follow) the leader via the "
+                         "shared HA directory instead of --master")
     ap.add_argument("--slots", type=int, default=2)
     ap.add_argument("--host", default="127.0.0.1")
     ap.add_argument("--tm-id", default=None)
     ap.add_argument("--secret", default=None)
     args = ap.parse_args(rest)
+    if (args.master is None) == (args.ha_dir is None):
+        print("pass exactly one of --master / --ha-dir", file=sys.stderr)
+        return 2
     tm = TaskManagerProcess(args.master, args.slots, args.host, args.tm_id,
-                            secret=args.secret)
-    print(f"taskmanager {tm.tm_id} registered with {args.master} "
+                            secret=args.secret, ha_dir=args.ha_dir)
+    print(f"taskmanager {tm.tm_id} registered with {tm.jm_address} "
           f"(rpc {tm.rpc.address}, data {tm.data_server.address})",
           flush=True)
     try:

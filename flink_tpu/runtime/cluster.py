@@ -261,10 +261,15 @@ class Dispatcher(RpcEndpoint):
                    "cancel_job", "list_jobs")
 
     def __init__(self, rpc_service: RpcService, blob: BlobServer,
-                 archive_dir: Optional[str] = None):
+                 archive_dir: Optional[str] = None,
+                 ha_store=None):
         super().__init__(DISPATCHER)
         self._rpc = rpc_service
         self._blob = blob
+        #: durable submitted-job store (FsSubmittedJobGraphStore); jobs
+        #: persist on submit, drop on terminal, and a newly elected
+        #: dispatcher resubmits them (Dispatcher.java:502)
+        self._ha_store = ha_store
         #: finished jobs also archive to disk for the HistoryServer
         #: (ref: FsJobArchivist wired into the dispatcher's terminal
         #: path; key jobmanager.archive.fs.dir)
@@ -277,6 +282,13 @@ class Dispatcher(RpcEndpoint):
 
     def submit_job(self, job_graph_blob: bytes, job_config: dict) -> str:
         job_id = f"job-{uuid.uuid4().hex[:12]}"
+        if self._ha_store is not None:
+            self._ha_store.put(job_id, job_graph_blob, job_config)
+        self._launch_job(job_id, job_graph_blob, job_config)
+        return job_id
+
+    def _launch_job(self, job_id: str, job_graph_blob: bytes,
+                    job_config: dict) -> None:
         blob_key = self._blob.put_blob(job_graph_blob)
         master = JobMaster(job_id, blob_key, job_graph_blob, job_config,
                            self._rpc)
@@ -285,7 +297,26 @@ class Dispatcher(RpcEndpoint):
         self._masters[job_id] = master
         self._rpc.start_server(master)
         master.launch()
-        return job_id
+
+    def recover_jobs(self) -> int:
+        """Resubmit every stored job this dispatcher doesn't already
+        know (runs on leadership grant; the jobs resume from their
+        latest completed checkpoint when checkpoint storage is
+        filesystem-backed).  RM/blob addresses in the stored config
+        pointed at the DEAD leader and are rewritten to this one."""
+        if self._ha_store is None:
+            return 0
+        n = 0
+        for rec in self._ha_store.recover_all():
+            job_id = rec["job_id"]
+            if job_id in self._masters or job_id in self._archived:
+                continue
+            config = dict(rec["config"])
+            config["rm_address"] = self._rpc.address
+            config["blob_address"] = self._rpc.address
+            self._launch_job(job_id, rec["graph_blob"], config)
+            n += 1
+        return n
 
     def _archive_job(self, job_id: str) -> None:
         master = self._masters.pop(job_id, None)
@@ -295,6 +326,8 @@ class Dispatcher(RpcEndpoint):
         self._archived[job_id] = snapshot
         self._rpc.stop_server(master)
         self._blob.delete_blob(master.blob_key)
+        if self._ha_store is not None:
+            self._ha_store.remove(job_id)
         if self.archive_dir is not None:
             from flink_tpu.runtime.history import FsJobArchivist
             FsJobArchivist.archive(self.archive_dir, job_id, {
@@ -354,6 +387,12 @@ class JobMaster(RpcEndpoint):
         super().__init__(f"jobmaster-{job_id}")
         self.job_id = job_id
         self.blob_key = blob_key
+        #: unique per JobMaster incarnation: a recovered job's new
+        #: master restarts attempt numbering, so TaskExecutors compare
+        #: (epoch, attempt) — a different epoch ALWAYS supersedes the
+        #: old incarnation's still-running tasks (no double execution
+        #: after leader failover)
+        self.master_epoch = uuid.uuid4().hex
         self.job_config = job_config
         self._rpc = rpc_service
         self.job_graph: JobGraph = cloudpickle.loads(graph_blob)
@@ -474,7 +513,19 @@ class JobMaster(RpcEndpoint):
         # free the previous attempt's slots before re-requesting, or a
         # chain of failovers leaks the pool dry
         rm.sync.release_slots(self.job_id)
-        slots = rm.sync.request_slots(self.job_id, n_slots)
+        # pending-slot-request semantics: TaskManagers may still be
+        # (re-)registering — e.g. right after a JobManager failover —
+        # so retry the allocation for a grace window before failing
+        deadline = _time.monotonic() + self.job_config.get(
+            "slot_request_timeout_s", 10.0)
+        while True:
+            try:
+                slots = rm.sync.request_slots(self.job_id, n_slots)
+                break
+            except Exception:  # noqa: BLE001 — not enough slots yet
+                if self.cancel_requested or _time.monotonic() >= deadline:
+                    raise
+                _time.sleep(0.1)
 
         # slot i ← subtask i of every vertex (slot sharing)
         locations: Dict[Tuple[int, int], str] = {}
@@ -512,6 +563,7 @@ class JobMaster(RpcEndpoint):
                                if tk in restore_map}
                 tdd = {
                     "job_id": self.job_id, "attempt": attempt,
+                    "master_epoch": self.master_epoch,
                     "blob_key": self.blob_key,
                     "blob_address": self.job_config["blob_address"],
                     "assignments": entry["assignments"],
@@ -853,8 +905,19 @@ class TaskExecutor(RpcEndpoint):
     # -- deployment ---------------------------------------------------
     def submit_tasks(self, tdd: dict) -> None:
         job_id, attempt = tdd["job_id"], tdd["attempt"]
+        epoch = tdd.get("master_epoch")
         old = self._attempts.get(job_id)
-        if old is not None and old.attempt < attempt:
+        if old is not None:
+            if getattr(old, "master_epoch", None) == epoch \
+                    and old.attempt > attempt:
+                # a stale (out-of-order) deployment must not replace a
+                # newer attempt of the same master
+                raise RpcException(
+                    f"stale deployment: attempt {attempt} of {job_id} "
+                    f"after attempt {old.attempt}")
+            # a later attempt of the SAME master, or ANY attempt from a
+            # NEW master incarnation (leader failover recovery),
+            # supersedes what runs here
             old.teardown()
             self._drop_attempt_channels(old)
             self._attempts.pop(job_id, None)
@@ -867,6 +930,7 @@ class TaskExecutor(RpcEndpoint):
         job_graph: JobGraph = cloudpickle.loads(blob)
 
         att = _JobAttempt(job_id, attempt)
+        att.master_epoch = epoch
         att.jm_gateway = self._rpc.connect(tdd["jm_address"], tdd["jm_name"])
         mine: Set[Tuple[int, int]] = {tuple(a) for a in tdd["assignments"]}
         job_group = self.metrics.job_group(job_graph.job_name)
@@ -906,6 +970,7 @@ class TaskExecutor(RpcEndpoint):
         local pairs get direct in-memory channels, remote pairs go
         through the data plane (the ExecutionGraph POINTWISE/ALL_TO_ALL
         wiring + partition location table of the TDD)."""
+        from flink_tpu.runtime.failover import pointwise_targets
         locations = {tuple(k): v for k, v in tdd["locations"].items()}
         data_addresses = tdd["data_addresses"]
         capacity = tdd["channel_capacity"]
@@ -915,11 +980,7 @@ class TaskExecutor(RpcEndpoint):
             feedback = getattr(edge, "is_feedback", False)
             for i in range(n_up):
                 if edge.partitioner.is_pointwise:
-                    if n_down >= n_up:
-                        targets = list(range(i * n_down // n_up,
-                                             (i + 1) * n_down // n_up))
-                    else:
-                        targets = [i * n_down // n_up]
+                    targets = pointwise_targets(i, n_up, n_down)
                 else:
                     targets = list(range(n_down))
                 up_mine = (edge.source_vertex_id, i) in mine
@@ -1082,17 +1143,41 @@ class JobManagerProcess:
 
     def __init__(self, bind_host: str = "127.0.0.1", port: int = 0,
                  archive_dir: Optional[str] = None,
-                 secret: Optional[str] = None):
+                 secret: Optional[str] = None,
+                 ha_dir: Optional[str] = None):
         self.rpc = RpcService(bind_host, port, secret=secret)
         self.blob = BlobServer()
         self.resource_manager = ResourceManager(self.rpc)
-        self.dispatcher = Dispatcher(self.rpc, self.blob, archive_dir)
+        ha_store = None
+        self.election = None
+        if ha_dir is not None:
+            from flink_tpu.runtime.ha import (
+                FileLeaderElection,
+                FsSubmittedJobGraphStore,
+            )
+            ha_store = FsSubmittedJobGraphStore(ha_dir)
+            self.election = FileLeaderElection(ha_dir)
+        self.dispatcher = Dispatcher(self.rpc, self.blob, archive_dir,
+                                     ha_store=ha_store)
         self.rpc.start_server(self.blob)
         self.rpc.start_server(self.resource_manager)
         self.rpc.start_server(self.dispatcher)
         self.address = self.rpc.address
+        if self.election is not None:
+            # campaign: on leadership, publish this address and
+            # resubmit every stored job (Dispatcher.java:502)
+            self.election.start(
+                self.address,
+                lambda: self.dispatcher.run_async(
+                    self.dispatcher.recover_jobs))
+
+    @property
+    def is_leader(self) -> bool:
+        return self.election is None or self.election.is_leader
 
     def stop(self) -> None:
+        if self.election is not None:
+            self.election.stop()
         self.rpc.stop()
 
 
@@ -1100,21 +1185,55 @@ class TaskManagerProcess:
     """One worker process: TaskExecutor endpoint + DataServer,
     registered with the ResourceManager."""
 
-    def __init__(self, jm_address: str, num_slots: int = 2,
+    def __init__(self, jm_address: Optional[str] = None, num_slots: int = 2,
                  bind_host: str = "127.0.0.1", tm_id: Optional[str] = None,
-                 secret: Optional[str] = None):
+                 secret: Optional[str] = None,
+                 ha_dir: Optional[str] = None):
+        assert (jm_address is None) != (ha_dir is None), \
+            "pass exactly one of jm_address / ha_dir"
         self.tm_id = tm_id or f"tm-{uuid.uuid4().hex[:8]}"
+        self.num_slots = num_slots
         self.rpc = RpcService(bind_host, 0, secret=secret)
         self.data_server = DataServer(bind_host, 0)
         self.task_executor = TaskExecutor(self.tm_id, self.rpc,
                                           self.data_server, num_slots)
         self.rpc.start_server(self.task_executor)
+        self.ha_dir = ha_dir
+        self._running = True
+        if ha_dir is not None:
+            from flink_tpu.runtime.ha import FileLeaderElection
+            jm_address = FileLeaderElection.wait_for_leader(ha_dir)
+        self.jm_address = jm_address
+        self._register(jm_address)
+        if ha_dir is not None:
+            # watch the leader file: a NEW leader after failover has a
+            # fresh ResourceManager — re-register there (the
+            # reconnect-to-new-leader path of the reference's
+            # leader-retrieval listener)
+            threading.Thread(target=self._leader_watch, daemon=True,
+                             name=f"tm-leader-watch-{self.tm_id}"
+                             ).start()
+
+    def _register(self, jm_address: str) -> None:
         rm = self.rpc.connect(jm_address, RESOURCE_MANAGER)
         rm.sync.register_task_executor(self.tm_id, self.rpc.address,
-                                       self.data_server.address, num_slots)
-        self.jm_address = jm_address
+                                       self.data_server.address,
+                                       self.num_slots)
+
+    def _leader_watch(self) -> None:
+        from flink_tpu.runtime.ha import FileLeaderElection
+        while self._running:
+            _time.sleep(0.25)
+            addr = FileLeaderElection.current_leader_address(self.ha_dir)
+            if addr and addr != self.jm_address:
+                try:
+                    self._register(addr)
+                    self.jm_address = addr
+                except Exception:  # noqa: BLE001 — leader not up yet
+                    pass
 
     def stop(self) -> None:
+        self._running = False
         try:
             rm = self.rpc.connect(self.jm_address, RESOURCE_MANAGER)
             rm.tell.unregister_task_executor(self.tm_id)
@@ -1132,13 +1251,17 @@ class RemoteExecutor:
     """Submits a JobGraph to a remote Dispatcher and polls for the
     result — the LocalExecutor/MiniCluster API over the cluster."""
 
-    def __init__(self, jm_address: str, state_backend: str = "heap",
+    def __init__(self, jm_address: Optional[str] = None,
+                 state_backend: str = "heap",
                  max_parallelism: int = 128,
                  restart_strategy: Optional[dict] = None,
                  processing_time_service=None,
                  channel_capacity: int = DEFAULT_CHANNEL_CAPACITY,
                  metric_registry=None, latency_interval_ms=None,
-                 secret: Optional[str] = None):
+                 secret: Optional[str] = None,
+                 ha_dir: Optional[str] = None):
+        assert jm_address is not None or ha_dir is not None
+        self.ha_dir = ha_dir
         self.jm_address = jm_address
         self.state_backend = state_backend
         self.max_parallelism = max_parallelism
@@ -1151,11 +1274,23 @@ class RemoteExecutor:
         job_id = self.submit(job_graph)
         return self.wait(job_id)
 
+    def _resolve(self) -> str:
+        if self.ha_dir is not None:
+            from flink_tpu.runtime.ha import FileLeaderElection
+            addr = FileLeaderElection.current_leader_address(self.ha_dir)
+            if addr:
+                return addr
+        if self.jm_address is None:
+            from flink_tpu.runtime.ha import FileLeaderElection
+            return FileLeaderElection.wait_for_leader(self.ha_dir)
+        return self.jm_address
+
     def submit(self, job_graph: JobGraph) -> str:
-        dispatcher = self._rpc.connect(self.jm_address, DISPATCHER)
+        address = self._resolve()
+        dispatcher = self._rpc.connect(address, DISPATCHER)
         config = {
-            "rm_address": self.jm_address,
-            "blob_address": self.jm_address,
+            "rm_address": address,
+            "blob_address": address,
             "state_backend": self.state_backend,
             "max_parallelism": self.max_parallelism,
             "restart_strategy": self.restart_strategy_config,
@@ -1166,10 +1301,18 @@ class RemoteExecutor:
 
     def wait(self, job_id: str, timeout: float = 300.0
              ) -> JobExecutionResult:
-        dispatcher = self._rpc.connect(self.jm_address, DISPATCHER)
         deadline = _time.monotonic() + timeout
         while _time.monotonic() < deadline:
-            status = dispatcher.sync.request_job_result(job_id)
+            try:
+                dispatcher = self._rpc.connect(self._resolve(), DISPATCHER)
+                status = dispatcher.sync.request_job_result(job_id)
+            except Exception:  # noqa: BLE001 — leader failover window:
+                # re-resolve and keep polling (the new dispatcher
+                # recovers the job under the same id)
+                if self.ha_dir is None:
+                    raise
+                _time.sleep(0.1)
+                continue
             if status["state"] in ("FINISHED", "CANCELED"):
                 result = JobExecutionResult(status["job_name"])
                 payload = status.get("result") or {}
@@ -1185,7 +1328,7 @@ class RemoteExecutor:
         raise TimeoutError(f"job {job_id} still running after {timeout}s")
 
     def cancel(self, job_id: str) -> None:
-        dispatcher = self._rpc.connect(self.jm_address, DISPATCHER)
+        dispatcher = self._rpc.connect(self._resolve(), DISPATCHER)
         dispatcher.sync.cancel_job(job_id)
 
     def stop(self) -> None:

@@ -1,0 +1,184 @@
+"""High availability: leader election + submitted-job-graph store.
+
+Rebuilds the reference's HA services
+(flink-runtime/.../highavailability/HighAvailabilityServices.java, the
+ZooKeeper implementations — ZooKeeperLeaderElectionService.java,
+ZooKeeperSubmittedJobGraphStore — and the Dispatcher's job recovery
+path, Dispatcher.java:502 recoverJobs → createJobManagerRunner) on a
+SHARED FILESYSTEM instead of ZooKeeper (this environment has no ZK;
+a shared directory is the TPU-pod-appropriate coordination medium —
+the same place checkpoints already live):
+
+- **Leader election**: a lease file (`leader.lock`) acquired with
+  O_EXCL; the leader refreshes its mtime every `lease_refresh_s`, and
+  a standby steals the lease once the mtime is older than
+  `lease_timeout_s` (the session-timeout analogue of the ZK ephemeral
+  node).  The elected leader publishes its RPC address in
+  `leader.addr` for clients and TaskManagers to resolve.
+- **Job graph store**: submitted job graphs persist as files under
+  `jobs/`; a newly elected dispatcher recovers and resubmits every
+  stored job, which resumes from the latest completed checkpoint when
+  the job uses filesystem checkpoint storage.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import threading
+import time as _time
+import uuid
+from typing import Callable, Dict, List, Optional
+
+
+class FileLeaderElection:
+    """Lease-file leader election (ref: LeaderElectionService +
+    the ZK ephemeral-node semantics, approximated with mtime leases)."""
+
+    def __init__(self, ha_dir: str, lease_timeout_s: float = 3.0,
+                 lease_refresh_s: float = 0.5):
+        self.ha_dir = ha_dir
+        self.lease_timeout_s = lease_timeout_s
+        self.lease_refresh_s = lease_refresh_s
+        self.contender_id = uuid.uuid4().hex
+        self._lock_path = os.path.join(ha_dir, "leader.lock")
+        self._addr_path = os.path.join(ha_dir, "leader.addr")
+        self.is_leader = False
+        self._running = False
+        self._on_leadership: Optional[Callable[[], None]] = None
+        self._thread: Optional[threading.Thread] = None
+        os.makedirs(ha_dir, exist_ok=True)
+
+    # ---- campaign ---------------------------------------------------
+    def start(self, address: str,
+              on_leadership: Callable[[], None]) -> None:
+        """Campaign in the background; `on_leadership` fires (once) on
+        grant, after the address is published."""
+        self._address = address
+        self._on_leadership = on_leadership
+        self._running = True
+        self._thread = threading.Thread(target=self._campaign_loop,
+                                        daemon=True, name="ha-campaign")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._running = False
+        if self.is_leader:
+            self.is_leader = False
+            try:
+                # release only OUR lease — never a successor's
+                with open(self._lock_path) as f:
+                    if f.read().strip() == self.contender_id:
+                        os.remove(self._lock_path)
+            except OSError:
+                pass
+
+    def _campaign_loop(self) -> None:
+        while self._running:
+            if self.is_leader:
+                # refresh the lease — but only if it is still OURS (a
+                # paused leader whose lease was stolen must demote, not
+                # silently refresh the successor's lock)
+                try:
+                    with open(self._lock_path) as f:
+                        owned = f.read().strip() == self.contender_id
+                    if owned:
+                        os.utime(self._lock_path)
+                    else:
+                        self.is_leader = False
+                except OSError:
+                    self.is_leader = False  # lease lost
+                _time.sleep(self.lease_refresh_s)
+                continue
+            if self._try_acquire():
+                self.is_leader = True
+                with open(self._addr_path + ".part", "w") as f:
+                    f.write(self._address)
+                os.replace(self._addr_path + ".part", self._addr_path)
+                if self._on_leadership is not None:
+                    self._on_leadership()
+            else:
+                _time.sleep(self.lease_refresh_s)
+
+    def _try_acquire(self) -> bool:
+        try:
+            fd = os.open(self._lock_path,
+                         os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+            os.write(fd, self.contender_id.encode())
+            os.close(fd)
+            return True
+        except FileExistsError:
+            # steal a stale lease (dead leader: mtime stopped moving).
+            # The steal is an atomic RENAME: of several concurrent
+            # stealers exactly one wins the rename; the losers' rename
+            # raises and nobody can delete a successor's FRESH lock
+            # (the remove-after-stat TOCTOU that causes split brain).
+            try:
+                age = _time.time() - os.path.getmtime(self._lock_path)
+            except OSError:
+                return False
+            if age > self.lease_timeout_s:
+                stale = (self._lock_path
+                         + f".stale-{self.contender_id[:8]}")
+                try:
+                    os.rename(self._lock_path, stale)
+                    os.remove(stale)
+                except OSError:
+                    pass  # another stealer won the rename
+            return False
+
+    # ---- discovery --------------------------------------------------
+    @staticmethod
+    def current_leader_address(ha_dir: str) -> Optional[str]:
+        path = os.path.join(ha_dir, "leader.addr")
+        try:
+            with open(path) as f:
+                return f.read().strip() or None
+        except OSError:
+            return None
+
+    @staticmethod
+    def wait_for_leader(ha_dir: str, timeout: float = 30.0) -> str:
+        deadline = _time.monotonic() + timeout
+        while _time.monotonic() < deadline:
+            addr = FileLeaderElection.current_leader_address(ha_dir)
+            if addr:
+                return addr
+            _time.sleep(0.05)
+        raise TimeoutError(f"no leader published in {ha_dir}")
+
+
+class FsSubmittedJobGraphStore:
+    """Durable submitted-job store (ref:
+    ZooKeeperSubmittedJobGraphStore — put on submit, remove on
+    terminal, recoverJobGraphs on leadership)."""
+
+    def __init__(self, ha_dir: str):
+        self.directory = os.path.join(ha_dir, "jobs")
+        os.makedirs(self.directory, exist_ok=True)
+
+    def put(self, job_id: str, graph_blob: bytes, job_config: dict) -> None:
+        path = os.path.join(self.directory, job_id)
+        with open(path + ".part", "wb") as f:
+            pickle.dump({"job_id": job_id, "graph_blob": graph_blob,
+                         "config": job_config}, f,
+                        protocol=pickle.HIGHEST_PROTOCOL)
+        os.replace(path + ".part", path)
+
+    def remove(self, job_id: str) -> None:
+        try:
+            os.remove(os.path.join(self.directory, job_id))
+        except OSError:
+            pass
+
+    def recover_all(self) -> List[dict]:
+        out = []
+        for name in sorted(os.listdir(self.directory)):
+            if name.endswith(".part"):
+                continue
+            try:
+                with open(os.path.join(self.directory, name), "rb") as f:
+                    out.append(pickle.load(f))
+            except (OSError, pickle.PickleError):
+                continue
+        return out

@@ -47,6 +47,11 @@ from flink_tpu.runtime.checkpoints import (
     make_checkpoint_storage,
     make_restart_strategy,
 )
+from flink_tpu.runtime.failover import (
+    TaskFailureException,
+    compute_pipelined_regions,
+    region_of,
+)
 from flink_tpu.runtime.metrics import (
     LatencyStats,
     MetricRegistry,
@@ -88,6 +93,9 @@ class JobExecutionResult:
         self.accumulators: Dict[str, Any] = {}
         self.checkpoints_completed = 0
         self.restarts = 0
+        #: restarts that were scoped to the failed pipelined region
+        #: (healthy regions carried their live state across)
+        self.region_restarts = 0
         self.cancelled = False
 
 
@@ -774,7 +782,8 @@ class LocalExecutor:
                  processing_time_service=None,
                  channel_capacity: int = DEFAULT_CHANNEL_CAPACITY,
                  metric_registry=None,
-                 latency_interval_ms: Optional[int] = None):
+                 latency_interval_ms: Optional[int] = None,
+                 failover_strategy: str = "full"):
         self.state_backend = state_backend
         self.max_parallelism = max_parallelism
         self.restart_strategy_config = restart_strategy or {"strategy": "none"}
@@ -782,6 +791,9 @@ class LocalExecutor:
         self.channel_capacity = channel_capacity
         self.metrics = metric_registry or MetricRegistry()
         self.latency_interval_ms = latency_interval_ms
+        #: "full" | "region" (ref: FailoverStrategyLoader /
+        #: jobmanager.execution.failover-strategy)
+        self.failover_strategy = failover_strategy
 
     # ---- graph → subtasks ------------------------------------------
     def build_subtasks(self, job_graph: JobGraph) -> Dict[int, List[SubtaskInstance]]:
@@ -811,11 +823,14 @@ class LocalExecutor:
         storage = make_checkpoint_storage(cp_config) if cp_config else None
         restart = make_restart_strategy(self.restart_strategy_config)
         restore_from = initial_restore_point(job_graph)
+        carryover = None
+        regions = (compute_pipelined_regions(job_graph)
+                   if self.failover_strategy == "region" else None)
         try:
             while True:
                 try:
                     self._run_attempt(job_graph, client, result, storage,
-                                      restore_from)
+                                      restore_from, carryover)
                     client._finish(result=result)
                     return
                 except JobCancelledException:
@@ -827,17 +842,47 @@ class LocalExecutor:
                 except Exception as e:  # noqa: BLE001
                     restart.notify_failure(_time.monotonic() * 1000.0)
                     if client.cancel_requested or not restart.can_restart():
+                        if isinstance(e, TaskFailureException):
+                            raise e.cause from e
                         raise
                     result.restarts += 1
                     if restart.delay_ms:
                         _time.sleep(restart.delay_ms / 1000.0)
                     restore_from = storage.latest() if storage else None
+                    carryover = None
+                    if (regions is not None
+                            and isinstance(e, TaskFailureException)
+                            and getattr(e, "live_state", None) is not None):
+                        failed_region = set(region_of(regions, e.task_key))
+                        # a healthy subtask whose capture failed pulls
+                        # its whole region into the restart scope
+                        for fk in getattr(e, "capture_failed_keys", []):
+                            failed_region |= region_of(regions, fk)
+                        healthy = {k for k, v in e.live_state.items()
+                                   if k not in failed_region}
+                        if healthy:
+                            # restart-pipelined-region: healthy regions
+                            # carry their live state (operators, queued
+                            # elements, watermarks, alignment) across
+                            # the restart; only the failed region
+                            # restores from the checkpoint
+                            carryover = {k: e.live_state[k]
+                                         for k in healthy}
+                            result.region_restarts += 1
+                            if restore_from is not None:
+                                restore_from = {
+                                    **restore_from,
+                                    "tasks": {
+                                        k: v for k, v
+                                        in restore_from["tasks"].items()
+                                        if k in failed_region}}
         except BaseException as e:  # noqa: BLE001
             client._finish(error=e)
 
     def _run_attempt(self, job_graph: JobGraph, client: JobClient,
                      result: JobExecutionResult, storage,
-                     restore_from: Optional[dict]) -> None:
+                     restore_from: Optional[dict],
+                     carryover: Optional[dict] = None) -> None:
         subtasks = self.build_subtasks(job_graph)
         all_tasks: List[SubtaskInstance] = [
             st for v in job_graph.topological_vertices() for st in subtasks[v.id]]
@@ -853,7 +898,18 @@ class LocalExecutor:
         # backends support restore-after-bind)
         for st in all_tasks:
             st.open()
-        if restore_from is not None:
+        if carryover is not None:
+            # region failover: healthy subtasks resume their LIVE state
+            # (operators + queued elements + watermarks + alignment);
+            # the failed region restores from the checkpoint below
+            for st in all_tasks:
+                cap = carryover.get(st.task_key)
+                if cap is not None:
+                    _restore_live_capture(st, cap)
+                elif restore_from is not None \
+                        and st.task_key in restore_from["tasks"]:
+                    st.restore([restore_from["tasks"][st.task_key]])
+        elif restore_from is not None:
             # failover restores one-to-one; savepoint restore handles
             # rescale (key-group re-split + operator-state round robin)
             assign_restore_snapshots(job_graph, restore_from, subtasks)
@@ -911,6 +967,16 @@ class LocalExecutor:
             self._loop(client, result, coordinator, ack_queue,
                        all_tasks, sources, coop_sources, threaded_sources,
                        non_sources)
+        except TaskFailureException as tfe:
+            if self.failover_strategy == "region" and not any(
+                    not s.supports_stepping for s in sources):
+                # capture live state BEFORE teardown for region
+                # carryover (thread-hosted sources can't carry over:
+                # their run() would restart from scratch — fall back
+                # to full restart by not capturing)
+                tfe.live_state, tfe.capture_failed_keys = \
+                    _capture_live_state(all_tasks, tfe.task_key)
+            raise
         finally:
             if coordinator is not None:
                 # completed_count is per attempt; accumulate across restarts
@@ -962,16 +1028,23 @@ class LocalExecutor:
             # 1. sources
             for s in coop_sources:
                 if not s.finished:
-                    progress += s.source_step(self.SOURCE_BATCH)
+                    try:
+                        progress += s.source_step(self.SOURCE_BATCH)
+                    except Exception as e:  # noqa: BLE001
+                        raise TaskFailureException(s.task_key, e) from e
             for s in threaded_sources:
                 if s.thread_error is not None:
-                    raise s.thread_error
+                    raise TaskFailureException(s.task_key, s.thread_error) \
+                        from s.thread_error
                 s.try_inject_threaded_trigger()
                 s.try_deliver_notifications()
 
             # 2. operators
             for st in non_sources:
-                progress += st.step(self.STEP_BUDGET)
+                try:
+                    progress += st.step(self.STEP_BUDGET)
+                except Exception as e:  # noqa: BLE001
+                    raise TaskFailureException(st.task_key, e) from e
 
             # 3. processing time (polled services fire on this loop —
             # the single-owner replacement for the reference's timer
@@ -1160,6 +1233,49 @@ def gather_accumulators(all_tasks, into: Dict[str, Any]) -> None:
                 merge_accumulators(into, get_accs())
 
 
+def _capture_live_state(all_tasks, failed_key):
+    """Per-subtask live capture for region failover: operator
+    snapshots, channel queues/flags, watermark valve state.  Returns
+    (captured, capture_failed_keys); a subtask whose capture raises is
+    reported so its WHOLE REGION joins the restart scope.
+
+    In-flight checkpoint machinery does NOT carry over: queued
+    CheckpointBarriers are dropped and alignment state resets — the
+    in-flight checkpoint can never complete (the failed region never
+    acks it), and the new attempt's coordinator reuses ids from the
+    last COMPLETED checkpoint, so a carried barrier would collide with
+    a re-issued id at a different stream position (an inconsistent
+    cut)."""
+    import copy as _copy
+    out = {}
+    capture_failed = []
+    for st in all_tasks:
+        if st.task_key == failed_key:
+            continue
+        try:
+            out[st.task_key] = {
+                "snap": st.snapshot(),
+                "finished": st.finished,
+                "queues": [[el for el in ch.queue if not el.is_barrier]
+                           for ch in st.input_channels],
+                "eos": [ch.eos for ch in st.input_channels],
+                "wm": (_copy.deepcopy(st._watermarks),
+                       dict(st._current_wm)),
+            }
+        except Exception:  # noqa: BLE001 — expand the restart scope
+            capture_failed.append(st.task_key)
+    return out, capture_failed
+
+
+def _restore_live_capture(st, cap) -> None:
+    st.restore([cap["snap"]])
+    st.finished = cap["finished"]
+    for ch, q, eos in zip(st.input_channels, cap["queues"], cap["eos"]):
+        ch.queue.extend(q)
+        ch.eos = eos
+    st._watermarks, st._current_wm = cap["wm"]
+
+
 def _clone_partitioner(p):
     import copy
     return copy.copy(p)
@@ -1194,11 +1310,9 @@ def build_and_wire_subtasks(job_graph: JobGraph, state_backend: str,
         downs = subtasks[edge.target_vertex_id]
         for i, up in enumerate(ups):
             if edge.partitioner.is_pointwise:
-                n_up, n_down = len(ups), len(downs)
-                if n_down >= n_up:
-                    targets = downs[i * n_down // n_up:(i + 1) * n_down // n_up]
-                else:
-                    targets = [downs[i * n_down // n_up]]
+                from flink_tpu.runtime.failover import pointwise_targets
+                targets = [downs[t] for t in
+                           pointwise_targets(i, len(ups), len(downs))]
             else:
                 targets = downs
             channels = [d.new_channel(edge.type_number) for d in targets]

@@ -185,6 +185,17 @@ class StreamExecutionEnvironment:
         self.restart_strategy = {"strategy": strategy, **kw}
         return self
 
+    def set_failover_strategy(self, strategy: str
+                              ) -> "StreamExecutionEnvironment":
+        """"full" (default) | "region" — scope of a restart on task
+        failure (ref: jobmanager.execution.failover-strategy,
+        RestartPipelinedRegionStrategy).  "region" restarts only the
+        failed task's pipelined region on the local executor; healthy
+        regions carry their live state across the restart."""
+        assert strategy in ("full", "region")
+        self.failover_strategy = strategy
+        return self
+
     def set_savepoint_restore(self, path: str) -> "StreamExecutionEnvironment":
         """Start the next execution from a savepoint — the
         `flink run -s <path>` contract.  Restoring at a different
@@ -272,6 +283,11 @@ class StreamExecutionEnvironment:
                 num_task_managers=self.num_task_managers, **kw)
         else:
             from flink_tpu.runtime.local import LocalExecutor
+            # region failover is a LocalExecutor capability; the
+            # distributed tiers restart the full job (the reference's
+            # "full" strategy)
+            kw["failover_strategy"] = getattr(self, "failover_strategy",
+                                              "full")
             self._last_executor = LocalExecutor(**kw)
         return self._last_executor
 
