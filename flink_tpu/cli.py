@@ -11,6 +11,8 @@ in-process runtime).
                                    [--slots N]
     python -m flink_tpu config-docs                  render the config-option
                                                      reference (flink-docs)
+    python -m flink_tpu shell [--master H:P]         interactive REPL with a
+                                                     preloaded environment
 """
 
 from __future__ import annotations
@@ -55,6 +57,8 @@ def main(argv=None) -> int:
     if verb == "bench":
         import subprocess
         return subprocess.call([sys.executable, "bench.py"] + rest)
+    if verb == "shell":
+        return _shell(rest)
     if verb == "config-docs":
         from flink_tpu.core.config_docs import main as docs_main
         return docs_main()
@@ -66,6 +70,35 @@ def main(argv=None) -> int:
           f"try: run | info | bench | jobmanager | taskmanager",
           file=sys.stderr)
     return 2
+
+
+def _shell(rest) -> int:
+    """Interactive REPL with a preloaded environment (ref:
+    flink-scala-shell/.../FlinkShell.scala — a shell wired to a local
+    or remote cluster)."""
+    import argparse
+    import code
+
+    ap = argparse.ArgumentParser(prog="flink_tpu shell")
+    ap.add_argument("--master", default=None,
+                    help="attach to a running cluster (host:port); "
+                         "default: local executor")
+    args = ap.parse_args(rest)
+
+    from flink_tpu.streaming.datastream import StreamExecutionEnvironment
+    env = StreamExecutionEnvironment()
+    if args.master:
+        env.use_remote_cluster(args.master)
+    import flink_tpu
+    namespace = {"env": env, "flink_tpu": flink_tpu}
+    banner = (f"flink_tpu {flink_tpu.__version__} shell — "
+              f"`env` is a StreamExecutionEnvironment"
+              + (f" attached to {args.master}" if args.master
+                 else " (local executor)")
+              + "\nExample: env.from_collection([1,2,3])"
+                ".map(lambda x: x*2).print_(); env.execute()")
+    code.interact(banner=banner, local=namespace)
+    return 0
 
 
 def _jobmanager(rest) -> int:
