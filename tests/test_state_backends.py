@@ -583,3 +583,36 @@ def test_spill_tier_config_key():
     cfg.set("state.backend.tpu.max-device-slots", 4096)
     backend = load_state_backend(cfg, FULL_RANGE, MAX_PAR)
     assert backend.max_device_slots == 4096
+
+
+# ---------------------------------------------------------------------
+# type extraction (TypeInformation / Types / the extractor analogue)
+# ---------------------------------------------------------------------
+
+def test_type_extraction_and_serializer_roundtrip():
+    from flink_tpu.core.types import Types, extract_type_infos, type_info_of
+
+    cases = [
+        (7, "Long"), (1.5, "Double"), (True, "Boolean"),
+        ("x", "String"), (b"b", "Bytes"),
+        ((1, "a"), "Tuple2<Long, String>"),
+        ([1, 2, 3], "List<Long>"),
+        ({"k": 2.0}, "Map<String, Double>"),
+    ]
+    for sample, name in cases:
+        info = type_info_of(sample)
+        assert info.name == name, (sample, info.name)
+        ser = info.create_serializer()
+        assert ser.deserialize_from_bytes(
+            ser.serialize_to_bytes(sample)) == sample
+
+    # unknown types widen to the pickled generic type
+    class Custom:
+        pass
+
+    assert type_info_of(Custom()).name == "Pickled"
+    assert extract_type_infos([1, 2]).name == "Long"
+    assert extract_type_infos([1, "a"]).name == "Pickled"
+    # composite constructor
+    t = Types.TUPLE(Types.LONG, Types.STRING)
+    assert t.arity == 2 and not t.is_basic_type
