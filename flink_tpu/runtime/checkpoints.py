@@ -91,15 +91,19 @@ class MemoryCheckpointStorage(CheckpointStorage):
 class FsCheckpointStorage(CheckpointStorage):
     """One pickle file per completed checkpoint under `dir/chk-N`
     (ref: FsStateBackend / FsCheckpointStorage — rename-free write then
-    atomic rename, so a torn write never becomes `latest`)."""
+    atomic rename, so a torn write never becomes `latest`).  The
+    directory resolves through the FileSystem SPI (core/fs.py), so
+    `mem://...` or any registered scheme works as checkpoint storage
+    exactly like the reference's pluggable checkpoint filesystems."""
 
     def __init__(self, directory: str, retain: int = 1):
-        self.directory = directory
+        from flink_tpu.core.fs import get_file_system
+        self.fs, self.directory = get_file_system(directory)
         self.retain = retain
-        os.makedirs(directory, exist_ok=True)
+        self.fs.makedirs(self.directory)
 
     def _path(self, checkpoint_id: int) -> str:
-        return os.path.join(self.directory, f"chk-{checkpoint_id}")
+        return f"{self.directory.rstrip('/')}/chk-{checkpoint_id}"
 
     def persist(self, checkpoint_id, metadata, task_snapshots):
         payload = {
@@ -108,13 +112,13 @@ class FsCheckpointStorage(CheckpointStorage):
             "tasks": task_snapshots,
         }
         tmp = self._path(checkpoint_id) + ".part"
-        with open(tmp, "wb") as f:
+        with self.fs.open(tmp, "wb") as f:
             pickle.dump(payload, f, protocol=pickle.HIGHEST_PROTOCOL)
             size = f.tell()
-        os.replace(tmp, self._path(checkpoint_id))
+        self.fs.replace(tmp, self._path(checkpoint_id))
         for cid in self.checkpoint_ids()[:-self.retain]:
             try:
-                os.remove(self._path(cid))
+                self.fs.remove(self._path(cid))
             except OSError:
                 pass
         return size
@@ -125,14 +129,14 @@ class FsCheckpointStorage(CheckpointStorage):
 
     def load(self, checkpoint_id):
         path = self._path(checkpoint_id)
-        if not os.path.exists(path):
+        if not self.fs.exists(path):
             return None
-        with open(path, "rb") as f:
+        with self.fs.open(path, "rb") as f:
             return pickle.load(f)
 
     def checkpoint_ids(self):
         ids = []
-        for name in os.listdir(self.directory):
+        for name in self.fs.listdir(self.directory):
             if name.startswith("chk-") and not name.endswith(".part"):
                 try:
                     ids.append(int(name[4:]))

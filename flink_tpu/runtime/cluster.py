@@ -380,7 +380,7 @@ class JobMaster(RpcEndpoint):
     ExecutionGraph future pipeline on the JM main thread."""
 
     RPC_METHODS = ("acknowledge_checkpoint", "decline_checkpoint",
-                   "update_task_execution_state")
+                   "update_task_execution_state", "fetch_restore_state")
 
     def __init__(self, job_id: str, blob_key: str, graph_blob: bytes,
                  job_config: dict, rpc_service: RpcService):
@@ -425,6 +425,16 @@ class JobMaster(RpcEndpoint):
                                     error_blob: bytes) -> None:
         """A task failed on its TaskExecutor (ref: JobMaster.java:440)."""
         self._failure_queue.append((attempt, task_key, error_blob))
+
+    def fetch_restore_state(self, attempt: int, task_keys) -> dict:
+        """Local-recovery miss path: serve the restore snapshots for
+        these tasks from the attempt's restore map."""
+        att, restore_map = getattr(self, "_attempt_restore", (None, None))
+        if att != attempt or restore_map is None:
+            raise RpcException(f"no restore state for attempt {attempt} "
+                               f"(deploy already completed)")
+        return {tuple(tk): restore_map[tuple(tk)] for tk in task_keys
+                if tuple(tk) in restore_map}
 
     # -- lifecycle ----------------------------------------------------
     def launch(self) -> None:
@@ -501,6 +511,11 @@ class JobMaster(RpcEndpoint):
                 rm.tell.release_slots(self.job_id)
             except Exception:  # noqa: BLE001
                 pass
+            for gw in self._gateways.values():
+                try:  # terminal: drop local-recovery state everywhere
+                    gw.tell.release_job(self.job_id)
+                except Exception:  # noqa: BLE001
+                    pass
             if self.on_terminal is not None:
                 self.on_terminal()
 
@@ -545,10 +560,14 @@ class JobMaster(RpcEndpoint):
                              for vid, v in jg.vertices.items() if v.is_source
                              for i in range(v.parallelism)})
         restore_map = None
+        restore_cid = None
         if restore_from is not None:
             restore_map = compute_restore_assignments(
                 {vid: v.parallelism for vid, v in jg.vertices.items()},
                 restore_from)
+            restore_cid = restore_from.get("checkpoint_id")
+        #: served to TaskExecutors that miss their local state store
+        self._attempt_restore = (attempt, restore_map)
 
         # deploy (Execution.deploy :488 → TaskExecutor.submitTask :383)
         cleanup_tms: List[dict] = []
@@ -557,10 +576,20 @@ class JobMaster(RpcEndpoint):
                 if not entry["assignments"]:
                     continue
                 restore = None
+                restore_refs = None
                 if restore_map is not None:
-                    restore = {tk: restore_map[tk]
-                               for tk in map(tuple, entry["assignments"])
-                               if tk in restore_map}
+                    mine = [tk for tk in map(tuple, entry["assignments"])
+                            if tk in restore_map]
+                    if restore_cid is not None and all(
+                            len(restore_map[tk]) == 1 for tk in mine):
+                        # local-recovery fast path (ref:
+                        # TaskLocalStateStore): ship only (task, cid)
+                        # references — the TaskExecutor restores from
+                        # its local copy of the acked snapshot and
+                        # fetches payloads only on a miss
+                        restore_refs = {tk: restore_cid for tk in mine}
+                    else:
+                        restore = {tk: restore_map[tk] for tk in mine}
                 tdd = {
                     "job_id": self.job_id, "attempt": attempt,
                     "master_epoch": self.master_epoch,
@@ -576,6 +605,7 @@ class JobMaster(RpcEndpoint):
                     "channel_capacity": self.job_config.get(
                         "channel_capacity", DEFAULT_CHANNEL_CAPACITY),
                     "restore": restore,
+                    "restore_refs": restore_refs,
                     "jm_address": self._rpc.address,
                     "jm_name": self.name,
                 }
@@ -585,6 +615,10 @@ class JobMaster(RpcEndpoint):
                 if entry["assignments"]:
                     self._gateway(entry["slot"]).sync.start_tasks(
                         self.job_id, attempt)
+            # all submit_tasks calls (and their synchronous local-
+            # recovery miss-fetches) are done — release the pinned
+            # full-state restore map
+            self._attempt_restore = (attempt, None)
             return self._supervise(attempt, by_tm, source_tms, storage)
         finally:
             for slot in cleanup_tms:
@@ -880,7 +914,7 @@ class TaskExecutor(RpcEndpoint):
     RPC_METHODS = ("ping", "allocate_slot", "submit_tasks", "start_tasks",
                    "job_status", "pause_job", "resume_job", "stop_workers",
                    "end_drain_round", "finish_vertex", "finish_job",
-                   "cancel_job", "trigger_checkpoint",
+                   "cancel_job", "release_job", "trigger_checkpoint",
                    "notify_checkpoint_complete")
 
     def __init__(self, tm_id: str, rpc_service: RpcService,
@@ -893,6 +927,16 @@ class TaskExecutor(RpcEndpoint):
         self.metrics = MetricRegistry()
         self._attempts: Dict[str, _JobAttempt] = {}  # job_id -> live attempt
         self._blob_cache: Dict[str, bytes] = {}
+        #: local recovery (ref: TaskLocalStateStore/TaskStateManager):
+        #: the last TWO acked snapshots per task (cid -> pickled) —
+        #: two, because the most common failure timing is a crash
+        #: while checkpoint N+1 is in flight, and the restore then
+        #: targets the still-latest-completed N
+        self._local_state: Dict[Tuple[str, Tuple[int, int]],
+                                Dict[int, bytes]] = {}
+        #: observability: restores served locally vs fetched from JM
+        self.local_restores = 0
+        self.remote_restores = 0
 
     # -- liveness -----------------------------------------------------
     def ping(self) -> str:
@@ -953,10 +997,45 @@ class TaskExecutor(RpcEndpoint):
                 st = att.by_key.get(tuple(tk))
                 if st is not None:
                     st.restore(list(snaps))
+        restore_refs = tdd.get("restore_refs")
+        if restore_refs:
+            import pickle as _pickle
+            misses = []
+            for tk, cid in restore_refs.items():
+                tk = tuple(tk)
+                local = self._local_state.get((job_id, tk), {})
+                if cid in local:
+                    st = att.by_key.get(tk)
+                    if st is not None:
+                        st.restore([_pickle.loads(local[cid])])
+                        self.local_restores += 1
+                else:
+                    misses.append(tk)
+            if misses:
+                fetched = att.jm_gateway.sync.fetch_restore_state(
+                    attempt, misses)
+                for tk, snaps in fetched.items():
+                    st = att.by_key.get(tuple(tk))
+                    if st is not None:
+                        st.restore(list(snaps))
+                        self.remote_restores += 1
 
         jm = att.jm_gateway
 
-        def ack(task_key, cid, snapshot, _jm=jm, _att=attempt):
+        def ack(task_key, cid, snapshot, _jm=jm, _att=attempt,
+                _jid=job_id):
+            # keep a pickled local copy first (local recovery), then
+            # ack to the coordinator
+            import pickle as _pickle
+            try:
+                entry = self._local_state.setdefault(
+                    (_jid, tuple(task_key)), {})
+                entry[cid] = _pickle.dumps(
+                    snapshot, protocol=_pickle.HIGHEST_PROTOCOL)
+                for old in sorted(entry)[:-2]:
+                    del entry[old]
+            except Exception:  # noqa: BLE001 — unpicklable snapshot:
+                pass           # the JM fallback path still works
             _jm.tell.acknowledge_checkpoint(_att, task_key, cid, snapshot)
 
         for st in att.subtasks:
@@ -1080,10 +1159,18 @@ class TaskExecutor(RpcEndpoint):
         att = self._require(job_id, attempt)
         accumulators: Dict[str, Any] = {}
         gather_accumulators(att.subtasks, accumulators)
+        self.release_job(job_id)
         att.teardown()
         self._drop_attempt_channels(att)
         self._attempts.pop(job_id, None)
         return accumulators
+
+    def release_job(self, job_id: str) -> None:
+        """Terminal disposal: the job will never restart here — drop
+        its local-recovery snapshots (cancel_job is per-ATTEMPT and
+        must keep them for the next restore)."""
+        for key in [k for k in self._local_state if k[0] == job_id]:
+            del self._local_state[key]
 
     def cancel_job(self, job_id: str, attempt: int) -> None:
         att = self._attempts.get(job_id)

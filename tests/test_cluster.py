@@ -59,6 +59,7 @@ def _records(n_keys=8, per_key=100):
 def cluster():
     jm = JobManagerProcess()
     tms = [TaskManagerProcess(jm.address, num_slots=2) for _ in range(2)]
+    jm._test_tms = tms  # test-only handle for counters
     yield jm
     for tm in tms:
         tm.stop()
@@ -153,8 +154,13 @@ class GatedSource(FromCollectionSource):
 def test_remote_exactly_once_recovery(cluster):
     """A task fails inside a TaskExecutor after a completed
     checkpoint; the JobMaster restarts the attempt from the snapshot
-    and the counts stay exactly-once."""
+    and the counts stay exactly-once.  The restore itself is served by
+    the TaskExecutors' LOCAL state stores (local recovery,
+    TaskLocalStateStore) — the restart TDD ships (task, checkpoint-id)
+    references, not payloads."""
     FailOnceAfterCheckpoint.reset()
+    before_local = sum(tm.task_executor.local_restores
+                       for tm in cluster._test_tms)
     records = _records(n_keys=6, per_key=200)
     env = _env(cluster)
     env.enable_checkpointing(20)
@@ -170,6 +176,9 @@ def test_remote_exactly_once_recovery(cluster):
     assert result.restarts == 1
     assert result.checkpoints_completed >= 1
     assert sum(result.accumulators["collected"]) == 6 * 200
+    after_local = sum(tm.task_executor.local_restores
+                      for tm in cluster._test_tms)
+    assert after_local > before_local, "restore never used local state"
 
 
 def test_remote_cancel(cluster):
