@@ -94,6 +94,8 @@ class _MemFile(io.BytesIO):
         self._lock = lock
 
     def close(self):
+        if self.closed:
+            return  # idempotent, like every other Python file object
         with self._lock:  # writers publish under the same lock every
             # other MemoryFileSystem operation holds
             self._store[self._path] = (self.getvalue(), _time.time())
@@ -108,6 +110,8 @@ class _MemTextFile(io.StringIO):
         self._lock = lock
 
     def close(self):
+        if self.closed:
+            return
         with self._lock:
             self._store[self._path] = (self.getvalue().encode(),
                                        _time.time())

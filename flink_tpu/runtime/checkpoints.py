@@ -232,20 +232,25 @@ def write_savepoint(directory: str, checkpoint_id: int, metadata: dict,
                     parallelisms: Dict[int, int]) -> str:
     """Atomic single-file savepoint: {checkpoint_id, metadata, tasks,
     parallelisms} — parallelisms (vertex_id -> subtask count at
-    snapshot time) let restore detect rescale."""
-    os.makedirs(directory, exist_ok=True)
-    path = os.path.join(directory, f"savepoint-{checkpoint_id}")
+    snapshot time) let restore detect rescale.  Resolves through the
+    FileSystem SPI like checkpoint storage (mem:// etc. work)."""
+    from flink_tpu.core.fs import get_file_system
+    fs, directory = get_file_system(directory)
+    fs.makedirs(directory)
+    path = f"{directory.rstrip('/')}/savepoint-{checkpoint_id}"
     payload = {"checkpoint_id": checkpoint_id, "metadata": metadata,
                "tasks": task_snapshots, "parallelisms": parallelisms}
     tmp = path + ".part"
-    with open(tmp, "wb") as f:
+    with fs.open(tmp, "wb") as f:
         pickle.dump(payload, f, protocol=pickle.HIGHEST_PROTOCOL)
-    os.replace(tmp, path)
+    fs.replace(tmp, path)
     return path
 
 
 def load_savepoint(path: str) -> dict:
-    with open(path, "rb") as f:
+    from flink_tpu.core.fs import get_file_system
+    fs, path = get_file_system(path)
+    with fs.open(path, "rb") as f:
         return pickle.load(f)
 
 
@@ -263,7 +268,13 @@ class CheckpointCoordinator:
                  notify_complete: Callable[[int], None],
                  min_pause_ms: int = 0,
                  max_concurrent: int = 1,
-                 clock: Callable[[], float] = None):
+                 clock: Callable[[], float] = None,
+                 metadata_extra: Optional[dict] = None):
+        #: merged into every completed checkpoint's metadata (e.g. the
+        #: JobMaster's master_epoch + attempt — the provenance local
+        #: recovery needs, since bare checkpoint ids are reused across
+        #: attempts)
+        self.metadata_extra = metadata_extra or {}
         self.interval_ms = interval_ms
         self.mode = mode  # exactly_once | at_least_once
         self.storage = storage
@@ -386,7 +397,8 @@ class CheckpointCoordinator:
         now = self._clock()
         state_bytes = self.storage.persist(
             pc.checkpoint_id,
-            {"timestamp": pc.timestamp, "mode": self.mode},
+            {"timestamp": pc.timestamp, "mode": self.mode,
+             **self.metadata_extra},
             pc.acks)
         self.completed_count += 1
         self.latest_completed_id = pc.checkpoint_id

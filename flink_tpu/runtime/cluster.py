@@ -560,12 +560,19 @@ class JobMaster(RpcEndpoint):
                              for vid, v in jg.vertices.items() if v.is_source
                              for i in range(v.parallelism)})
         restore_map = None
-        restore_cid = None
+        restore_ref = None
         if restore_from is not None:
             restore_map = compute_restore_assignments(
                 {vid: v.parallelism for vid, v in jg.vertices.items()},
                 restore_from)
-            restore_cid = restore_from.get("checkpoint_id")
+            md = restore_from.get("metadata", {})
+            if restore_from.get("checkpoint_id") is not None \
+                    and md.get("master_epoch") is not None:
+                # full provenance: (epoch, attempt, cid) uniquely names
+                # the snapshot — bare cids repeat across attempts
+                restore_ref = {"cid": restore_from["checkpoint_id"],
+                               "epoch": md["master_epoch"],
+                               "attempt": md["attempt"]}
         #: served to TaskExecutors that miss their local state store
         self._attempt_restore = (attempt, restore_map)
 
@@ -580,14 +587,14 @@ class JobMaster(RpcEndpoint):
                 if restore_map is not None:
                     mine = [tk for tk in map(tuple, entry["assignments"])
                             if tk in restore_map]
-                    if restore_cid is not None and all(
+                    if restore_ref is not None and all(
                             len(restore_map[tk]) == 1 for tk in mine):
                         # local-recovery fast path (ref:
-                        # TaskLocalStateStore): ship only (task, cid)
-                        # references — the TaskExecutor restores from
+                        # TaskLocalStateStore): ship only snapshot
+                        # REFERENCES — the TaskExecutor restores from
                         # its local copy of the acked snapshot and
                         # fetches payloads only on a miss
-                        restore_refs = {tk: restore_cid for tk in mine}
+                        restore_refs = {tk: restore_ref for tk in mine}
                     else:
                         restore = {tk: restore_map[tk] for tk in mine}
                 tdd = {
@@ -658,6 +665,8 @@ class JobMaster(RpcEndpoint):
                 trigger_sources=trigger_sources,
                 notify_complete=notify_complete,
                 min_pause_ms=cp_cfg.get("min_pause", 0),
+                metadata_extra={"master_epoch": self.master_epoch,
+                                "attempt": attempt},
             )
             ids = storage.checkpoint_ids()
             if ids:
@@ -1001,13 +1010,14 @@ class TaskExecutor(RpcEndpoint):
         if restore_refs:
             import pickle as _pickle
             misses = []
-            for tk, cid in restore_refs.items():
+            for tk, ref in restore_refs.items():
                 tk = tuple(tk)
+                key = (ref["epoch"], ref["attempt"], ref["cid"])
                 local = self._local_state.get((job_id, tk), {})
-                if cid in local:
+                if key in local:
                     st = att.by_key.get(tk)
                     if st is not None:
-                        st.restore([_pickle.loads(local[cid])])
+                        st.restore([_pickle.loads(local[key])])
                         self.local_restores += 1
                 else:
                     misses.append(tk)
@@ -1023,14 +1033,17 @@ class TaskExecutor(RpcEndpoint):
         jm = att.jm_gateway
 
         def ack(task_key, cid, snapshot, _jm=jm, _att=attempt,
-                _jid=job_id):
+                _jid=job_id, _epoch=epoch):
             # keep a pickled local copy first (local recovery), then
             # ack to the coordinator
             import pickle as _pickle
             try:
                 entry = self._local_state.setdefault(
                     (_jid, tuple(task_key)), {})
-                entry[cid] = _pickle.dumps(
+                # keyed by full provenance: (epoch, attempt, cid) —
+                # bare cids repeat across attempts and could restore a
+                # STALE prior-attempt snapshot
+                entry[(_epoch, _att, cid)] = _pickle.dumps(
                     snapshot, protocol=_pickle.HIGHEST_PROTOCOL)
                 for old in sorted(entry)[:-2]:
                     del entry[old]

@@ -54,6 +54,56 @@ INITIAL_CREDIT = 8
 ChannelKey = Tuple  # (job_id, attempt, edge_id, up_idx, down_idx)
 
 
+def encode_elements(batch: list):
+    """Wire record encoding (ref: SpanningRecordSerializer — the
+    typed per-record codecs of the reference's data plane).  Pure
+    StreamRecord batches of homogeneous primitives take a COLUMNAR
+    fast path (two numpy buffers instead of N pickled objects —
+    numeric shuffles dominate the keyBy exchange); everything else
+    (watermarks, barriers, EOS, composite values) rides pickle, the
+    universal Python codec."""
+    import numpy as np
+
+    from flink_tpu.streaming.elements import StreamRecord
+
+    if batch and all(type(el) is StreamRecord for el in batch):
+        vals = [el.value for el in batch]
+        vt = type(vals[0])
+        if vt in (int, float) and all(type(v) is vt for v in vals):
+            try:
+                ts = [el.timestamp for el in batch]
+                if all(t is None for t in ts):
+                    ts_arr = None
+                elif all(type(t) is int for t in ts):
+                    ts_arr = np.asarray(ts, np.int64).tobytes()
+                else:
+                    return ("pickle", batch)
+                dtype = np.int64 if vt is int else np.float64
+                return ("col", np.asarray(vals, dtype).tobytes(),
+                        np.dtype(dtype).name, ts_arr)
+            except OverflowError:
+                # arbitrary-precision ints beyond int64: pickle keeps
+                # them exact (the codec must never lose a record)
+                return ("pickle", batch)
+    return ("pickle", batch)
+
+
+def decode_elements(enc):
+    import numpy as np
+
+    from flink_tpu.streaming.elements import StreamRecord
+
+    if enc[0] == "pickle":
+        return enc[1]
+    _, val_bytes, dtype_name, ts_bytes = enc
+    vals = np.frombuffer(val_bytes, np.dtype(dtype_name))
+    cast = int if vals.dtype.kind == "i" else float
+    if ts_bytes is None:
+        return [StreamRecord(cast(v), None) for v in vals]
+    ts = np.frombuffer(ts_bytes, np.int64)
+    return [StreamRecord(cast(v), int(t)) for v, t in zip(vals, ts)]
+
+
 def _send(sock: socket.socket, obj: Any, lock: threading.Lock) -> None:
     # plain pickle, not cloudpickle: the data plane carries records
     # (data), never code — and pickle is measurably faster
@@ -168,7 +218,8 @@ class _ProducerConnection:
                         batch.append(ch.queue.popleft())
                     ch.sent += len(batch)
                     _send(self.sock, {"kind": "data", "channel": ch.key,
-                                      "elements": batch}, self.write_lock)
+                                      "elements": encode_elements(batch)},
+                          self.write_lock)
                     progressed = True
                 if not progressed:
                     self._wake.wait(0.001)
@@ -333,7 +384,7 @@ class DataClient:
                 binding = self._bindings.get(tuple(frame["channel"]))
                 if binding is None:
                     continue
-                elements = frame["elements"]
+                elements = decode_elements(frame["elements"])
                 binding.received += len(elements)
                 with binding.lock:
                     binding.granted -= 1

@@ -478,3 +478,41 @@ def test_checkpoint_job_on_mem_filesystem():
     from flink_tpu.core.fs import get_file_system
     fs, _ = get_file_system("mem://ckpt/job-a")
     assert any(n.startswith("chk-") for n in fs.listdir("mem://ckpt/job-a"))
+
+
+# ---------------------------------------------------------------------
+# wire record codecs (the SpanningRecordSerializer role)
+# ---------------------------------------------------------------------
+
+def test_wire_codec_columnar_and_fallback():
+    from flink_tpu.runtime.netchannel import decode_elements, encode_elements
+    from flink_tpu.streaming.elements import (
+        MAX_WATERMARK,
+        StreamRecord,
+        Watermark,
+    )
+
+    # homogeneous ints with timestamps -> columnar
+    batch = [StreamRecord(i * 3, i * 10) for i in range(100)]
+    enc = encode_elements(batch)
+    assert enc[0] == "col"
+    out = decode_elements(enc)
+    assert [(r.value, r.timestamp) for r in out] == \
+        [(r.value, r.timestamp) for r in batch]
+    assert all(type(r.value) is int for r in out)
+
+    # floats without timestamps -> columnar
+    batch = [StreamRecord(i * 0.5) for i in range(10)]
+    enc = encode_elements(batch)
+    assert enc[0] == "col"
+    assert [r.value for r in decode_elements(enc)] == \
+        [r.value for r in batch]
+
+    # mixed elements (watermarks/composites) -> pickle fallback
+    for batch in ([StreamRecord((1, 2), 5)],
+                  [StreamRecord(1, 5), Watermark(9)],
+                  [MAX_WATERMARK],
+                  []):
+        enc = encode_elements(batch)
+        assert enc[0] == "pickle"
+        assert decode_elements(enc) == batch
