@@ -144,7 +144,9 @@ def bench_hll(n_events=1 << 23, n_keys=1_000_000, precision=12):
     eng = VectorizedTumblingWindows(agg, 1000, initial_capacity=1 << 21,
                                     microbatch=1 << 20)
     eng.emit_arrays = True
-    tpu_rate = run_engine(eng, kh, ts, None, vh, horizon=999)
+    # 4 reps: the shared machine's 2-5x contention spikes are
+    # transient; best-of-N needs enough N to catch a quiet window
+    tpu_rate = run_engine(eng, kh, ts, None, vh, horizon=999, reps=4)
     fired = sum(len(k) for k, _, _, _ in eng.fired)
     assert fired > 0.9 * min(n_keys, n_events), fired
     return tpu_rate, base_rate
@@ -165,7 +167,7 @@ def bench_wordcount(n_events=1 << 23, n_words=50_000):
                                     microbatch=1 << 20)
     eng.emit_arrays = True
     tpu_rate = run_engine(eng, kh, ts, ones.astype(np.float32), None,
-                          horizon=4999)
+                          horizon=4999, reps=3)
     assert sum(len(k) for k, _, _, _ in eng.fired) > 0.9 * n_words
     return tpu_rate, base_rate
 
