@@ -275,7 +275,15 @@ def bench_sql(n_events=1 << 19, n_keys=20_000, precision=12):
 
 
 def main():
+    # single-config runs MERGE into the existing report instead of
+    # clobbering the other configs' results
     results = {}
+    if len(sys.argv) > 1:
+        try:
+            with open("bench_report.json") as f:
+                results = json.load(f)
+        except (OSError, ValueError):
+            pass
     suite = [
         ("wordcount", bench_wordcount),
         ("hll", bench_hll),

@@ -30,6 +30,9 @@ Differentially tested against the scalar WindowOperator.
 
 from __future__ import annotations
 
+import bisect
+import heapq
+
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import jax
@@ -76,6 +79,10 @@ class VectorizedSessionWindows(_ScratchMergeMixin):
         self.emit = emit
         self.emitted: List[Tuple[Any, Any, int, int]] = []
         self.num_late_dropped = 0
+        #: (end, key_hash) min-heap driving watermark expiry — entries
+        #: go stale when merges extend a session; pops revalidate
+        #: against the live table
+        self._expiry_heap: List[Tuple[int, int]] = []
 
         self._jit_update = make_masked_update(self.agg)
         self._jit_merge = jax.jit(self.agg.merge_slots, donate_argnums=0)
@@ -128,15 +135,18 @@ class VectorizedSessionWindows(_ScratchMergeMixin):
         sess_kh = kh_s[first_of]
 
         # post-merge lateness: a batch-session is late iff it overlaps
-        # no live session AND ends at/before the watermark
+        # no live session AND ends at/before the watermark.  Vectorized
+        # pre-filter: with time-ordered input the candidate set is
+        # empty, so the per-session Python probe below runs only for
+        # genuinely late stragglers
         live_mask = np.ones(n_sessions, bool)
-        for i in range(n_sessions):
-            if sess_end[i] - 1 <= self.watermark:
-                sessions = self.table.get(int(sess_kh[i]))
-                if not sessions or not any(
-                        s.start < sess_end[i] and sess_start[i] < s.end
-                        for s in sessions):
-                    live_mask[i] = False
+        candidates = np.nonzero(sess_end - 1 <= self.watermark)[0]
+        for i in candidates.tolist():
+            sessions = self.table.get(int(sess_kh[i]))
+            if not sessions or not any(
+                    s.start < sess_end[i] and sess_start[i] < s.end
+                    for s in sessions):
+                live_mask[i] = False
         if not live_mask.all():
             dropped_sessions = np.nonzero(~live_mask)[0]
             dropped_records = np.isin(sess_id, dropped_sessions)
@@ -181,6 +191,8 @@ class VectorizedSessionWindows(_ScratchMergeMixin):
         merge_src: List[int] = []
         free_after: List[int] = []
         keys_sorted = keys_arr[order]
+        heap_push = heapq.heappush
+        expiry = self._expiry_heap
         for i in live_sessions.tolist():
             khash = int(sess_kh[i])
             s_new = int(sess_start[i])
@@ -191,8 +203,10 @@ class VectorizedSessionWindows(_ScratchMergeMixin):
             overlapping = [s for s in sessions
                            if s.start < e_new and s_new < s.end]
             if not overlapping:
-                sessions.append(_Session(s_new, e_new, slot_new, key_obj))
-                sessions.sort(key=lambda s: s.start)
+                bisect.insort(sessions,
+                              _Session(s_new, e_new, slot_new, key_obj),
+                              key=lambda s: s.start)
+                heap_push(expiry, (e_new, khash))
                 continue
             # coalesce: keep the first live session as the survivor,
             # fold the batch slot and any other overlapped sessions in
@@ -209,6 +223,7 @@ class VectorizedSessionWindows(_ScratchMergeMixin):
                 merge_src.append(other.slot)
                 free_after.append(other.slot)
                 sessions.remove(other)
+            heap_push(expiry, (survivor.end, khash))
         self._merge_tiled(merge_dst, merge_src)
         self._clear_release(free_after)
 
@@ -218,8 +233,21 @@ class VectorizedSessionWindows(_ScratchMergeMixin):
         fired = 0
         fire_slots: List[int] = []
         fire_meta: List[Tuple[Any, int, int]] = []
-        for khash in list(self.table):
-            sessions = self.table[khash]
+        # expiry-heap walk: only keys whose (possibly stale) minimum
+        # session end is due are visited — an advance that retires
+        # nothing is O(1), not O(keys) (merge-extended sessions leave
+        # stale heap entries behind; revalidation against the live
+        # table makes them harmless)
+        expiry = self._expiry_heap
+        seen: set = set()
+        while expiry and expiry[0][0] - 1 <= watermark:
+            _, khash = heapq.heappop(expiry)
+            if khash in seen:
+                continue
+            seen.add(khash)
+            sessions = self.table.get(khash)
+            if not sessions:
+                continue
             remaining = []
             for s in sessions:
                 if s.end - 1 <= watermark:
@@ -274,5 +302,10 @@ class VectorizedSessionWindows(_ScratchMergeMixin):
         self.table = {kh: [_Session(s, e, slot, key)
                            for (s, e, slot, key) in lst]
                       for kh, lst in snap["table"].items()}
+        # rebuild the expiry heap from the restored live sessions
+        self._expiry_heap = [(s.end, kh)
+                             for kh, lst in self.table.items()
+                             for s in lst]
+        heapq.heapify(self._expiry_heap)
         if snap.get("scratch") is not None:
             self._scratch_slot_id = snap["scratch"]
