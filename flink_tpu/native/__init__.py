@@ -73,6 +73,17 @@ def _ensure_loaded() -> Optional[ctypes.CDLL]:
         lib.ft_index_set.argtypes = [c.c_void_p, u64p, i64p, c.c_int64]
         lib.ft_index_export.argtypes = [c.c_void_p, u64p, i64p]
         lib.ft_index_export.restype = c.c_int64
+        u16p = np.ctypeslib.ndpointer(np.uint16, flags="C_CONTIGUOUS")
+        u8p = np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS")
+        lib.ft_hll_log_compact.argtypes = [
+            u64p, u16p, u8p, c.c_int64, c.c_int,
+            u64p, u16p, u8p, i32p, c.POINTER(c.c_int64)]
+        lib.ft_hll_log_compact.restype = c.c_int64
+        lib.ft_hll_log_fire.argtypes = [
+            u64p, u16p, u8p, c.c_int64, c.c_int, u64p, f64p]
+        lib.ft_hll_log_fire.restype = c.c_int64
+        lib.ft_sum_log_fire.argtypes = [u64p, f64p, c.c_int64, u64p, f64p]
+        lib.ft_sum_log_fire.restype = c.c_int64
         _lib = lib
     except Exception as e:  # noqa: BLE001 — no compiler / bad env
         _load_error = str(e)
@@ -152,6 +163,56 @@ class NativeSlotIndex:
         slots = np.empty(n, np.int64)
         k = _lib.ft_index_export(self._h, hashes, slots)
         return hashes[:k], slots[:k]
+
+
+# ---- log-structured window engine kernels ---------------------------------
+
+def hll_log_compact(keys: np.ndarray, regs: np.ndarray, ranks: np.ndarray,
+                    precision: int):
+    """Sort a window's HLL cell log by key and dedup (reg)->max(rank).
+    Returns (uniq cell keys, regs, ranks, per-key run ends)."""
+    lib = _ensure_loaded()
+    n = len(keys)
+    keys = np.ascontiguousarray(keys, np.uint64)
+    regs = np.ascontiguousarray(regs, np.uint16)
+    ranks = np.ascontiguousarray(ranks, np.uint8)
+    ok = np.empty(n, np.uint64)
+    orr = np.empty(n, np.uint16)
+    ork = np.empty(n, np.uint8)
+    ends = np.empty(n, np.int32)
+    n_cells = ctypes.c_int64(0)
+    n_keys = lib.ft_hll_log_compact(keys, regs, ranks, n, precision,
+                                    ok, orr, ork, ends,
+                                    ctypes.byref(n_cells))
+    c = n_cells.value
+    return ok[:c], orr[:c], ork[:c], ends[:n_keys]
+
+
+def hll_log_fire(keys: np.ndarray, regs: np.ndarray, ranks: np.ndarray,
+                 precision: int):
+    """Host-tier HLL fire over a window's cell log: per distinct key,
+    the estimate (same math as sketches.HyperLogLogAggregate)."""
+    lib = _ensure_loaded()
+    n = len(keys)
+    keys = np.ascontiguousarray(keys, np.uint64)
+    regs = np.ascontiguousarray(regs, np.uint16)
+    ranks = np.ascontiguousarray(ranks, np.uint8)
+    ok = np.empty(n, np.uint64)
+    est = np.empty(n, np.float64)
+    n_keys = lib.ft_hll_log_fire(keys, regs, ranks, n, precision, ok, est)
+    return ok[:n_keys], est[:n_keys]
+
+
+def sum_log_fire(keys: np.ndarray, values: np.ndarray):
+    """Per distinct key, the sum of its logged values (key-sorted)."""
+    lib = _ensure_loaded()
+    n = len(keys)
+    keys = np.ascontiguousarray(keys, np.uint64)
+    values = np.ascontiguousarray(values, np.float64)
+    ok = np.empty(n, np.uint64)
+    s = np.empty(n, np.float64)
+    n_keys = lib.ft_sum_log_fire(keys, values, n, ok, s)
+    return ok[:n_keys], s[:n_keys]
 
 
 # ---- compiled baselines (bench.py) ----------------------------------------

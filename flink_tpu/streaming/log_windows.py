@@ -1,0 +1,283 @@
+"""Log-structured tumbling-window engine — the combiner tier.
+
+The reference's windowed-aggregation hot path is one random
+read-modify-write of keyed state per record (heap:
+``HeapAggregatingState.add`` → ``stateTable.transform``,
+HeapAggregatingState.java:80-89; RocksDB: a get/deserialize/add/put
+round trip, RocksDBAggregatingState.java:108-131).  At multi-GB state
+that mechanism is memory-latency-bound on every substrate — the
+compiled host baseline and the XLA scatter path both measure in the
+single-digit M updates/s (BENCH_NOTES.md).
+
+This engine restructures the work the TPU-first way (SURVEY.md §7
+"per-record semantics vs batched execution"): **ingest appends** the
+record's aggregate *cells* to a per-window log at memcpy speed, and
+the **fire sorts the log and reduces each key's run densely** —
+adaptive LSD radix sort + segmented reduction (native/host_runtime.cpp
+``ft_hll_log_*`` / ``ft_sum_log_fire``), with an optional on-device
+finish (`finish_tier="device"`) that runs the transcendental estimate
+phase as one jitted scan over the compacted cells.  It is the same
+pre-aggregation seam the reference exposes as chained combiners
+(AggregateUtil.scala:1028): state per window is bounded by
+min(events, keys x m) via periodic log compaction, and a window's
+state snapshot is its (compacted) log — smaller than a dense register
+file whenever events/window < keys x m.
+
+Scope: integer-keyed streams (the key rides in the log; grouping is
+exact, no hash collisions) and the mergeable aggregates with a cell
+decomposition — HyperLogLog (cell = (register, rank), combine = max)
+and Sum (cell = value, combine = add).  Other aggregates use the
+device-resident scatter engine (vectorized.py), which also remains
+the multi-chip path (parallel/mesh_windows.py).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+import flink_tpu.native as nat
+from flink_tpu.ops.device_agg import DeviceAggregateFunction, SumAggregate
+from flink_tpu.ops.hashing import split_hash64_np
+from flink_tpu.ops.sketches import HyperLogLogAggregate
+
+
+class _WindowLog:
+    """Columnar append log for one window."""
+
+    __slots__ = ("keys", "cols", "count")
+
+    def __init__(self):
+        self.keys: List[np.ndarray] = []
+        self.cols: List[Tuple[np.ndarray, ...]] = []
+        self.count = 0
+
+    def append(self, keys: np.ndarray, *cols: np.ndarray) -> None:
+        self.keys.append(keys)
+        self.cols.append(cols)
+        self.count += len(keys)
+
+    def concat(self) -> Tuple[np.ndarray, Tuple[np.ndarray, ...]]:
+        keys = (self.keys[0] if len(self.keys) == 1
+                else np.concatenate(self.keys))
+        n_cols = len(self.cols[0])
+        cols = tuple(
+            (self.cols[0][j] if len(self.cols) == 1
+             else np.concatenate([c[j] for c in self.cols]))
+            for j in range(n_cols))
+        self.keys = [keys]
+        self.cols = [cols]
+        return keys, cols
+
+
+class LogStructuredTumblingWindows:
+    """Batched keyBy().window(Tumbling...).aggregate(agg), combiner
+    tier.  Same engine interface as VectorizedTumblingWindows.
+
+    finish_tier: "host" (C++ fused sort+estimate), "device" (C++
+    sort/compact, then one jitted exp2/cumsum finish on TPU), or
+    "auto" (host — on tunnel-attached chips the 34 MB/window D2H of
+    the scan exceeds the host finish; flip to device on pod hosts).
+    """
+
+    def __init__(self, aggregate: DeviceAggregateFunction,
+                 window_size_ms: int,
+                 compact_threshold: int = 64 << 20,
+                 finish_tier: str = "auto",
+                 emit=None):
+        if isinstance(aggregate, HyperLogLogAggregate):
+            if aggregate.precision > 16:
+                raise ValueError("log engine supports precision <= 16 "
+                                 "(u16 register cells)")
+            self._mode = "hll"
+        elif isinstance(aggregate, SumAggregate):
+            self._mode = "sum"
+        else:
+            raise TypeError(
+                "LogStructuredTumblingWindows supports HyperLogLog and Sum "
+                "cell decompositions; use VectorizedTumblingWindows for "
+                f"{type(aggregate).__name__}")
+        if not nat.available():
+            raise RuntimeError(f"native runtime required: {nat.load_error()}")
+        self.agg = aggregate
+        self.size = window_size_ms
+        self.compact_threshold = compact_threshold
+        self.finish_tier = finish_tier
+        self.windows: Dict[int, _WindowLog] = {}
+        self.watermark = -(2 ** 63)
+        self.emit = emit
+        self.emitted: List[Tuple[Any, Any, int, int]] = []
+        self.emit_arrays = False
+        self.fired: List[Tuple[np.ndarray, np.ndarray, int, int]] = []
+        self.num_late_dropped = 0
+        self._jit_finish = None
+
+    # ---- ingestion --------------------------------------------------
+    def process_batch(self, keys, timestamps, values=None,
+                      key_hashes=None, value_hashes=None) -> None:
+        ts = np.asarray(timestamps, np.int64)
+        keys = np.asarray(keys)
+        if not np.issubdtype(keys.dtype, np.integer):
+            raise TypeError("log engine requires integer keys "
+                            "(the key rides in the log)")
+        keys = keys.astype(np.uint64, copy=False)
+        starts = ts - np.mod(ts, self.size)
+        live = starts + self.size - 1 > self.watermark
+        if not live.all():
+            self.num_late_dropped += int((~live).sum())
+            if not live.any():
+                return
+            keys, ts, starts = keys[live], ts[live], starts[live]
+            if values is not None:
+                values = np.asarray(values)[live]
+            if value_hashes is not None:
+                value_hashes = np.asarray(value_hashes)[live]
+
+        if self._mode == "hll":
+            if value_hashes is None:
+                from flink_tpu.streaming.vectorized import hash_keys_np
+                value_hashes = hash_keys_np(values)
+            hi, lo = split_hash64_np(value_hashes)
+            ranks, regs = self.agg.compress_value_hash(hi, lo)
+            cols = (np.ascontiguousarray(regs, np.uint16),
+                    np.ascontiguousarray(ranks, np.uint8))
+        else:
+            cols = (np.asarray(values, np.float64),)
+
+        uniq_starts = np.unique(starts)
+        for start in uniq_starts:
+            log = self.windows.get(int(start))
+            if log is None:
+                log = self.windows[int(start)] = _WindowLog()
+            if len(uniq_starts) == 1:
+                log.append(keys, *cols)
+            else:
+                mask = starts == start
+                log.append(keys[mask], *(c[mask] for c in cols))
+            if log.count > self.compact_threshold:
+                self._compact(log)
+
+    def flush(self, grow_to: Optional[int] = None) -> None:
+        """No device micro-batch to flush — kept for interface parity."""
+
+    def _compact(self, log: _WindowLog) -> None:
+        keys, cols = log.concat()
+        if self._mode == "hll":
+            ck, cr, crk, _ = nat.hll_log_compact(
+                keys, cols[0], cols[1], self.agg.precision)
+            log.keys = [ck]
+            log.cols = [(cr, crk)]
+            log.count = len(ck)
+        else:
+            ks, sums = nat.sum_log_fire(keys, cols[0])
+            log.keys = [ks]
+            log.cols = [(sums,)]
+            log.count = len(ks)
+
+    # ---- firing -----------------------------------------------------
+    def advance_watermark(self, watermark: int) -> int:
+        self.watermark = watermark
+        fired = 0
+        for start in sorted(self.windows):
+            if start + self.size - 1 > watermark:
+                continue
+            log = self.windows.pop(start)
+            if log.count == 0:
+                continue
+            keys, cols = log.concat()
+            if self._mode == "hll":
+                out_keys, results = self._fire_hll(keys, cols)
+            else:
+                out_keys, results = nat.sum_log_fire(keys, cols[0])
+                results = results.astype(self.agg.value_dtype)
+            end = start + self.size
+            if self.emit_arrays:
+                self.fired.append((out_keys, results, start, end))
+            elif self.emit is not None:
+                for k, r in zip(out_keys, results):
+                    self.emit(k, r, start, end)
+            else:
+                self.emitted.extend(zip(out_keys, results,
+                                        [start] * len(out_keys),
+                                        [end] * len(out_keys)))
+            fired += len(out_keys)
+        return fired
+
+    def _fire_hll(self, keys, cols):
+        if self.finish_tier == "device":
+            ck, cr, crk, ends = nat.hll_log_compact(
+                keys, cols[0], cols[1], self.agg.precision)
+            uniq = ck[ends - 1]
+            return uniq, self._device_finish(crk, ends)
+        return nat.hll_log_fire(keys, cols[0], cols[1], self.agg.precision)
+
+    def _device_finish(self, ranks: np.ndarray, ends: np.ndarray):
+        """One jitted pass over the compacted cells: exp2 contributions,
+        cumsum, per-key diff at run ends, estimate — the dense phase of
+        the fire on the device (pads to power-of-two jit shapes)."""
+        import jax
+        import jax.numpy as jnp
+
+        if self._jit_finish is None:
+            m = float(self.agg.m)
+            alpha = self.agg.alpha
+
+            def finish(ranks_p, ends_p, n_cells, n_keys):
+                cell_live = jnp.arange(ranks_p.shape[0]) < n_cells
+                inv = jnp.where(
+                    cell_live,
+                    jnp.exp2(-ranks_p.astype(jnp.float32)) - 1.0, 0.0)
+                cs = jnp.cumsum(inv)
+                key_live = jnp.arange(ends_p.shape[0]) < n_keys
+                e = jnp.where(key_live, ends_p, 1)
+                cum_at_end = cs[e - 1]
+                prev = jnp.concatenate([jnp.zeros(1), cum_at_end[:-1]])
+                seg = cum_at_end - prev
+                prev_e = jnp.concatenate(
+                    [jnp.zeros(1, e.dtype), e[:-1]])
+                n_present = (e - prev_e).astype(jnp.float32)
+                sum_inv = m + seg
+                est = alpha * m * m / sum_inv
+                zeros = m - n_present
+                linear = m * (jnp.log(m) - jnp.log(jnp.maximum(zeros, 1.0)))
+                return jnp.where((est <= 2.5 * m) & (zeros > 0),
+                                 linear, est)
+
+            self._jit_finish = jax.jit(finish, static_argnums=())
+        n_cells, n_keys = len(ranks), len(ends)
+        pc = 1 << max(0, (n_cells - 1)).bit_length()
+        pk = 1 << max(0, (n_keys - 1)).bit_length()
+        ranks_p = np.zeros(pc, np.uint8)
+        ranks_p[:n_cells] = ranks
+        ends_p = np.ones(pk, np.int32)
+        ends_p[:n_keys] = ends
+        out = np.asarray(self._jit_finish(ranks_p, ends_p,
+                                          np.int32(n_cells),
+                                          np.int32(n_keys)))
+        return out[:n_keys].astype(np.float64)
+
+    # ---- checkpoint integration ------------------------------------
+    def snapshot(self) -> dict:
+        wins = {}
+        for start, log in self.windows.items():
+            keys, cols = log.concat()
+            wins[int(start)] = {"keys": keys.copy(),
+                                "cols": [c.copy() for c in cols]}
+        return {"mode": self._mode, "size": self.size,
+                "watermark": self.watermark,
+                "num_late_dropped": self.num_late_dropped,
+                "windows": wins}
+
+    def restore(self, snap: dict) -> None:
+        self.watermark = snap["watermark"]
+        self.num_late_dropped = snap["num_late_dropped"]
+        self.windows = {}
+        for start, w in snap["windows"].items():
+            log = _WindowLog()
+            log.append(np.asarray(w["keys"], np.uint64),
+                       *(np.asarray(c) for c in w["cols"]))
+            self.windows[int(start)] = log
+
+    def block_until_ready(self) -> None:
+        """Host-tier state is always materialized."""

@@ -298,16 +298,29 @@ class _ScratchMergeMixin:
 class _WindowShard:
     """Per-live-window bookkeeping: its own slot index + first-seen
     keys (and their hashes, for cross-window merging), all slots drawn
-    from the shared arena."""
+    from the shared arena.  Keys stay as numpy arrays end to end —
+    per-record Python boxing (.tolist) measurably dominates the host
+    side of the ingest loop at 1M+ keys/window."""
 
-    __slots__ = ("start", "index", "keys", "slot_list", "hash_list")
+    __slots__ = ("start", "index", "key_list", "slot_list", "hash_list")
 
     def __init__(self, start: int):
         self.start = start
         self.index = make_slot_index()
-        self.keys: List[Any] = []
+        self.key_list: List[np.ndarray] = []
         self.slot_list: List[np.ndarray] = []
         self.hash_list: List[np.ndarray] = []
+
+    @property
+    def n_keys(self) -> int:
+        return sum(len(a) for a in self.key_list)
+
+    def all_keys(self) -> np.ndarray:
+        if not self.key_list:
+            return np.empty(0, object)
+        if len(self.key_list) > 1:
+            self.key_list = [np.concatenate(self.key_list)]
+        return self.key_list[0]
 
     def all_slots(self) -> np.ndarray:
         if not self.slot_list:
@@ -346,9 +359,10 @@ class VectorizedTumblingWindows:
         self.emit = emit
         self.emitted: List[Tuple[Any, Any, int, int]] = []
         #: True → skip per-key tuples; fires land in `fired` as
-        #: (keys_list, results_np, start, end) batches
+        #: (keys_np, results_np, start, end) batches, both in
+        #: slot-sorted fire order
         self.emit_arrays = False
-        self.fired: List[Tuple[list, np.ndarray, int, int]] = []
+        self.fired: List[Tuple[np.ndarray, np.ndarray, int, int]] = []
         self.num_late_dropped = 0
         # pending micro-batch (pre-allocated growing buffers)
         self._p_slots: List[np.ndarray] = []
@@ -456,7 +470,7 @@ class VectorizedTumblingWindows:
             slots, new_uniq, first_idx = shard.index.lookup_or_insert(
                 bh, self.arena.alloc)
             if len(first_idx):
-                shard.keys.extend(masked_keys[first_idx].tolist())
+                shard.key_list.append(masked_keys[first_idx])
                 shard.slot_list.append(np.asarray(slots[first_idx], np.int64))
                 shard.hash_list.append(np.asarray(bh[first_idx], np.uint64))
             self._buffer(slots, m_values, m_vhashes)
@@ -533,22 +547,38 @@ class VectorizedTumblingWindows:
             slots = shard.all_slots()
             if len(slots):
                 end = start + self.size
-                if self.emit_arrays:
-                    self.fired.append(
-                        (shard.keys, self._gather_tiled_np(slots), start, end))
-                else:
-                    results = self._gather_tiled(slots)
-                    if self.emit is not None:
-                        for key, res in zip(shard.keys, results):
-                            self.emit(key, res, start, end)
-                    else:
-                        self.emitted.extend(
-                            zip(shard.keys, results,
-                                [start] * len(slots), [end] * len(slots)))
+                slots = self._emit_fire(shard.all_keys(), slots, start, end)
                 fired += len(slots)
                 self._clear_tiled(slots)
                 self.arena.release(slots)
         return fired
+
+    def _emit_fire(self, keys, slots: np.ndarray, start: int, end: int):
+        """Fire (keys, slots) in slot-sorted order; returns the slots
+        in fire order so callers clear/release the same layout.
+
+        Slot order matters: a window's slots are a dense arena range
+        (up to free-list fragmentation), so the sorted gather/clear
+        collapses to dynamic-slice tiles (memory bandwidth) instead of
+        row gathers (~2.5M rows/s); sorted release also keeps future
+        allocations ascending, so the property is self-sustaining."""
+        if len(slots) == 0:
+            return slots
+        keys = keys if isinstance(keys, np.ndarray) else np.asarray(
+            keys, dtype=object)
+        order = np.argsort(slots, kind="stable")
+        slots = slots[order]
+        keys = keys[order]
+        if self.emit_arrays:
+            self.fired.append((keys, self._gather_tiled_np(slots),
+                               start, end))
+        elif self.emit is not None:
+            for key, res in zip(keys, self._gather_tiled(slots)):
+                self.emit(key, res, start, end)
+        else:
+            self.emitted.extend(zip(keys, self._gather_tiled(slots),
+                                    [start] * len(slots), [end] * len(slots)))
+        return slots
 
     def _is_contiguous_tile(self, chunk: np.ndarray, tile: int) -> bool:
         """Full tile of strictly consecutive slots, fully inside the
@@ -734,15 +764,15 @@ class VectorizedSlidingWindows(_ScratchMergeMixin, VectorizedTumblingWindows):
                 # single-pane window: gather straight from pane slots
                 shard = panes[0]
                 slots = shard.all_slots()
-                keys = shard.keys
+                keys = shard.all_keys()
                 self._emit_fire(keys, slots, W, end)
                 fired += len(slots)
                 continue
             # union the panes' keys into fresh fire slots, merging on
             # device pane by pane
             union_index = make_slot_index(
-                sum(len(p.keys) for p in panes))
-            union_keys: List[Any] = []
+                sum(p.n_keys for p in panes))
+            union_key_list: List[np.ndarray] = []
             union_slot_list: List[np.ndarray] = []
             for shard in panes:
                 ph = shard.all_hashes()
@@ -750,31 +780,19 @@ class VectorizedSlidingWindows(_ScratchMergeMixin, VectorizedTumblingWindows):
                 uslots, _, first_idx = union_index.lookup_or_insert(
                     ph, self.arena.alloc)
                 if len(first_idx):
-                    pk = shard.keys
-                    union_keys.extend(pk[i] for i in first_idx.tolist())
+                    union_key_list.append(shard.all_keys()[first_idx])
                     union_slot_list.append(uslots[first_idx])
                 self._merge_tiled(uslots, pslots)
             union_slots = (np.concatenate(union_slot_list)
                            if union_slot_list else np.empty(0, np.int64))
-            self._emit_fire(union_keys, union_slots, W, end)
+            union_keys = (np.concatenate(union_key_list)
+                          if union_key_list else np.empty(0, object))
+            union_slots = self._emit_fire(union_keys, union_slots, W, end)
             fired += len(union_slots)
             self._clear_tiled(union_slots)
             self.arena.release(union_slots)
         self._prune_panes(watermark)
         return fired
-
-    def _emit_fire(self, keys, slots: np.ndarray, start: int, end: int):
-        if len(slots) == 0:
-            return
-        if self.emit_arrays:
-            self.fired.append((list(keys), self._gather_tiled_np(slots),
-                               start, end))
-        elif self.emit is not None:
-            for key, res in zip(keys, self._gather_tiled(slots)):
-                self.emit(key, res, start, end)
-        else:
-            self.emitted.extend(zip(keys, self._gather_tiled(slots),
-                                    [start] * len(slots), [end] * len(slots)))
 
     def _prune_panes(self, watermark: int) -> None:
         """Pane [P, P+slide) is dead once its last containing window
@@ -785,6 +803,7 @@ class VectorizedSlidingWindows(_ScratchMergeMixin, VectorizedTumblingWindows):
             shard = self.windows.pop(P)
             slots = shard.all_slots()
             if len(slots):
+                slots = np.sort(slots)
                 self._clear_tiled(slots)
                 self.arena.release(slots)
 
@@ -814,14 +833,19 @@ def _snapshot_shard(sh: _WindowShard) -> dict:
         occ = sh.index.table_hash != _EMPTY
         ih = sh.index.table_hash[occ].copy()
         isl = sh.index.table_slot[occ].copy()
-    return {"start": sh.start, "keys": list(sh.keys),
+    return {"start": sh.start, "keys": sh.all_keys().copy(),
             "slots": sh.all_slots().copy(), "hashes": sh.all_hashes().copy(),
             "index_hashes": ih, "index_slots": isl}
 
 
 def _restore_shard(snap: dict) -> _WindowShard:
     sh = _WindowShard(snap["start"])
-    sh.keys = list(snap["keys"])
+    ks = snap["keys"]
+    if not isinstance(ks, np.ndarray):  # legacy list-format snapshot
+        arr = np.empty(len(ks), object)
+        arr[:] = ks
+        ks = arr
+    sh.key_list = [ks] if len(ks) else []
     sh.slot_list = [np.array(snap["slots"], np.int64)]
     sh.hash_list = [np.array(snap["hashes"], np.uint64)]
     if "index_hash" in snap:  # legacy full-table snapshot format

@@ -228,6 +228,187 @@ void ft_key_groups(const uint64_t* kh, int32_t* out, int64_t n,
   }
 }
 
+}  // extern "C" (reopened below — the log-engine templates need C++ linkage)
+
+// ---- log-structured window engine support ---------------------------------
+// The combiner tier of the windowed-aggregation engines (the role of
+// the reference's pre-aggregation seam, AggregateUtil.scala:1028 /
+// chained combiners): ingest appends (key, cell, payload) triples to a
+// per-window log; the fire turns random per-record state RMW into
+// sort + segmented dense reduction.  The sort is an adaptive LSD radix
+// (skips constant high bits of the key range); per-key dedup uses an
+// L1-resident scratch register file.  The estimate math mirrors
+// flink_tpu/ops/sketches.py HyperLogLogAggregate._estimate exactly.
+
+namespace {
+
+struct HllRec {
+  uint64_t key;
+  uint32_t aux;  // reg (low 16) | rank << 16
+};
+
+struct SumRec {
+  uint64_t key;
+  double value;
+};
+
+// Adaptive LSD radix sort by .key (stable).  Sorts in place via a
+// ping-pong scratch; returns pointer to the sorted buffer (either
+// recs or scratch).
+template <typename Rec>
+Rec* radix_sort_by_key(Rec* recs, Rec* scratch, int64_t n) {
+  if (n <= 1) return recs;
+  uint64_t key_or = 0;
+  for (int64_t i = 0; i < n; ++i) key_or |= recs[i].key;
+  int bits = 64 - (key_or ? __builtin_clzll(key_or) : 63);
+  const int DIGIT = 11;
+  const int R = 1 << DIGIT;
+  int passes = (bits + DIGIT - 1) / DIGIT;
+  if (passes == 0) passes = 1;
+  // one counting pass for all digit histograms
+  std::vector<int64_t> counts(static_cast<size_t>(passes) * R, 0);
+  for (int64_t i = 0; i < n; ++i) {
+    uint64_t k = recs[i].key;
+    for (int p = 0; p < passes; ++p)
+      ++counts[static_cast<size_t>(p) * R + ((k >> (p * DIGIT)) & (R - 1))];
+  }
+  Rec* src = recs;
+  Rec* dst = scratch;
+  for (int p = 0; p < passes; ++p) {
+    int64_t* c = &counts[static_cast<size_t>(p) * R];
+    int64_t sum = 0;
+    for (int d = 0; d < R; ++d) {
+      int64_t t = c[d];
+      c[d] = sum;
+      sum += t;
+    }
+    int shift = p * DIGIT;
+    for (int64_t i = 0; i < n; ++i)
+      dst[c[(src[i].key >> shift) & (R - 1)]++] = src[i];
+    Rec* t = src;
+    src = dst;
+    dst = t;
+  }
+  return src;
+}
+
+// Sort an HLL cell log by key (stable radix) and walk each key's run,
+// deduping (reg) -> max(rank) through an L1-resident scratch register
+// file.  Calls per_key(key, touched_regs, reg_max) once per distinct
+// key; reg_max entries for the touched regs are cleared afterwards.
+// Safe because ranks are always >= 1 (compress_value_hash contract,
+// flink_tpu/ops/sketches.py) so reg_max == 0 means "not touched".
+template <typename PerKey>
+void hll_log_scan(const uint64_t* keys, const uint16_t* regs,
+                  const uint8_t* ranks, int64_t n, int64_t m,
+                  PerKey&& per_key) {
+  std::vector<HllRec> buf(n), scratch(n);
+  for (int64_t i = 0; i < n; ++i)
+    buf[i] = {keys[i], static_cast<uint32_t>(regs[i]) |
+                           (static_cast<uint32_t>(ranks[i]) << 16)};
+  HllRec* sorted = radix_sort_by_key(buf.data(), scratch.data(), n);
+  std::vector<uint8_t> reg_max(m, 0);
+  std::vector<uint16_t> touched;
+  touched.reserve(1024);
+  int64_t i = 0;
+  while (i < n) {
+    uint64_t k = sorted[i].key;
+    touched.clear();
+    for (; i < n && sorted[i].key == k; ++i) {
+      uint16_t r = static_cast<uint16_t>(sorted[i].aux & 0xFFFF);
+      uint8_t rk = static_cast<uint8_t>(sorted[i].aux >> 16);
+      if (reg_max[r] == 0) touched.push_back(r);
+      if (reg_max[r] < rk) reg_max[r] = rk;
+    }
+    per_key(k, touched, reg_max);
+    for (uint16_t r : touched) reg_max[r] = 0;
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+// Sort an HLL window log by key (stable), dedup each key's (reg) cells
+// to the max rank.  Outputs compacted triples in key-sorted order plus
+// the exclusive end of each key's cell run.  Returns n_keys and writes
+// the compacted cell count to *n_cells_out.  Output buffers must hold
+// n entries.  precision <= 16 (reg is u16 — the compress_value_hash
+// contract, flink_tpu/ops/sketches.py).
+int64_t ft_hll_log_compact(const uint64_t* keys, const uint16_t* regs,
+                           const uint8_t* ranks, int64_t n, int precision,
+                           uint64_t* out_keys, uint16_t* out_regs,
+                           uint8_t* out_ranks, int32_t* out_ends,
+                           int64_t* n_cells_out) {
+  int64_t n_keys = 0, n_cells = 0;
+  hll_log_scan(keys, regs, ranks, n, 1ll << precision,
+               [&](uint64_t k, const std::vector<uint16_t>& touched,
+                   const std::vector<uint8_t>& reg_max) {
+    for (uint16_t r : touched) {
+      out_keys[n_cells] = k;   // key repeated per cell (engine slices)
+      out_regs[n_cells] = r;
+      out_ranks[n_cells] = reg_max[r];
+      ++n_cells;
+    }
+    out_ends[n_keys++] = static_cast<int32_t>(n_cells);
+  });
+  *n_cells_out = n_cells;
+  return n_keys;
+}
+
+// Host-tier fire: per distinct key, the HLL estimate (same formula as
+// sketches.py _estimate: alpha_m bias correction + linear counting).
+// Outputs are in key-sorted order.  Returns n_keys.
+int64_t ft_hll_log_fire(const uint64_t* keys, const uint16_t* regs,
+                        const uint8_t* ranks, int64_t n, int precision,
+                        uint64_t* out_keys, double* out_est) {
+  const int64_t m = 1ll << precision;
+  double alpha;
+  if (m == 16) alpha = 0.673;
+  else if (m == 32) alpha = 0.697;
+  else if (m == 64) alpha = 0.709;
+  else alpha = 0.7213 / (1.0 + 1.079 / static_cast<double>(m));
+  double inv_tab[64];
+  for (int j = 0; j < 64; ++j) inv_tab[j] = 1.0 / ldexp(1.0, j);
+  const double mf = static_cast<double>(m);
+  int64_t n_keys = 0;
+  hll_log_scan(keys, regs, ranks, n, m,
+               [&](uint64_t k, const std::vector<uint16_t>& touched,
+                   const std::vector<uint8_t>& reg_max) {
+    // registers not present contribute 2^-0 = 1 each
+    double inv_sum = mf - static_cast<double>(touched.size());
+    for (uint16_t r : touched) inv_sum += inv_tab[reg_max[r]];
+    double est = alpha * mf * mf / inv_sum;
+    double zeros = mf - static_cast<double>(touched.size());
+    if (est <= 2.5 * mf && zeros > 0.0)
+      est = mf * (__builtin_log(mf) - __builtin_log(zeros));
+    out_keys[n_keys] = k;
+    out_est[n_keys] = est;
+    ++n_keys;
+  });
+  return n_keys;
+}
+
+// Sum-log fire (word-count / rolling-sum shape): per distinct key, the
+// sum of its logged values.  Returns n_keys; outputs key-sorted.
+int64_t ft_sum_log_fire(const uint64_t* keys, const double* values,
+                        int64_t n, uint64_t* out_keys, double* out_sum) {
+  std::vector<SumRec> buf(n), scratch(n);
+  for (int64_t i = 0; i < n; ++i) buf[i] = {keys[i], values[i]};
+  SumRec* sorted = radix_sort_by_key(buf.data(), scratch.data(), n);
+  int64_t n_keys = 0;
+  int64_t i = 0;
+  while (i < n) {
+    uint64_t k = sorted[i].key;
+    double s = 0.0;
+    for (; i < n && sorted[i].key == k; ++i) s += sorted[i].value;
+    out_keys[n_keys] = k;
+    out_sum[n_keys] = s;
+    ++n_keys;
+  }
+  return n_keys;
+}
+
 // ---- compiled heap-backend baselines --------------------------------------
 // Each returns elapsed seconds for the measured loop; rates are n/elapsed.
 
