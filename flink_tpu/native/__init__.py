@@ -53,6 +53,9 @@ def _ensure_loaded() -> Optional[ctypes.CDLL]:
         lib.ft_heap_tumbling_baseline.argtypes = [
             u64p, u64p, f64p, c.c_int64, c.c_int, c.c_int, c.c_int64]
         lib.ft_heap_tumbling_baseline.restype = c.c_double
+        lib.ft_heap_windowed_hll_baseline.argtypes = [
+            u64p, u64p, i64p, c.c_int64, c.c_int64, c.c_int, c.c_int64]
+        lib.ft_heap_windowed_hll_baseline.restype = c.c_double
         lib.ft_heap_sliding_hist_baseline.argtypes = [
             u64p, f32p, i64p, c.c_int64, c.c_int64, c.c_int64, c.c_int,
             c.c_int64]
@@ -84,6 +87,16 @@ def _ensure_loaded() -> Optional[ctypes.CDLL]:
         lib.ft_hll_log_fire.restype = c.c_int64
         lib.ft_sum_log_fire.argtypes = [u64p, f64p, c.c_int64, u64p, f64p]
         lib.ft_sum_log_fire.restype = c.c_int64
+        lib.ft_qsketch_log_fire.argtypes = [
+            u64p, u16p, c.c_int64, c.c_int, f64p, c.c_int,
+            c.c_double, c.c_int64, c.c_double, u64p, f64p]
+        lib.ft_qsketch_log_fire.restype = c.c_int64
+        lib.ft_session_log_fire.argtypes = [
+            u64p, i64p, f32p, u64p, c.c_int64, c.c_int64, c.c_int64,
+            c.c_int, c.c_int,
+            u64p, i64p, i64p, f64p,
+            u64p, i64p, f32p, u64p, c.POINTER(c.c_int64)]
+        lib.ft_session_log_fire.restype = c.c_int64
         _lib = lib
     except Exception as e:  # noqa: BLE001 — no compiler / bad env
         _load_error = str(e)
@@ -215,6 +228,52 @@ def sum_log_fire(keys: np.ndarray, values: np.ndarray):
     return ok[:n_keys], s[:n_keys]
 
 
+def qsketch_log_fire(keys: np.ndarray, buckets: np.ndarray,
+                     n_buckets: int, quantiles, log_gamma: float,
+                     offset: int, mid_corr: float):
+    """Per distinct key, the requested quantiles from its logged
+    DDSketch buckets (key-sorted).  Returns (keys, q [n_keys, n_q])."""
+    lib = _ensure_loaded()
+    n = len(keys)
+    keys = np.ascontiguousarray(keys, np.uint64)
+    buckets = np.ascontiguousarray(buckets, np.uint16)
+    q = np.ascontiguousarray(quantiles, np.float64)
+    ok = np.empty(n, np.uint64)
+    out = np.empty(n * len(q), np.float64)
+    n_keys = lib.ft_qsketch_log_fire(keys, buckets, n, n_buckets,
+                                     q, len(q), log_gamma, offset,
+                                     mid_corr, ok, out)
+    return ok[:n_keys], out[:n_keys * len(q)].reshape(n_keys, len(q))
+
+
+def session_log_fire(keys: np.ndarray, ts: np.ndarray, weights: np.ndarray,
+                     vhs: np.ndarray, gap_ms: int, watermark: int,
+                     depth: int, width: int):
+    """Close every session whose end-1 <= watermark: returns
+    (closed keys, starts, ends, totals, retained (keys, ts, w, vh))."""
+    lib = _ensure_loaded()
+    n = len(keys)
+    keys = np.ascontiguousarray(keys, np.uint64)
+    ts = np.ascontiguousarray(ts, np.int64)
+    weights = np.ascontiguousarray(weights, np.float32)
+    vhs = np.ascontiguousarray(vhs, np.uint64)
+    ok = np.empty(n, np.uint64)
+    os_ = np.empty(n, np.int64)
+    oe = np.empty(n, np.int64)
+    ot = np.empty(n, np.float64)
+    rk = np.empty(n, np.uint64)
+    rt = np.empty(n, np.int64)
+    rw = np.empty(n, np.float32)
+    rv = np.empty(n, np.uint64)
+    n_ret = ctypes.c_int64(0)
+    n_closed = lib.ft_session_log_fire(
+        keys, ts, weights, vhs, n, gap_ms, watermark, depth, width,
+        ok, os_, oe, ot, rk, rt, rw, rv, ctypes.byref(n_ret))
+    r = n_ret.value
+    return (ok[:n_closed], os_[:n_closed], oe[:n_closed], ot[:n_closed],
+            (rk[:r].copy(), rt[:r].copy(), rw[:r].copy(), rv[:r].copy()))
+
+
 # ---- compiled baselines (bench.py) ----------------------------------------
 
 def _pow2_at_least(n: int) -> int:
@@ -237,6 +296,23 @@ def heap_tumbling_baseline(kh: np.ndarray, vh: Optional[np.ndarray],
     cap = _pow2_at_least(capacity or 2 * n)
     elapsed = lib.ft_heap_tumbling_baseline(
         kh, vh, values, n, 1 if kind == "hll" else 0, precision, cap)
+    return n / elapsed
+
+
+def heap_windowed_hll_baseline(kh: np.ndarray, vh: np.ndarray,
+                               ts: np.ndarray, window_ms: int,
+                               precision: int = 12,
+                               capacity: Optional[int] = None) -> float:
+    """Multi-window tumbling HLL baseline (per-window state, cleanup on
+    fire) — the north-star 10M-keyspace shape.  Returns records/s."""
+    lib = _ensure_loaded()
+    n = len(kh)
+    cap = _pow2_at_least(capacity or n)
+    elapsed = lib.ft_heap_windowed_hll_baseline(
+        np.ascontiguousarray(kh, np.uint64),
+        np.ascontiguousarray(vh, np.uint64),
+        np.ascontiguousarray(ts, np.int64),
+        n, window_ms, precision, cap)
     return n / elapsed
 
 

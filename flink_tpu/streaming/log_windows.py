@@ -1,4 +1,4 @@
-"""Log-structured tumbling-window engine — the combiner tier.
+"""Log-structured window engines — the combiner tier.
 
 The reference's windowed-aggregation hot path is one random
 read-modify-write of keyed state per record (heap:
@@ -9,25 +9,40 @@ that mechanism is memory-latency-bound on every substrate — the
 compiled host baseline and the XLA scatter path both measure in the
 single-digit M updates/s (BENCH_NOTES.md).
 
-This engine restructures the work the TPU-first way (SURVEY.md §7
+These engines restructure the work the TPU-first way (SURVEY.md §7
 "per-record semantics vs batched execution"): **ingest appends** the
 record's aggregate *cells* to a per-window log at memcpy speed, and
 the **fire sorts the log and reduces each key's run densely** —
 adaptive LSD radix sort + segmented reduction (native/host_runtime.cpp
-``ft_hll_log_*`` / ``ft_sum_log_fire``), with an optional on-device
-finish (`finish_tier="device"`) that runs the transcendental estimate
-phase as one jitted scan over the compacted cells.  It is the same
+``ft_*_log_fire``), with an optional on-device finish
+(``finish_tier="device"``) that runs the transcendental estimate phase
+as one jitted scan over the compacted cells.  It is the same
 pre-aggregation seam the reference exposes as chained combiners
 (AggregateUtil.scala:1028): state per window is bounded by
 min(events, keys x m) via periodic log compaction, and a window's
 state snapshot is its (compacted) log — smaller than a dense register
 file whenever events/window < keys x m.
 
+Engines:
+- :class:`LogStructuredTumblingWindows` — config #1/#2 shapes.
+- :class:`LogStructuredSlidingWindows` — pane logs at slide
+  granularity; a window fire concatenates its panes' logs (the merge
+  is free — the sort regroups across panes).  One log append per
+  record regardless of the overlap factor, where the reference writes
+  every record into size/slide window states
+  (SlidingEventTimeWindows.assignWindows).
+- :class:`LogStructuredSessionWindows` — sort by (key, ts), split
+  runs at gaps (TimeWindow.intersects is inclusive: abutting windows
+  merge), close sessions behind the watermark; each closed session's
+  Count-Min sketch builds in an L1-resident scratch — the sort makes
+  the working set session-local instead of all-keys-live.
+
 Scope: integer-keyed streams (the key rides in the log; grouping is
-exact, no hash collisions) and the mergeable aggregates with a cell
-decomposition — HyperLogLog (cell = (register, rank), combine = max)
-and Sum (cell = value, combine = add).  Other aggregates use the
-device-resident scatter engine (vectorized.py), which also remains
+exact) and mergeable aggregates with a cell decomposition —
+HyperLogLog (cell = (register, rank), combine = max), Sum
+(cell = value, combine = add), DDSketch quantiles (cell = bucket,
+combine = add), Count-Min (sessions).  Other aggregates use the
+device-resident scatter engines (vectorized.py), which also remain
 the multi-chip path (parallel/mesh_windows.py).
 """
 
@@ -40,11 +55,15 @@ import numpy as np
 import flink_tpu.native as nat
 from flink_tpu.ops.device_agg import DeviceAggregateFunction, SumAggregate
 from flink_tpu.ops.hashing import split_hash64_np
-from flink_tpu.ops.sketches import HyperLogLogAggregate
+from flink_tpu.ops.sketches import (
+    CountMinSketchAggregate,
+    HyperLogLogAggregate,
+    QuantileSketchAggregate,
+)
 
 
 class _WindowLog:
-    """Columnar append log for one window."""
+    """Columnar append log for one window (or pane)."""
 
     __slots__ = ("keys", "cols", "count")
 
@@ -71,151 +90,48 @@ class _WindowLog:
         return keys, cols
 
 
-class LogStructuredTumblingWindows:
-    """Batched keyBy().window(Tumbling...).aggregate(agg), combiner
-    tier.  Same engine interface as VectorizedTumblingWindows.
+# ---------------------------------------------------------------------
+# per-aggregate cell decompositions
+# ---------------------------------------------------------------------
 
-    finish_tier: "host" (C++ fused sort+estimate), "device" (C++
-    sort/compact, then one jitted exp2/cumsum finish on TPU), or
-    "auto" (host — on tunnel-attached chips the 34 MB/window D2H of
-    the scan exceeds the host finish; flip to device on pod hosts).
-    """
+class _HllMode:
+    name = "hll"
+    can_compact = True
 
-    def __init__(self, aggregate: DeviceAggregateFunction,
-                 window_size_ms: int,
-                 compact_threshold: int = 64 << 20,
-                 finish_tier: str = "auto",
-                 emit=None):
-        if isinstance(aggregate, HyperLogLogAggregate):
-            if aggregate.precision > 16:
-                raise ValueError("log engine supports precision <= 16 "
-                                 "(u16 register cells)")
-            self._mode = "hll"
-        elif isinstance(aggregate, SumAggregate):
-            self._mode = "sum"
-        else:
-            raise TypeError(
-                "LogStructuredTumblingWindows supports HyperLogLog and Sum "
-                "cell decompositions; use VectorizedTumblingWindows for "
-                f"{type(aggregate).__name__}")
-        if not nat.available():
-            raise RuntimeError(f"native runtime required: {nat.load_error()}")
-        self.agg = aggregate
-        self.size = window_size_ms
-        self.compact_threshold = compact_threshold
+    def __init__(self, agg: HyperLogLogAggregate, finish_tier: str):
+        if agg.precision > 16:
+            raise ValueError("log engine supports precision <= 16 "
+                             "(u16 register cells)")
+        self.agg = agg
         self.finish_tier = finish_tier
-        self.windows: Dict[int, _WindowLog] = {}
-        self.watermark = -(2 ** 63)
-        self.emit = emit
-        self.emitted: List[Tuple[Any, Any, int, int]] = []
-        self.emit_arrays = False
-        self.fired: List[Tuple[np.ndarray, np.ndarray, int, int]] = []
-        self.num_late_dropped = 0
         self._jit_finish = None
 
-    # ---- ingestion --------------------------------------------------
-    def process_batch(self, keys, timestamps, values=None,
-                      key_hashes=None, value_hashes=None) -> None:
-        ts = np.asarray(timestamps, np.int64)
-        keys = np.asarray(keys)
-        if not np.issubdtype(keys.dtype, np.integer):
-            raise TypeError("log engine requires integer keys "
-                            "(the key rides in the log)")
-        keys = keys.astype(np.uint64, copy=False)
-        starts = ts - np.mod(ts, self.size)
-        live = starts + self.size - 1 > self.watermark
-        if not live.all():
-            self.num_late_dropped += int((~live).sum())
-            if not live.any():
-                return
-            keys, ts, starts = keys[live], ts[live], starts[live]
-            if values is not None:
-                values = np.asarray(values)[live]
-            if value_hashes is not None:
-                value_hashes = np.asarray(value_hashes)[live]
+    def make_cols(self, values, value_hashes):
+        if value_hashes is None:
+            from flink_tpu.streaming.vectorized import hash_keys_np
+            value_hashes = hash_keys_np(values)
+        hi, lo = split_hash64_np(np.asarray(value_hashes))
+        ranks, regs = self.agg.compress_value_hash(hi, lo)
+        return (np.ascontiguousarray(regs, np.uint16),
+                np.ascontiguousarray(ranks, np.uint8))
 
-        if self._mode == "hll":
-            if value_hashes is None:
-                from flink_tpu.streaming.vectorized import hash_keys_np
-                value_hashes = hash_keys_np(values)
-            hi, lo = split_hash64_np(value_hashes)
-            ranks, regs = self.agg.compress_value_hash(hi, lo)
-            cols = (np.ascontiguousarray(regs, np.uint16),
-                    np.ascontiguousarray(ranks, np.uint8))
-        else:
-            cols = (np.asarray(values, np.float64),)
+    def compact(self, keys, cols):
+        ck, cr, crk, _ = nat.hll_log_compact(keys, cols[0], cols[1],
+                                             self.agg.precision)
+        return ck, (cr, crk)
 
-        uniq_starts = np.unique(starts)
-        for start in uniq_starts:
-            log = self.windows.get(int(start))
-            if log is None:
-                log = self.windows[int(start)] = _WindowLog()
-            if len(uniq_starts) == 1:
-                log.append(keys, *cols)
-            else:
-                mask = starts == start
-                log.append(keys[mask], *(c[mask] for c in cols))
-            if log.count > self.compact_threshold:
-                self._compact(log)
-
-    def flush(self, grow_to: Optional[int] = None) -> None:
-        """No device micro-batch to flush — kept for interface parity."""
-
-    def _compact(self, log: _WindowLog) -> None:
-        keys, cols = log.concat()
-        if self._mode == "hll":
-            ck, cr, crk, _ = nat.hll_log_compact(
-                keys, cols[0], cols[1], self.agg.precision)
-            log.keys = [ck]
-            log.cols = [(cr, crk)]
-            log.count = len(ck)
-        else:
-            ks, sums = nat.sum_log_fire(keys, cols[0])
-            log.keys = [ks]
-            log.cols = [(sums,)]
-            log.count = len(ks)
-
-    # ---- firing -----------------------------------------------------
-    def advance_watermark(self, watermark: int) -> int:
-        self.watermark = watermark
-        fired = 0
-        for start in sorted(self.windows):
-            if start + self.size - 1 > watermark:
-                continue
-            log = self.windows.pop(start)
-            if log.count == 0:
-                continue
-            keys, cols = log.concat()
-            if self._mode == "hll":
-                out_keys, results = self._fire_hll(keys, cols)
-            else:
-                out_keys, results = nat.sum_log_fire(keys, cols[0])
-                results = results.astype(self.agg.value_dtype)
-            end = start + self.size
-            if self.emit_arrays:
-                self.fired.append((out_keys, results, start, end))
-            elif self.emit is not None:
-                for k, r in zip(out_keys, results):
-                    self.emit(k, r, start, end)
-            else:
-                self.emitted.extend(zip(out_keys, results,
-                                        [start] * len(out_keys),
-                                        [end] * len(out_keys)))
-            fired += len(out_keys)
-        return fired
-
-    def _fire_hll(self, keys, cols):
+    def fire(self, keys, cols):
         if self.finish_tier == "device":
             ck, cr, crk, ends = nat.hll_log_compact(
                 keys, cols[0], cols[1], self.agg.precision)
-            uniq = ck[ends - 1]
-            return uniq, self._device_finish(crk, ends)
+            return ck[ends - 1], self._device_finish(crk, ends)
         return nat.hll_log_fire(keys, cols[0], cols[1], self.agg.precision)
 
     def _device_finish(self, ranks: np.ndarray, ends: np.ndarray):
-        """One jitted pass over the compacted cells: exp2 contributions,
-        cumsum, per-key diff at run ends, estimate — the dense phase of
-        the fire on the device (pads to power-of-two jit shapes)."""
+        """One jitted pass over the compacted cells: exp2
+        contributions, cumsum, per-key diff at run ends, estimate —
+        the dense phase of the fire on the device (power-of-two jit
+        shapes)."""
         import jax
         import jax.numpy as jnp
 
@@ -234,8 +150,7 @@ class LogStructuredTumblingWindows:
                 cum_at_end = cs[e - 1]
                 prev = jnp.concatenate([jnp.zeros(1), cum_at_end[:-1]])
                 seg = cum_at_end - prev
-                prev_e = jnp.concatenate(
-                    [jnp.zeros(1, e.dtype), e[:-1]])
+                prev_e = jnp.concatenate([jnp.zeros(1, e.dtype), e[:-1]])
                 n_present = (e - prev_e).astype(jnp.float32)
                 sum_inv = m + seg
                 est = alpha * m * m / sum_inv
@@ -244,7 +159,7 @@ class LogStructuredTumblingWindows:
                 return jnp.where((est <= 2.5 * m) & (zeros > 0),
                                  linear, est)
 
-            self._jit_finish = jax.jit(finish, static_argnums=())
+            self._jit_finish = jax.jit(finish)
         n_cells, n_keys = len(ranks), len(ends)
         pc = 1 << max(0, (n_cells - 1)).bit_length()
         pk = 1 << max(0, (n_keys - 1)).bit_length()
@@ -257,6 +172,189 @@ class LogStructuredTumblingWindows:
                                           np.int32(n_keys)))
         return out[:n_keys].astype(np.float64)
 
+
+class _SumMode:
+    name = "sum"
+    can_compact = True
+
+    def __init__(self, agg: SumAggregate, finish_tier: str):
+        self.agg = agg
+
+    def make_cols(self, values, value_hashes):
+        return (np.asarray(values, np.float64),)
+
+    def compact(self, keys, cols):
+        ks, sums = nat.sum_log_fire(keys, cols[0])
+        return ks, (sums,)
+
+    def fire(self, keys, cols):
+        ks, sums = nat.sum_log_fire(keys, cols[0])
+        return ks, sums.astype(self.agg.value_dtype)
+
+
+class _QuantileMode:
+    name = "quantile"
+    #: no count-combining compaction yet — a compact() that returns the
+    #: log unchanged would make every over-threshold ingest batch
+    #: re-concatenate the whole log (quadratic), so compaction is
+    #: disabled; the log is bounded by events-per-window
+    can_compact = False
+
+    def __init__(self, agg: QuantileSketchAggregate, finish_tier: str):
+        if agg.buckets > (1 << 16):
+            raise ValueError("log engine supports <= 65536 buckets")
+        self.agg = agg
+
+    def make_cols(self, values, value_hashes):
+        # numpy twin of QuantileSketchAggregate._bucket_of (f32 math to
+        # match the device kernel's bucketing)
+        agg = self.agg
+        v = np.asarray(values, np.float32)
+        logs = np.log(np.maximum(v, np.float32(agg.min_value)),
+                      dtype=np.float32) / np.float32(agg.log_gamma)
+        b = 1 + np.floor(logs).astype(np.int32) - agg.offset
+        b = np.clip(b, 1, agg.buckets - 1)
+        b = np.where(v <= agg.min_value, 0, b)
+        return (b.astype(np.uint16),)
+
+    def compact(self, keys, cols):
+        # buckets are few: compaction would need (key, bucket) counts;
+        # the raw log is already compact enough in practice
+        return keys, cols
+
+    def fire(self, keys, cols):
+        agg = self.agg
+        mid_corr = 2.0 / (1.0 + 1.0 / agg.gamma)
+        ks, q = nat.qsketch_log_fire(keys, cols[0], agg.buckets,
+                                     agg.quantiles, agg.log_gamma,
+                                     agg.offset, mid_corr)
+        return ks, q
+
+
+def _mode_for(agg: DeviceAggregateFunction, finish_tier: str):
+    if isinstance(agg, HyperLogLogAggregate):
+        return _HllMode(agg, finish_tier)
+    if isinstance(agg, SumAggregate):
+        return _SumMode(agg, finish_tier)
+    if isinstance(agg, QuantileSketchAggregate):
+        return _QuantileMode(agg, finish_tier)
+    raise TypeError(
+        "log-structured engines support HyperLogLog / Sum / "
+        "QuantileSketch cell decompositions; use the vectorized "
+        f"engines for {type(agg).__name__}")
+
+
+# ---------------------------------------------------------------------
+# engines
+# ---------------------------------------------------------------------
+
+class LogStructuredTumblingWindows:
+    """Batched keyBy().window(Tumbling...).aggregate(agg), combiner
+    tier.  Same engine interface as VectorizedTumblingWindows.
+
+    finish_tier: "host" (C++ fused sort+reduce), "device" (C++
+    sort/compact, then one jitted finish on TPU — HLL only), or
+    "auto" (host — on tunnel-attached chips the per-window D2H of the
+    scan exceeds the host finish; flip to device on pod hosts).
+    """
+
+    def __init__(self, aggregate: DeviceAggregateFunction,
+                 window_size_ms: int,
+                 compact_threshold: int = 64 << 20,
+                 finish_tier: str = "auto",
+                 emit=None):
+        if not nat.available():
+            raise RuntimeError(f"native runtime required: {nat.load_error()}")
+        self.agg = aggregate
+        self.mode = _mode_for(aggregate, finish_tier)
+        self.size = window_size_ms
+        #: how far past a (pane) start a record stays live — the
+        #: sliding subclass widens this to the full window size
+        self.lateness_horizon = window_size_ms
+        self.compact_threshold = compact_threshold
+        self.windows: Dict[int, _WindowLog] = {}
+        self.watermark = -(2 ** 63)
+        self.emit = emit
+        self.emitted: List[Tuple[Any, Any, int, int]] = []
+        self.emit_arrays = False
+        self.fired: List[Tuple[np.ndarray, np.ndarray, int, int]] = []
+        self.num_late_dropped = 0
+
+    # ---- ingestion --------------------------------------------------
+    def process_batch(self, keys, timestamps, values=None,
+                      key_hashes=None, value_hashes=None) -> None:
+        ts = np.asarray(timestamps, np.int64)
+        keys = np.asarray(keys)
+        if not np.issubdtype(keys.dtype, np.integer):
+            raise TypeError("log engine requires integer keys "
+                            "(the key rides in the log)")
+        keys = keys.astype(np.uint64, copy=False)
+        starts = ts - np.mod(ts, self.size)
+        live = starts + self.lateness_horizon - 1 > self.watermark
+        if not live.all():
+            self.num_late_dropped += int((~live).sum())
+            if not live.any():
+                return
+            keys, ts, starts = keys[live], ts[live], starts[live]
+            if values is not None:
+                values = np.asarray(values)[live]
+            if value_hashes is not None:
+                value_hashes = np.asarray(value_hashes)[live]
+
+        cols = self.mode.make_cols(values, value_hashes)
+        uniq_starts = np.unique(starts)
+        for start in uniq_starts:
+            log = self.windows.get(int(start))
+            if log is None:
+                log = self.windows[int(start)] = _WindowLog()
+            if len(uniq_starts) == 1:
+                log.append(keys, *cols)
+            else:
+                mask = starts == start
+                log.append(keys[mask], *(c[mask] for c in cols))
+            if self.mode.can_compact and log.count > self.compact_threshold:
+                self._compact(log)
+
+    def flush(self, grow_to: Optional[int] = None) -> None:
+        """No device micro-batch to flush — kept for interface parity."""
+
+    def _compact(self, log: _WindowLog) -> None:
+        keys, cols = log.concat()
+        ck, ccols = self.mode.compact(keys, cols)
+        log.keys = [ck]
+        log.cols = [ccols]
+        log.count = len(ck)
+
+    # ---- firing -----------------------------------------------------
+    def advance_watermark(self, watermark: int) -> int:
+        self.watermark = watermark
+        fired = 0
+        for start in sorted(self.windows):
+            if start + self.size - 1 > watermark:
+                continue
+            log = self.windows.pop(start)
+            if log.count == 0:
+                continue
+            keys, cols = log.concat()
+            fired += self._fire_window(keys, cols, start, start + self.size)
+        return fired
+
+    def _fire_window(self, keys, cols, start: int, end: int) -> int:
+        out_keys, results = self.mode.fire(keys, cols)
+        self._emit(out_keys, results, start, end)
+        return len(out_keys)
+
+    def _emit(self, out_keys, results, start: int, end: int) -> None:
+        if self.emit_arrays:
+            self.fired.append((out_keys, results, start, end))
+        elif self.emit is not None:
+            for k, r in zip(out_keys, results):
+                self.emit(k, r, start, end)
+        else:
+            self.emitted.extend(zip(out_keys, results,
+                                    [start] * len(out_keys),
+                                    [end] * len(out_keys)))
+
     # ---- checkpoint integration ------------------------------------
     def snapshot(self) -> dict:
         wins = {}
@@ -264,20 +362,206 @@ class LogStructuredTumblingWindows:
             keys, cols = log.concat()
             wins[int(start)] = {"keys": keys.copy(),
                                 "cols": [c.copy() for c in cols]}
-        return {"mode": self._mode, "size": self.size,
+        return {"mode": self.mode.name, "size": self.size,
                 "watermark": self.watermark,
                 "num_late_dropped": self.num_late_dropped,
-                "windows": wins}
+                "windows": wins,
+                # sliding subclass: without it a restored engine would
+                # re-fire already-fired windows from pruned panes
+                "fired_horizon": getattr(self, "_fired_horizon", None)}
 
     def restore(self, snap: dict) -> None:
         self.watermark = snap["watermark"]
         self.num_late_dropped = snap["num_late_dropped"]
+        if snap.get("fired_horizon") is not None:
+            self._fired_horizon = snap["fired_horizon"]
         self.windows = {}
         for start, w in snap["windows"].items():
             log = _WindowLog()
             log.append(np.asarray(w["keys"], np.uint64),
                        *(np.asarray(c) for c in w["cols"]))
             self.windows[int(start)] = log
+
+    def block_until_ready(self) -> None:
+        """Host-tier state is always materialized."""
+
+
+class LogStructuredSlidingWindows(LogStructuredTumblingWindows):
+    """Sliding windows composed from slide-granularity pane logs.
+
+    Ingest appends each record ONCE to its pane's log; a window's fire
+    concatenates the size/slide pane logs — the sort+reduce regroups
+    keys across panes, so pane merging costs nothing beyond the fire
+    itself.  Semantics match WindowOperator + SlidingEventTimeWindows
+    with lateness 0 (same fire/prune rules as
+    VectorizedSlidingWindows)."""
+
+    def __init__(self, aggregate: DeviceAggregateFunction,
+                 window_size_ms: int, slide_ms: int,
+                 compact_threshold: int = 64 << 20,
+                 finish_tier: str = "auto", emit=None):
+        if window_size_ms % slide_ms != 0:
+            raise ValueError("window size must be a multiple of the slide")
+        super().__init__(aggregate, slide_ms, compact_threshold,
+                         finish_tier, emit)
+        self.window_size = window_size_ms
+        self.slide = slide_ms
+        self.lateness_horizon = window_size_ms
+        self._fired_horizon = -(2 ** 63)
+
+    def advance_watermark(self, watermark: int) -> int:
+        prev = self._fired_horizon
+        self._fired_horizon = watermark
+        self.watermark = watermark
+        fired = 0
+        if not self.windows:
+            return 0
+        min_pane = min(self.windows)
+        max_pane = max(self.windows)
+        hi = min(watermark - self.window_size + 1, max_pane)
+        start_from = max(min_pane - self.window_size + self.slide,
+                         prev - self.window_size + 2)
+        first = -(-start_from // self.slide) * self.slide
+        if first <= hi:
+            for W in range(first, hi + 1, self.slide):
+                logs = [self.windows[p]
+                        for p in range(W, W + self.window_size, self.slide)
+                        if p in self.windows and self.windows[p].count]
+                if not logs:
+                    continue
+                parts = [lg.concat() for lg in logs]
+                keys = (parts[0][0] if len(parts) == 1 else
+                        np.concatenate([p[0] for p in parts]))
+                n_cols = len(parts[0][1])
+                cols = tuple(
+                    (parts[0][1][j] if len(parts) == 1 else
+                     np.concatenate([p[1][j] for p in parts]))
+                    for j in range(n_cols))
+                fired += self._fire_window(keys, cols, W,
+                                           W + self.window_size)
+        # prune panes no future window needs
+        for P in sorted(self.windows):
+            if P + self.window_size - 1 > watermark:
+                break
+            del self.windows[P]
+        return fired
+
+
+class LogStructuredSessionWindows:
+    """Session windows (gap-merged, EventTimeSessionWindows /
+    MergingWindowSet.java:156 semantics) + Count-Min totals over an
+    event log.
+
+    Ingest appends (key, ts, weight, value-hash); the watermark fire
+    sorts by (key, ts), splits runs at gaps (inclusive — abutting
+    windows merge, TimeWindow.intersects), closes sessions with
+    end-1 <= watermark (each closed session's Count-Min builds in an
+    L1-resident scratch) and retains open sessions' events.
+    """
+
+    def __init__(self, aggregate: CountMinSketchAggregate, gap_ms: int,
+                 emit=None):
+        if not isinstance(aggregate, CountMinSketchAggregate):
+            raise TypeError("session log engine aggregates Count-Min")
+        if not nat.available():
+            raise RuntimeError(f"native runtime required: {nat.load_error()}")
+        self.agg = aggregate
+        self.gap = gap_ms
+        self.watermark = -(2 ** 63)
+        self.emit = emit
+        self.emitted: List[Tuple[Any, Any, int, int]] = []
+        self.emit_arrays = False
+        self.fired: List[Tuple[np.ndarray, np.ndarray, int, int]] = []
+        self.num_late_dropped = 0
+        self._log_keys: List[np.ndarray] = []
+        self._log_ts: List[np.ndarray] = []
+        self._log_w: List[np.ndarray] = []
+        self._log_vh: List[np.ndarray] = []
+
+    def process_batch(self, keys, timestamps, values=None,
+                      key_hashes=None, value_hashes=None) -> None:
+        ts = np.asarray(timestamps, np.int64)
+        keys = np.asarray(keys)
+        if not np.issubdtype(keys.dtype, np.integer):
+            raise TypeError("log engine requires integer keys")
+        keys = keys.astype(np.uint64, copy=False)
+        # lateness 0: an event whose own window [ts, ts+gap) has
+        # end-1 <= watermark is late.  (A post-merge refinement — the
+        # event might still touch a LIVE session — cannot apply here:
+        # closed sessions already fired, so accepting it would change
+        # an emitted result.  The vectorized engine keeps live-session
+        # state across the watermark and can accept those stragglers;
+        # both behaviors are within the reference's lateness-0
+        # contract, which drops by isWindowLate before merging.)
+        live = ts + self.gap - 1 > self.watermark
+        if not live.all():
+            self.num_late_dropped += int((~live).sum())
+            if not live.any():
+                return
+            keys, ts = keys[live], ts[live]
+            if values is not None:
+                values = np.asarray(values)[live]
+            if value_hashes is not None:
+                value_hashes = np.asarray(value_hashes)[live]
+        if value_hashes is None:
+            from flink_tpu.streaming.vectorized import hash_keys_np
+            value_hashes = hash_keys_np(values)
+        w = (np.ones(len(keys), np.float32) if values is None
+             else np.asarray(values, np.float32))
+        self._log_keys.append(keys)
+        self._log_ts.append(ts)
+        self._log_w.append(w)
+        self._log_vh.append(np.asarray(value_hashes, np.uint64))
+
+    def flush(self, grow_to=None) -> None:
+        """Interface parity."""
+
+    def advance_watermark(self, watermark: int) -> int:
+        self.watermark = watermark
+        if not self._log_keys:
+            return 0
+        keys = np.concatenate(self._log_keys)
+        ts = np.concatenate(self._log_ts)
+        w = np.concatenate(self._log_w)
+        vh = np.concatenate(self._log_vh)
+        ok, os_, oe, ot, retained = nat.session_log_fire(
+            keys, ts, w, vh, self.gap, watermark,
+            self.agg.depth, self.agg.width)
+        rk, rt, rw, rv = retained
+        self._log_keys = [rk] if len(rk) else []
+        self._log_ts = [rt] if len(rt) else []
+        self._log_w = [rw] if len(rw) else []
+        self._log_vh = [rv] if len(rv) else []
+        totals = ot.astype(np.int64)
+        if self.emit_arrays:
+            if len(ok):
+                self.fired.append((ok, totals, os_, oe))
+        elif self.emit is not None:
+            for k, t, s, e in zip(ok, totals, os_, oe):
+                self.emit(k, t, int(s), int(e))
+        else:
+            self.emitted.extend(
+                (k, t, int(s), int(e))
+                for k, t, s, e in zip(ok, totals, os_, oe))
+        return len(ok)
+
+    def snapshot(self) -> dict:
+        cat = (lambda xs, dt: np.concatenate(xs) if xs
+               else np.empty(0, dt))
+        return {"watermark": self.watermark,
+                "num_late_dropped": self.num_late_dropped,
+                "keys": cat(self._log_keys, np.uint64),
+                "ts": cat(self._log_ts, np.int64),
+                "w": cat(self._log_w, np.float32),
+                "vh": cat(self._log_vh, np.uint64)}
+
+    def restore(self, snap: dict) -> None:
+        self.watermark = snap["watermark"]
+        self.num_late_dropped = snap["num_late_dropped"]
+        self._log_keys = [snap["keys"]] if len(snap["keys"]) else []
+        self._log_ts = [snap["ts"]] if len(snap["ts"]) else []
+        self._log_w = [snap["w"]] if len(snap["w"]) else []
+        self._log_vh = [snap["vh"]] if len(snap["vh"]) else []
 
     def block_until_ready(self) -> None:
         """Host-tier state is always materialized."""

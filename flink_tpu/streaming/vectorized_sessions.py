@@ -121,7 +121,10 @@ class VectorizedSessionWindows(_ScratchMergeMixin):
         brk = np.ones(n, bool)
         if n > 1:
             same_key = kh_s[1:] == kh_s[:-1]
-            within_gap = (ts_s[1:] - ts_s[:-1]) < self.gap
+            # <=: abutting [a, a+g) / [a+g, a+2g) windows intersect and
+            # merge (TimeWindow.intersects is inclusive — ref:
+            # TimeWindow.java intersects, test_session_bridge_merge)
+            within_gap = (ts_s[1:] - ts_s[:-1]) <= self.gap
             brk[1:] = ~(same_key & within_gap)
         sess_id = np.cumsum(brk) - 1          # per sorted record
         n_sessions = int(sess_id[-1]) + 1
@@ -144,7 +147,7 @@ class VectorizedSessionWindows(_ScratchMergeMixin):
         for i in candidates.tolist():
             sessions = self.table.get(int(sess_kh[i]))
             if not sessions or not any(
-                    s.start < sess_end[i] and sess_start[i] < s.end
+                    s.start <= sess_end[i] and sess_start[i] <= s.end
                     for s in sessions):
                 live_mask[i] = False
         if not live_mask.all():
@@ -201,7 +204,7 @@ class VectorizedSessionWindows(_ScratchMergeMixin):
             key_obj = keys_sorted[first_of[i]]
             sessions = self.table.setdefault(khash, [])
             overlapping = [s for s in sessions
-                           if s.start < e_new and s_new < s.end]
+                           if s.start <= e_new and s_new <= s.end]
             if not overlapping:
                 bisect.insort(sessions,
                               _Session(s_new, e_new, slot_new, key_obj),

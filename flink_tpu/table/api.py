@@ -49,6 +49,17 @@ class Table:
         self.stream = stream
         self.schema = schema
 
+    def _as_rows(self) -> "Table":
+        """Row view of a columnar table: explode RecordBatches so the
+        row-at-a-time operators can consume them (the fallback bridge
+        out of the columnar tier)."""
+        if not getattr(self, "columnar", False):
+            return self
+        from flink_tpu.streaming.columnar import explode_to_rows
+        t = Table(self.t_env, explode_to_rows(self.stream), self.schema)
+        t.rowtime = getattr(self, "rowtime", None)
+        return t
+
     # ---- Table API (subset of ref Table.scala ops) -------------------
     def select(self, *exprs) -> "Table":
         exprs = [self.t_env._expr(e) for e in exprs]
@@ -56,7 +67,7 @@ class Table:
             raise SqlError("aggregates need group_by().window() or SQL")
         names = [output_name(e, i) for i, e in enumerate(exprs)]
         fns = [strip_alias(e).compile(self.schema) for e in exprs]
-        out = self.stream.map(
+        out = self._as_rows().stream.map(
             lambda row, fns=fns: tuple(f(row) for f in fns),
             name="select")
         return Table(self.t_env, out, Schema(names))
@@ -65,7 +76,7 @@ class Table:
         e = self.t_env._expr(predicate)
         fn = e.compile(self.schema)
         return Table(self.t_env,
-                     self.stream.filter(lambda row: bool(fn(row)),
+                     self._as_rows().stream.filter(lambda row: bool(fn(row)),
                                         name="filter"),
                      self.schema)
 
@@ -74,7 +85,9 @@ class Table:
     def union_all(self, other: "Table") -> "Table":
         if other.schema.fields != self.schema.fields:
             raise SqlError("UNION ALL requires identical schemas")
-        return Table(self.t_env, self.stream.union(other.stream),
+        return Table(self.t_env,
+                     self._as_rows().stream.union(
+                         other._as_rows().stream),
                      self.schema)
 
     def group_by(self, *exprs) -> "GroupedTable":
@@ -199,6 +212,24 @@ class StreamTableEnvironment:
         t.rowtime = rowtime
         return t
 
+    def from_columns(self, cols, rowtime: str, chunk: int = 1 << 19,
+                     ooo_slack_ms: int = 0) -> Table:
+        """Columnar source table: numpy column arrays, time-sorted on
+        `rowtime`.  Eligible windowed GROUP BY plans over it compile
+        onto the vectorized RecordBatch tier
+        (streaming/columnar.py) — the Blink-planner analogue of the
+        reference's Janino codegen (codegen/CodeGenerator.scala): the
+        per-record interpretation gap closes by batching, not by
+        generating row code."""
+        from flink_tpu.streaming.columnar import ColumnarSource
+        stream = self.env.add_source(
+            ColumnarSource(dict(cols), rowtime, chunk, ooo_slack_ms),
+            name="columnar_source")
+        t = Table(self, stream, Schema(list(cols)))
+        t.rowtime = rowtime
+        t.columnar = True
+        return t
+
     def register_table(self, name: str, table: Table) -> None:
         self.tables[name] = table
 
@@ -293,12 +324,82 @@ class _CompositeAgg(_AggBase):
                 for (a, _), sx, sy in zip(self.parts, x, y)]
 
 
+def _try_columnar_windowed_agg(table: Table, keys: List[Expr],
+                               spec: WindowSpec, select: List[Expr],
+                               having: Optional[Expr]) -> Optional[Table]:
+    """Columnar physical plan: single group key, single device-eligible
+    aggregate over a plain column, projection of key/agg/window-props
+    only, columnar source, parallelism 1.  Compiles onto
+    ColumnarWindowOperator — whole RecordBatches feed the window
+    engine, fires leave as RecordBatches (streaming/columnar.py).
+    Returns None when the plan doesn't fit (row path takes over)."""
+    if having is not None or not getattr(table, "columnar", False):
+        return None
+    if table.stream.env.parallelism != 1:
+        return None
+    key_exprs = [strip_alias(k) for k in keys]
+    if len(key_exprs) != 1 or not isinstance(key_exprs[0], Column):
+        return None
+    key_col = key_exprs[0].name
+    agg_sites: List[AggCall] = []
+    for e in select:
+        for a in find_aggs(e):
+            if not any(repr(a) == repr(x) for x in agg_sites):
+                agg_sites.append(a)
+    if len(agg_sites) != 1:
+        return None
+    site = agg_sites[0]
+    if site.args and not isinstance(site.args[0], Column):
+        return None
+    input_col = site.args[0].name if site.args else None
+    t_env = table.t_env
+    try:
+        agg = (t_env.udafs[site.name]() if site.name in t_env.udafs
+               else make_builtin_agg(site))
+    except SqlError:
+        return None
+    if not _is_device_agg(agg):
+        return None
+    out_fields = []
+    out_names = []
+    for i, e in enumerate(select):
+        inner = strip_alias(e)
+        nm = output_name(e, i)
+        if isinstance(inner, AggCall) and repr(inner) == repr(site):
+            out_fields.append((nm, "agg"))
+        elif isinstance(inner, Column) and inner.name == key_col:
+            out_fields.append((nm, "key"))
+        elif isinstance(inner, WindowProp):
+            out_fields.append((nm, "wstart" if inner.kind == "start"
+                               else "wend"))
+        else:
+            return None
+        out_names.append(nm)
+    assigner = _assigner_for(spec)
+    from flink_tpu.streaming.columnar import ColumnarWindowOperator
+
+    def factory(assigner=assigner, agg=agg, key_col=key_col,
+                input_col=input_col, out_fields=tuple(out_fields)):
+        return ColumnarWindowOperator(assigner, agg, key_col, input_col,
+                                      out_fields)
+
+    out = table.stream._add_op("columnar_window_agg", factory,
+                               parallelism=1)
+    t = Table(t_env, out, Schema(out_names))
+    t.columnar = True
+    return t
+
+
 def _lower_windowed_agg(table: Table, keys: List[Expr], spec: WindowSpec,
                         select: List[Expr], having: Optional[Expr] = None
                         ) -> Table:
     """keyBy(group keys) → window(assigner) → aggregate(composite)
     with the select list evaluated at fire time (the
     DataStreamGroupWindowAggregate.scala:197-238 shape)."""
+    fast = _try_columnar_windowed_agg(table, keys, spec, select, having)
+    if fast is not None:
+        return fast
+    table = table._as_rows()
     t_env = table.t_env
     schema = table.schema
     key_exprs = [strip_alias(k) for k in keys]
@@ -421,6 +522,7 @@ def _lower_continuous_group_agg(table: Table, keys: List[Expr],
     accumulators and emit the refreshed result row (the accumulate
     side of GroupAggProcessFunction.scala; consume via
     to_retract_stream semantics — last row per key wins)."""
+    table = table._as_rows()
     t_env = table.t_env
     schema = table.schema
     key_exprs = [strip_alias(k) for k in keys]

@@ -409,6 +409,153 @@ int64_t ft_sum_log_fire(const uint64_t* keys, const double* values,
   return n_keys;
 }
 
+// Quantile-sketch log fire (DDSketch log-histogram, the t-digest role —
+// flink_tpu/ops/sketches.py QuantileSketchAggregate).  Cells are
+// (key, bucket) with +1 counts; per distinct key the requested
+// quantiles are answered by an ascending scan of an L1-resident bucket
+// scratch.  bucket value = exp((b - 0.5 + offset) * log_gamma) *
+// mid_corr, bucket 0 = 0 (same formula as QuantileSketchAggregate
+// .result).  out_q is [n_keys x n_q] row-major.  Returns n_keys.
+int64_t ft_qsketch_log_fire(const uint64_t* keys, const uint16_t* buckets,
+                            int64_t n, int n_buckets,
+                            const double* quantiles, int n_q,
+                            double log_gamma, int64_t offset,
+                            double mid_corr,
+                            uint64_t* out_keys, double* out_q) {
+  std::vector<HllRec> buf(n), scratch(n);
+  for (int64_t i = 0; i < n; ++i)
+    buf[i] = {keys[i], static_cast<uint32_t>(buckets[i])};
+  HllRec* sorted = radix_sort_by_key(buf.data(), scratch.data(), n);
+  std::vector<int64_t> counts(n_buckets, 0);
+  std::vector<uint16_t> touched;
+  touched.reserve(256);
+  int64_t n_keys = 0;
+  int64_t i = 0;
+  while (i < n) {
+    uint64_t k = sorted[i].key;
+    touched.clear();
+    int64_t total = 0;
+    for (; i < n && sorted[i].key == k; ++i) {
+      uint16_t b = static_cast<uint16_t>(sorted[i].aux & 0xFFFF);
+      if (counts[b] == 0) touched.push_back(b);
+      ++counts[b];
+      ++total;
+    }
+    for (int q = 0; q < n_q; ++q) {
+      double target = quantiles[q] * static_cast<double>(total);
+      if (target < 1.0) target = 1.0;
+      int64_t acc = 0;
+      int sel = n_buckets - 1;
+      for (int b = 0; b < n_buckets; ++b) {
+        acc += counts[b];
+        if (static_cast<double>(acc) >= target) { sel = b; break; }
+      }
+      out_q[n_keys * n_q + q] =
+          sel == 0 ? 0.0
+                   : __builtin_exp((static_cast<double>(sel) - 0.5 +
+                                    static_cast<double>(offset)) *
+                                   log_gamma) * mid_corr;
+    }
+    out_keys[n_keys++] = k;
+    for (uint16_t b : touched) counts[b] = 0;
+  }
+  return n_keys;
+}
+
+// Session-window fire over an event log (config #4 shape:
+// EventTimeSessionWindows + Count-Min totals, MergingWindowSet.java:156
+// semantics with lateness 0).  Sorts the log by (key, ts); each key
+// run splits into sessions at gaps > gap_ms; sessions whose end-1 <=
+// watermark are CLOSED: their Count-Min sketch is built in an
+// L1-resident scratch (depth hashed increments per event — the same
+// per-record work the reference pays, but against a session-local 4KB
+// table instead of an all-keys-live state backend) and the session
+// (key, start, end, total) is emitted.  Open sessions' events are
+// copied to the retained log.  Returns n_closed; *n_retained gets the
+// retained count.  Output buffers sized n.
+int64_t ft_session_log_fire(const uint64_t* keys, const int64_t* ts,
+                            const float* weights, const uint64_t* vhs,
+                            int64_t n, int64_t gap_ms, int64_t watermark,
+                            int depth, int width,
+                            uint64_t* out_keys, int64_t* out_start,
+                            int64_t* out_end, double* out_total,
+                            uint64_t* ret_keys, int64_t* ret_ts,
+                            float* ret_w, uint64_t* ret_vh,
+                            int64_t* n_retained) {
+  struct Ev { uint64_t key; int64_t idx; };
+  // sort by ts (stable) then by key (stable) -> (key, ts) order;
+  // the sign-bit flip makes signed ts order correctly under the
+  // unsigned radix
+  std::vector<Ev> buf(n), scratch(n);
+  for (int64_t i = 0; i < n; ++i)
+    buf[i] = {static_cast<uint64_t>(ts[i]) ^ 0x8000000000000000ull, i};
+  Ev* s1 = radix_sort_by_key(buf.data(), scratch.data(), n);
+  // rewrite keys for the second pass, preserving the ts-sorted idx
+  Ev* other = (s1 == buf.data()) ? scratch.data() : buf.data();
+  for (int64_t i = 0; i < n; ++i) other[i] = {keys[s1[i].idx], s1[i].idx};
+  Ev* sorted = radix_sort_by_key(other, s1, n);
+
+  std::vector<int32_t> cm(static_cast<size_t>(depth) * width, 0);
+  std::vector<int32_t> cm_touched;
+  cm_touched.reserve(1024);
+  int64_t n_closed = 0, n_ret = 0;
+  int64_t i = 0;
+  while (i < n) {
+    uint64_t k = sorted[i].key;
+    int64_t run_end = i;
+    while (run_end < n && sorted[run_end].key == k) ++run_end;
+    // split the run into sessions at gaps
+    int64_t a = i;
+    while (a < run_end) {
+      int64_t b = a + 1;
+      int64_t last = ts[sorted[a].idx];
+      while (b < run_end && ts[sorted[b].idx] - last <= gap_ms) {
+        last = ts[sorted[b].idx];
+        ++b;
+      }
+      int64_t sess_start = ts[sorted[a].idx];
+      int64_t sess_end = last + gap_ms;
+      if (sess_end - 1 <= watermark) {
+        double total = 0.0;
+        for (int64_t j = a; j < b; ++j) {
+          int64_t idx = sorted[j].idx;
+          total += static_cast<double>(weights[idx]);
+          uint64_t h = vhs[idx];
+          for (int d = 0; d < depth; ++d) {
+            uint64_t hd = splitmix64(h + 0x9E3779B97F4A7C15ull *
+                                     static_cast<uint64_t>(d));
+            int32_t pos = static_cast<int32_t>(
+                d * width +
+                static_cast<int64_t>(hd % static_cast<uint64_t>(width)));
+            if (cm[pos] == 0) cm_touched.push_back(pos);
+            ++cm[pos];
+          }
+        }
+        for (int32_t p : cm_touched) cm[p] = 0;
+        cm_touched.clear();
+        out_keys[n_closed] = k;
+        out_start[n_closed] = sess_start;
+        out_end[n_closed] = sess_end;
+        out_total[n_closed] = total;
+        ++n_closed;
+      } else {
+        for (int64_t j = a; j < b; ++j) {
+          int64_t idx = sorted[j].idx;
+          ret_keys[n_ret] = keys[idx];
+          ret_ts[n_ret] = ts[idx];
+          ret_w[n_ret] = weights[idx];
+          ret_vh[n_ret] = vhs[idx];
+          ++n_ret;
+        }
+      }
+      a = b;
+    }
+    i = run_end;
+  }
+  *n_retained = n_ret;
+  return n_closed;
+}
+
 // ---- compiled heap-backend baselines --------------------------------------
 // Each returns elapsed seconds for the measured loop; rates are n/elapsed.
 
@@ -467,6 +614,64 @@ double ft_heap_tumbling_baseline(const uint64_t* kh, const uint64_t* vh,
   } else {
     for (int64_t s2 = 0; s2 < table.next_slot; ++s2) sink += sums[s2];
   }
+  (void)sink;
+  return now_s() - t0;
+}
+
+// North-star scale variant (10M keyspace): tumbling HLL with MULTIPLE
+// windows over time-sorted input, one live window at a time — the
+// heap backend's per-(key, namespace=window) state with cleanup on
+// fire (WindowOperator.java:576-626 clearAllState).  Per record:
+// probe + register max; at each window boundary: the estimate scan
+// over live keys, then state cleanup (registers of live slots zeroed,
+// table reset).  Returns elapsed seconds.
+double ft_heap_windowed_hll_baseline(const uint64_t* kh, const uint64_t* vh,
+                                     const int64_t* ts, int64_t n,
+                                     int64_t window_ms, int precision,
+                                     int64_t capacity_pow2) {
+  const int64_t m = 1ll << precision;
+  double inv_tab[64];
+  for (int j = 0; j < 64; ++j) inv_tab[j] = 1.0 / ldexp(1.0, j);
+  const double mf = static_cast<double>(m);
+  const double alpha_m2 = 0.7213 / (1.0 + 1.079 / mf) * mf * mf;
+  ProbeTable table(capacity_pow2);
+  std::vector<uint8_t> regs(capacity_pow2 * m, 0);
+  volatile double sink = 0.0;
+  double t0 = now_s();
+  int64_t win_start = ts[0] - (ts[0] % window_ms);
+  auto fire = [&]() {
+    for (int64_t s = 0; s < table.next_slot; ++s) {
+      uint8_t* r = &regs[s * m];
+      double inv_sum = 0.0;
+      int zeros = 0;
+      for (int64_t j = 0; j < m; ++j) {
+        inv_sum += inv_tab[r[j]];
+        zeros += (r[j] == 0);
+      }
+      double est = alpha_m2 / inv_sum;
+      if (zeros && est < 2.5 * mf)
+        est = mf * __builtin_log(mf / zeros);
+      sink += est;
+      std::memset(r, 0, m);  // state cleanup on window purge
+    }
+    std::fill(table.hash.begin(), table.hash.end(), 0);
+    table.next_slot = 0;
+  };
+  for (int64_t i = 0; i < n; ++i) {
+    int64_t w = ts[i] - (ts[i] % window_ms);
+    if (w != win_start) {
+      fire();
+      win_start = w;
+    }
+    int64_t s = table.get_or_insert(kh[i]);
+    uint64_t h = vh[i];
+    uint64_t reg = h & (static_cast<uint64_t>(m) - 1);
+    uint32_t hi = static_cast<uint32_t>(h >> 32);
+    uint8_t rank = static_cast<uint8_t>((hi == 0 ? 32 : __builtin_clz(hi)) + 1);
+    uint8_t* r = &regs[s * m + reg];
+    if (*r < rank) *r = rank;
+  }
+  fire();
   (void)sink;
   return now_s() - t0;
 }
