@@ -87,6 +87,16 @@ def _ensure_loaded() -> Optional[ctypes.CDLL]:
         lib.ft_hll_log_fire.restype = c.c_int64
         lib.ft_sum_log_fire.argtypes = [u64p, f64p, c.c_int64, u64p, f64p]
         lib.ft_sum_log_fire.restype = c.c_int64
+        lib.ft_sumtab_new.argtypes = [c.c_int64]
+        lib.ft_sumtab_new.restype = c.c_void_p
+        lib.ft_sumtab_free.argtypes = [c.c_void_p]
+        lib.ft_sumtab_size.argtypes = [c.c_void_p]
+        lib.ft_sumtab_size.restype = c.c_int64
+        lib.ft_sumtab_ingest.argtypes = [c.c_void_p, u64p, f64p,
+                                         c.c_int64, c.c_int64]
+        lib.ft_sumtab_ingest.restype = c.c_int64
+        lib.ft_sumtab_export.argtypes = [c.c_void_p, u64p, f64p]
+        lib.ft_sumtab_export.restype = c.c_int64
         lib.ft_qsketch_log_fire.argtypes = [
             u64p, u16p, c.c_int64, c.c_int, f64p, c.c_int,
             c.c_double, c.c_int64, c.c_double, u64p, f64p]
@@ -226,6 +236,45 @@ def sum_log_fire(keys: np.ndarray, values: np.ndarray):
     s = np.empty(n, np.float64)
     n_keys = lib.ft_sum_log_fire(keys, values, n, ok, s)
     return ok[:n_keys], s[:n_keys]
+
+
+class NativeSumTable:
+    """Dense per-window sum accumulator (the hash-combiner tier):
+    key -> running sum in an open-addressing C++ table.  Starts at
+    `capacity` and grows geometrically — a window with few keys stays
+    small."""
+
+    __slots__ = ("_h", "capacity")
+
+    def __init__(self, capacity: int = 1 << 12):
+        lib = _ensure_loaded()
+        self.capacity = 1 << max(4, (capacity - 1).bit_length())
+        self._h = lib.ft_sumtab_new(self.capacity)
+
+    def __del__(self):
+        if _lib is not None and getattr(self, "_h", None):
+            _lib.ft_sumtab_free(self._h)
+            self._h = None
+
+    @property
+    def n(self) -> int:
+        return _lib.ft_sumtab_size(self._h)
+
+    def ingest(self, keys: np.ndarray, values: np.ndarray,
+               max_distinct: int) -> int:
+        """Accumulate; returns records consumed (< len(keys) when the
+        distinct cap was hit — switch this window to log form)."""
+        keys = np.ascontiguousarray(keys, np.uint64)
+        values = np.ascontiguousarray(values, np.float64)
+        return _lib.ft_sumtab_ingest(self._h, keys, values, len(keys),
+                                     max_distinct)
+
+    def export(self):
+        n = self.n
+        keys = np.empty(n, np.uint64)
+        sums = np.empty(n, np.float64)
+        k = _lib.ft_sumtab_export(self._h, keys, sums)
+        return keys[:k], sums[:k]
 
 
 def qsketch_log_fire(keys: np.ndarray, buckets: np.ndarray,

@@ -158,11 +158,14 @@ class ColumnarWindowOperator(StreamOperator):
         self.num_late_records_dropped = 0
 
     # ---- engine selection -------------------------------------------
-    def _make_engine(self, key_dtype) -> Any:
+    def _make_engine(self, key_dtype, require_log: bool = False) -> Any:
+        """require_log: restoring a log-tier checkpoint — a silent
+        fallback to the vectorized tier would feed it an incompatible
+        snapshot format, so failures must surface."""
         from flink_tpu.streaming import log_windows as lw
         integral = np.issubdtype(key_dtype, np.integer)
         a = self.assigner
-        if integral:
+        if integral or require_log:
             try:
                 if isinstance(a, TumblingEventTimeWindows) and a.offset == 0:
                     return lw.LogStructuredTumblingWindows(self.agg, a.size)
@@ -173,7 +176,9 @@ class ColumnarWindowOperator(StreamOperator):
                 if isinstance(a, EventTimeSessionWindows):
                     return lw.LogStructuredSessionWindows(self.agg, a.gap)
             except (TypeError, RuntimeError):
-                pass  # unsupported cell decomposition / no native lib
+                if require_log:
+                    raise  # checkpoint needs this tier
+                # unsupported cell decomposition / no native lib
         from flink_tpu.streaming.device_window_operator import (
             engine_for_assigner,
         )
@@ -290,10 +295,11 @@ class ColumnarWindowOperator(StreamOperator):
         for s in snapshots:
             if "columnar_engine" in s:
                 if self.engine is None:
-                    key_dtype = (np.dtype(np.uint64)
-                                 if s.get("columnar_tier") == "log"
+                    is_log = s.get("columnar_tier") == "log"
+                    key_dtype = (np.dtype(np.uint64) if is_log
                                  else np.dtype(object))
-                    self.engine = self._make_engine(key_dtype)
+                    self.engine = self._make_engine(key_dtype,
+                                                    require_log=is_log)
                     if hasattr(self.engine, "fired"):
                         self.engine.emit_arrays = True
                 self.engine.restore(s["columnar_engine"])

@@ -89,6 +89,56 @@ class _WindowLog:
         self.cols = [cols]
         return keys, cols
 
+    def compact(self, mode) -> None:
+        keys, cols = self.concat()
+        ck, ccols = mode.compact(keys, cols)
+        self.keys = [ck]
+        self.cols = [ccols]
+        self.count = len(ck)
+
+
+class _SumTabLog:
+    """Adaptive sum window state (the hash-combiner tier): a dense
+    C++ key->sum table while the distinct-key count stays
+    cache-resident (the per-record probe+add is then L1/L2-local —
+    the word-count shape), spilling to the ordinary cell log when
+    cardinality outgrows it (the sort+reduce fire then wins).  Same
+    interface as _WindowLog."""
+
+    __slots__ = ("tab", "log", "max_distinct")
+
+    def __init__(self, max_distinct: int = 1 << 19):
+        self.tab = nat.NativeSumTable()  # starts small, grows
+        self.log: Optional[_WindowLog] = None
+        self.max_distinct = max_distinct
+
+    @property
+    def count(self) -> int:
+        return self.tab.n if self.log is None else self.log.count
+
+    def append(self, keys: np.ndarray, values: np.ndarray) -> None:
+        if self.log is None:
+            values = np.asarray(values, np.float64)
+            consumed = self.tab.ingest(keys, values, self.max_distinct)
+            if consumed == len(keys):
+                return
+            # cardinality outgrew the table: spill to log form
+            self.log = _WindowLog()
+            tk, tsums = self.tab.export()
+            self.log.append(tk, tsums)
+            keys, values = keys[consumed:], values[consumed:]
+        self.log.append(keys, np.asarray(values, np.float64))
+
+    def concat(self):
+        if self.log is None:
+            tk, tsums = self.tab.export()
+            return tk, (tsums,)
+        return self.log.concat()
+
+    def compact(self, mode) -> None:
+        if self.log is not None:
+            self.log.compact(mode)
+
 
 # ---------------------------------------------------------------------
 # per-aggregate cell decompositions
@@ -97,6 +147,9 @@ class _WindowLog:
 class _HllMode:
     name = "hll"
     can_compact = True
+
+    def new_log(self):
+        return _WindowLog()
 
     def __init__(self, agg: HyperLogLogAggregate, finish_tier: str):
         if agg.precision > 16:
@@ -180,6 +233,9 @@ class _SumMode:
     def __init__(self, agg: SumAggregate, finish_tier: str):
         self.agg = agg
 
+    def new_log(self):
+        return _SumTabLog()
+
     def make_cols(self, values, value_hashes):
         return (np.asarray(values, np.float64),)
 
@@ -200,6 +256,9 @@ class _QuantileMode:
     #: disabled; the log is bounded by events-per-window
     can_compact = False
 
+    def new_log(self):
+        return _WindowLog()
+
     def __init__(self, agg: QuantileSketchAggregate, finish_tier: str):
         if agg.buckets > (1 << 16):
             raise ValueError("log engine supports <= 65536 buckets")
@@ -216,11 +275,6 @@ class _QuantileMode:
         b = np.clip(b, 1, agg.buckets - 1)
         b = np.where(v <= agg.min_value, 0, b)
         return (b.astype(np.uint16),)
-
-    def compact(self, keys, cols):
-        # buckets are few: compaction would need (key, bucket) counts;
-        # the raw log is already compact enough in practice
-        return keys, cols
 
     def fire(self, keys, cols):
         agg = self.agg
@@ -306,24 +360,17 @@ class LogStructuredTumblingWindows:
         for start in uniq_starts:
             log = self.windows.get(int(start))
             if log is None:
-                log = self.windows[int(start)] = _WindowLog()
+                log = self.windows[int(start)] = self.mode.new_log()
             if len(uniq_starts) == 1:
                 log.append(keys, *cols)
             else:
                 mask = starts == start
                 log.append(keys[mask], *(c[mask] for c in cols))
             if self.mode.can_compact and log.count > self.compact_threshold:
-                self._compact(log)
+                log.compact(self.mode)
 
     def flush(self, grow_to: Optional[int] = None) -> None:
         """No device micro-batch to flush — kept for interface parity."""
-
-    def _compact(self, log: _WindowLog) -> None:
-        keys, cols = log.concat()
-        ck, ccols = self.mode.compact(keys, cols)
-        log.keys = [ck]
-        log.cols = [ccols]
-        log.count = len(ck)
 
     # ---- firing -----------------------------------------------------
     def advance_watermark(self, watermark: int) -> int:
@@ -377,7 +424,7 @@ class LogStructuredTumblingWindows:
             self._fired_horizon = snap["fired_horizon"]
         self.windows = {}
         for start, w in snap["windows"].items():
-            log = _WindowLog()
+            log = self.mode.new_log()
             log.append(np.asarray(w["keys"], np.uint64),
                        *(np.asarray(c) for c in w["cols"]))
             self.windows[int(start)] = log

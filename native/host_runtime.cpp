@@ -409,6 +409,108 @@ int64_t ft_sum_log_fire(const uint64_t* keys, const double* values,
   return n_keys;
 }
 
+// Dense sum accumulator (the hash-combiner tier for Sum aggregates):
+// per-window open-addressing key -> running sum, used by the log
+// engines while the distinct-key count stays cache-resident; the
+// engine switches to log appends past the cap (export + re-ingest
+// as a compacted log).  Per record this is exactly the baseline's
+// probe+add — embedded as the framework's ingest combiner.
+struct FtSumTab {
+  ProbeTable table;
+  std::vector<double> sums;
+  std::vector<uint64_t> keys;  // original key per slot
+  // key 0 is held out of the probe table entirely (ProbeTable remaps
+  // a zero hash internally, which would merge user key 0 with the
+  // remap constant — grouping here must be EXACT on raw keys)
+  double zero_sum = 0.0;
+  bool has_zero = false;
+  explicit FtSumTab(int64_t cap)
+      : table(cap), sums(cap, 0.0) {}
+
+  int64_t distinct() const {
+    return table.next_slot + (has_zero ? 1 : 0);
+  }
+
+  void grow_if_needed() {
+    if (table.next_slot * 5 <= static_cast<int64_t>(table.hash.size()) * 3)
+      return;
+    size_t new_cap = table.hash.size() * 2;
+    table.hash.assign(new_cap, 0);
+    table.slot.assign(new_cap, -1);
+    table.mask = new_cap - 1;
+    int64_t n = table.next_slot;
+    table.next_slot = 0;
+    sums.resize(new_cap, 0.0);
+    for (int64_t s = 0; s < n; ++s)
+      table.get_or_insert(keys[s]);  // reinsert: slot ids stay stable
+  }
+};
+
+void* ft_sumtab_new(int64_t capacity_pow2) {
+  return new FtSumTab(capacity_pow2 < 16 ? 16 : capacity_pow2);
+}
+
+void ft_sumtab_free(void* p) { delete static_cast<FtSumTab*>(p); }
+
+int64_t ft_sumtab_size(void* p) {
+  return static_cast<FtSumTab*>(p)->distinct();
+}
+
+// Accumulate until the distinct-key count would exceed max_distinct;
+// returns the number of records consumed (== n unless the cap was
+// hit — the engine then switches this window to log representation).
+// The table grows geometrically below the cap (starts small; a
+// window with few keys stays small).
+int64_t ft_sumtab_ingest(void* p, const uint64_t* keys,
+                         const double* vals, int64_t n,
+                         int64_t max_distinct) {
+  FtSumTab& st = *static_cast<FtSumTab*>(p);
+  for (int64_t i = 0; i < n; ++i) {
+    if (keys[i] == 0) {
+      if (!st.has_zero) {
+        if (st.distinct() + 1 > max_distinct) return i;
+        st.has_zero = true;
+      }
+      st.zero_sum += vals[i];
+      continue;
+    }
+    st.grow_if_needed();
+    int64_t before = st.table.next_slot;
+    int64_t s = st.table.get_or_insert(keys[i]);
+    if (st.table.next_slot != before) {
+      if (st.distinct() > max_distinct) {
+        // undo the overflowing insert and stop
+        uint64_t h = keys[i];
+        uint64_t pos = (h ^ (h >> 32)) & st.table.mask;
+        while (st.table.hash[pos] != h) pos = (pos + 1) & st.table.mask;
+        st.table.hash[pos] = 0;
+        st.table.slot[pos] = -1;
+        st.table.next_slot = before;
+        return i;
+      }
+      st.keys.push_back(keys[i]);
+    }
+    st.sums[s] += vals[i];
+  }
+  return n;
+}
+
+// Export (key, sum) pairs in slot (first-seen) order; returns count.
+int64_t ft_sumtab_export(void* p, uint64_t* keys_out, double* sums_out) {
+  FtSumTab& st = *static_cast<FtSumTab*>(p);
+  int64_t k = 0;
+  for (; k < st.table.next_slot; ++k) {
+    keys_out[k] = st.keys[k];
+    sums_out[k] = st.sums[k];
+  }
+  if (st.has_zero) {
+    keys_out[k] = 0;
+    sums_out[k] = st.zero_sum;
+    ++k;
+  }
+  return k;
+}
+
 // Quantile-sketch log fire (DDSketch log-histogram, the t-digest role —
 // flink_tpu/ops/sketches.py QuantileSketchAggregate).  Cells are
 // (key, bucket) with +1 counts; per distinct key the requested

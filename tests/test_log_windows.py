@@ -312,3 +312,43 @@ def test_sliding_snapshot_preserves_fired_horizon():
     ref.emitted.clear()
     ref.advance_watermark(7999)
     assert sorted(map(tuple, b.emitted)) == sorted(map(tuple, ref.emitted))
+
+
+def test_sum_dense_table_spill_to_log():
+    """The adaptive sum state must produce identical results whether it
+    stays dense or spills to log form mid-window (incl. key 0)."""
+    from flink_tpu.streaming.log_windows import _SumTabLog
+    rng = np.random.default_rng(29)
+    keys = rng.integers(0, 5000, 40_000).astype(np.uint64)
+    keys[:10] = 0  # key 0 exercises the probe-table zero remap
+    vals = rng.random(40_000)
+    dense = _SumTabLog(max_distinct=1 << 16)
+    spill = _SumTabLog(max_distinct=1 << 10)  # forces mid-stream spill
+    for st in (dense, spill):
+        for i in range(0, 40_000, 4096):
+            st.append(keys[i:i + 4096], vals[i:i + 4096])
+    assert spill.log is not None and dense.log is None
+    dk, (dv,) = dense.concat()
+    sk, (sv,) = spill.concat()
+    want = {}
+    for k, v in zip(keys.tolist(), vals.tolist()):
+        want[k] = want.get(k, 0.0) + v
+    for ks, vs in ((dk, dv), (sk, sv)):
+        got_k, got_v = nat.sum_log_fire(ks, vs)
+        got = dict(zip(got_k.tolist(), got_v.tolist()))
+        assert set(got) == set(want)
+        for k in want:
+            assert got[k] == pytest.approx(want[k], rel=1e-9)
+
+
+def test_sum_key_zero_and_sentinel_distinct():
+    """Key 0 and the probe table's internal remap constant must stay
+    distinct groups (code-review regression: they merged)."""
+    sentinel = 0x9E3779B97F4A7C15
+    eng = LogStructuredTumblingWindows(SumAggregate(np.float64), 1000)
+    eng.process_batch(np.array([0, sentinel, 0], np.uint64),
+                      np.array([10, 20, 30], np.int64),
+                      np.array([1.0, 10.0, 100.0]))
+    eng.advance_watermark(5000)
+    got = {int(k): float(r) for k, r, s, e in eng.emitted}
+    assert got == {0: 101.0, sentinel: 10.0}
