@@ -63,32 +63,58 @@ class RecordBatch:
 class ColumnarSource(SourceFunction):
     """Bounded source over column arrays; emits RecordBatch chunks and
     a watermark after each chunk (input must be time-sorted on the
-    rowtime column, the usual replayed-log shape)."""
+    rowtime column, the usual replayed-log shape).
+
+    Implements the cooperative-stepping + offset-checkpoint contract
+    (same as FromCollectionSource): snapshots at step boundaries see
+    only fully-emitted batches, so recovery resumes exactly-once."""
 
     def __init__(self, cols: Dict[str, np.ndarray], rowtime: str,
                  chunk: int = 1 << 19, ooo_slack_ms: int = 0):
         self.cols = {k: np.asarray(v) for k, v in cols.items()}
+        self.cols[rowtime] = np.asarray(self.cols[rowtime], np.int64)
         self.rowtime = rowtime
         self.chunk = chunk
         self.ooo_slack_ms = ooo_slack_ms
         self._running = True
+        #: resume offset in ROWS (always a chunk boundary)
+        self.offset = 0
+        self._final_watermark = True
 
     def run(self, ctx) -> None:
-        ts_all = np.asarray(self.cols[self.rowtime], np.int64)
+        while self.emit_step(ctx, self.chunk):
+            pass
+
+    def emit_step(self, ctx, max_records: int) -> bool:
+        from flink_tpu.streaming.elements import MAX_WATERMARK
+        ts_all = self.cols[self.rowtime]
         n = len(ts_all)
-        for i in range(0, n, self.chunk):
-            if not self._running:
-                return
-            sl = slice(i, i + self.chunk)
+        if self.offset < n and self._running:
+            sl = slice(self.offset, self.offset + self.chunk)
             batch = RecordBatch({k: v[sl] for k, v in self.cols.items()},
                                 ts_all[sl])
             ctx.collect(batch)
+            self.offset = min(self.offset + self.chunk, n)
             ctx.emit_watermark(Watermark(
-                int(ts_all[min(i + self.chunk, n) - 1])
-                - self.ooo_slack_ms - 1))
+                int(ts_all[self.offset - 1]) - self.ooo_slack_ms - 1))
+        if self.offset < n and self._running:
+            return True
+        if self._final_watermark:
+            ctx.emit_watermark(MAX_WATERMARK)
+            self._final_watermark = False
+        return False
 
     def cancel(self) -> None:
         self._running = False
+
+    # checkpoint hooks (CheckpointedFunction-shaped source state)
+    def snapshot_function_state(self, checkpoint_id=None) -> dict:
+        return {"offset": self.offset,
+                "final_watermark": self._final_watermark}
+
+    def restore_function_state(self, state: dict) -> None:
+        self.offset = state["offset"]
+        self._final_watermark = state["final_watermark"]
 
 
 class ColumnarCollectSink(SinkFunction):

@@ -122,10 +122,12 @@ class _SumTabLog:
             consumed = self.tab.ingest(keys, values, self.max_distinct)
             if consumed == len(keys):
                 return
-            # cardinality outgrew the table: spill to log form
+            # cardinality outgrew the table: spill to log form and
+            # free the native table (it is never consulted again)
             self.log = _WindowLog()
             tk, tsums = self.tab.export()
             self.log.append(tk, tsums)
+            self.tab = None
             keys, values = keys[consumed:], values[consumed:]
         self.log.append(keys, np.asarray(values, np.float64))
 

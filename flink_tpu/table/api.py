@@ -359,7 +359,13 @@ def _try_columnar_windowed_agg(table: Table, keys: List[Expr],
     except SqlError:
         return None
     if not _is_device_agg(agg):
-        return None
+        # builtin substitution only — a user-registered UDAF under the
+        # same name must keep its own semantics (row path)
+        if site.name in t_env.udafs:
+            return None
+        agg = _device_builtin_equivalent(site)
+        if agg is None:
+            return None
     out_fields = []
     out_names = []
     for i, e in enumerate(select):
@@ -388,6 +394,24 @@ def _try_columnar_windowed_agg(table: Table, keys: List[Expr],
     t = Table(t_env, out, Schema(out_names))
     t.columnar = True
     return t
+
+
+def _device_builtin_equivalent(site: AggCall):
+    """Vectorized twin of a scalar builtin aggregate for the columnar
+    plan (numeric columns only — which is all a columnar source
+    carries).  None -> the plan stays on the row path."""
+    import numpy as np
+    from flink_tpu.ops import device_agg as da
+    if getattr(site, "distinct", False):
+        return None
+    # AVG is excluded: AvgAggregate accumulates float32, which would
+    # diverge from the row path's float64 mean at large magnitudes
+    return {
+        "SUM": lambda: da.SumAggregate(np.float64),
+        "COUNT": lambda: da.CountAggregate(),
+        "MIN": lambda: da.MinAggregate(np.float64),
+        "MAX": lambda: da.MaxAggregate(np.float64),
+    }.get(site.name, lambda: None)()
 
 
 def _lower_windowed_agg(table: Table, keys: List[Expr], spec: WindowSpec,

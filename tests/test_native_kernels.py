@@ -1,0 +1,128 @@
+"""Property tests for the native log-engine kernels against pure-python
+references: radix sort grouping over adversarial key patterns, dedup
+correctness, session splitting, and the sum table's exactness."""
+
+import numpy as np
+import pytest
+
+import flink_tpu.native as nat
+
+pytestmark = pytest.mark.skipif(not nat.available(),
+                                reason="native runtime unavailable")
+
+
+KEY_PATTERNS = [
+    ("uniform_small", lambda rng, n: rng.integers(0, 50, n)),
+    ("uniform_wide", lambda rng, n: rng.integers(0, 2 ** 63, n)),
+    ("all_equal", lambda rng, n: np.full(n, 7)),
+    ("extremes", lambda rng, n: rng.choice(
+        [0, 1, 2 ** 63 - 1, 2 ** 64 - 1, 0x9E3779B97F4A7C15], n)),
+    ("high_bits_only", lambda rng, n: rng.integers(0, 4, n) << 60),
+]
+
+
+@pytest.mark.parametrize("name,gen", KEY_PATTERNS)
+def test_sum_log_fire_matches_python(name, gen):
+    rng = np.random.default_rng(hash(name) % 2 ** 31)
+    n = 5000
+    keys = gen(rng, n).astype(np.uint64)
+    vals = rng.random(n)
+    ok, osum = nat.sum_log_fire(keys, vals)
+    want = {}
+    for k, v in zip(keys.tolist(), vals.tolist()):
+        want[k] = want.get(k, 0.0) + v
+    got = dict(zip(ok.tolist(), osum.tolist()))
+    assert set(got) == set(want)
+    for k in want:
+        assert got[k] == pytest.approx(want[k], rel=1e-9)
+    # key-sorted output
+    assert np.all(np.diff(ok.astype(np.uint64)) > 0) or len(ok) <= 1
+
+
+@pytest.mark.parametrize("name,gen", KEY_PATTERNS)
+def test_hll_compact_matches_python(name, gen):
+    rng = np.random.default_rng(hash(name) % 2 ** 31 + 1)
+    n = 4000
+    keys = gen(rng, n).astype(np.uint64)
+    regs = rng.integers(0, 1024, n).astype(np.uint16)
+    ranks = rng.integers(1, 40, n).astype(np.uint8)
+    ck, cr, crk, ends = nat.hll_log_compact(keys, regs, ranks, 10)
+    want = {}
+    for k, r, rk in zip(keys.tolist(), regs.tolist(), ranks.tolist()):
+        cur = want.setdefault(k, {})
+        cur[r] = max(cur.get(r, 0), rk)
+    got = {}
+    for k, r, rk in zip(ck.tolist(), cr.tolist(), crk.tolist()):
+        got.setdefault(k, {})[r] = rk
+    assert got == want
+    # ends partition the cells by key
+    assert ends[-1] == len(ck)
+    assert np.all(np.diff(ends) > 0)
+
+
+def test_empty_inputs():
+    e64 = np.empty(0, np.uint64)
+    ok, osum = nat.sum_log_fire(e64, np.empty(0))
+    assert len(ok) == 0
+    ck, cr, crk, ends = nat.hll_log_compact(
+        e64, np.empty(0, np.uint16), np.empty(0, np.uint8), 10)
+    assert len(ck) == 0 and len(ends) == 0
+
+
+def test_session_fire_negative_timestamps():
+    """Signed timestamps order correctly under the radix (sign-bit
+    bias): a session spanning negative->positive time stays one run."""
+    keys = np.array([5, 5, 5], np.uint64)
+    ts = np.array([-1500, -800, -100], np.int64)
+    ok, os_, oe, ot, retained = nat.session_log_fire(
+        keys, ts, np.ones(3, np.float32),
+        np.array([1, 2, 3], np.uint64), 1000, 10_000, 2, 32)
+    assert len(ok) == 1
+    assert (int(os_[0]), int(oe[0]), float(ot[0])) == (-1500, 900, 3.0)
+    assert len(retained[0]) == 0
+
+
+def test_session_fire_retains_open_sessions():
+    keys = np.array([1, 1, 2], np.uint64)
+    ts = np.array([0, 100, 5000], np.int64)
+    ok, os_, oe, ot, retained = nat.session_log_fire(
+        keys, ts, np.ones(3, np.float32),
+        np.array([9, 9, 9], np.uint64), 500, 4000, 2, 32)
+    # key 1's session [0, 600) closed; key 2's [5000, 5500) still open
+    assert [int(k) for k in ok] == [1]
+    rk, rt, rw, rv = retained
+    assert rk.tolist() == [2] and rt.tolist() == [5000]
+
+
+def test_qsketch_fire_quantile_positions():
+    # one key, bucket counts chosen so q50/q99 land in known buckets
+    keys = np.zeros(100, np.uint64)
+    buckets = np.concatenate([np.full(50, 3), np.full(49, 7),
+                              np.full(1, 9)]).astype(np.uint16)
+    import math
+    log_gamma = math.log(1.1)
+    ok, q = nat.qsketch_log_fire(keys, buckets, 16, [0.5, 0.99],
+                                 log_gamma, 0, 1.0)
+    assert len(ok) == 1
+    b50 = math.exp((3 - 0.5) * log_gamma)
+    b99 = math.exp((7 - 0.5) * log_gamma)
+    assert q[0, 0] == pytest.approx(b50, rel=1e-9)
+    assert q[0, 1] == pytest.approx(b99, rel=1e-9)
+
+
+def test_sumtab_growth_from_small():
+    """The dense table starts tiny and grows; sums survive rehashes."""
+    t = nat.NativeSumTable(16)
+    rng = np.random.default_rng(31)
+    keys = rng.integers(0, 3000, 30_000).astype(np.uint64)
+    vals = rng.random(30_000)
+    consumed = t.ingest(keys, vals, 1 << 19)
+    assert consumed == len(keys)
+    ek, es = t.export()
+    want = {}
+    for k, v in zip(keys.tolist(), vals.tolist()):
+        want[k] = want.get(k, 0.0) + v
+    got = dict(zip(ek.tolist(), es.tolist()))
+    assert set(got) == set(want)
+    for k in want:
+        assert got[k] == pytest.approx(want[k], rel=1e-9)
