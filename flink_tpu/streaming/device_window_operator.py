@@ -42,6 +42,28 @@ from flink_tpu.streaming.windowing import (
 )
 
 
+def log_engine_for_assigner(assigner, agg: DeviceAggregateFunction):
+    """Log-structured combiner tier for this assigner+aggregate, or
+    None when the cell decomposition / assigner shape doesn't fit
+    (streaming/log_windows.py scope: integer keys, HLL/Sum/Quantile
+    cells, Count-Min sessions)."""
+    from flink_tpu.streaming import log_windows as lw
+    try:
+        if isinstance(assigner, TumblingEventTimeWindows) \
+                and assigner.offset == 0:
+            return lw.LogStructuredTumblingWindows(agg, assigner.size)
+        if (isinstance(assigner, SlidingEventTimeWindows)
+                and assigner.offset == 0
+                and assigner.size % assigner.slide == 0):
+            return lw.LogStructuredSlidingWindows(agg, assigner.size,
+                                                  assigner.slide)
+        if isinstance(assigner, EventTimeSessionWindows):
+            return lw.LogStructuredSessionWindows(agg, assigner.gap)
+    except (TypeError, ValueError, RuntimeError):
+        pass  # unsupported cell decomposition / params / no native lib
+    return None
+
+
 def engine_for_assigner(assigner, agg: DeviceAggregateFunction,
                         initial_capacity: int = 1 << 14, mesh=None,
                         mesh_axis: str = "kg", max_parallelism: int = 128):
@@ -115,13 +137,17 @@ class DeviceWindowOperator(StreamOperator):
 
     # ---- lifecycle --------------------------------------------------
     def open(self):
-        self.engine = engine_for_assigner(self.assigner, self.agg,
-                                          self.initial_capacity,
-                                          mesh=self.mesh,
-                                          mesh_axis=self.mesh_axis)
-        if self.engine is None:
-            raise ValueError(
-                f"no device engine for assigner {self.assigner!r}")
+        if self.mesh is not None:
+            # mesh jobs pick the sharded engine up front; single-chip
+            # jobs defer tier selection to the first flush (the log
+            # combiner tier needs the key dtype)
+            self.engine = engine_for_assigner(self.assigner, self.agg,
+                                              self.initial_capacity,
+                                              mesh=self.mesh,
+                                              mesh_axis=self.mesh_axis)
+            if self.engine is None:
+                raise ValueError(
+                    f"no device engine for assigner {self.assigner!r}")
         self.collector = TimestampedCollector(self.output)
         # metric parity with the scalar WindowOperator (ref:
         # WindowOperator.java:138 numLateRecordsDropped); reset = this
@@ -146,6 +172,28 @@ class DeviceWindowOperator(StreamOperator):
         if len(self._keys) >= self.flush_batch:
             self._flush_buffer()
 
+    def _ensure_engine(self, keys_arr: np.ndarray):
+        """Tier selection on the first flush: integer-keyed streams get
+        the log-structured combiner tier when the aggregate has a cell
+        decomposition; everything else (and every aggregate the log
+        tier doesn't cover) runs the device-resident scatter tier."""
+        if self.engine is not None:
+            return
+        if np.issubdtype(keys_arr.dtype, np.integer):
+            self.engine = log_engine_for_assigner(self.assigner, self.agg)
+        if self.engine is None:
+            self.engine = engine_for_assigner(self.assigner, self.agg,
+                                              self.initial_capacity)
+        if self.engine is None:
+            raise ValueError(
+                f"no device engine for assigner {self.assigner!r}")
+        # fast-forward a lazily created engine to the operator's
+        # watermark — records behind it must count as LATE, not be
+        # aggregated into windows that already passed downstream
+        wm = getattr(self, "current_watermark", None)
+        if wm is not None and wm > -(2 ** 63):
+            self.engine.advance_watermark(wm)
+
     def _flush_buffer(self):
         if not self._keys:
             return
@@ -162,8 +210,10 @@ class DeviceWindowOperator(StreamOperator):
             vals = np.asarray(values)
         else:
             vals = None
+        keys_arr = np.asarray(self._keys)
+        self._ensure_engine(keys_arr)
         self.engine.process_batch(
-            np.asarray(self._keys),
+            keys_arr,
             np.asarray(self._ts, np.int64),
             vals)
         self._keys.clear()
@@ -187,13 +237,15 @@ class DeviceWindowOperator(StreamOperator):
                 return
             self._last_fireable = fireable
         self._flush_buffer()
-        before = len(self.engine.emitted)
-        self.engine.advance_watermark(wm)
-        self._emit_from(before)
-        self.num_late_records_dropped = self.engine.num_late_dropped
-        if self.metrics is not None:
-            self.metrics.counter(
-                "numLateRecordsDropped").count = self.engine.num_late_dropped
+        if self.engine is not None:
+            before = len(self.engine.emitted)
+            self.engine.advance_watermark(wm)
+            self._emit_from(before)
+            self.num_late_records_dropped = self.engine.num_late_dropped
+            if self.metrics is not None:
+                self.metrics.counter(
+                    "numLateRecordsDropped").count = \
+                    self.engine.num_late_dropped
         self.current_watermark = wm
         self.output.emit_watermark(watermark)
 
@@ -225,7 +277,14 @@ class DeviceWindowOperator(StreamOperator):
     def snapshot_state(self, checkpoint_id: Optional[int] = None) -> dict:
         self._flush_buffer()
         snap = super().snapshot_state(checkpoint_id)
-        snap["device_engine"] = self.engine.snapshot()
+        if self.engine is not None:
+            from flink_tpu.streaming import log_windows as lw
+            snap["device_engine"] = self.engine.snapshot()
+            snap["device_tier"] = (
+                "log" if isinstance(
+                    self.engine, (lw.LogStructuredTumblingWindows,
+                                  lw.LogStructuredSessionWindows))
+                else "vectorized")
         return snap
 
     def restore_state(self, snapshots) -> None:
@@ -237,4 +296,16 @@ class DeviceWindowOperator(StreamOperator):
                 "restore at the checkpointed parallelism")
         for s in snapshots:
             if "device_engine" in s:
+                if self.engine is None:
+                    if s.get("device_tier") == "log":
+                        self.engine = log_engine_for_assigner(
+                            self.assigner, self.agg)
+                        if self.engine is None:
+                            raise RuntimeError(
+                                "checkpoint was taken on the log engine "
+                                "tier, which is unavailable here (native "
+                                "runtime required)")
+                    else:
+                        self.engine = engine_for_assigner(
+                            self.assigner, self.agg, self.initial_capacity)
                 self.engine.restore(s["device_engine"])

@@ -287,6 +287,33 @@ class _QuantileMode:
         return ks, q
 
 
+def _as_u64_keys(engine, keys) -> np.ndarray:
+    """Normalize integer keys to their uint64 bit pattern (exact
+    grouping for signed and unsigned alike); the signedness is locked
+    on the first batch — a later flip would silently reinterpret keys
+    >= 2^63 emitted from earlier batches, so it is rejected."""
+    keys = np.asarray(keys)
+    if not np.issubdtype(keys.dtype, np.integer):
+        raise TypeError("log engine requires integer keys "
+                        "(the key rides in the log)")
+    signed = bool(np.issubdtype(keys.dtype, np.signedinteger))
+    if engine._keys_signed is None:
+        engine._keys_signed = signed
+    elif engine._keys_signed != signed:
+        raise TypeError(
+            "key dtype signedness changed mid-stream "
+            f"(was {'signed' if engine._keys_signed else 'unsigned'}, "
+            f"got {keys.dtype}); keep the key dtype stable")
+    if signed:
+        return keys.astype(np.int64, copy=False).view(np.uint64)
+    return keys.astype(np.uint64, copy=False)
+
+
+def _keys_out(engine, keys_u64: np.ndarray) -> np.ndarray:
+    return (keys_u64.view(np.int64) if engine._keys_signed
+            else keys_u64)
+
+
 def _mode_for(agg: DeviceAggregateFunction, finish_tier: str):
     if isinstance(agg, HyperLogLogAggregate):
         return _HllMode(agg, finish_tier)
@@ -335,16 +362,15 @@ class LogStructuredTumblingWindows:
         self.emit_arrays = False
         self.fired: List[Tuple[np.ndarray, np.ndarray, int, int]] = []
         self.num_late_dropped = 0
+        #: signed input keys ride as their uint64 bit pattern and view
+        #: back at fire (locked on the first batch)
+        self._keys_signed = None
 
     # ---- ingestion --------------------------------------------------
     def process_batch(self, keys, timestamps, values=None,
                       key_hashes=None, value_hashes=None) -> None:
         ts = np.asarray(timestamps, np.int64)
-        keys = np.asarray(keys)
-        if not np.issubdtype(keys.dtype, np.integer):
-            raise TypeError("log engine requires integer keys "
-                            "(the key rides in the log)")
-        keys = keys.astype(np.uint64, copy=False)
+        keys = _as_u64_keys(self, keys)
         starts = ts - np.mod(ts, self.size)
         live = starts + self.lateness_horizon - 1 > self.watermark
         if not live.all():
@@ -390,7 +416,7 @@ class LogStructuredTumblingWindows:
 
     def _fire_window(self, keys, cols, start: int, end: int) -> int:
         out_keys, results = self.mode.fire(keys, cols)
-        self._emit(out_keys, results, start, end)
+        self._emit(_keys_out(self, out_keys), results, start, end)
         return len(out_keys)
 
     def _emit(self, out_keys, results, start: int, end: int) -> None:
@@ -415,6 +441,7 @@ class LogStructuredTumblingWindows:
                 "watermark": self.watermark,
                 "num_late_dropped": self.num_late_dropped,
                 "windows": wins,
+                "keys_signed": self._keys_signed,
                 # sliding subclass: without it a restored engine would
                 # re-fire already-fired windows from pruned panes
                 "fired_horizon": getattr(self, "_fired_horizon", None)}
@@ -422,6 +449,7 @@ class LogStructuredTumblingWindows:
     def restore(self, snap: dict) -> None:
         self.watermark = snap["watermark"]
         self.num_late_dropped = snap["num_late_dropped"]
+        self._keys_signed = snap.get("keys_signed")
         if snap.get("fired_horizon") is not None:
             self._fired_horizon = snap["fired_horizon"]
         self.windows = {}
@@ -522,6 +550,7 @@ class LogStructuredSessionWindows:
         self.emit_arrays = False
         self.fired: List[Tuple[np.ndarray, np.ndarray, int, int]] = []
         self.num_late_dropped = 0
+        self._keys_signed = None
         self._log_keys: List[np.ndarray] = []
         self._log_ts: List[np.ndarray] = []
         self._log_w: List[np.ndarray] = []
@@ -530,10 +559,7 @@ class LogStructuredSessionWindows:
     def process_batch(self, keys, timestamps, values=None,
                       key_hashes=None, value_hashes=None) -> None:
         ts = np.asarray(timestamps, np.int64)
-        keys = np.asarray(keys)
-        if not np.issubdtype(keys.dtype, np.integer):
-            raise TypeError("log engine requires integer keys")
-        keys = keys.astype(np.uint64, copy=False)
+        keys = _as_u64_keys(self, keys)
         # lateness 0: an event whose own window [ts, ts+gap) has
         # end-1 <= watermark is late.  (A post-merge refinement — the
         # event might still touch a LIVE session — cannot apply here:
@@ -582,6 +608,7 @@ class LogStructuredSessionWindows:
         self._log_w = [rw] if len(rw) else []
         self._log_vh = [rv] if len(rv) else []
         totals = ot.astype(np.int64)
+        ok = _keys_out(self, ok)
         if self.emit_arrays:
             if len(ok):
                 self.fired.append((ok, totals, os_, oe))
@@ -599,6 +626,7 @@ class LogStructuredSessionWindows:
                else np.empty(0, dt))
         return {"watermark": self.watermark,
                 "num_late_dropped": self.num_late_dropped,
+                "keys_signed": self._keys_signed,
                 "keys": cat(self._log_keys, np.uint64),
                 "ts": cat(self._log_ts, np.int64),
                 "w": cat(self._log_w, np.float32),
@@ -607,6 +635,7 @@ class LogStructuredSessionWindows:
     def restore(self, snap: dict) -> None:
         self.watermark = snap["watermark"]
         self.num_late_dropped = snap["num_late_dropped"]
+        self._keys_signed = snap.get("keys_signed")
         self._log_keys = [snap["keys"]] if len(snap["keys"]) else []
         self._log_ts = [snap["ts"]] if len(snap["ts"]) else []
         self._log_w = [snap["w"]] if len(snap["w"]) else []

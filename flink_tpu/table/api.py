@@ -16,6 +16,8 @@ config #5).
 
 from __future__ import annotations
 
+import numpy as np
+
 from typing import Any, Callable, Dict, List, Optional, Sequence
 
 from flink_tpu.table.expressions import (
@@ -228,6 +230,7 @@ class StreamTableEnvironment:
         t = Table(self, stream, Schema(list(cols)))
         t.rowtime = rowtime
         t.columnar = True
+        t.col_dtypes = {k: np.asarray(v).dtype for k, v in cols.items()}
         return t
 
     def register_table(self, name: str, table: Table) -> None:
@@ -363,7 +366,8 @@ def _try_columnar_windowed_agg(table: Table, keys: List[Expr],
         # same name must keep its own semantics (row path)
         if site.name in t_env.udafs:
             return None
-        agg = _device_builtin_equivalent(site)
+        agg = _device_builtin_equivalent(
+            site, getattr(table, "col_dtypes", {}).get(input_col))
         if agg is None:
             return None
     out_fields = []
@@ -396,19 +400,23 @@ def _try_columnar_windowed_agg(table: Table, keys: List[Expr],
     return t
 
 
-def _device_builtin_equivalent(site: AggCall):
+def _device_builtin_equivalent(site: AggCall, input_dtype=None):
     """Vectorized twin of a scalar builtin aggregate for the columnar
-    plan (numeric columns only — which is all a columnar source
-    carries).  None -> the plan stays on the row path."""
+    plan.  None -> the plan stays on the row path.  SUM/MIN/MAX only
+    substitute for FLOATING input columns: the device twins accumulate
+    float64, which matches the row path exactly there but would round
+    int64 values beyond 2^53 (and change the output type).  AVG is
+    excluded outright — AvgAggregate accumulates float32."""
     import numpy as np
     from flink_tpu.ops import device_agg as da
     if getattr(site, "distinct", False):
         return None
-    # AVG is excluded: AvgAggregate accumulates float32, which would
-    # diverge from the row path's float64 mean at large magnitudes
+    if site.name == "COUNT":
+        return da.CountAggregate()
+    if input_dtype is None or not np.issubdtype(input_dtype, np.floating):
+        return None
     return {
         "SUM": lambda: da.SumAggregate(np.float64),
-        "COUNT": lambda: da.CountAggregate(),
         "MIN": lambda: da.MinAggregate(np.float64),
         "MAX": lambda: da.MaxAggregate(np.float64),
     }.get(site.name, lambda: None)()

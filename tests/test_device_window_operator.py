@@ -173,3 +173,74 @@ def test_device_hll_through_api():
         # 5000 distinct at precision 11 sits in the raw-HLL bias zone
         # (~2.5*m): allow the known high bias, not just stddev
         assert abs(est - 5000) / 5000 < 0.12
+
+
+def test_engine_tier_selection_by_key_dtype():
+    """Integer-keyed jobs ride the log combiner tier; object keys ride
+    the device-resident scatter tier (the lazy first-flush choice)."""
+    import numpy as np
+    from flink_tpu.ops.sketches import HyperLogLogAggregate
+    from flink_tpu.streaming.device_window_operator import DeviceWindowOperator
+    from flink_tpu.streaming.harness import OneInputStreamOperatorTestHarness
+    from flink_tpu.streaming.log_windows import LogStructuredTumblingWindows
+    from flink_tpu.streaming.vectorized import VectorizedTumblingWindows
+    from flink_tpu.streaming.windowing import TumblingEventTimeWindows, Time
+
+    def build(keys):
+        op = DeviceWindowOperator(
+            TumblingEventTimeWindows.of(Time.seconds(1)),
+            HyperLogLogAggregate(precision=8))
+        h = OneInputStreamOperatorTestHarness(op, key_selector=lambda v: v)
+        h.open()
+        for i, k in enumerate(keys):
+            h.process_element(k, 100 + i)
+        h.process_watermark(10_000)
+        return op
+
+    op_int = build([5, 7, 5])
+    assert isinstance(op_int.engine, LogStructuredTumblingWindows)
+    op_str = build(["a", "b", "a"])
+    assert isinstance(op_str.engine, VectorizedTumblingWindows)
+
+
+def test_lazy_engine_fast_forwards_watermark():
+    """A watermark that arrives before any element must make later
+    behind-watermark records LATE, not aggregate them (the lazily
+    created engine starts at the operator's current watermark)."""
+    import numpy as np
+    from flink_tpu.ops.device_agg import SumAggregate
+    from flink_tpu.streaming.device_window_operator import DeviceWindowOperator
+    from flink_tpu.streaming.harness import OneInputStreamOperatorTestHarness
+    from flink_tpu.streaming.windowing import TumblingEventTimeWindows, Time
+
+    op = DeviceWindowOperator(
+        TumblingEventTimeWindows.of(Time.seconds(1)),
+        SumAggregate(np.float64))
+    h = OneInputStreamOperatorTestHarness(op, key_selector=lambda v: v)
+    h.open()
+    h.process_watermark(10_000)
+    h.process_element(5, 100)      # behind the watermark -> late
+    h.process_watermark(11_000)
+    assert h.extract_output_values() == []
+    assert op.num_late_records_dropped == 1
+
+
+def test_log_ineligible_params_fall_back_to_vectorized():
+    """precision 18 exceeds the log tier's u16 cells: integer keys must
+    still run (on the scatter tier), not crash at first flush."""
+    from flink_tpu.ops.sketches import HyperLogLogAggregate
+    from flink_tpu.streaming.device_window_operator import DeviceWindowOperator
+    from flink_tpu.streaming.harness import OneInputStreamOperatorTestHarness
+    from flink_tpu.streaming.vectorized import VectorizedTumblingWindows
+    from flink_tpu.streaming.windowing import TumblingEventTimeWindows, Time
+
+    op = DeviceWindowOperator(
+        TumblingEventTimeWindows.of(Time.seconds(1)),
+        HyperLogLogAggregate(precision=18))
+    h = OneInputStreamOperatorTestHarness(op, key_selector=lambda v: v)
+    h.open()
+    for i in range(50):
+        h.process_element(i % 5, 100 + i)
+    h.process_watermark(10_000)
+    assert isinstance(op.engine, VectorizedTumblingWindows)
+    assert len(h.extract_output_values()) == 5

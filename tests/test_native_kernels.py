@@ -11,19 +11,25 @@ pytestmark = pytest.mark.skipif(not nat.available(),
                                 reason="native runtime unavailable")
 
 
+_EXTREMES = np.array([0, 1, 2 ** 63 - 1, 2 ** 64 - 1,
+                      0x9E3779B97F4A7C15], np.uint64)
+
 KEY_PATTERNS = [
     ("uniform_small", lambda rng, n: rng.integers(0, 50, n)),
     ("uniform_wide", lambda rng, n: rng.integers(0, 2 ** 63, n)),
     ("all_equal", lambda rng, n: np.full(n, 7)),
-    ("extremes", lambda rng, n: rng.choice(
-        [0, 1, 2 ** 63 - 1, 2 ** 64 - 1, 0x9E3779B97F4A7C15], n)),
-    ("high_bits_only", lambda rng, n: rng.integers(0, 4, n) << 60),
+    # index-select keeps the exact uint64 bit patterns (choice over a
+    # python list would round-trip through float64 and corrupt them)
+    ("extremes", lambda rng, n: _EXTREMES[rng.integers(0, 5, n)]),
+    ("high_bits_only", lambda rng, n: rng.integers(0, 4, n).astype(
+        np.uint64) << np.uint64(60)),
 ]
+_SEED = {name: i * 1000 + 17 for i, (name, _) in enumerate(KEY_PATTERNS)}
 
 
 @pytest.mark.parametrize("name,gen", KEY_PATTERNS)
 def test_sum_log_fire_matches_python(name, gen):
-    rng = np.random.default_rng(hash(name) % 2 ** 31)
+    rng = np.random.default_rng(_SEED[name])
     n = 5000
     keys = gen(rng, n).astype(np.uint64)
     vals = rng.random(n)
@@ -41,7 +47,7 @@ def test_sum_log_fire_matches_python(name, gen):
 
 @pytest.mark.parametrize("name,gen", KEY_PATTERNS)
 def test_hll_compact_matches_python(name, gen):
-    rng = np.random.default_rng(hash(name) % 2 ** 31 + 1)
+    rng = np.random.default_rng(_SEED[name] + 1)
     n = 4000
     keys = gen(rng, n).astype(np.uint64)
     regs = rng.integers(0, 1024, n).astype(np.uint16)
