@@ -34,11 +34,6 @@ from flink_tpu.ops.device_agg import DeviceAggregateFunction
 from flink_tpu.streaming.elements import StreamRecord, Watermark
 from flink_tpu.streaming.operators import StreamOperator
 from flink_tpu.streaming.sources import SinkFunction, SourceFunction
-from flink_tpu.streaming.windowing import (
-    EventTimeSessionWindows,
-    SlidingEventTimeWindows,
-    TumblingEventTimeWindows,
-)
 
 
 class RecordBatch:
@@ -188,28 +183,25 @@ class ColumnarWindowOperator(StreamOperator):
         """require_log: restoring a log-tier checkpoint — a silent
         fallback to the vectorized tier would feed it an incompatible
         snapshot format, so failures must surface."""
-        from flink_tpu.streaming import log_windows as lw
-        integral = np.issubdtype(key_dtype, np.integer)
-        a = self.assigner
-        if integral or require_log:
-            try:
-                if isinstance(a, TumblingEventTimeWindows) and a.offset == 0:
-                    return lw.LogStructuredTumblingWindows(self.agg, a.size)
-                if (isinstance(a, SlidingEventTimeWindows) and a.offset == 0
-                        and a.size % a.slide == 0):
-                    return lw.LogStructuredSlidingWindows(self.agg, a.size,
-                                                          a.slide)
-                if isinstance(a, EventTimeSessionWindows):
-                    return lw.LogStructuredSessionWindows(self.agg, a.gap)
-            except (TypeError, RuntimeError):
-                if require_log:
-                    raise  # checkpoint needs this tier
-                # unsupported cell decomposition / no native lib
         from flink_tpu.streaming.device_window_operator import (
             engine_for_assigner,
+            log_engine_for_assigner,
         )
-        eng = engine_for_assigner(self.assigner, self.agg,
-                                  self.initial_capacity)
+        if require_log:
+            from flink_tpu.streaming import log_windows as lw
+            eng = log_engine_for_assigner(self.assigner, self.agg)
+            if eng is None:
+                raise RuntimeError(
+                    "checkpoint was taken on the log engine tier, which "
+                    "is unavailable here (native runtime / eligible "
+                    "aggregate required)")
+            return eng
+        eng = None
+        if np.issubdtype(key_dtype, np.integer):
+            eng = log_engine_for_assigner(self.assigner, self.agg)
+        if eng is None:
+            eng = engine_for_assigner(self.assigner, self.agg,
+                                      self.initial_capacity)
         if eng is None:
             raise ValueError(f"no engine for assigner {self.assigner!r}")
         return eng
@@ -231,6 +223,11 @@ class ColumnarWindowOperator(StreamOperator):
             # engines without batch-fire support deliver via .emitted
             if hasattr(self.engine, "fired"):
                 self.engine.emit_arrays = True
+            # fast-forward to the operator watermark: rows behind it
+            # must count as late, not fire into closed windows
+            wm = getattr(self, "current_watermark", None)
+            if wm is not None and wm > -(2 ** 63):
+                self.engine.advance_watermark(wm)
         values = None
         value_hashes = None
         if self.input_col is not None:

@@ -42,6 +42,16 @@ from flink_tpu.streaming.windowing import (
 )
 
 
+def assigner_supported(assigner) -> bool:
+    """Shape check shared by the fail-fast open() and the planner: the
+    assigners the single-device engines (either tier) cover."""
+    if isinstance(assigner, TumblingEventTimeWindows):
+        return assigner.offset == 0
+    if isinstance(assigner, SlidingEventTimeWindows):
+        return assigner.offset == 0 and assigner.size % assigner.slide == 0
+    return isinstance(assigner, EventTimeSessionWindows)
+
+
 def log_engine_for_assigner(assigner, agg: DeviceAggregateFunction):
     """Log-structured combiner tier for this assigner+aggregate, or
     None when the cell decomposition / assigner shape doesn't fit
@@ -137,6 +147,10 @@ class DeviceWindowOperator(StreamOperator):
 
     # ---- lifecycle --------------------------------------------------
     def open(self):
+        if self.mesh is None and not assigner_supported(self.assigner):
+            # fail fast at open, not at the first flush
+            raise ValueError(
+                f"no device engine for assigner {self.assigner!r}")
         if self.mesh is not None:
             # mesh jobs pick the sharded engine up front; single-chip
             # jobs defer tier selection to the first flush (the log

@@ -15,6 +15,7 @@
 //
 // Build: g++ -O3 -march=native -shared -fPIC (flink_tpu/native loader).
 
+#include <algorithm>
 #include <cmath>
 #include <cstdint>
 #include <cstring>
@@ -528,6 +529,15 @@ int64_t ft_qsketch_log_fire(const uint64_t* keys, const uint16_t* buckets,
   for (int64_t i = 0; i < n; ++i)
     buf[i] = {keys[i], static_cast<uint32_t>(buckets[i])};
   HllRec* sorted = radix_sort_by_key(buf.data(), scratch.data(), n);
+  // bucket midpoint values precomputed once (one exp per BUCKET, not
+  // one per key x quantile — singleton-heavy fires are exp-bound
+  // otherwise)
+  std::vector<double> bucket_val(n_buckets);
+  bucket_val[0] = 0.0;
+  for (int b = 1; b < n_buckets; ++b)
+    bucket_val[b] = __builtin_exp(
+        (static_cast<double>(b) - 0.5 + static_cast<double>(offset)) *
+        log_gamma) * mid_corr;
   std::vector<int64_t> counts(n_buckets, 0);
   std::vector<uint16_t> touched;
   touched.reserve(256);
@@ -543,20 +553,25 @@ int64_t ft_qsketch_log_fire(const uint64_t* keys, const uint16_t* buckets,
       ++counts[b];
       ++total;
     }
-    for (int q = 0; q < n_q; ++q) {
-      double target = quantiles[q] * static_cast<double>(total);
-      if (target < 1.0) target = 1.0;
-      int64_t acc = 0;
-      int sel = n_buckets - 1;
-      for (int b = 0; b < n_buckets; ++b) {
-        acc += counts[b];
-        if (static_cast<double>(acc) >= target) { sel = b; break; }
+    if (touched.size() == 1) {
+      // all mass in one bucket: every quantile answers it
+      double v = bucket_val[touched[0]];
+      for (int q = 0; q < n_q; ++q) out_q[n_keys * n_q + q] = v;
+    } else {
+      // accumulate over the key's touched buckets only, ascending
+      // (absent buckets hold zero count — skipping them is exact)
+      std::sort(touched.begin(), touched.end());
+      for (int q = 0; q < n_q; ++q) {
+        double target = quantiles[q] * static_cast<double>(total);
+        if (target < 1.0) target = 1.0;
+        int64_t acc = 0;
+        uint16_t sel = touched.back();
+        for (uint16_t b : touched) {
+          acc += counts[b];
+          if (static_cast<double>(acc) >= target) { sel = b; break; }
+        }
+        out_q[n_keys * n_q + q] = bucket_val[sel];
       }
-      out_q[n_keys * n_q + q] =
-          sel == 0 ? 0.0
-                   : __builtin_exp((static_cast<double>(sel) - 0.5 +
-                                    static_cast<double>(offset)) *
-                                   log_gamma) * mid_corr;
     }
     out_keys[n_keys++] = k;
     for (uint16_t b : touched) counts[b] = 0;
