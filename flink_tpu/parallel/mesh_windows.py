@@ -40,6 +40,14 @@ case the reference handles by unbounded heap state).
 Overflow is grow-or-fail per region: a record that cannot claim a slot
 within max_probes raises immediately instead of dropping data
 (VERDICT r1 "weak #6": a silent overflow counter is data loss).
+
+Scope: tumbling assigners.  Sliding windows lower onto slide-
+granularity panes (see VectorizedSlidingWindows / the log engines), so
+the mesh extension is a composition: pane regions in this ring plus a
+per-window merge of pane STATE rows (keys stay shard-local across
+panes — hash routing is pane-independent — so the merge needs no
+cross-shard exchange, only a state-row gather per pane).  Left for a
+later round; single-device engines serve sliding/session meanwhile.
 """
 
 from __future__ import annotations
