@@ -13,5 +13,10 @@ from flink_tpu.parallel.mesh_agg import (
     MeshWindowAggregation,
     make_sharded_step,
 )
+from flink_tpu.parallel.mesh_windows import (
+    MeshSlidingWindows,
+    MeshTumblingWindows,
+)
 
-__all__ = ["MeshWindowAggregation", "make_sharded_step"]
+__all__ = ["MeshWindowAggregation", "make_sharded_step",
+           "MeshTumblingWindows", "MeshSlidingWindows"]

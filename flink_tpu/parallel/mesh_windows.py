@@ -41,13 +41,14 @@ Overflow is grow-or-fail per region: a record that cannot claim a slot
 within max_probes raises immediately instead of dropping data
 (VERDICT r1 "weak #6": a silent overflow counter is data loss).
 
-Scope: tumbling assigners.  Sliding windows lower onto slide-
-granularity panes (see VectorizedSlidingWindows / the log engines), so
-the mesh extension is a composition: pane regions in this ring plus a
-per-window merge of pane STATE rows (keys stay shard-local across
-panes — hash routing is pane-independent — so the merge needs no
-cross-shard exchange, only a state-row gather per pane).  Left for a
-later round; single-device engines serve sliding/session meanwhile.
+:class:`MeshSlidingWindows` composes sliding windows from slide-
+granularity pane regions in the same ring: keys stay shard-local
+across panes (hash routing is pane-independent), so a window fire is
+a SHARD-LOCAL jitted merge — each pane region's occupied keys insert
+into a scratch region and their accumulators fold in via
+agg.merge_slots, then the scratch region fires like a tumbling window.
+No cross-shard exchange happens at fire; the keyBy all_to_all runs
+only at ingest, once per record regardless of the overlap factor.
 """
 
 from __future__ import annotations
@@ -154,6 +155,74 @@ def _build_programs(mesh: Mesh, axis: str, agg: DeviceAggregateFunction,
     return init_sharded, step, fire
 
 
+def _build_clear_program(mesh: Mesh, axis: str,
+                         agg: DeviceAggregateFunction, region_size: int):
+    """Clear one region (occupancy + accumulators) with no outputs —
+    the pane-prune path needs no gather."""
+
+    def local_clear(table, state, r):
+        table = jax.tree_util.tree_map(lambda a: a[0], table)
+        state = jax.tree_util.tree_map(lambda a: a[0], state)
+        r = r[0]
+        slots = r * jnp.int32(region_size) + jnp.arange(
+            region_size, dtype=jnp.int32)
+        table = DeviceHashTable(
+            key_hi=table.key_hi,
+            key_lo=table.key_lo,
+            occupied=table.occupied.at[slots].set(False),
+        )
+        state = agg.clear_slots(state, slots)
+        return jax.tree_util.tree_map(lambda a: a[None], (table, state))
+
+    return jax.jit(shard_map(
+        local_clear, mesh=mesh,
+        in_specs=(P(axis), P(axis), P(axis)),
+        out_specs=(P(axis), P(axis)),
+    ), donate_argnums=(0, 1))
+
+
+def _build_merge_program(mesh: Mesh, axis: str,
+                         agg: DeviceAggregateFunction, n_panes: int,
+                         region_size: int, scratch_region: int,
+                         junk_slot: int, max_probes: int):
+    """Shard-local pane merge for sliding fires: for each of the
+    window's n_panes regions (static unroll), insert the region's
+    occupied keys into the scratch region and fold their accumulators
+    in via agg.merge_slots.  No collectives — keys live in the same
+    shard across panes.  Lanes that miss (unoccupied, or scratch
+    overflow) are pointed at a sacrificial junk slot (junk ⊕= junk is
+    never read; the junk region is never inserted into)."""
+
+    def local_merge(table, state, regions):
+        table = jax.tree_util.tree_map(lambda a: a[0], table)
+        state = jax.tree_util.tree_map(lambda a: a[0], state)
+        regions = regions[0]                      # [n_panes] int32
+        lane = jnp.arange(region_size, dtype=jnp.int32)
+        scratch = jnp.full(region_size, scratch_region, jnp.int32)
+        overflow = jnp.int32(0)
+        for i in range(n_panes):
+            src_slots = regions[i] * jnp.int32(region_size) + lane
+            occ = table.occupied[src_slots]
+            hi = table.key_hi[src_slots]
+            lo = table.key_lo[src_slots]
+            table, dst, ok = insert_or_lookup_regions_impl(
+                table, hi, lo, scratch, occ,
+                region_size=region_size, max_probes=max_probes)
+            eff = occ & ok & (dst >= 0)
+            dst_safe = jnp.where(eff, dst, junk_slot)
+            src_safe = jnp.where(eff, src_slots, junk_slot)
+            state = agg.merge_slots(state, dst_safe, src_safe)
+            overflow = overflow + (occ & ~eff).sum()
+        return (jax.tree_util.tree_map(lambda a: a[None], (table, state)),
+                overflow[None])
+
+    return jax.jit(shard_map(
+        local_merge, mesh=mesh,
+        in_specs=(P(axis), P(axis), P(axis)),
+        out_specs=(P(axis), P(axis)),
+    ), donate_argnums=(0, 1))
+
+
 class MeshTumblingWindows:
     """Multi-window mesh-sharded tumbling engine with the vectorized-
     engine host interface (DeviceWindowOperator-compatible).
@@ -170,10 +239,16 @@ class MeshTumblingWindows:
                  max_probes: int = 64):
         self.agg = aggregate
         self.size = window_size_ms
+        #: how far past a (pane) start a record stays live — the
+        #: sliding subclass widens this to the full window size
+        self.lateness_horizon = window_size_ms
         self.mesh = mesh
         self.axis = axis
         self.n_shards = mesh.shape[axis]
         self.ring = ring
+        #: ring slots handed to windows; subclasses may reserve a
+        #: suffix of the ring for scratch regions
+        self.usable_ring = ring
         self.region_size = capacity_per_window_shard
         if step_batch % self.n_shards:
             step_batch += self.n_shards - step_batch % self.n_shards
@@ -212,7 +287,7 @@ class MeshTumblingWindows:
         ts = np.asarray(timestamps, np.int64)
         kh = key_hashes if key_hashes is not None else hash_keys_np(keys)
         starts = ts - np.mod(ts, self.size)
-        live = starts + self.size - 1 > self.watermark
+        live = starts + self.lateness_horizon - 1 > self.watermark
         if not live.all():
             self.num_late_dropped += int((~live).sum())
             if not live.any():
@@ -267,7 +342,7 @@ class MeshTumblingWindows:
         got = self.live.get(start)
         if got is not None:
             return got
-        r = (start // self.size) % self.ring
+        r = (start // self.size) % self.usable_ring
         if self.ring_window[r] is not None:
             return None  # occupied by another live window — park
         self.ring_window[r] = start
@@ -355,9 +430,9 @@ class MeshTumblingWindows:
                 break
         return fired
 
-    def _fire_window(self, start: int) -> int:
-        r = self.live.pop(start)
-        self.ring_window[r] = None
+    def _fire_region(self, r: int):
+        """Fire-and-clear one device region; returns (key hash64s,
+        results) for its occupied lanes across all shards."""
         r_arr = np.full(self.n_shards, r, np.int32)
         (self.table, self.state), (hi, lo, occ, res) = self._fire(
             self.table, self.state, r_arr)
@@ -367,20 +442,26 @@ class MeshTumblingWindows:
         res = np.asarray(res)
         res = res.reshape(res.shape[0] * res.shape[1], *res.shape[2:])
         sel = np.nonzero(occ)[0]
-        wdir = self.key_directory.pop(start, {})
-        if not len(sel):
-            return 0
         h64 = (hi[sel].astype(np.uint64) << np.uint64(32)) | lo[sel].astype(
             np.uint64)
+        return h64, res[sel]
+
+    def _fire_window(self, start: int) -> int:
+        r = self.live.pop(start)
+        self.ring_window[r] = None
+        h64, res = self._fire_region(r)
+        wdir = self.key_directory.pop(start, {})
+        if not len(h64):
+            return 0
         end = start + self.size
         keys = [wdir[h] for h in h64.tolist()]
         if self.emit_arrays:
-            self.fired.append((keys, res[sel], start, end))
+            self.fired.append((keys, res, start, end))
         else:
-            for k, v in zip(keys, res[sel]):
+            for k, v in zip(keys, res):
                 out = v.item() if np.ndim(v) == 0 else v
                 self.emitted.append((k, out, start, end))
-        return len(sel)
+        return len(keys)
 
     def block_until_ready(self) -> None:
         jax.tree_util.tree_map(lambda a: a.block_until_ready(), self.state)
@@ -401,6 +482,7 @@ class MeshTumblingWindows:
                              None if h is None else np.array(h))
                             for kh, v, h in lst]
                         for s, lst in self.pending.items()},
+            "fired_horizon": getattr(self, "_fired_horizon", None),
         }
 
     def restore(self, snap: dict) -> None:
@@ -417,9 +499,179 @@ class MeshTumblingWindows:
             self.key_directory = {s: dict(kd) for s in snap["live"]}
         else:
             self.key_directory = {s: dict(d) for s, d in kd.items()}
+        if snap.get("fired_horizon") is not None:
+            self._fired_horizon = snap["fired_horizon"]
         self.pending = {s: list(lst) for s, lst in snap["pending"].items()}
         self._b_kh.clear()
         self._b_ring.clear()
         self._b_val.clear()
         self._b_vh.clear()
         self._b_count = 0
+
+
+class MeshSlidingWindows(MeshTumblingWindows):
+    """Mesh-sharded sliding windows by pane composition.
+
+    Ingest runs the tumbling engine at slide granularity (one region
+    per pane, one all_to_all-routed insert per record); a window fire
+    merges its size/slide pane regions SHARD-LOCALLY into a reserved
+    scratch region (agg.merge_slots — mergeability is the sketch
+    kernels' design property) and fires the scratch like a tumbling
+    window.  Pane regions stay live until no future window needs them
+    (same fire/prune rules as VectorizedSlidingWindows /
+    LogStructuredSlidingWindows, lateness 0)."""
+
+    def __init__(self, aggregate: DeviceAggregateFunction,
+                 window_size_ms: int, slide_ms: int, mesh: Mesh,
+                 axis: str = "kg", max_parallelism: int = 128,
+                 capacity_per_window_shard: int = 1 << 12,
+                 extra_ring: int = 4, step_batch: int = 1 << 12,
+                 max_probes: int = 64):
+        if window_size_ms % slide_ms != 0:
+            raise ValueError("window size must be a multiple of the slide "
+                             "(pane composition)")
+        n_panes = window_size_ms // slide_ms
+        if n_panes > 32:
+            # the merge program statically unrolls n_panes probe
+            # passes and the ring allocates n_panes regions per shard
+            # — compile time and HBM scale with the overlap factor
+            raise ValueError(
+                f"mesh sliding supports size/slide <= 32 (got {n_panes}); "
+                "use the single-device sliding engines for higher overlap")
+        # pane slots + slack for in-flight panes + scratch + junk
+        ring = n_panes + extra_ring + 2
+        super().__init__(aggregate, slide_ms, mesh, axis, max_parallelism,
+                         capacity_per_window_shard, ring, step_batch,
+                         max_probes)
+        self.window_size = window_size_ms
+        self.slide = slide_ms
+        self.n_panes = n_panes
+        self.lateness_horizon = window_size_ms
+        # reserve the ring's last two regions: scratch (window merges
+        # fire from it) and junk (sacrificial no-op lanes; never
+        # inserted into, so its occupancy stays empty)
+        self.usable_ring = ring - 2
+        self.scratch_region = ring - 2
+        self.junk_region = ring - 1
+        self.ring_window[self.scratch_region] = -1
+        self.ring_window[self.junk_region] = -1
+        self._fired_horizon = -(2 ** 63)
+        self._merge = _build_merge_program(
+            mesh, axis, aggregate, n_panes, self.region_size,
+            self.scratch_region, self.junk_region * self.region_size,
+            max_probes)
+        self._clear = _build_clear_program(mesh, axis, aggregate,
+                                           self.region_size)
+
+    # ---- firing ------------------------------------------------------
+    def advance_watermark(self, watermark: int) -> int:
+        prev = self._fired_horizon
+        self._fired_horizon = watermark
+        self.watermark = watermark
+        fired = 0
+        done = set()
+        while True:
+            progress = False
+            for start in sorted(self.pending):
+                if self._acquire_ring_slot(start) is not None:
+                    for kh, vals, vhs in self.pending.pop(start):
+                        self._ingest_window(start, kh, vals, vhs)
+                    progress = True
+            self.flush()
+            if self.live:
+                min_pane = min(self.live)
+                max_pane = max(self.live)
+                hi = min(watermark - self.window_size + 1, max_pane)
+                start_from = min_pane - self.window_size + self.slide
+                first = -(-start_from // self.slide) * self.slide
+                for W in range(first, hi + 1, self.slide):
+                    if W in done or W + self.window_size - 1 <= prev:
+                        continue
+                    # a parked pane's records are on time — firing
+                    # without them would silently lose data.  Skip;
+                    # pruning frees slots, the pane unparks, and the
+                    # outer loop fires this window (the oldest pane's
+                    # windows are never blocked, so progress holds)
+                    if any(p in self.pending
+                           for p in range(W, W + self.window_size,
+                                          self.slide)):
+                        continue
+                    panes = [p for p in range(W, W + self.window_size,
+                                              self.slide) if p in self.live]
+                    if not panes:
+                        continue
+                    fired += self._fire_sliding_window(W, panes)
+                    done.add(W)
+                    progress = True
+            if self._prune_panes(watermark, done, prev):
+                progress = True
+            if not progress:
+                break
+        return fired
+
+    def _fire_sliding_window(self, W: int, pane_starts) -> int:
+        regions = np.full(self.n_panes, self.junk_region, np.int32)
+        for i, p in enumerate(pane_starts):
+            regions[i] = self.live[p]
+        reg_arr = np.tile(regions, (self.n_shards, 1))
+        (self.table, self.state), overflow = self._merge(
+            self.table, self.state, reg_arr)
+        ov = int(np.asarray(overflow).sum())
+        if ov:
+            raise MeshWindowOverflowError(
+                f"{ov} keys overflowed the sliding scratch region "
+                f"(capacity_per_window_shard={self.region_size}); a "
+                f"window's distinct keys per shard must fit one region")
+        h64, res = self._fire_region(self.scratch_region)
+        if not len(h64):
+            return 0
+        dirs = [self.key_directory[p] for p in pane_starts
+                if p in self.key_directory]
+        keys = []
+        for h in h64.tolist():
+            for d in dirs:
+                if h in d:
+                    keys.append(d[h])
+                    break
+            else:  # pragma: no cover — directory invariant violated
+                raise KeyError(f"fired key hash {h} not in any pane "
+                               "directory")
+        end = W + self.window_size
+        if self.emit_arrays:
+            self.fired.append((keys, res, W, end))
+        else:
+            for k, v in zip(keys, res):
+                out = v.item() if np.ndim(v) == 0 else v
+                self.emitted.append((k, out, W, end))
+        return len(keys)
+
+    def _prune_panes(self, watermark: int, done, prev: int) -> bool:
+        """Pane [P, P+slide) dies once every window containing it has
+        FIRED (not merely become due — a due window blocked on a
+        parked pane still needs this pane's data): clear its device
+        region and free its ring slot + key directory."""
+        pruned = False
+        for P in sorted(self.live):
+            if P + self.window_size - 1 > watermark:
+                break
+            blocked = False
+            for W in range(P - self.window_size + self.slide,
+                           P + self.slide, self.slide):
+                if (W + self.window_size - 1 <= watermark
+                        and W + self.window_size - 1 > prev
+                        and W not in done
+                        and any(q in self.pending or q in self.live
+                                for q in range(W, W + self.window_size,
+                                               self.slide))):
+                    blocked = True
+                    break
+            if blocked:
+                continue
+            r = self.live.pop(P)
+            self.ring_window[r] = None
+            (self.table, self.state) = self._clear(
+                self.table, self.state,
+                np.full(self.n_shards, r, np.int32))
+            self.key_directory.pop(P, None)
+            pruned = True
+        return pruned

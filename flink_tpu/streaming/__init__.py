@@ -9,4 +9,8 @@
   datastream  fluent API (in flink_tpu/streaming/datastream.py)
   graph       StreamGraph -> JobGraph translation with chaining
   task        single-process StreamTask execution
+  vectorized  device-resident scatter window engines (TPU HBM state)
+  log_windows log-structured combiner window engines (sort + reduce)
+  columnar    RecordBatch vectorized-execution tier (sources, window
+              operator, explode bridge)
 """

@@ -93,6 +93,15 @@ def engine_for_assigner(assigner, agg: DeviceAggregateFunction,
                                          initial_capacity=initial_capacity)
     if isinstance(assigner, SlidingEventTimeWindows):
         if assigner.size % assigner.slide == 0 and assigner.offset == 0:
+            if mesh is not None:
+                from flink_tpu.parallel.mesh_windows import (
+                    MeshSlidingWindows,
+                )
+                return MeshSlidingWindows(
+                    agg, assigner.size, assigner.slide, mesh,
+                    axis=mesh_axis, max_parallelism=max_parallelism,
+                    capacity_per_window_shard=max(
+                        1 << 8, initial_capacity // mesh.shape[mesh_axis]))
             return VectorizedSlidingWindows(agg, assigner.size,
                                             assigner.slide,
                                             initial_capacity=initial_capacity)

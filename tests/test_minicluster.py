@@ -430,3 +430,107 @@ def test_mesh_window_job_checkpoint_recovery(mesh):
         expect[(k, t - t % 1000)] += 1
     got = {(k, s): int(v) for (k, s, v) in sink.values}
     assert got == dict(expect)
+
+
+# ---------------------------------------------------------------------
+# MeshSlidingWindows: pane-composed sliding on the mesh
+# ---------------------------------------------------------------------
+
+def test_mesh_sliding_counts_match_reference(mesh):
+    from flink_tpu.parallel.mesh_windows import MeshSlidingWindows
+    eng = MeshSlidingWindows(CountAggregate(), 3000, 1000, mesh,
+                             capacity_per_window_shard=256, step_batch=64)
+    rng = np.random.default_rng(3)
+    keys = rng.integers(0, 40, 600)
+    ts = np.sort(rng.integers(0, 6000, 600))
+    eng.process_batch(keys, ts)
+    eng.advance_watermark(20_000)
+    expect = collections.Counter()
+    for k, t in zip(keys.tolist(), ts.tolist()):
+        pane = t - t % 1000
+        for w in range(pane - 2000, pane + 1000, 1000):
+            expect[(k, w, w + 3000)] += 1
+    got = {(k, s, e): v for (k, v, s, e) in eng.emitted}
+    assert got == dict(expect)
+
+
+def test_mesh_sliding_incremental_watermarks_match_vectorized(mesh):
+    from flink_tpu.parallel.mesh_windows import MeshSlidingWindows
+    from flink_tpu.streaming.vectorized import VectorizedSlidingWindows
+    rng = np.random.default_rng(5)
+    n = 800
+    keys = rng.integers(0, 30, n).astype(np.uint64)
+    ts = np.sort(rng.integers(0, 8000, n))
+    vals = rng.random(n).astype(np.float32)
+
+    ref = VectorizedSlidingWindows(SumAggregate(), 2000, 1000,
+                                   initial_capacity=512)
+    ref.process_batch(keys, ts, vals, key_hashes=None)
+    ref.advance_watermark(30_000)
+
+    eng = MeshSlidingWindows(SumAggregate(), 2000, 1000, mesh,
+                             capacity_per_window_shard=128, step_batch=64)
+    CH = 200
+    for i in range(0, n, CH):
+        sl = slice(i, i + CH)
+        eng.process_batch(keys[sl], ts[sl], vals[sl])
+        eng.advance_watermark(int(ts[sl][-1]) - 1)
+    eng.advance_watermark(30_000)
+
+    want = {(int(k), s, e): round(float(r), 3)
+            for k, r, s, e in ref.emitted}
+    got = {(int(k), s, e): round(float(r), 3)
+           for k, r, s, e in eng.emitted}
+    assert got == want
+
+
+def test_mesh_sliding_snapshot_restore(mesh):
+    from flink_tpu.parallel.mesh_windows import MeshSlidingWindows
+    rng = np.random.default_rng(7)
+    n = 400
+    keys = rng.integers(0, 20, n)
+    ts = np.sort(rng.integers(0, 5000, n))
+
+    ref = MeshSlidingWindows(CountAggregate(), 2000, 1000, mesh,
+                             capacity_per_window_shard=128, step_batch=64)
+    ref.process_batch(keys, ts)
+    ref.advance_watermark(20_000)
+
+    a = MeshSlidingWindows(CountAggregate(), 2000, 1000, mesh,
+                           capacity_per_window_shard=128, step_batch=64)
+    a.process_batch(keys[:200], ts[:200])
+    a.advance_watermark(int(ts[199]) - 1)
+    snap = a.snapshot()
+    b = MeshSlidingWindows(CountAggregate(), 2000, 1000, mesh,
+                           capacity_per_window_shard=128, step_batch=64)
+    b.restore(snap)
+    b.process_batch(keys[200:], ts[200:])
+    b.advance_watermark(20_000)
+    combined = {(int(k), s, e): v for k, v, s, e in a.emitted}
+    for k, v, s, e in b.emitted:
+        combined[(int(k), s, e)] = v
+    want = {(int(k), s, e): v for k, v, s, e in ref.emitted}
+    assert combined == want
+
+
+def test_mesh_sliding_parked_pane_not_lost(mesh):
+    """Data spanning more panes than usable ring slots, then one big
+    watermark: windows must not fire while one of their panes is
+    parked (code-review regression — pane 6000's records were lost)."""
+    from flink_tpu.parallel.mesh_windows import MeshSlidingWindows
+    eng = MeshSlidingWindows(CountAggregate(), 2000, 1000, mesh,
+                             capacity_per_window_shard=64, step_batch=32,
+                             extra_ring=4)  # usable ring = 6 panes
+    rng = np.random.default_rng(11)
+    n = 300
+    keys = rng.integers(0, 10, n)
+    ts = rng.integers(0, 10_000, n)  # 10 panes > 6 usable slots
+    eng.process_batch(keys, ts)
+    eng.advance_watermark(50_000)
+    expect = collections.Counter()
+    for k, t in zip(keys.tolist(), ts.tolist()):
+        pane = t - t % 1000
+        for w in range(pane - 1000, pane + 1000, 1000):
+            expect[(k, w, w + 2000)] += 1
+    got = {(k, s, e): v for (k, v, s, e) in eng.emitted}
+    assert got == dict(expect)
