@@ -534,3 +534,32 @@ def test_mesh_sliding_parked_pane_not_lost(mesh):
             expect[(k, w, w + 2000)] += 1
     got = {(k, s, e): v for (k, v, s, e) in eng.emitted}
     assert got == dict(expect)
+
+
+def test_mesh_sliding_window_job_on_minicluster(mesh):
+    """keyBy().window(Sliding...).aggregate(device_agg) over the mesh,
+    executed from a JobGraph — the sliding twin of the tumbling mesh
+    job (engine_for_assigner routes sliding+mesh to
+    MeshSlidingWindows)."""
+    from flink_tpu.streaming.windowing import SlidingEventTimeWindows
+    events = _sorted_events(n=500, n_keys=30, horizon=5000, seed=13)
+    env = StreamExecutionEnvironment()
+    env.set_mesh(mesh).use_mini_cluster(2)
+    env.set_parallelism(2)
+    sink = CollectSink()
+    stream = env.from_collection(events)
+    stream = stream.assign_timestamps_and_watermarks(
+        BoundedOutOfOrdernessTimestampExtractor(0, lambda e: e[1]))
+    (stream.key_by(lambda e: e[0])
+        .window(SlidingEventTimeWindows.of(2000, 1000))
+        .aggregate(CountAggregate(), window_function=(
+            lambda key, w, vals: [(key, w.start, w.end, vals[0])]))
+        .add_sink(sink))
+    env.execute("mesh-sliding-window-job")
+    expect = collections.Counter()
+    for k, t in events:
+        pane = t - t % 1000
+        for w in range(pane - 1000, pane + 1000, 1000):
+            expect[(k, w, w + 2000)] += 1
+    got = {(k, s, e): int(v) for (k, s, e, v) in sink.values}
+    assert got == dict(expect)
