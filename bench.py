@@ -324,8 +324,10 @@ def bench_sql(n_events=1 << 22, n_keys=500_000, precision=12):
     out = t_env.sql_query(
         "SELECT k, APPROX_COUNT_DISTINCT(u) AS d "
         "FROM ev GROUP BY TUMBLE(ts, INTERVAL '1' SECOND), k")
+    assert getattr(out, "columnar", False), \
+        "sql bench plan fell off the columnar tier"
     sink = ColumnarCollectSink()
-    out.to_append_stream().add_sink(sink)
+    out.to_append_stream(batched=True).add_sink(sink)
     t0 = time.perf_counter()
     env.execute("bench-sql")
     elapsed = time.perf_counter() - t0

@@ -32,7 +32,7 @@ def main():
     assert getattr(result, "columnar", False), "columnar plan expected"
 
     sink = ColumnarCollectSink()
-    result.to_append_stream().add_sink(sink)
+    result.to_append_stream(batched=True).add_sink(sink)
     env.execute("sql-columnar-unique-visitors")
 
     print(f"{sink.total_rows()} result rows in "

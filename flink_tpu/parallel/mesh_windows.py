@@ -483,6 +483,8 @@ class MeshTumblingWindows:
                             for kh, v, h in lst]
                         for s, lst in self.pending.items()},
             "fired_horizon": getattr(self, "_fired_horizon", None),
+            "blocked": (sorted(self._blocked)
+                        if hasattr(self, "_blocked") else None),
         }
 
     def restore(self, snap: dict) -> None:
@@ -501,6 +503,8 @@ class MeshTumblingWindows:
             self.key_directory = {s: dict(d) for s, d in kd.items()}
         if snap.get("fired_horizon") is not None:
             self._fired_horizon = snap["fired_horizon"]
+        if hasattr(self, "_blocked"):
+            self._blocked = set(snap.get("blocked") or ())
         self.pending = {s: list(lst) for s, lst in snap["pending"].items()}
         self._b_kh.clear()
         self._b_ring.clear()
@@ -556,6 +560,11 @@ class MeshSlidingWindows(MeshTumblingWindows):
         self.ring_window[self.scratch_region] = -1
         self.ring_window[self.junk_region] = -1
         self._fired_horizon = -(2 ** 63)
+        #: due windows skipped because one of their panes was parked
+        #: (pending) — carried across advance_watermark calls so they
+        #: fire once the pane unparks, instead of being silently lost
+        #: behind the fired horizon (round-2 advisor finding)
+        self._blocked: set = set()
         self._merge = _build_merge_program(
             mesh, axis, aggregate, n_panes, self.region_size,
             self.scratch_region, self.junk_region * self.region_size,
@@ -568,6 +577,10 @@ class MeshSlidingWindows(MeshTumblingWindows):
         prev = self._fired_horizon
         self._fired_horizon = watermark
         self.watermark = watermark
+        # windows due on an earlier call but skipped on a parked pane:
+        # retry them past the fired horizon (they never fired)
+        retry = self._blocked
+        blocked = set(retry)
         fired = 0
         done = set()
         while True:
@@ -578,23 +591,29 @@ class MeshSlidingWindows(MeshTumblingWindows):
                         self._ingest_window(start, kh, vals, vhs)
                     progress = True
             self.flush()
-            if self.live:
-                min_pane = min(self.live)
-                max_pane = max(self.live)
+            # scan windows over live AND pending panes — a due window
+            # whose every pane is parked has no live pane to anchor the
+            # scan, yet must be recorded as blocked so it fires later
+            panes_known = set(self.live) | set(self.pending)
+            if panes_known:
+                min_pane = min(panes_known)
+                max_pane = max(panes_known)
                 hi = min(watermark - self.window_size + 1, max_pane)
                 start_from = min_pane - self.window_size + self.slide
                 first = -(-start_from // self.slide) * self.slide
                 for W in range(first, hi + 1, self.slide):
-                    if W in done or W + self.window_size - 1 <= prev:
+                    if W in done or (W + self.window_size - 1 <= prev
+                                     and W not in retry):
                         continue
                     # a parked pane's records are on time — firing
-                    # without them would silently lose data.  Skip;
-                    # pruning frees slots, the pane unparks, and the
-                    # outer loop fires this window (the oldest pane's
-                    # windows are never blocked, so progress holds)
+                    # without them would silently lose data.  Park the
+                    # WINDOW too (blocked set): pruning frees slots,
+                    # the pane unparks, and this loop — or a later
+                    # advance_watermark call — fires it
                     if any(p in self.pending
                            for p in range(W, W + self.window_size,
                                           self.slide)):
+                        blocked.add(W)
                         continue
                     panes = [p for p in range(W, W + self.window_size,
                                               self.slide) if p in self.live]
@@ -603,10 +622,11 @@ class MeshSlidingWindows(MeshTumblingWindows):
                     fired += self._fire_sliding_window(W, panes)
                     done.add(W)
                     progress = True
-            if self._prune_panes(watermark, done, prev):
+            if self._prune_panes(watermark, done, prev, retry):
                 progress = True
             if not progress:
                 break
+        self._blocked = blocked - done
         return fired
 
     def _fire_sliding_window(self, W: int, pane_starts) -> int:
@@ -645,11 +665,14 @@ class MeshSlidingWindows(MeshTumblingWindows):
                 self.emitted.append((k, out, W, end))
         return len(keys)
 
-    def _prune_panes(self, watermark: int, done, prev: int) -> bool:
+    def _prune_panes(self, watermark: int, done, prev: int,
+                     retry=frozenset()) -> bool:
         """Pane [P, P+slide) dies once every window containing it has
         FIRED (not merely become due — a due window blocked on a
         parked pane still needs this pane's data): clear its device
-        region and free its ring slot + key directory."""
+        region and free its ring slot + key directory.  Windows in
+        ``retry`` sit behind the fired horizon but never fired (they
+        were blocked on a parked pane) — they count as unfired here."""
         pruned = False
         for P in sorted(self.live):
             if P + self.window_size - 1 > watermark:
@@ -658,7 +681,7 @@ class MeshSlidingWindows(MeshTumblingWindows):
             for W in range(P - self.window_size + self.slide,
                            P + self.slide, self.slide):
                 if (W + self.window_size - 1 <= watermark
-                        and W + self.window_size - 1 > prev
+                        and (W + self.window_size - 1 > prev or W in retry)
                         and W not in done
                         and any(q in self.pending or q in self.live
                                 for q in range(W, W + self.window_size,

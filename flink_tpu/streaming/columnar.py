@@ -81,6 +81,11 @@ class ColumnarSource(SourceFunction):
             pass
 
     def emit_step(self, ctx, max_records: int) -> bool:
+        """One cooperative step = ONE RecordBatch (`max_records` counts
+        stream ELEMENTS, same per-element accounting as
+        FromCollectionSource; a batch is the indivisible element here —
+        slicing it to max_records rows would cap every batch at the
+        executor's step size and destroy the columnar amortization)."""
         from flink_tpu.streaming.elements import MAX_WATERMARK
         ts_all = self.cols[self.rowtime]
         n = len(ts_all)

@@ -581,8 +581,12 @@ class LogStructuredSessionWindows:
         if value_hashes is None:
             from flink_tpu.streaming.vectorized import hash_keys_np
             value_hashes = hash_keys_np(values)
+        # per-event int truncation, matching the device tier
+        # (CountMinSketchAggregate.update casts each weight to int32)
+        # so both engines implement one semantics for fractional
+        # weights (round-2 advisor finding)
         w = (np.ones(len(keys), np.float32) if values is None
-             else np.asarray(values, np.float32))
+             else np.asarray(values).astype(np.int32).astype(np.float32))
         self._log_keys.append(keys)
         self._log_ts.append(ts)
         self._log_w.append(w)

@@ -99,11 +99,19 @@ class Table:
         return WindowedTable(self, spec)
 
     # ---- sinks -------------------------------------------------------
-    def to_append_stream(self):
-        return self.stream
+    def to_append_stream(self, batched: bool = False):
+        """Stream of row tuples regardless of the physical plan: a
+        columnar fast-path plan is bridged through explode_to_rows so
+        the element type never depends on planner eligibility (round-2
+        advisor finding).  ``batched=True`` opts into RecordBatch
+        elements when the plan is columnar (zero bridging cost; a
+        row-at-a-time plan still yields row tuples)."""
+        if batched:
+            return self.stream
+        return self._as_rows().stream
 
-    def execute_insert(self, sink) -> None:
-        self.stream.add_sink(sink)
+    def execute_insert(self, sink, batched: bool = False) -> None:
+        self.to_append_stream(batched=batched).add_sink(sink)
 
 
 class GroupedTable:
@@ -271,8 +279,8 @@ class StreamTableEnvironment:
         return t.select(*q.select)
 
     # ---- conversion --------------------------------------------------
-    def to_append_stream(self, table: Table):
-        return table.stream
+    def to_append_stream(self, table: Table, batched: bool = False):
+        return table.to_append_stream(batched=batched)
 
     def _expr(self, e) -> Expr:
         if isinstance(e, Expr):

@@ -37,7 +37,7 @@ def run_columnar(keys, ts, users, sql=SQL):
         {"k": keys, "u": users, "ts": ts}, rowtime="ts", chunk=4096))
     out = t_env.sql_query(sql)
     sink = ColumnarCollectSink()
-    out.to_append_stream().add_sink(sink)
+    out.to_append_stream(batched=True).add_sink(sink)
     env.execute("columnar")
     return sink
 
@@ -138,7 +138,7 @@ def test_columnar_session_sql_with_hll_falls_back_cleanly():
     out = t_env.sql_query(sql)
     assert getattr(out, "columnar", False)
     sink = ColumnarCollectSink()
-    out.to_append_stream().add_sink(sink)
+    out.to_append_stream(batched=True).add_sink(sink)
     env.execute("columnar-session")
     row = run_rowpath(keys, ts, users, sql)
     got = sorted((int(k), round(float(d))) for k, d in sink.rows())
@@ -190,7 +190,7 @@ def test_columnar_exactly_once_recovery():
         "GROUP BY TUMBLE(ts, INTERVAL '1' SECOND), k")
     assert getattr(out, "columnar", False)
     sink = ColumnarCollectSink()
-    out.to_append_stream().add_sink(sink)
+    out.to_append_stream(batched=True).add_sink(sink)
     result = env.execute("columnar-exactly-once")
 
     assert failer.failed, "the induced failure never fired"
