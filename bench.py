@@ -142,17 +142,24 @@ def run_engine(engine, kh, ts, values, vhs, horizon, chunk=1 << 20,
 # Config #2 — headline: tumbling 1s HLL COUNT DISTINCT, 1M keys, p12
 # ---------------------------------------------------------------------
 
-def bench_hll(n_events=1 << 23, n_keys=1_000_000, precision=12):
-    """Log-structured combiner tier (the framework's default engine
-    for this workload)."""
+def _hll_workload(n_events, n_keys, precision):
+    """Shared config-#2 workload + compiled baseline for the three
+    hll entries (log/host, log/device, scatter): ONE definition so
+    they stay comparable."""
     keys, ts, users = synth(n_events, n_keys, 1000, seed=7)
     kh = nat.splitmix64(keys)
     vh = nat.splitmix64(users)
-
     base_n = 1 << 22
     base_rate = best_of(lambda: nat.heap_tumbling_baseline(
         kh[:base_n], vh[:base_n], None, "hll", precision=precision,
         capacity=2 * n_keys))
+    return keys, ts, kh, vh, base_rate
+
+
+def bench_hll(n_events=1 << 23, n_keys=1_000_000, precision=12):
+    """Log-structured combiner tier (the framework's default engine
+    for this workload)."""
+    keys, ts, kh, vh, base_rate = _hll_workload(n_events, n_keys, precision)
 
     agg = HyperLogLogAggregate(precision=precision)
     eng = LogStructuredTumblingWindows(agg, 1000)
@@ -201,18 +208,33 @@ def bench_hll_10m(n_events=1 << 23, n_keys=10_000_000, precision=12):
     return rate, base_rate
 
 
+def bench_hll_device(n_events=1 << 23, n_keys=1_000_000, precision=12):
+    """Log tier with the window-fire finish forced ON DEVICE
+    (finish_tier="device": C++ sort/compact, then one jitted
+    exp2/cumsum/estimate scan on the TPU).  Measured, not asserted —
+    through this tunnel the host finish wins (link_probe picks it);
+    this entry keeps the device path's cost an honest number on every
+    attachment (round-2 verdict item 1a)."""
+    keys, ts, kh, vh, base_rate = _hll_workload(n_events, n_keys, precision)
+    agg = HyperLogLogAggregate(precision=precision)
+    eng = LogStructuredTumblingWindows(agg, 1000, finish_tier="device")
+    eng.emit_arrays = True
+    rate = run_engine(eng, keys, ts, None, vh, horizon=999, reps=3)
+    fired = sum(len(k) for k, _, _, _ in eng.fired)
+    assert fired > 0.9 * min(n_keys, n_events), fired
+    return rate, base_rate
+
+
 def bench_hll_scatter(n_events=1 << 23, n_keys=1_000_000, precision=12):
     """Device-resident scatter tier on the same workload (state in TPU
-    HBM; the multi-chip path)."""
-    keys, ts, users = synth(n_events, n_keys, 1000, seed=7)
-    kh = nat.splitmix64(keys)
-    vh = nat.splitmix64(users)
-    base_n = 1 << 22
-    base_rate = best_of(lambda: nat.heap_tumbling_baseline(
-        kh[:base_n], vh[:base_n], None, "hll", precision=precision,
-        capacity=2 * n_keys))
+    HBM; the multi-chip path).  Capacity is sized to the keyspace
+    (1.25x) rather than the next power of two: the window fire reads
+    the whole register file once (full-arena fast path), so slack
+    capacity is pure bandwidth tax."""
+    keys, ts, kh, vh, base_rate = _hll_workload(n_events, n_keys, precision)
     agg = HyperLogLogAggregate(precision=precision)
-    eng = VectorizedTumblingWindows(agg, 1000, initial_capacity=1 << 21,
+    eng = VectorizedTumblingWindows(agg, 1000,
+                                    initial_capacity=n_keys + n_keys // 4,
                                     microbatch=1 << 20)
     eng.emit_arrays = True
     # 4 reps: the shared machine's 2-5x contention spikes are
@@ -350,6 +372,7 @@ def main():
         ("hll", bench_hll),
         ("hll_10m", bench_hll_10m),
         ("hll_scatter", bench_hll_scatter),
+        ("hll_device", bench_hll_device),
         ("sliding_quantile", bench_sliding_quantile),
         ("session_cm", bench_session_cm),
         ("sql", bench_sql),

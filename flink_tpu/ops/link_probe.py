@@ -1,0 +1,106 @@
+"""Host↔device link micro-probe for engine-tier auto-selection.
+
+Several engine choices hinge on how the accelerator is attached, not
+on what it nominally is:
+
+- the log engines' window-fire finish (``finish_tier="auto"``,
+  flink_tpu/streaming/log_windows.py) can run its dense estimate phase
+  either in C++ on the host or as one jitted scan on the device, and
+- the measured outcome flips with the link: a tunnel-attached chip
+  (H2D ~0.6 GB/s in this environment, compute at the same ~5-7%
+  fraction of spec) loses 3.5x running the finish on device, while a
+  pod-attached chip (PCIe/ICI-class link, compute at spec) wins —
+  BENCH_NOTES.md records both sides.
+
+Rather than hardcoding a host default (round-2 verdict: "auto-select
+tier from a startup link/scatter micro-probe rather than a hardcoded
+host default"), this module measures the H2D link ONCE per process
+with plain ``jax.device_put`` transfers — deliberately no jit, so the
+probe costs two small transfers (~30 ms on the slowest observed link)
+and never a compile — and exposes a tier recommendation.
+
+The decision threshold (4 GB/s) is calibrated from measurement, not
+theory: the 0.61 GB/s tunnel measures host-finish 3.5x faster; link
+quality tracks compute quality on every observed attachment, and a
+chip you reach at multi-GB/s H2D runs its XLA scan at a spec fraction
+where the device finish wins (the ``hll_device`` bench entry keeps the
+device path measured so the calibration stays honest).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, Optional
+
+#: resolved once per process; force=True re-measures
+_cache: Dict[str, float] = {}
+
+#: H2D bandwidth above which the device-side window finish is
+#: expected to win (see module docstring for the calibration)
+DEVICE_FINISH_MIN_H2D_GBPS = 4.0
+
+_PROBE_BYTES = 8 << 20
+
+
+def _measure() -> Dict[str, float]:
+    import jax
+    import numpy as np
+
+    dev = jax.devices()[0]
+    if dev.platform == "cpu":
+        # same memory domain: "transfers" are memcpy and the "device"
+        # is this host — the C++ finish is the faster same-silicon path
+        return {"h2d_gbps": float("inf"), "cpu": 1.0}
+    # warm the transfer path (lazy backend init, pinning)
+    np.asarray(jax.device_put(np.zeros(4096, np.uint8), dev)[:1])
+
+    def best_of(nbytes: int, reps: int) -> float:
+        buf = np.zeros(nbytes, np.uint8)
+        best = 0.0
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            arr = jax.device_put(buf, dev)
+            # sync via a data-dependent readback, NOT
+            # block_until_ready (which returns immediately on some
+            # remote-attached backends); the tiny D2H adds one RTT,
+            # negligible against the payload
+            np.asarray(arr[:1])
+            best = max(best, nbytes / (time.perf_counter() - t0) / 1e9)
+            del arr
+        return best
+
+    # best-of-5: the result is cached for the process, so one
+    # contended sample must not misclassify the link (observed 20x
+    # swings on shared machines — the best sample is the least
+    # contended estimate of the link itself)
+    h2d = best_of(_PROBE_BYTES, 5)
+    if h2d > 1.0:
+        # fast link: 8 MB is RTT-overhead-dominated at multi-GB/s
+        # (0.4 ms payload vs dispatch+readback latency) — re-measure
+        # with a payload big enough to amortize it
+        h2d = max(h2d, best_of(8 * _PROBE_BYTES, 3))
+    # no d2h figure: reading back a just-transferred buffer can be
+    # served from a host-side copy on remote attachments (measured
+    # "171 GB/s" through a ~1 GB/s tunnel) — only h2d is trustworthy
+    # without compiling device code, and only h2d drives the decision
+    return {"h2d_gbps": h2d, "cpu": 0.0}
+
+
+def measure(force: bool = False) -> Dict[str, float]:
+    """Cached link measurements: {h2d_gbps, cpu}."""
+    global _cache
+    if force or not _cache:
+        _cache = _measure()
+    return _cache
+
+
+def recommended_finish_tier(override: Optional[str] = None) -> str:
+    """"host" or "device" for the log engines' fire finish.  An
+    explicit override ("host"/"device") passes through untouched."""
+    if override in ("host", "device"):
+        return override
+    m = measure()
+    if m["cpu"]:
+        return "host"
+    return ("device" if m["h2d_gbps"] >= DEVICE_FINISH_MIN_H2D_GBPS
+            else "host")

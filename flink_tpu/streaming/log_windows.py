@@ -158,6 +158,12 @@ class _HllMode:
             raise ValueError("log engine supports precision <= 16 "
                              "(u16 register cells)")
         self.agg = agg
+        if finish_tier == "auto":
+            # startup link micro-probe, not a hardcoded host default:
+            # tunnel-class links lose 3.5x on the device finish,
+            # pod-class links win it (ops/link_probe.py calibration)
+            from flink_tpu.ops.link_probe import recommended_finish_tier
+            finish_tier = recommended_finish_tier()
         self.finish_tier = finish_tier
         self._jit_finish = None
 
@@ -337,8 +343,10 @@ class LogStructuredTumblingWindows:
 
     finish_tier: "host" (C++ fused sort+reduce), "device" (C++
     sort/compact, then one jitted finish on TPU — HLL only), or
-    "auto" (host — on tunnel-attached chips the per-window D2H of the
-    scan exceeds the host finish; flip to device on pod hosts).
+    "auto" (resolved by the one-shot H2D link micro-probe in
+    flink_tpu/ops/link_probe.py: tunnel-attached chips run the finish
+    on host, pod-attached chips on device — both sides measured, see
+    BENCH_NOTES.md and the hll_device bench entry).
     """
 
     def __init__(self, aggregate: DeviceAggregateFunction,

@@ -238,6 +238,11 @@ class _SlotArena:
     def high_water(self) -> int:
         return self.next
 
+    @property
+    def live_count(self) -> int:
+        """Slots currently allocated (handed out and not released)."""
+        return self.next - sum(len(c) for c in self.free)
+
 
 def pad_pow2(a: np.ndarray, fill) -> np.ndarray:
     """Pad to the next power of two (stable jit shapes)."""
@@ -401,6 +406,14 @@ class VectorizedTumblingWindows:
         self._jit_clear_contig = jax.jit(_clear_contig,
                                          static_argnums=(2,),
                                          donate_argnums=0)
+        # full-arena fire: when the fired window owns EVERY live slot
+        # (the steady tumbling cadence — one window live at a time) and
+        # covers enough of the arena, one fused full-array reduce beats
+        # tiled dynamic-slice gathers (a [tile, m] dynamic_slice out of
+        # a multi-GB array materializes unfused, ~4x the bandwidth cost
+        # — measured, BENCH_NOTES.md), and the clear becomes one
+        # donated full fill at write bandwidth
+        self._jit_result_all = jax.jit(agg.result_dense)
         # fire/clear tile bounded by BYTES not slot count: a gather or
         # clear materializes [tile, *slot_shape] intermediates, so wide
         # per-slot state (Count-Min: depth*width ints) must shrink the
@@ -547,13 +560,26 @@ class VectorizedTumblingWindows:
             slots = shard.all_slots()
             if len(slots):
                 end = start + self.size
-                slots = self._emit_fire(shard.all_keys(), slots, start, end)
+                full = (len(slots) == self.arena.live_count
+                        and 4 * len(slots) >= self.capacity)
+                slots = self._emit_fire(shard.all_keys(), slots, start, end,
+                                        full=full)
                 fired += len(slots)
-                self._clear_tiled(slots)
+                if full:
+                    # the fired results are already materialized on the
+                    # host; DROP the register file before rebuilding it
+                    # (a donated pure fill cannot alias its input —
+                    # measured OOM at 2x arena — so peak must stay at
+                    # one arena), then refill fresh at write bandwidth
+                    self.state = None
+                    self.state = self.agg.init_state(self.capacity)
+                else:
+                    self._clear_tiled(slots)
                 self.arena.release(slots)
         return fired
 
-    def _emit_fire(self, keys, slots: np.ndarray, start: int, end: int):
+    def _emit_fire(self, keys, slots: np.ndarray, start: int, end: int,
+                   full: bool = False):
         """Fire (keys, slots) in slot-sorted order; returns the slots
         in fire order so callers clear/release the same layout.
 
@@ -569,14 +595,25 @@ class VectorizedTumblingWindows:
         order = np.argsort(slots, kind="stable")
         slots = slots[order]
         keys = keys[order]
+        if full:
+            # one fused reduce over the whole state (no slice
+            # materialization), one D2H of the per-slot results,
+            # host-side fancy index into fire order
+            results = np.asarray(self._jit_result_all(self.state))[slots]
         if self.emit_arrays:
-            self.fired.append((keys, self._gather_tiled_np(slots),
+            self.fired.append((keys,
+                               results if full
+                               else self._gather_tiled_np(slots),
                                start, end))
         elif self.emit is not None:
-            for key, res in zip(keys, self._gather_tiled(slots)):
+            res_list = (results.tolist() if full
+                        else self._gather_tiled(slots))
+            for key, res in zip(keys, res_list):
                 self.emit(key, res, start, end)
         else:
-            self.emitted.extend(zip(keys, self._gather_tiled(slots),
+            res_list = (results.tolist() if full
+                        else self._gather_tiled(slots))
+            self.emitted.extend(zip(keys, res_list,
                                     [start] * len(slots), [end] * len(slots)))
         return slots
 

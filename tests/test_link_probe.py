@@ -1,0 +1,95 @@
+"""Link micro-probe: decision logic + auto-tier wiring.
+
+The probe's measurement path runs against whatever backend the test
+process has (CPU under conftest), so the decision logic is tested by
+seeding the module cache — the threshold comparison must not depend on
+a live accelerator."""
+
+import numpy as np
+
+from flink_tpu.ops import link_probe
+from flink_tpu.ops.sketches import HyperLogLogAggregate
+from flink_tpu.streaming.log_windows import LogStructuredTumblingWindows
+
+
+def _seeded(cache):
+    old = dict(link_probe._cache)
+    link_probe._cache.clear()
+    link_probe._cache.update(cache)
+    return old
+
+
+def _restore(old):
+    link_probe._cache.clear()
+    link_probe._cache.update(old)
+
+
+def test_tier_decision_thresholds():
+    old = _seeded({"h2d_gbps": 0.6, "cpu": 0.0})
+    try:
+        assert link_probe.recommended_finish_tier() == "host"
+        _seeded({"h2d_gbps": 16.0, "cpu": 0.0})
+        assert link_probe.recommended_finish_tier() == "device"
+        _seeded({"h2d_gbps": float("inf"), "cpu": 1.0})
+        # same memory domain: the C++ finish IS the device
+        assert link_probe.recommended_finish_tier() == "host"
+    finally:
+        _restore(old)
+
+
+def test_explicit_override_passes_through():
+    old = _seeded({"h2d_gbps": 0.01, "cpu": 0.0})
+    try:
+        assert link_probe.recommended_finish_tier("device") == "device"
+        assert link_probe.recommended_finish_tier("host") == "host"
+    finally:
+        _restore(old)
+
+
+def test_auto_engine_resolves_via_probe():
+    """finish_tier="auto" must land on the probe's recommendation at
+    construction time (not stay "auto")."""
+    old = _seeded({"h2d_gbps": 16.0, "cpu": 0.0})
+    try:
+        eng = LogStructuredTumblingWindows(
+            HyperLogLogAggregate(precision=10), 1000, finish_tier="auto")
+        assert eng.mode.finish_tier == "device"
+        _seeded({"h2d_gbps": 0.5, "cpu": 0.0})
+        eng = LogStructuredTumblingWindows(
+            HyperLogLogAggregate(precision=10), 1000, finish_tier="auto")
+        assert eng.mode.finish_tier == "host"
+    finally:
+        _restore(old)
+
+
+def test_measure_runs_on_this_backend():
+    """The real measurement path (CPU backend under conftest) returns
+    a finite decision without compiling device code."""
+    m = link_probe.measure(force=True)
+    assert set(m) == {"h2d_gbps", "cpu"}
+    assert link_probe.recommended_finish_tier() in ("host", "device")
+
+
+def test_device_finish_matches_host_finish():
+    """Both finishes implement one semantics: same keys, estimates
+    within float-summation-order tolerance (the device scan sums the
+    2^-rank contributions in f32 cumsum order, the host in run
+    order)."""
+    rng = np.random.default_rng(5)
+    n = 20_000
+    keys = rng.integers(0, 500, n).astype(np.uint64)
+    ts = np.sort(rng.integers(0, 1000, n).astype(np.int64))
+    vals = rng.integers(0, 5000, n).astype(np.uint64)
+    agg = HyperLogLogAggregate(precision=11)
+    outs = {}
+    for tier in ("host", "device"):
+        eng = LogStructuredTumblingWindows(agg, 1000, finish_tier=tier)
+        eng.emit_arrays = True
+        eng.process_batch(keys, ts, values=vals)
+        eng.advance_watermark(1999)
+        k, r, _, _ = eng.fired[0]
+        outs[tier] = dict(zip(k.tolist(), r.tolist()))
+    assert set(outs["host"]) == set(outs["device"])
+    for k, v in outs["host"].items():
+        assert abs(v - outs["device"][k]) <= 1e-3 * max(v, 1.0), \
+            (k, v, outs["device"][k])

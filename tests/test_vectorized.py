@@ -35,6 +35,46 @@ def test_slot_index_dedup_and_persistence():
     assert slots2[0] == slots[1] and slots2[1] == slots[3]
 
 
+def test_full_arena_fire_matches_heap():
+    """The full-arena fire fast path (one fused full-state reduce +
+    host index + state rebuild) must emit identical results to the
+    scalar baseline — and must actually trigger: one live window whose
+    slots are >= capacity/4."""
+    rng = np.random.default_rng(11)
+    n = 4000
+    keys = rng.integers(0, 300, n)
+    ts = rng.integers(0, 1000, n)  # ONE tumbling window
+    vals = rng.random(n).astype(np.float32)
+
+    vec = VectorizedTumblingWindows(SumAggregate(np.float32), 1000,
+                                    initial_capacity=512)
+    heap = ScalarHeapTumblingWindows(SumAggregate(np.float32), 1000)
+    vec.process_batch(keys, ts, vals)
+    for i in range(n):
+        heap.process(int(keys[i]), int(ts[i]), float(vals[i]))
+    vec.flush()
+    # pin the fast-path precondition before firing
+    slots = vec.windows[0].all_slots()
+    assert len(slots) == vec.arena.live_count
+    assert 4 * len(slots) >= vec.capacity
+    vec.advance_watermark(1999)
+    heap.advance_watermark(1999)
+
+    def norm(items):
+        return sorted((int(k), s, e, round(float(r), 2))
+                      for k, r, s, e in items)
+
+    assert norm(vec.emitted) == norm(heap.emitted)
+    # state was rebuilt: a second window re-uses the cleared slots
+    vec.process_batch(keys[:100], ts[:100] + 2000, vals[:100])
+    heap2 = ScalarHeapTumblingWindows(SumAggregate(np.float32), 1000)
+    for i in range(100):
+        heap2.process(int(keys[i]), int(ts[i]) + 2000, float(vals[i]))
+    vec.advance_watermark(3999)
+    heap2.advance_watermark(3999)
+    assert norm(vec.emitted[len(heap.emitted):]) == norm(heap2.emitted)
+
+
 def test_hash_keys_int_matches_scalar():
     from flink_tpu.core.keygroups import stable_hash64
     keys = np.array([0, 1, 2, 123456789], np.int64)
