@@ -107,6 +107,29 @@ def _ensure_loaded() -> Optional[ctypes.CDLL]:
             u64p, i64p, i64p, f64p,
             u64p, i64p, f32p, u64p, c.POINTER(c.c_int64)]
         lib.ft_session_log_fire.restype = c.c_int64
+        lib.ft_intern_new.argtypes = [c.c_int64]
+        lib.ft_intern_new.restype = c.c_void_p
+        lib.ft_intern_free.argtypes = [c.c_void_p]
+        lib.ft_intern_size.argtypes = [c.c_void_p]
+        lib.ft_intern_size.restype = c.c_int64
+        lib.ft_intern_rows.argtypes = [c.c_void_p, u8p, c.c_int64,
+                                       c.c_int64, c.c_int64, u64p, i64p]
+        lib.ft_intern_rows.restype = c.c_int64
+        lib.ft_heap_tumbling_baseline_str.argtypes = [
+            u8p, c.c_int64, c.c_int64, c.c_int64, f64p, c.c_int64]
+        lib.ft_heap_tumbling_baseline_str.restype = c.c_double
+        lib.ft_wordsums_new.argtypes = []
+        lib.ft_wordsums_new.restype = c.c_void_p
+        lib.ft_wordsums_free.argtypes = [c.c_void_p]
+        lib.ft_wordsums_count.argtypes = [c.c_void_p]
+        lib.ft_wordsums_count.restype = c.c_int64
+        lib.ft_wordsums_fire.argtypes = [c.c_void_p, i64p, f64p]
+        lib.ft_wordsums_fire.restype = c.c_int64
+        lib.ft_wordsums_load.argtypes = [c.c_void_p, i64p, f64p, c.c_int64]
+        lib.ft_intern_sum.argtypes = [c.c_void_p, c.c_void_p, u8p,
+                                      c.c_int64, c.c_int64, f64p,
+                                      c.c_int64, c.c_int64, i64p]
+        lib.ft_intern_sum.restype = c.c_int64
         _lib = lib
     except Exception as e:  # noqa: BLE001 — no compiler / bad env
         _load_error = str(e)
@@ -395,4 +418,135 @@ def heap_session_cm_baseline(kh: np.ndarray, vh: np.ndarray, ts: np.ndarray,
         np.ascontiguousarray(vh, np.uint64),
         np.ascontiguousarray(ts, np.int64),
         n, gap_ms, depth, width, cap)
+    return n / elapsed
+
+
+# ---- string key interning ---------------------------------------------------
+
+def _string_rows(arr: np.ndarray):
+    """(raw row buffer u8 view, width_in_elems, elem_size) for a
+    fixed-width numpy string array ('<U' UCS4 or '|S' bytes)."""
+    if arr.dtype.kind == "U":
+        elem = 4
+    elif arr.dtype.kind == "S":
+        elem = 1
+    else:
+        raise TypeError(f"not a fixed-width string array: {arr.dtype}")
+    arr = np.ascontiguousarray(arr)
+    width = arr.dtype.itemsize // elem
+    if width == 0:  # zero-width dtype (all-empty strings)
+        arr = arr.astype(f"{arr.dtype.kind}1")
+        width = 1
+    # explicit second dim: reshape(n, -1) rejects n=0
+    rows = arr.view(np.uint8).reshape(len(arr), width * elem)
+    return rows, width, elem
+
+
+class NativeStringInterner:
+    """String → dense uint64 id, content-exact, first-seen order.
+
+    One C++ pass over numpy's contiguous fixed-width row buffer per
+    batch — no per-string Python objects cross the boundary.  Dense
+    first-seen ids make restore trivial: re-interning the id→string
+    directory in order reproduces the same ids (round-2 verdict item
+    2; the integer-keyed tiers take the ids from here)."""
+
+    __slots__ = ("_h",)
+
+    def __init__(self, capacity: int = 1 << 12):
+        lib = _ensure_loaded()
+        if lib is None:
+            raise RuntimeError(f"native runtime required: {_load_error}")
+        self._h = lib.ft_intern_new(_pow2_at_least(capacity))
+
+    def __del__(self):
+        if _lib is not None and getattr(self, "_h", None):
+            _lib.ft_intern_free(self._h)
+            self._h = None
+
+    @property
+    def n(self) -> int:
+        return _lib.ft_intern_size(self._h)
+
+    def intern(self, arr: np.ndarray):
+        """→ (ids uint64 [n], first_idx int64 [n_new]): dense ids per
+        row; first_idx = batch row of each newly-seen string, in id
+        order (append arr[first_idx] to the id→string directory)."""
+        rows, width, elem = _string_rows(arr)
+        n = len(arr)
+        ids = np.empty(n, np.uint64)
+        first_idx = np.empty(max(n, 1), np.int64)
+        n_new = _lib.ft_intern_rows(self._h, rows, width, elem, n, ids,
+                                    first_idx)
+        return ids, first_idx[:n_new]
+
+
+class NativeWordSums:
+    """Dense per-window sum accumulator over interned word ids — the
+    fused ingest half of the wordcount_str engine.  ``add`` interns
+    and accumulates in one C++ pass (phase-split hashing + prefetched
+    probe + direct-indexed add; see ft_intern_sum); ``fire`` exports
+    (id, sum) for every touched id and resets."""
+
+    __slots__ = ("_h",)
+
+    def __init__(self):
+        lib = _ensure_loaded()
+        if lib is None:
+            raise RuntimeError(f"native runtime required: {_load_error}")
+        self._h = lib.ft_wordsums_new()
+
+    def __del__(self):
+        if _lib is not None and getattr(self, "_h", None):
+            _lib.ft_wordsums_free(self._h)
+            self._h = None
+
+    def add(self, interner: "NativeStringInterner", words: np.ndarray,
+            weights=None):
+        """→ first_idx of newly-interned words (append words[first_idx]
+        to the shared id→word directory)."""
+        rows, width, elem = _string_rows(words)
+        n = len(words)
+        first_idx = np.empty(max(n, 1), np.int64)
+        if weights is None:
+            w = np.zeros(1, np.float64)
+            has_w = 0
+        else:
+            w = np.ascontiguousarray(weights, np.float64)
+            has_w = 1
+        n_new = _lib.ft_intern_sum(interner._h, self._h, rows, width,
+                                   elem, w, has_w, n, first_idx)
+        return first_idx[:n_new]
+
+    @property
+    def touched(self) -> int:
+        return _lib.ft_wordsums_count(self._h)
+
+    def fire(self):
+        """→ (ids int64, sums float64) of touched ids; resets."""
+        k = self.touched
+        ids = np.empty(k, np.int64)
+        sums = np.empty(k, np.float64)
+        _lib.ft_wordsums_fire(self._h, ids, sums)
+        return ids, sums
+
+    def load(self, ids: np.ndarray, sums: np.ndarray) -> None:
+        _lib.ft_wordsums_load(
+            self._h, np.ascontiguousarray(ids, np.int64),
+            np.ascontiguousarray(sums, np.float64), len(ids))
+
+
+def heap_tumbling_baseline_str(words: np.ndarray,
+                               values: np.ndarray,
+                               capacity: Optional[int] = None) -> float:
+    """Per-record heap-backend work on STRING keys (hash + probe with
+    string-equality verification + add, per record), compiled.
+    Returns records/second."""
+    lib = _ensure_loaded()
+    rows, width, elem = _string_rows(words)
+    n = len(words)
+    cap = _pow2_at_least(capacity or 2 * n)
+    elapsed = lib.ft_heap_tumbling_baseline_str(
+        rows, width, elem, n,
+        np.ascontiguousarray(values, np.float64), cap)
     return n / elapsed

@@ -202,6 +202,10 @@ class ColumnarWindowOperator(StreamOperator):
                     "aggregate required)")
             return eng
         eng = None
+        if key_dtype.kind in "US":
+            eng = self._string_engine()
+            if eng is not None:
+                return eng
         if np.issubdtype(key_dtype, np.integer):
             eng = log_engine_for_assigner(self.assigner, self.agg)
         if eng is None:
@@ -210,6 +214,15 @@ class ColumnarWindowOperator(StreamOperator):
         if eng is None:
             raise ValueError(f"no engine for assigner {self.assigner!r}")
         return eng
+
+    def _string_engine(self):
+        """Fused wordcount engine for a STRING key column (tumbling
+        float sum — the SQL wordcount shape); None when the shape or
+        native runtime doesn't fit."""
+        from flink_tpu.streaming.device_window_operator import (
+            string_sum_engine_for_assigner,
+        )
+        return string_sum_engine_for_assigner(self.assigner, self.agg)
 
     def open(self):
         pass  # engine built on first batch (needs the key dtype)
@@ -307,11 +320,13 @@ class ColumnarWindowOperator(StreamOperator):
         if self.engine is not None:
             snap["columnar_engine"] = self.engine.snapshot()
             from flink_tpu.streaming import log_windows as lw
-            snap["columnar_tier"] = (
-                "log" if isinstance(
-                    self.engine, (lw.LogStructuredTumblingWindows,
-                                  lw.LogStructuredSessionWindows))
-                else "vectorized")
+            if isinstance(self.engine, lw.StringSumTumblingWindows):
+                snap["columnar_tier"] = "string_sum"
+            elif isinstance(self.engine, (lw.LogStructuredTumblingWindows,
+                                          lw.LogStructuredSessionWindows)):
+                snap["columnar_tier"] = "log"
+            else:
+                snap["columnar_tier"] = "vectorized"
         return snap
 
     def restore_state(self, snapshots) -> None:
@@ -323,11 +338,19 @@ class ColumnarWindowOperator(StreamOperator):
         for s in snapshots:
             if "columnar_engine" in s:
                 if self.engine is None:
-                    is_log = s.get("columnar_tier") == "log"
-                    key_dtype = (np.dtype(np.uint64) if is_log
-                                 else np.dtype(object))
-                    self.engine = self._make_engine(key_dtype,
-                                                    require_log=is_log)
+                    tier = s.get("columnar_tier")
+                    if tier == "string_sum":
+                        self.engine = self._string_engine()
+                        if self.engine is None:
+                            raise RuntimeError(
+                                "checkpoint was taken on the fused "
+                                "string-sum tier, unavailable here")
+                    else:
+                        is_log = tier == "log"
+                        key_dtype = (np.dtype(np.uint64) if is_log
+                                     else np.dtype(object))
+                        self.engine = self._make_engine(
+                            key_dtype, require_log=is_log)
                     if hasattr(self.engine, "fired"):
                         self.engine.emit_arrays = True
                 self.engine.restore(s["columnar_engine"])

@@ -52,6 +52,24 @@ def assigner_supported(assigner) -> bool:
     return isinstance(assigner, EventTimeSessionWindows)
 
 
+def string_sum_engine_for_assigner(assigner, agg: DeviceAggregateFunction):
+    """Fused intern+sum engine for STRING-keyed tumbling sums, or None
+    when the shape doesn't fit.  Floating accumulation only: the C++
+    kernel sums in double, so integer value dtypes (exact beyond 2^53)
+    must stay on the exact tiers."""
+    from flink_tpu.ops.device_agg import SumAggregate
+    from flink_tpu.streaming.log_windows import StringSumTumblingWindows
+    if (isinstance(agg, SumAggregate)
+            and np.issubdtype(agg.value_dtype, np.floating)
+            and isinstance(assigner, TumblingEventTimeWindows)
+            and assigner.offset == 0):
+        try:
+            return StringSumTumblingWindows(agg, assigner.size)
+        except RuntimeError:
+            pass  # no native runtime
+    return None
+
+
 def log_engine_for_assigner(assigner, agg: DeviceAggregateFunction):
     """Log-structured combiner tier for this assigner+aggregate, or
     None when the cell decomposition / assigner shape doesn't fit
@@ -153,6 +171,13 @@ class DeviceWindowOperator(StreamOperator):
         self._values: List[Any] = []
         self._last_fireable = None
         self.num_late_records_dropped = 0  # metric parity
+        # string keys dictionary-encode to dense uint64 ids in ONE C++
+        # pass per batch (native.NativeStringInterner), so
+        # keyBy("word") over strings rides the integer-keyed fast
+        # tiers; emission maps ids back through _id_to_key (ref shape:
+        # SocketWindowWordCount.java:70-84)
+        self._interner = None
+        self._id_to_key: List[Any] = []
 
     # ---- lifecycle --------------------------------------------------
     def open(self):
@@ -195,14 +220,33 @@ class DeviceWindowOperator(StreamOperator):
         if len(self._keys) >= self.flush_batch:
             self._flush_buffer()
 
+    def _wants_fused_string_sum(self) -> bool:
+        from flink_tpu.ops.device_agg import SumAggregate
+        from flink_tpu.streaming.log_windows import StringSumTumblingWindows
+        if self.engine is not None:
+            # locked at first flush; later batches must keep feeding
+            # the fused engine raw strings
+            return isinstance(self.engine, StringSumTumblingWindows)
+        return (self.mesh is None
+                and isinstance(self.agg, SumAggregate)
+                and np.issubdtype(self.agg.value_dtype, np.floating)
+                and isinstance(self.assigner, TumblingEventTimeWindows)
+                and self.assigner.offset == 0)
+
     def _ensure_engine(self, keys_arr: np.ndarray):
         """Tier selection on the first flush: integer-keyed streams get
         the log-structured combiner tier when the aggregate has a cell
-        decomposition; everything else (and every aggregate the log
-        tier doesn't cover) runs the device-resident scatter tier."""
+        decomposition (string keys reach it through the interner);
+        string-keyed tumbling sums get the fused wordcount engine;
+        everything else (and every aggregate the log tier doesn't
+        cover) runs the device-resident scatter tier."""
         if self.engine is not None:
             return
-        if np.issubdtype(keys_arr.dtype, np.integer):
+        if keys_arr.dtype.kind in "US" and keys_arr.ndim == 1 \
+                and self._wants_fused_string_sum():
+            self.engine = string_sum_engine_for_assigner(self.assigner,
+                                                         self.agg)
+        if self.engine is None and np.issubdtype(keys_arr.dtype, np.integer):
             self.engine = log_engine_for_assigner(self.assigner, self.agg)
         if self.engine is None:
             self.engine = engine_for_assigner(self.assigner, self.agg,
@@ -233,7 +277,7 @@ class DeviceWindowOperator(StreamOperator):
             vals = np.asarray(values)
         else:
             vals = None
-        keys_arr = np.asarray(self._keys)
+        keys_arr = self._maybe_intern(np.asarray(self._keys))
         self._ensure_engine(keys_arr)
         self.engine.process_batch(
             keys_arr,
@@ -242,6 +286,32 @@ class DeviceWindowOperator(StreamOperator):
         self._keys.clear()
         self._ts.clear()
         self._values.clear()
+
+    def _maybe_intern(self, keys_arr: np.ndarray) -> np.ndarray:
+        """Dictionary-encode fixed-width string keys to dense uint64
+        ids (first batch decides; later batches coerce to the locked
+        representation).  Without the native runtime the raw keys pass
+        through to the object-key fallback path."""
+        if self._interner is None:
+            # 1-D only: composite keys coerce to 2-D string arrays
+            # whose rows must stay tuples on emission
+            if keys_arr.dtype.kind not in "US" or keys_arr.ndim != 1:
+                return keys_arr
+            import flink_tpu.native as nat
+            if not nat.available():
+                return keys_arr
+            if self._wants_fused_string_sum():
+                # the fused wordcount engine consumes raw strings
+                # (intern + dense sum in one C++ pass) and emits the
+                # original words itself
+                return keys_arr
+            self._interner = nat.NativeStringInterner()
+        elif keys_arr.dtype.kind not in "US":
+            keys_arr = keys_arr.astype(np.str_)
+        ids, first_idx = self._interner.intern(keys_arr)
+        if len(first_idx):
+            self._id_to_key.extend(keys_arr[first_idx].tolist())
+        return ids
 
     def process_watermark(self, watermark: Watermark):
         # Fires only happen when the watermark crosses a window-end
@@ -284,11 +354,14 @@ class DeviceWindowOperator(StreamOperator):
     def _emit_from(self, start_idx: int):
         emitted = self.engine.emitted
         fn = self.window_function
+        id_to_key = self._id_to_key if self._interner is not None else None
         for key, result, w_start, w_end in emitted[start_idx:]:
             self.collector.set_absolute_timestamp(w_end - 1)
             if fn is None:
                 self.collector.collect(result)
             else:
+                if id_to_key is not None:
+                    key = id_to_key[int(key)]
                 out = fn(key, TimeWindow(w_start, w_end), [result])
                 if out is not None:
                     for v in out:
@@ -303,11 +376,18 @@ class DeviceWindowOperator(StreamOperator):
         if self.engine is not None:
             from flink_tpu.streaming import log_windows as lw
             snap["device_engine"] = self.engine.snapshot()
-            snap["device_tier"] = (
-                "log" if isinstance(
-                    self.engine, (lw.LogStructuredTumblingWindows,
-                                  lw.LogStructuredSessionWindows))
-                else "vectorized")
+            if isinstance(self.engine, lw.StringSumTumblingWindows):
+                snap["device_tier"] = "string_sum"
+            elif isinstance(self.engine, (lw.LogStructuredTumblingWindows,
+                                          lw.LogStructuredSessionWindows)):
+                snap["device_tier"] = "log"
+            else:
+                snap["device_tier"] = "vectorized"
+        if self._interner is not None:
+            # ids are dense first-seen: the directory alone rebuilds
+            # the interner on restore (re-interning in order
+            # reproduces every id)
+            snap["string_key_directory"] = list(self._id_to_key)
         return snap
 
     def restore_state(self, snapshots) -> None:
@@ -318,9 +398,24 @@ class DeviceWindowOperator(StreamOperator):
                 "parallelism change (engine state is not key-grouped); "
                 "restore at the checkpointed parallelism")
         for s in snapshots:
+            if s.get("string_key_directory") is not None:
+                import flink_tpu.native as nat
+                directory = s["string_key_directory"]
+                self._interner = nat.NativeStringInterner(
+                    max(16, 2 * len(directory)))
+                self._id_to_key = list(directory)
+                if directory:
+                    ids, _ = self._interner.intern(np.asarray(directory))
+                    assert int(ids[-1]) == len(directory) - 1
             if "device_engine" in s:
                 if self.engine is None:
-                    if s.get("device_tier") == "log":
+                    if s.get("device_tier") == "string_sum":
+                        from flink_tpu.streaming.log_windows import (
+                            StringSumTumblingWindows,
+                        )
+                        self.engine = StringSumTumblingWindows(
+                            self.agg, self.assigner.size)
+                    elif s.get("device_tier") == "log":
                         self.engine = log_engine_for_assigner(
                             self.assigner, self.agg)
                         if self.engine is None:

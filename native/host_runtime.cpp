@@ -887,4 +887,335 @@ double ft_heap_session_cm_baseline(const uint64_t* kh, const uint64_t* vh,
   return now_s() - t0;
 }
 
+// ---- string key interning --------------------------------------------------
+// Dictionary-encode string keys ONCE per batch so keyBy("word") over
+// real strings rides the integer-keyed fast tiers (round-2 verdict
+// item 2; ref shape: SocketWindowWordCount.java:70-84 keyBy("word")).
+// Strings arrive as numpy's fixed-width row buffer ('<Uk' UCS4 rows or
+// '|Sk' byte rows) — one contiguous block, no per-string Python
+// objects cross the boundary.  Ids are dense in first-seen order, so a
+// restore that re-interns the id->string directory in order
+// reproduces the same ids.  Exact: hash collisions fall back to
+// codepoint comparison against the interned pool.
+
+}  // extern "C"
+
+namespace {
+
+struct FtInterner {
+  std::vector<uint64_t> hash;    // content hash (0 = empty marker)
+  std::vector<int64_t> id;       // dense id per table position
+  std::vector<uint32_t> pool;    // interned codepoints, span-addressed
+  std::vector<int64_t> span_off;
+  std::vector<int32_t> span_len;
+  uint64_t mask;
+  int64_t n = 0;
+  // fused-kernel phase scratch — on the INTERNER (one per operator),
+  // not the per-window sums, so k live windows share one buffer
+  std::vector<uint64_t> hs;
+  std::vector<int32_t> lens;
+  std::vector<uint64_t> cand_pos;
+  std::vector<int64_t> ids;
+
+  explicit FtInterner(int64_t cap) : hash(cap, 0), id(cap, -1),
+                                     mask(static_cast<uint64_t>(cap) - 1) {}
+
+  void grow_if_needed(int64_t incoming) {
+    if ((n + incoming) * 5 <= static_cast<int64_t>(hash.size()) * 3) return;
+    size_t new_cap = hash.size();
+    while ((n + incoming) * 5 > static_cast<int64_t>(new_cap) * 3)
+      new_cap *= 2;
+    std::vector<uint64_t> oh(std::move(hash));
+    std::vector<int64_t> oi(std::move(id));
+    hash.assign(new_cap, 0);
+    id.assign(new_cap, -1);
+    mask = new_cap - 1;
+    for (size_t i = 0; i < oh.size(); ++i) {
+      if (oh[i] == 0) continue;
+      uint64_t pos = (oh[i] ^ (oh[i] >> 32)) & mask;
+      while (hash[pos] != 0) pos = (pos + 1) & mask;
+      hash[pos] = oh[i];
+      id[pos] = oi[i];
+    }
+  }
+};
+
+// hash + logical length of one fixed-width row (trailing zero elements
+// are numpy's padding; an embedded trailing NUL is indistinguishable —
+// the same limitation numpy's own '<U' round-trip has)
+template <typename E>
+inline uint64_t row_hash(const E* row, int64_t width, int32_t* len_out) {
+  int64_t len = width;
+  while (len > 0 && row[len - 1] == 0) --len;
+  uint64_t h = 0xCBF29CE484222325ull;
+  for (int64_t j = 0; j < len; ++j)
+    h = (h ^ static_cast<uint32_t>(row[j])) * 0x100000001B3ull;
+  *len_out = static_cast<int32_t>(len);
+  uint64_t f = splitmix64(h);
+  return f ? f : 0x9E3779B97F4A7C15ull;  // 0 is the empty marker
+}
+
+template <typename E>
+int64_t intern_rows_t(FtInterner& it, const E* rows, int64_t width,
+                      int64_t n, uint64_t* out_ids, int64_t* first_idx) {
+  it.grow_if_needed(n);
+  int64_t n_new = 0;
+  for (int64_t i = 0; i < n; ++i) {
+    const E* row = rows + i * width;
+    int32_t len;
+    uint64_t h = row_hash(row, width, &len);
+    uint64_t pos = (h ^ (h >> 32)) & it.mask;
+    for (;;) {
+      uint64_t cur = it.hash[pos];
+      if (cur == h) {
+        int64_t cand = it.id[pos];
+        // verify content (exact grouping, not hash-trusting)
+        if (it.span_len[cand] == len) {
+          const uint32_t* p = it.pool.data() + it.span_off[cand];
+          bool eq = true;
+          for (int32_t j = 0; j < len; ++j)
+            if (p[j] != static_cast<uint32_t>(row[j])) { eq = false; break; }
+          if (eq) { out_ids[i] = static_cast<uint64_t>(cand); break; }
+        }
+      } else if (cur == 0) {
+        int64_t new_id = it.n++;
+        it.hash[pos] = h;
+        it.id[pos] = new_id;
+        it.span_off.push_back(static_cast<int64_t>(it.pool.size()));
+        it.span_len.push_back(len);
+        for (int32_t j = 0; j < len; ++j)
+          it.pool.push_back(static_cast<uint32_t>(row[j]));
+        out_ids[i] = static_cast<uint64_t>(new_id);
+        first_idx[n_new++] = i;
+        break;
+      }
+      pos = (pos + 1) & it.mask;
+    }
+  }
+  return n_new;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* ft_intern_new(int64_t capacity_pow2) {
+  return new FtInterner(capacity_pow2 < 16 ? 16 : capacity_pow2);
+}
+
+void ft_intern_free(void* p) { delete static_cast<FtInterner*>(p); }
+
+int64_t ft_intern_size(void* p) { return static_cast<FtInterner*>(p)->n; }
+
+// rows: n rows x width elements of elem_size bytes (1 = '|S', 4 =
+// '<U'); out_ids[n] dense first-seen ids; first_idx gets the batch row
+// of each NEW id, in id order.  Returns the number of new ids.
+int64_t ft_intern_rows(void* p, const uint8_t* rows, int64_t width,
+                       int64_t elem_size, int64_t n, uint64_t* out_ids,
+                       int64_t* first_idx) {
+  FtInterner& it = *static_cast<FtInterner*>(p);
+  if (elem_size == 4)
+    return intern_rows_t(it, reinterpret_cast<const uint32_t*>(rows),
+                         width, n, out_ids, first_idx);
+  return intern_rows_t(it, rows, width, n, out_ids, first_idx);
+}
+
+// Fused intern+sum for the wordcount shape: the batch interface IS
+// the structural edge over the reference's per-record API, so exploit
+// it — phase 1 hashes every row with no cross-iteration dependency
+// (superscalar), phase 2 probes with the NEXT row's table line
+// prefetched and adds into a dense id-indexed sum array (no second
+// probe: interned ids are dense).  The per-record baseline below
+// cannot phase-split or prefetch ahead — its API sees one record at
+// a time, exactly like HeapAggregatingState.add.
+
+struct FtWordSums {
+  std::vector<double> sums;      // dense, indexed by interned id
+  std::vector<int64_t> touched;  // ids with nonzero activity
+  std::vector<uint8_t> seen;
+};
+
+// ---- string-keyed baseline -------------------------------------------------
+// The per-record work of the reference's heap backend on a STRING
+// key: hash the string, probe with string-equality verification, add
+// — per record (HeapAggregatingState.add with a String key), then the
+// per-key fire scan.  The honest baseline for wordcount_str.
+double ft_heap_tumbling_baseline_str(const uint8_t* rows, int64_t width,
+                                     int64_t elem_size, int64_t n,
+                                     const double* values,
+                                     int64_t capacity_pow2) {
+  FtInterner table(capacity_pow2);
+  std::vector<double> sums;
+  sums.reserve(1 << 16);
+  double t0 = now_s();
+  for (int64_t i = 0; i < n; ++i) {
+    uint64_t id_;
+    int64_t fi;
+    if (elem_size == 4)
+      intern_rows_t(table,
+                    reinterpret_cast<const uint32_t*>(rows) + i * width,
+                    width, 1, &id_, &fi);
+    else
+      intern_rows_t(table, rows + i * width, width, 1, &id_, &fi);
+    if (static_cast<int64_t>(id_) >= static_cast<int64_t>(sums.size()))
+      sums.resize(id_ + 1, 0.0);
+    sums[id_] += values[i];
+  }
+  // fire: per-key read+accumulate (cheap for sums, as in the int case)
+  volatile double sink = 0.0;
+  double acc = 0.0;
+  for (size_t s = 0; s < sums.size(); ++s) acc += sums[s];
+  sink = acc;
+  (void)sink;
+  return now_s() - t0;
+}
+
+void* ft_wordsums_new() { return new FtWordSums(); }
+void ft_wordsums_free(void* p) { delete static_cast<FtWordSums*>(p); }
+int64_t ft_wordsums_count(void* p) {
+  return static_cast<int64_t>(static_cast<FtWordSums*>(p)->touched.size());
+}
+
+// Export (id, sum) for every touched id and reset the accumulator.
+int64_t ft_wordsums_fire(void* p, int64_t* ids_out, double* sums_out) {
+  FtWordSums& ws = *static_cast<FtWordSums*>(p);
+  int64_t k = 0;
+  for (int64_t id_ : ws.touched) {
+    ids_out[k] = id_;
+    sums_out[k] = ws.sums[id_];
+    ws.sums[id_] = 0.0;
+    ws.seen[id_] = 0;
+    ++k;
+  }
+  ws.touched.clear();
+  return k;
+}
+
+// Bulk import (restore): sums[id] += s, touched tracking maintained.
+void ft_wordsums_load(void* p, const int64_t* ids, const double* sums,
+                      int64_t k) {
+  FtWordSums& ws = *static_cast<FtWordSums*>(p);
+  for (int64_t i = 0; i < k; ++i) {
+    int64_t id_ = ids[i];
+    if (id_ >= static_cast<int64_t>(ws.sums.size())) {
+      ws.sums.resize(id_ + 1, 0.0);
+      ws.seen.resize(id_ + 1, 0);
+    }
+    if (!ws.seen[id_]) { ws.seen[id_] = 1; ws.touched.push_back(id_); }
+    ws.sums[id_] += sums[i];
+  }
+}
+
+}  // extern "C"
+
+namespace {
+
+template <typename E>
+int64_t intern_sum_t(FtInterner& it, FtWordSums& ws, const E* rows,
+                     int64_t width, const double* weights, int64_t n,
+                     int64_t* first_idx) {
+  it.grow_if_needed(n);
+  // phase 1: hash every row — no cross-iteration dependency, so the
+  // core pipelines it (the per-record baseline interleaves hashing
+  // with a dependent probe and cannot)
+  it.hs.resize(n);
+  it.lens.resize(n);
+  it.cand_pos.resize(n);
+  it.ids.resize(n);
+  for (int64_t i = 0; i < n; ++i)
+    it.hs[i] = row_hash(rows + i * width, width, &it.lens[i]);
+  // phase 2: FIRST probe for every row — each iteration independent,
+  // so the OoO core overlaps 4-8 table loads where the per-record
+  // baseline serializes hash -> probe -> verify -> add per record
+  for (int64_t i = 0; i < n; ++i) {
+    uint64_t h = it.hs[i];
+    uint64_t pos = (h ^ (h >> 32)) & it.mask;
+    it.cand_pos[i] = pos;
+    it.ids[i] = (it.hash[pos] == h) ? it.id[pos] : -1;
+  }
+  // phase 3: verify first-probe hits (independent pool compares);
+  // false hits (64-bit collision at equal table slot) fall to slow
+  for (int64_t i = 0; i < n; ++i) {
+    int64_t cand = it.ids[i];
+    if (cand < 0) continue;
+    int32_t len = it.lens[i];
+    if (it.span_len[cand] != len) { it.ids[i] = -1; continue; }
+    const E* row = rows + i * width;
+    const uint32_t* p = it.pool.data() + it.span_off[cand];
+    for (int32_t j = 0; j < len; ++j)
+      if (p[j] != static_cast<uint32_t>(row[j])) { it.ids[i] = -1; break; }
+  }
+  // phase 4: sequential slow path — empty slots (inserts), probe
+  // continuations, failed verifies.  Rare in steady state (the
+  // vocabulary is known), so the serial chain is off the hot path.
+  int64_t n_new = 0;
+  for (int64_t i = 0; i < n; ++i) {
+    if (it.ids[i] >= 0) continue;
+    uint64_t h = it.hs[i];
+    int32_t len = it.lens[i];
+    const E* row = rows + i * width;
+    uint64_t pos = it.cand_pos[i];
+    for (;;) {
+      uint64_t cur = it.hash[pos];
+      if (cur == h) {
+        int64_t cand = it.id[pos];
+        if (it.span_len[cand] == len) {
+          const uint32_t* p = it.pool.data() + it.span_off[cand];
+          bool eq = true;
+          for (int32_t j = 0; j < len; ++j)
+            if (p[j] != static_cast<uint32_t>(row[j])) { eq = false; break; }
+          if (eq) { it.ids[i] = cand; break; }
+        }
+      } else if (cur == 0) {
+        int64_t id_ = it.n++;
+        it.hash[pos] = h;
+        it.id[pos] = id_;
+        it.span_off.push_back(static_cast<int64_t>(it.pool.size()));
+        it.span_len.push_back(len);
+        for (int32_t j = 0; j < len; ++j)
+          it.pool.push_back(static_cast<uint32_t>(row[j]));
+        it.ids[i] = id_;
+        first_idx[n_new++] = i;
+        break;
+      }
+      pos = (pos + 1) & it.mask;
+    }
+  }
+  // phase 5: adds — direct-indexed, no probe
+  int64_t max_id = it.n - 1;
+  if (max_id >= static_cast<int64_t>(ws.sums.size())) {
+    int64_t cap = ws.sums.size() ? static_cast<int64_t>(ws.sums.size())
+                                 : 1024;
+    while (cap <= max_id) cap *= 2;
+    ws.sums.resize(cap, 0.0);
+    ws.seen.resize(cap, 0);
+  }
+  for (int64_t i = 0; i < n; ++i) {
+    int64_t id_ = it.ids[i];
+    if (!ws.seen[id_]) { ws.seen[id_] = 1; ws.touched.push_back(id_); }
+    ws.sums[id_] += weights ? weights[i] : 1.0;
+  }
+  return n_new;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Fused intern + windowed sum (the wordcount_str engine's ingest).
+// weights may be null (count semantics).  Returns the number of NEW
+// interner entries; first_idx gets their batch rows in id order.
+int64_t ft_intern_sum(void* interner, void* wsums, const uint8_t* rows,
+                      int64_t width, int64_t elem_size,
+                      const double* weights, int64_t has_weights,
+                      int64_t n, int64_t* first_idx) {
+  FtInterner& it = *static_cast<FtInterner*>(interner);
+  FtWordSums& ws = *static_cast<FtWordSums*>(wsums);
+  const double* w = has_weights ? weights : nullptr;
+  if (elem_size == 4)
+    return intern_sum_t(it, ws, reinterpret_cast<const uint32_t*>(rows),
+                        width, w, n, first_idx);
+  return intern_sum_t(it, ws, rows, width, w, n, first_idx);
+}
+
 }  // extern "C"

