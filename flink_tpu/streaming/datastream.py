@@ -470,6 +470,15 @@ class DataStream:
         from flink_tpu.streaming.joining import JoinedStreams
         return JoinedStreams(self, other)
 
+    def interval_join(self, other: "DataStream"):
+        """Time-bounded stream-stream join:
+        a.interval_join(b).where(k1).equal_to(k2)
+         .between(lower_ms, upper_ms).apply(fn) — pairs with
+        b.ts - a.ts in [lower, upper] and equal keys (the reference's
+        windowed table join bounds, WindowJoinUtil.scala)."""
+        from flink_tpu.streaming.joining import IntervalJoinedStreams
+        return IntervalJoinedStreams(self, other)
+
     def co_group(self, other: "DataStream"):
         """(ref: DataStream.coGroup :701)."""
         from flink_tpu.streaming.joining import CoGroupedStreams

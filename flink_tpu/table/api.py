@@ -23,11 +23,16 @@ from typing import Any, Callable, Dict, List, Optional, Sequence
 from flink_tpu.table.expressions import (
     AggCall,
     Alias,
+    BinaryOp,
     Column,
     Expr,
+    Literal,
+    OverCall,
     Schema,
+    UnaryOp,
     WindowProp,
     find_aggs,
+    find_overs,
     output_name,
     strip_alias,
     substitute,
@@ -72,15 +77,19 @@ class Table:
         out = self._as_rows().stream.map(
             lambda row, fns=fns: tuple(f(row) for f in fns),
             name="select")
-        return Table(self.t_env, out, Schema(names))
+        t = Table(self.t_env, out, Schema(names))
+        t._updating = getattr(self, "_updating", False)
+        return t
 
     def filter(self, predicate) -> "Table":
         e = self.t_env._expr(predicate)
         fn = e.compile(self.schema)
-        return Table(self.t_env,
-                     self._as_rows().stream.filter(lambda row: bool(fn(row)),
-                                        name="filter"),
-                     self.schema)
+        t = Table(self.t_env,
+                  self._as_rows().stream.filter(lambda row: bool(fn(row)),
+                                                name="filter"),
+                  self.schema)
+        t._updating = getattr(self, "_updating", False)
+        return t
 
     where = filter
 
@@ -99,6 +108,26 @@ class Table:
         return WindowedTable(self, spec)
 
     # ---- sinks -------------------------------------------------------
+    def to_retract_stream(self):
+        """(is_add: bool, row) pairs — retractions precede each
+        update's refreshed row (the reference's toRetractStream /
+        GroupAggProcessFunction protocol).  Available on continuous
+        (non-windowed) aggregation results; append-only tables emit
+        (True, row) for every row."""
+        rs = getattr(self, "_retract_stream", None)
+        if rs is not None:
+            return rs
+        if getattr(self, "_updating", False):
+            # derived from an updating aggregate: the retraction half
+            # was lost by the intervening filter/select — mislabeling
+            # the upsert rows as append-only adds would double-count
+            raise SqlError(
+                "retract protocol lost: consume to_retract_stream() "
+                "on the aggregation result BEFORE filter/select, or "
+                "use a windowed aggregation (append-only)")
+        return self._as_rows().stream.map(lambda row: (True, row),
+                                          name="as_retract")
+
     def to_append_stream(self, batched: bool = False):
         """Stream of row tuples regardless of the physical plan: a
         columnar fast-path plan is bridged through explode_to_rows so
@@ -259,10 +288,22 @@ class StreamTableEnvironment:
         q = parse(sql, udaf_names=self.udafs.keys())
         if q.table not in self.tables:
             raise SqlError(f"unknown table {q.table!r}")
-        src = self.tables[q.table]
-        t = src
+        if q.join is not None:
+            t = _lower_join(self, q)
+        else:
+            t = self.tables[q.table]
         if q.where is not None:
             t = t.filter(q.where)
+        has_overs = any(find_overs(e) for e in q.select)
+        if has_overs:
+            if q.window is not None or q.group_by or q.having is not None:
+                raise SqlError(
+                    "OVER aggregates cannot mix with GROUP BY/HAVING")
+            if any(find_aggs(e) for e in q.select):
+                raise SqlError(
+                    "cannot mix OVER aggregates with plain aggregates "
+                    "in one SELECT")
+            return _lower_over_agg(t, q.select)
         has_aggs = any(find_aggs(e) for e in q.select)
         if q.window is not None:
             if not has_aggs:
@@ -604,7 +645,14 @@ def _lower_continuous_group_agg(table: Table, keys: List[Expr],
 
     acc_desc = ValueStateDescriptor("sql_group_acc")
 
+    prev_desc = ValueStateDescriptor("sql_group_prev")
+
     class GroupAgg(ProcessFunction):
+        """Emits the retract-stream protocol: (False, old_row) then
+        (True, new_row) per update (GroupAggProcessFunction.scala's
+        retract/accumulate pair; first result for a key emits only the
+        accumulate side)."""
+
         def process_element(self, value, ctx, out):
             st = ctx.get_state(acc_desc)
             acc = st.value()
@@ -621,16 +669,328 @@ def _lower_continuous_group_agg(table: Table, keys: List[Expr],
             else:
                 key_t = key
             row = (*key_t, *aggs)
-            out.collect(tuple(f(row) for f in out_fns))
+            out_row = tuple(f(row) for f in out_fns)
+            prev = ctx.get_state(prev_desc)
+            old = prev.value()
+            if old is not None:
+                out.collect((False, old))
+            out.collect((True, out_row))
+            prev.update(out_row)
 
     def key_selector(row):
         ks = tuple(f(row) for f in key_fns)
         return ks if len(ks) != 1 else ks[0]
 
-    if keys:
-        out = (table.stream.key_by(key_selector)
-               .process(GroupAgg(), name="sql_group_agg"))
-    else:
-        out = (table.stream.key_by(lambda row: 0)
-               .process(GroupAgg(), name="sql_global_agg"))
+    pairs = (table.stream.key_by(key_selector if keys
+                                 else (lambda row: 0))
+             .process(GroupAgg(), name="sql_group_agg"))
+    # append view: the accumulate side only (the upsert stream — last
+    # row per key wins, exactly the pre-retraction behavior)
+    out = pairs.filter(lambda p: p[0], name="sql_group_adds") \
+               .map(lambda p: p[1], name="sql_group_rows")
+    t = Table(t_env, out, Schema(out_names))
+    t._retract_stream = pairs
+    t._updating = True
+    return t
+
+
+# ---------------------------------------------------------------------
+# stream-stream join lowering (ref: the Table layer's windowed join —
+# plan/nodes/datastream/DataStreamWindowJoin.scala with
+# WindowJoinUtil.scala's time-bound analysis)
+# ---------------------------------------------------------------------
+
+def _flatten_and(e: Expr):
+    e = strip_alias(e)
+    if isinstance(e, BinaryOp) and e.op == "AND":
+        return _flatten_and(e.left) + _flatten_and(e.right)
+    return [e]
+
+
+def _linear(e: Expr):
+    """expr -> (coeffs {col: +/-1}, const_ms) for +/- trees of columns
+    and numeric literals; None when non-linear."""
+    e = strip_alias(e)
+    if isinstance(e, Column):
+        return {e.name: 1}, 0
+    if isinstance(e, Literal) and isinstance(e.value, (int, float)) \
+            and not isinstance(e.value, bool):
+        return {}, e.value
+    if isinstance(e, UnaryOp) and e.op == "-":
+        r = _linear(e.operand)
+        if r is None:
+            return None
+        return {k: -v for k, v in r[0].items()}, -r[1]
+    if isinstance(e, BinaryOp) and e.op in ("+", "-"):
+        l, r = _linear(e.left), _linear(e.right)
+        if l is None or r is None:
+            return None
+        sign = 1 if e.op == "+" else -1
+        coeffs = dict(l[0])
+        for k, v in r[0].items():
+            coeffs[k] = coeffs.get(k, 0) + sign * v
+            if coeffs[k] == 0:
+                del coeffs[k]
+        return coeffs, l[1] + sign * r[1]
+    return None
+
+
+def _lower_join(t_env: "StreamTableEnvironment", q) -> Table:
+    """FROM a JOIN b ON a.k = b.k AND a.ts BETWEEN b.ts - X AND
+    b.ts + Y → the interval join operator (equal keys, r.ts - l.ts in
+    [lower, upper]); residual conjuncts become a post-join filter.
+    The joined schema qualifies every field with its table alias and
+    keeps unqualified names that are unambiguous."""
+    if q.join.table not in t_env.tables:
+        raise SqlError(f"unknown table {q.join.table!r}")
+    left = t_env.tables[q.table]._as_rows()
+    right = t_env.tables[q.join.table]._as_rows()
+    la = q.table_alias or q.table
+    ra = q.join.alias
+    lf, rf = left.schema.fields, right.schema.fields
+
+    # name -> (side, position); qualified always, unqualified if unique
+    resolve: Dict[str, tuple] = {}
+    for i, f in enumerate(lf):
+        resolve[f"{la}.{f}"] = ("l", i)
+    for i, f in enumerate(rf):
+        resolve[f"{ra}.{f}"] = ("r", i)
+    for i, f in enumerate(lf):
+        if f not in rf:
+            resolve.setdefault(f, ("l", i))
+    for i, f in enumerate(rf):
+        if f not in lf:
+            resolve.setdefault(f, ("r", i))
+
+    def side_of(name):
+        if name not in resolve:
+            raise SqlError(f"unknown or ambiguous join column {name!r}")
+        return resolve[name]
+
+    l_rt = getattr(left, "rowtime", None)
+    r_rt = getattr(right, "rowtime", None)
+    rt_names = set()
+    if l_rt is not None:
+        rt_names.update({l_rt, f"{la}.{l_rt}"})
+    if r_rt is not None:
+        rt_names.update({r_rt, f"{ra}.{r_rt}"})
+
+    equi_l: List[int] = []
+    equi_r: List[int] = []
+    lower = upper = None
+    residual: List[Expr] = []
+    for conj in _flatten_and(q.join.on):
+        handled = False
+        if isinstance(conj, BinaryOp) and conj.op in (
+                "=", "<", "<=", ">", ">="):
+            ll = _linear(conj.left)
+            rr = _linear(conj.right)
+            if ll is not None and rr is not None:
+                coeffs = dict(ll[0])
+                for k, v in rr[0].items():
+                    coeffs[k] = coeffs.get(k, 0) - v
+                    if coeffs[k] == 0:
+                        del coeffs[k]
+                const = ll[1] - rr[1]     # coeffs . cols + const OP 0
+                cols = list(coeffs)
+                if (conj.op == "=" and len(cols) == 2 and const == 0
+                        and not any(c in rt_names for c in cols)):
+                    (s1, p1), (s2, p2) = side_of(cols[0]), side_of(cols[1])
+                    if {coeffs[cols[0]], coeffs[cols[1]]} == {1, -1} \
+                            and {s1, s2} == {"l", "r"}:
+                        if s1 == "l":
+                            equi_l.append(p1)
+                            equi_r.append(p2)
+                        else:
+                            equi_l.append(p2)
+                            equi_r.append(p1)
+                        handled = True
+                elif (len(cols) == 2
+                      and all(c in rt_names for c in cols)
+                      and {coeffs[cols[0]], coeffs[cols[1]]} == {1, -1}
+                      and {side_of(cols[0])[0],
+                           side_of(cols[1])[0]} == {"l", "r"}):
+                    # normalize to d = r.ts - l.ts:  d OP bound
+                    c_l = next(coeffs[c] for c in cols
+                               if side_of(c)[0] == "l")
+                    # c_l*l + c_r*r + const OP 0; c_r = -c_l
+                    # c_l = +1:  l - r + const OP 0  ->  d INV(OP) const
+                    # c_l = -1:  r - l + const OP 0  ->  d OP -const
+                    if c_l == 1:
+                        op = {"<": ">", "<=": ">=",
+                              ">": "<", ">=": "<="}[conj.op] \
+                            if conj.op != "=" else "="
+                        bound = const
+                    else:
+                        op = conj.op
+                        bound = -const
+                    if op in (">=", ">"):
+                        lo = bound if op == ">=" else bound + 1
+                        lower = lo if lower is None else max(lower, lo)
+                    elif op in ("<=", "<"):
+                        hi = bound if op == "<=" else bound - 1
+                        upper = hi if upper is None else min(upper, hi)
+                    else:  # d = bound
+                        lower = upper = bound
+                    handled = True
+        if not handled:
+            residual.append(conj)
+    if not equi_l:
+        raise SqlError(
+            "streaming join needs at least one equi-key conjunct "
+            "(a.k = b.k)")
+    if lower is None or upper is None:
+        raise SqlError(
+            "streaming join needs a rowtime bound, e.g. "
+            "a.ts BETWEEN b.ts - INTERVAL '5' SECOND AND "
+            "b.ts + INTERVAL '5' SECOND "
+            "(unbounded stream joins would hold infinite state)")
+
+    el, er = list(equi_l), list(equi_r)
+
+    def ksl(row):
+        ks = tuple(row[p] for p in el)
+        return ks if len(ks) != 1 else ks[0]
+
+    def ksr(row):
+        ks = tuple(row[p] for p in er)
+        return ks if len(ks) != 1 else ks[0]
+
+    out = (left.stream.interval_join(right.stream)
+           .where(ksl).equal_to(ksr)
+           .between(int(lower), int(upper))
+           .apply(lambda l, r: (*l, *r), name="sql_interval_join"))
+    fields = [f"{la}.{f}" for f in lf] + [f"{ra}.{f}" for f in rf]
+    schema = Schema(fields)
+    # unqualified access for unambiguous names
+    for i, f in enumerate(lf):
+        if f not in rf:
+            schema.index.setdefault(f, i)
+    for i, f in enumerate(rf):
+        if f not in lf:
+            schema.index.setdefault(f, len(lf) + i)
+    t = Table(t_env, out, schema)
+    t.rowtime = f"{la}.{l_rt}" if l_rt else None
+    for conj in residual:
+        t = t.filter(conj)
+    return t
+
+
+# ---------------------------------------------------------------------
+# OVER window lowering (ref: DataStreamOverAggregate.scala ->
+# RowTimeBoundedRowsOver.scala / RowTimeBoundedRangeOver.scala)
+# ---------------------------------------------------------------------
+
+def _lower_over_agg(table: Table, select: List[Expr]) -> Table:
+    """Per-row bounded trailing aggregation: key by PARTITION BY, park
+    rows until the watermark passes their timestamp, then emit — in
+    timestamp order — the input row extended with each OVER agg
+    computed over its trailing frame (ROWS n / RANGE t PRECEDING)."""
+    table = table._as_rows()
+    t_env = table.t_env
+    schema = table.schema
+
+    overs: List[OverCall] = []
+    for e in select:
+        for o in find_overs(e):
+            if not any(o is x for x in overs):
+                overs.append(o)
+    spec = overs[0]
+    if any(o.spec_key() != spec.spec_key() for o in overs):
+        raise SqlError(
+            "all OVER aggregates in one query must share the same "
+            "window spec (the reference's single-over rule)")
+    schema.pos(spec.order_by)  # ORDER BY column must exist
+    rowtime = getattr(table, "rowtime", None)
+    if rowtime is not None and spec.order_by not in (
+            rowtime, rowtime.split(".")[-1]):
+        # frames advance in event time; ordering by anything else
+        # would silently compute rowtime-ordered frames (the
+        # reference's restriction: ORDER BY must be the time attr)
+        raise SqlError(
+            f"OVER ORDER BY must name the rowtime attribute "
+            f"{rowtime!r}, got {spec.order_by!r}")
+    part_fns = [t_env._expr(p).compile(schema) for p in spec.partition_by]
+    parts, _ = _build_agg_parts(
+        t_env, [o.agg for o in overs], schema)
+
+    # post-row = input row + one result column per OverCall
+    over_index = {id(o): i for i, o in enumerate(overs)}
+    post_fields = list(schema.fields) + [f"__o{i}"
+                                         for i in range(len(overs))]
+    post_schema = Schema(post_fields)
+    n_in = len(schema.fields)
+
+    def remap(e):
+        if isinstance(e, OverCall):
+            return Column(f"__o{over_index[id(e)]}")
+        return None
+
+    out_fns = [substitute(strip_alias(e), remap).compile(post_schema)
+               for e in select]
+    out_names = [output_name(e, i) for i, e in enumerate(select)]
+
+    from flink_tpu.core.state import ValueStateDescriptor
+    from flink_tpu.streaming.operators import ProcessFunction
+
+    pending_desc = ValueStateDescriptor("over_pending")
+    frame_desc = ValueStateDescriptor("over_frame")
+    mode, preceding = spec.mode, spec.preceding
+
+    class OverAgg(ProcessFunction):
+        def process_element(self, value, ctx, out):
+            ts = ctx.timestamp()
+            if ts is None:
+                raise SqlError("OVER window needs event-time records")
+            if ts <= ctx.current_watermark():
+                return  # late row: the frame already advanced past it
+            st = ctx.get_state(pending_desc)
+            pend = st.value() or {}
+            pend.setdefault(ts, []).append(value)
+            st.update(pend)
+            ctx.register_event_time_timer(ts)
+
+        def on_timer(self, timestamp, ctx, out):
+            st = ctx.get_state(pending_desc)
+            pend = st.value()
+            if not pend or timestamp not in pend:
+                return
+            rows = pend.pop(timestamp)
+            st.update(pend)
+            fst = ctx.get_state(frame_desc)
+            frame = fst.value() or []        # [(ts, row)] emitted
+            out.set_absolute_timestamp(timestamp)
+            for row in rows:
+                frame.append((timestamp, row))
+                if mode == "rows":
+                    if len(frame) > preceding + 1:
+                        del frame[:len(frame) - (preceding + 1)]
+                else:
+                    lo = timestamp - preceding
+                    k = 0
+                    while k < len(frame) and frame[k][0] < lo:
+                        k += 1
+                    if k:
+                        del frame[:k]
+                # recompute each agg over the frame (the reference
+                # retracts incrementally — accumulate/retract; the
+                # recompute is exact for any UDAF without a retract
+                # method, and the ROWS frame is bounded by n)
+                results = []
+                for agg, input_fn in parts:
+                    acc = agg.create_accumulator()
+                    for _t, r in frame:
+                        acc = agg.add(input_fn(r), acc)
+                    results.append(agg.get_result(acc))
+                post = (*row, *results)
+                out.collect(tuple(f(post) for f in out_fns))
+            fst.update(frame)
+
+    def key_selector(row):
+        ks = tuple(f(row) for f in part_fns)
+        return ks if len(ks) != 1 else (ks[0] if ks else 0)
+
+    keyed = table.stream.key_by(key_selector if part_fns
+                                else (lambda row: 0))
+    out = keyed.process(OverAgg(), name="sql_over_agg")
     return Table(t_env, out, Schema(out_names))

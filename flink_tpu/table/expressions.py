@@ -199,6 +199,38 @@ class AggCall(Expr):
         return f"{self.name}({d}{', '.join(map(repr, self.args))})"
 
 
+class OverCall(Expr):
+    """agg(...) OVER (PARTITION BY ... ORDER BY rowtime ROWS|RANGE
+    BETWEEN <n> PRECEDING AND CURRENT ROW) — per-row aggregation over
+    a bounded trailing frame (ref: DataStreamOverAggregate.scala /
+    RowTimeBoundedRangeOver.scala, RowTimeBoundedRowsOver.scala).  Not
+    row-compilable; the planner lowers the query onto the keyed Over
+    process function."""
+
+    def __init__(self, agg: "AggCall", partition_by: List[Expr],
+                 order_by: str, mode: str, preceding: int):
+        self.agg = agg
+        self.partition_by = partition_by
+        self.order_by = order_by
+        self.mode = mode            # "rows" | "range"
+        self.preceding = preceding  # rows count | range ms
+
+    def spec_key(self) -> str:
+        """Identity of the window spec (all OverCalls in one query
+        must share it — same restriction as the reference's
+        DataStreamOverAggregate single-over rule)."""
+        return repr((list(map(repr, self.partition_by)), self.order_by,
+                     self.mode, self.preceding))
+
+    def compile(self, schema: Schema):
+        raise ValueError("OVER aggregate outside the over-window "
+                         "lowering")
+
+    def __repr__(self):
+        return (f"{self.agg!r} OVER (partition {self.partition_by!r} "
+                f"order {self.order_by} {self.mode} {self.preceding})")
+
+
 class WindowProp(Expr):
     """TUMBLE_START/TUMBLE_END/HOP_*/SESSION_* — resolved by the
     windowed lowering (the window's [start, end))."""
@@ -238,11 +270,28 @@ def strip_alias(e: Expr) -> Expr:
 
 
 def find_aggs(e: Expr) -> List[AggCall]:
-    """All AggCall nodes in an expression tree."""
+    """All AggCall nodes in an expression tree (OVER frames hold
+    their own agg — excluded here; see find_overs)."""
     out: List[AggCall] = []
 
     def walk(x):
+        if isinstance(x, OverCall):
+            return
         if isinstance(x, AggCall):
+            out.append(x)
+            return
+        for child in _children(x):
+            walk(child)
+
+    walk(strip_alias(e))
+    return out
+
+
+def find_overs(e: Expr) -> List[OverCall]:
+    out: List[OverCall] = []
+
+    def walk(x):
+        if isinstance(x, OverCall):
             out.append(x)
             return
         for child in _children(x):

@@ -54,7 +54,8 @@ _WINDOW_FNS = {"TUMBLE": "tumble", "HOP": "hop", "SESSION": "session"}
 _AGG_FNS = {"COUNT", "SUM", "MIN", "MAX", "AVG", "APPROX_COUNT_DISTINCT"}
 _KEYWORDS = {"SELECT", "FROM", "WHERE", "GROUP", "BY", "HAVING", "AS",
              "AND", "OR", "NOT", "DISTINCT", "INTERVAL", "NULL", "TRUE",
-             "FALSE"}
+             "FALSE", "JOIN", "ON", "OVER", "PARTITION", "ORDER", "ROWS",
+             "RANGE", "BETWEEN", "PRECEDING", "CURRENT", "ROW"}
 
 
 @dataclass
@@ -67,6 +68,16 @@ class WindowSpec:
 
 
 @dataclass
+class JoinClause:
+    """FROM a [AS x] JOIN b [AS y] ON <condition> (streaming interval
+    join: the condition must carry equi-key conjuncts plus a time
+    bound on the two rowtimes — analyzed by the planner)."""
+    table: str
+    alias: str
+    on: Expr
+
+
+@dataclass
 class Query:
     select: List[Expr]
     table: str
@@ -74,6 +85,8 @@ class Query:
     group_by: List[Expr] = field(default_factory=list)
     window: Optional[WindowSpec] = None
     having: Optional[Expr] = None
+    table_alias: Optional[str] = None
+    join: Optional[JoinClause] = None
 
 
 class SqlError(ValueError):
@@ -134,6 +147,22 @@ def parse(sql: str, udaf_names=()) -> Query:
         select.append(_parse_select_item(tk, udafs))
     tk.expect("kw", "FROM")
     table = tk.expect("name")
+    table_alias = None
+    if tk.accept("kw", "AS"):
+        table_alias = tk.expect("name")
+    elif tk.peek()[0] == "name":
+        table_alias = tk.next()[1]
+    join = None
+    if tk.accept("kw", "JOIN"):
+        jt = tk.expect("name")
+        jalias = None
+        if tk.accept("kw", "AS"):
+            jalias = tk.expect("name")
+        elif tk.peek()[0] == "name":
+            jalias = tk.next()[1]
+        tk.expect("kw", "ON")
+        on = _parse_expr(tk, udafs)
+        join = JoinClause(table=jt, alias=jalias or jt, on=on)
     where = None
     if tk.accept("kw", "WHERE"):
         where = _parse_expr(tk, udafs)
@@ -158,7 +187,8 @@ def parse(sql: str, udaf_names=()) -> Query:
     if not tk.done:
         raise SqlError(f"unexpected trailing tokens: {tk.peek()}")
     return Query(select=select, table=table, where=where,
-                 group_by=group_by, window=window, having=having)
+                 group_by=group_by, window=window, having=having,
+                 table_alias=table_alias, join=join)
 
 
 def _parse_window(tk: _Tokens) -> WindowSpec:
@@ -236,6 +266,14 @@ def _parse_cmp(tk, udafs) -> Expr:
     if k == "op" and t in ("=", "<>", "!=", "<", "<=", ">", ">="):
         tk.next()
         e = BinaryOp(t, e, _parse_add(tk, udafs))
+    elif k == "kw" and t == "BETWEEN":
+        # e BETWEEN lo AND hi -> (e >= lo) AND (e <= hi); the inner
+        # AND binds to the BETWEEN, not the boolean layer
+        tk.next()
+        lo = _parse_add(tk, udafs)
+        tk.expect("kw", "AND")
+        hi = _parse_add(tk, udafs)
+        e = BinaryOp("AND", BinaryOp(">=", e, lo), BinaryOp("<=", e, hi))
     return e
 
 
@@ -283,9 +321,19 @@ def _parse_atom(tk, udafs) -> Expr:
     if k == "kw" and t in ("TRUE", "FALSE", "NULL"):
         tk.next()
         return Literal({"TRUE": True, "FALSE": False, "NULL": None}[t])
+    if k == "kw" and t == "INTERVAL":
+        # interval literal in expression position (join time bounds:
+        # b.ts - INTERVAL '5' SECOND); value = milliseconds
+        return Literal(_parse_interval(tk))
     if k == "name":
         name = t
         upper = name.upper()
+        if tk.peek(1) == ("op", "."):
+            # qualified column: alias.field (join queries)
+            tk.next()
+            tk.next()
+            fieldname = tk.expect("name")
+            return Column(f"{name}.{fieldname}")
         if tk.peek(1) == ("op", "("):
             tk.next()
             tk.next()  # (
@@ -305,11 +353,59 @@ def _parse_atom(tk, udafs) -> Expr:
                     args.append(_parse_expr(tk, udafs))
             tk.expect("op", ")")
             if upper in _AGG_FNS or upper in udafs:
-                return AggCall(upper, args, distinct=distinct)
+                agg = AggCall(upper, args, distinct=distinct)
+                if tk.accept("kw", "OVER"):
+                    return _parse_over(tk, udafs, agg)
+                return agg
             return ScalarCall(upper, args)
         tk.next()
         return Column(name)
     raise SqlError(f"unexpected token {tk.peek()}")
+
+
+def _parse_over(tk: _Tokens, udafs, agg: AggCall):
+    """OVER (PARTITION BY e[, e..] ORDER BY col
+    ROWS BETWEEN <n> PRECEDING AND CURRENT ROW |
+    RANGE BETWEEN INTERVAL '..' unit PRECEDING AND CURRENT ROW)
+    (the reference's bounded streaming OVER shapes:
+    RowTimeBoundedRowsOver / RowTimeBoundedRangeOver)."""
+    from flink_tpu.table.expressions import OverCall
+    tk.expect("op", "(")
+    partition: List[Expr] = []
+    if tk.accept("kw", "PARTITION"):
+        tk.expect("kw", "BY")
+        partition.append(_parse_expr(tk, udafs))
+        while tk.accept("op", ","):
+            partition.append(_parse_expr(tk, udafs))
+    tk.expect("kw", "ORDER")
+    tk.expect("kw", "BY")
+    order_col = tk.expect("name")
+    if tk.accept("op", "."):
+        order_col = f"{order_col}.{tk.expect('name')}"
+    k, t = tk.peek()
+    if k == "kw" and t == "ROWS":
+        tk.next()
+        tk.expect("kw", "BETWEEN")
+        num = tk.expect("number")
+        if "." in num:
+            raise SqlError("ROWS frame size must be an integer")
+        preceding = int(num)
+        mode = "rows"
+    elif k == "kw" and t == "RANGE":
+        tk.next()
+        tk.expect("kw", "BETWEEN")
+        preceding = _parse_interval(tk)
+        mode = "range"
+    else:
+        raise SqlError(
+            "OVER window needs ROWS or RANGE BETWEEN ... PRECEDING "
+            "AND CURRENT ROW (unbounded OVER is not supported)")
+    tk.expect("kw", "PRECEDING")
+    tk.expect("kw", "AND")
+    tk.expect("kw", "CURRENT")
+    tk.expect("kw", "ROW")
+    tk.expect("op", ")")
+    return OverCall(agg, partition, order_col, mode, preceding)
 
 
 def _skip_call_args(tk: _Tokens) -> None:

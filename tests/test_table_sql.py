@@ -282,3 +282,226 @@ def test_table_api_select_expressions():
     env.execute("table-select")
     assert sorted(sink.values) == [(3, 2), (7, 6)]
     assert out.schema.fields == ["s", "d"]
+
+
+# ---------------------------------------------------------------------
+# round-3: JOIN ... ON, OVER windows, retraction
+# (ref: DataStreamWindowJoin.scala / WindowJoinUtil.scala,
+#  DataStreamOverAggregate.scala / RowTimeBoundedRangeOver.scala,
+#  GroupAggProcessFunction.scala)
+# ---------------------------------------------------------------------
+
+def _two_tables(t_env, env, orders, ships):
+    os_ = env.from_collection(orders).assign_timestamps_and_watermarks(
+        BoundedOutOfOrdernessTimestampExtractor(0, lambda e: e[2]))
+    ss = env.from_collection(ships).assign_timestamps_and_watermarks(
+        BoundedOutOfOrdernessTimestampExtractor(0, lambda e: e[2]))
+    t_env.register_table("o", t_env.from_data_stream(
+        os_, ["oid", "user", "ts"], rowtime="ts"))
+    t_env.register_table("s", t_env.from_data_stream(
+        ss, ["sid", "suser", "sts"], rowtime="sts"))
+
+
+def test_sql_interval_join():
+    env = StreamExecutionEnvironment()
+    t_env = StreamTableEnvironment.create(env)
+    orders = [("o1", "u1", 100), ("o2", "u2", 1500), ("o3", "u1", 2500)]
+    ships = [("s1", "u1", 600), ("s2", "u2", 4500), ("s3", "u1", 2400)]
+    _two_tables(t_env, env, orders, ships)
+    out = t_env.sql_query(
+        "SELECT a.oid, b.sid FROM o AS a JOIN s AS b "
+        "ON a.user = b.suser AND a.ts BETWEEN b.sts - INTERVAL '1' SECOND "
+        "AND b.sts + INTERVAL '1' SECOND")
+    sink = CollectSink()
+    out.to_append_stream().add_sink(sink)
+    env.execute("sql-join")
+    assert sorted(sink.values) == [("o1", "s1"), ("o3", "s3")]
+
+
+def test_sql_join_residual_filter_and_unqualified_cols():
+    env = StreamExecutionEnvironment()
+    t_env = StreamTableEnvironment.create(env)
+    orders = [("o1", "u1", 100), ("o2", "u1", 700)]
+    ships = [("s1", "u1", 600)]
+    _two_tables(t_env, env, orders, ships)
+    # unqualified columns resolve (names are unambiguous); the oid
+    # inequality is a residual conjunct -> post-join filter
+    out = t_env.sql_query(
+        "SELECT oid, sid FROM o JOIN s "
+        "ON user = suser AND ts BETWEEN sts - INTERVAL '1' SECOND "
+        "AND sts + INTERVAL '1' SECOND AND oid <> 'o2'")
+    sink = CollectSink()
+    out.to_append_stream().add_sink(sink)
+    env.execute("sql-join-residual")
+    assert sorted(sink.values) == [("o1", "s1")]
+
+
+def test_sql_join_then_windowed_group_by():
+    """Joined rows carry the pair's max timestamp, so a windowed
+    GROUP BY composes downstream."""
+    env = StreamExecutionEnvironment()
+    t_env = StreamTableEnvironment.create(env)
+    orders = [("o1", "u1", 100), ("o2", "u1", 300), ("o3", "u1", 1200)]
+    ships = [("s1", "u1", 400), ("s2", "u1", 1300)]
+    _two_tables(t_env, env, orders, ships)
+    out = t_env.sql_query(
+        "SELECT a.user AS u, COUNT(*) AS c FROM o AS a JOIN s AS b "
+        "ON a.user = b.suser AND a.ts BETWEEN b.sts - INTERVAL '500' "
+        "MILLISECOND AND b.sts "
+        "GROUP BY TUMBLE(ts, INTERVAL '1' SECOND), a.user")
+    sink = CollectSink()
+    out.to_append_stream().add_sink(sink)
+    env.execute("sql-join-window")
+    # pairs: (o1,s1) ts 400, (o2,s1) ts 400, (o3,s2) ts 1300
+    assert sorted(sink.values) == [("u1", 1), ("u1", 2)]
+
+
+def test_sql_join_requires_equi_and_time_bound():
+    env = StreamExecutionEnvironment()
+    t_env = StreamTableEnvironment.create(env)
+    _two_tables(t_env, env, [("o1", "u1", 1)], [("s1", "u1", 2)])
+    with pytest.raises(SqlError, match="equi"):
+        t_env.sql_query(
+            "SELECT a.oid FROM o AS a JOIN s AS b "
+            "ON a.ts BETWEEN b.sts - INTERVAL '1' SECOND AND b.sts")
+    with pytest.raises(SqlError, match="rowtime bound"):
+        t_env.sql_query(
+            "SELECT a.oid FROM o AS a JOIN s AS b ON a.user = b.suser")
+
+
+_OVER_EV = sorted([("a", 1.0, 100), ("a", 2.0, 200), ("a", 3.0, 300),
+                   ("b", 10.0, 150), ("a", 4.0, 400), ("b", 20.0, 250)],
+                  key=lambda e: e[2])
+
+
+def _over_query(sql):
+    env = StreamExecutionEnvironment()
+    t_env = StreamTableEnvironment.create(env)
+    st = env.from_collection(_OVER_EV).assign_timestamps_and_watermarks(
+        BoundedOutOfOrdernessTimestampExtractor(0, lambda e: e[2]))
+    t_env.register_table("ev", t_env.from_data_stream(
+        st, ["k", "v", "ts"], rowtime="ts"))
+    out = t_env.sql_query(sql)
+    sink = CollectSink()
+    out.to_append_stream().add_sink(sink)
+    env.execute("over")
+    return sorted(sink.values)
+
+
+def test_sql_over_rows_preceding():
+    got = _over_query(
+        "SELECT k, v, SUM(v) OVER (PARTITION BY k ORDER BY ts "
+        "ROWS BETWEEN 1 PRECEDING AND CURRENT ROW) AS s FROM ev")
+    assert got == sorted([
+        ("a", 1.0, 1.0), ("a", 2.0, 3.0), ("a", 3.0, 5.0),
+        ("a", 4.0, 7.0), ("b", 10.0, 10.0), ("b", 20.0, 30.0)])
+
+
+def test_sql_over_range_preceding():
+    got = _over_query(
+        "SELECT k, v, SUM(v) OVER (PARTITION BY k ORDER BY ts "
+        "RANGE BETWEEN INTERVAL '150' MILLISECOND PRECEDING AND "
+        "CURRENT ROW) AS s FROM ev")
+    assert got == sorted([
+        ("a", 1.0, 1.0), ("a", 2.0, 3.0), ("a", 3.0, 5.0),
+        ("a", 4.0, 7.0), ("b", 10.0, 10.0), ("b", 20.0, 30.0)])
+
+
+def test_sql_over_multiple_aggs_one_spec():
+    got = _over_query(
+        "SELECT k, v, COUNT(v) OVER (PARTITION BY k ORDER BY ts "
+        "ROWS BETWEEN 2 PRECEDING AND CURRENT ROW) AS c, "
+        "SUM(v) OVER (PARTITION BY k ORDER BY ts "
+        "ROWS BETWEEN 2 PRECEDING AND CURRENT ROW) AS s FROM ev")
+    assert got == sorted([
+        ("a", 1.0, 1, 1.0), ("a", 2.0, 2, 3.0), ("a", 3.0, 3, 6.0),
+        ("a", 4.0, 3, 9.0), ("b", 10.0, 1, 10.0), ("b", 20.0, 2, 30.0)])
+
+
+def test_sql_over_spec_mismatch_rejected():
+    env = StreamExecutionEnvironment()
+    t_env = StreamTableEnvironment.create(env)
+    st = env.from_collection(_OVER_EV).assign_timestamps_and_watermarks(
+        BoundedOutOfOrdernessTimestampExtractor(0, lambda e: e[2]))
+    t_env.register_table("ev", t_env.from_data_stream(
+        st, ["k", "v", "ts"], rowtime="ts"))
+    with pytest.raises(SqlError, match="share the same"):
+        t_env.sql_query(
+            "SELECT SUM(v) OVER (PARTITION BY k ORDER BY ts ROWS "
+            "BETWEEN 1 PRECEDING AND CURRENT ROW) AS a, "
+            "SUM(v) OVER (PARTITION BY k ORDER BY ts ROWS "
+            "BETWEEN 2 PRECEDING AND CURRENT ROW) AS b FROM ev")
+    with pytest.raises(SqlError, match="GROUP BY"):
+        t_env.sql_query(
+            "SELECT SUM(v) OVER (PARTITION BY k ORDER BY ts ROWS "
+            "BETWEEN 1 PRECEDING AND CURRENT ROW) FROM ev GROUP BY k")
+
+
+def test_sql_retract_stream_protocol():
+    env = StreamExecutionEnvironment()
+    t_env = StreamTableEnvironment.create(env)
+    st = env.from_collection([("x", 1), ("x", 2), ("y", 5)])
+    t_env.register_table("ev", t_env.from_data_stream(st, ["k", "v"]))
+    out = t_env.sql_query("SELECT k, SUM(v) AS s FROM ev GROUP BY k")
+    pairs, rows = CollectSink(), CollectSink()
+    out.to_retract_stream().add_sink(pairs)
+    out.to_append_stream().add_sink(rows)
+    env.execute("retract")
+    assert pairs.values == [(True, ("x", 1)), (False, ("x", 1)),
+                            (True, ("x", 3)), (True, ("y", 5))]
+    assert rows.values == [("x", 1), ("x", 3), ("y", 5)]
+
+
+def test_retract_stream_on_append_table():
+    """Append-only tables present the retract protocol with adds only."""
+    env = StreamExecutionEnvironment()
+    t_env = StreamTableEnvironment.create(env)
+    st = env.from_collection([(1, 2), (3, 4)])
+    t = t_env.from_data_stream(st, ["a", "b"])
+    sink = CollectSink()
+    t.to_retract_stream().add_sink(sink)
+    env.execute("append-retract")
+    assert sink.values == [(True, (1, 2)), (True, (3, 4))]
+
+
+def test_sql_join_same_side_time_bound_rejected():
+    """A conjunct comparing one side's rowtime to itself is not a
+    cross-stream bound (code-review regression: raw StopIteration)."""
+    env = StreamExecutionEnvironment()
+    t_env = StreamTableEnvironment.create(env)
+    _two_tables(t_env, env, [("o1", "u1", 1)], [("s1", "u1", 2)])
+    with pytest.raises(SqlError, match="rowtime bound"):
+        t_env.sql_query(
+            "SELECT a.oid FROM o AS a JOIN s AS b ON a.user = b.suser "
+            "AND sts BETWEEN b.sts - INTERVAL '1' SECOND "
+            "AND b.sts + INTERVAL '1' SECOND")
+
+
+def test_sql_over_requires_rowtime_order_and_no_plain_aggs():
+    env = StreamExecutionEnvironment()
+    t_env = StreamTableEnvironment.create(env)
+    st = env.from_collection(_OVER_EV).assign_timestamps_and_watermarks(
+        BoundedOutOfOrdernessTimestampExtractor(0, lambda e: e[2]))
+    t_env.register_table("ev", t_env.from_data_stream(
+        st, ["k", "v", "ts"], rowtime="ts"))
+    with pytest.raises(SqlError, match="rowtime"):
+        t_env.sql_query(
+            "SELECT SUM(v) OVER (PARTITION BY k ORDER BY v ROWS "
+            "BETWEEN 1 PRECEDING AND CURRENT ROW) FROM ev")
+    with pytest.raises(SqlError, match="mix OVER"):
+        t_env.sql_query(
+            "SELECT SUM(v) OVER (PARTITION BY k ORDER BY ts ROWS "
+            "BETWEEN 1 PRECEDING AND CURRENT ROW) AS a, COUNT(v) AS c "
+            "FROM ev")
+
+
+def test_retract_protocol_not_lost_by_filter():
+    """filter/select on an updating aggregate must refuse to present
+    the upsert rows as an append-only retract stream."""
+    env = StreamExecutionEnvironment()
+    t_env = StreamTableEnvironment.create(env)
+    st = env.from_collection([("x", 1), ("x", 2)])
+    t_env.register_table("ev", t_env.from_data_stream(st, ["k", "v"]))
+    out = t_env.sql_query("SELECT k, SUM(v) AS s FROM ev GROUP BY k")
+    with pytest.raises(SqlError, match="retract protocol lost"):
+        out.filter(col("s") > 0).to_retract_stream()
