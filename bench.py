@@ -404,6 +404,59 @@ def bench_sql(n_events=1 << 22, n_keys=500_000, precision=12):
     return n_events / elapsed, base_rate
 
 
+def bench_sql_join(n_each=1 << 21, n_keys=100_000, bound_ms=500,
+                   span_ms=60_000):
+    """Windowed stream-stream join on the columnar tier: SQL
+    JOIN ... ON equi-key AND rowtime BETWEEN +-bound compiles onto
+    ColumnarIntervalJoinOperator (vectorized hash join per batch,
+    watermark-pruned buffers).  Baseline: the per-record time-bounded
+    join (probe per-key time-sorted buffer + range walk per record),
+    compiled, both inputs merged in event-time order."""
+    from flink_tpu.streaming.columnar import ColumnarCollectSink
+    from flink_tpu.streaming.datastream import StreamExecutionEnvironment
+    from flink_tpu.table import StreamTableEnvironment
+
+    rng = np.random.default_rng(23)
+    lk = rng.integers(0, n_keys, n_each).astype(np.uint64)
+    lts = np.sort(rng.integers(0, span_ms, n_each).astype(np.int64))
+    rk = rng.integers(0, n_keys, n_each).astype(np.uint64)
+    rts = np.sort(rng.integers(0, span_ms, n_each).astype(np.int64))
+
+    base_rate, base_pairs = nat.interval_join_baseline(
+        nat.splitmix64(lk), lts, nat.splitmix64(rk), rts,
+        -bound_ms, bound_ms, capacity=2 * n_keys)
+    base_rate = max(base_rate, *(nat.interval_join_baseline(
+        nat.splitmix64(lk), lts, nat.splitmix64(rk), rts,
+        -bound_ms, bound_ms, capacity=2 * n_keys)[0] for _ in range(2)))
+
+    def engine_run():
+        env = StreamExecutionEnvironment()
+        t_env = StreamTableEnvironment.create(env)
+        t_env.register_table("l", t_env.from_columns(
+            {"lid": np.arange(n_each), "k": lk, "ts": lts},
+            rowtime="ts", chunk=1 << 20))
+        t_env.register_table("r", t_env.from_columns(
+            {"rid": np.arange(n_each), "rk": rk, "rts": rts},
+            rowtime="rts", chunk=1 << 20))
+        out = t_env.sql_query(
+            "SELECT a.lid, b.rid FROM l AS a JOIN r AS b "
+            "ON a.k = b.rk AND a.ts BETWEEN b.rts - INTERVAL "
+            f"'{bound_ms}' MILLISECOND AND b.rts + INTERVAL "
+            f"'{bound_ms}' MILLISECOND")
+        assert getattr(out, "columnar", False), \
+            "join fell off the columnar tier"
+        sink = ColumnarCollectSink()
+        out.to_append_stream(batched=True).add_sink(sink)
+        t0 = time.perf_counter()
+        env.execute("bench-sql-join")
+        elapsed = time.perf_counter() - t0
+        assert sink.total_rows() == base_pairs, \
+            (sink.total_rows(), base_pairs)
+        return 2 * n_each / elapsed
+
+    return best_of(engine_run, reps=3), base_rate
+
+
 def main():
     # single-config runs MERGE into the existing report instead of
     # clobbering the other configs' results
@@ -424,6 +477,7 @@ def main():
         ("sliding_quantile", bench_sliding_quantile),
         ("session_cm", bench_session_cm),
         ("sql", bench_sql),
+        ("sql_join", bench_sql_join),
     ]
     only = sys.argv[1] if len(sys.argv) > 1 else None
     if only is not None and only not in {n for n, _ in suite}:

@@ -354,3 +354,292 @@ class ColumnarWindowOperator(StreamOperator):
                     if hasattr(self.engine, "fired"):
                         self.engine.emit_arrays = True
                 self.engine.restore(s["columnar_engine"])
+
+
+class ColumnarIntervalJoinOperator(StreamOperator):
+    """Vectorized stream-stream interval join over RecordBatch inputs
+    (the columnar twin of the row-level interval join,
+    flink_tpu/streaming/joining.py; ref role:
+    DataStreamWindowJoin.scala's time-bounded join).
+
+    Input elements are (tag, RecordBatch) carriers from the tagged
+    union (0 = left, 1 = right).  Each side keeps a columnar buffer;
+    an incoming batch probes the OTHER side's buffer with one
+    vectorized hash-join pass:
+
+      sort the buffer by 64-bit key hash (cached until the buffer
+      changes) -> searchsorted the batch's hashes for candidate group
+      ranges -> expand ranges with repeat/cumsum arithmetic -> filter
+      by the time bound r.ts - l.ts in [lower, upper] AND exact key
+      equality (hash-collision safe) -> gather the joined RecordBatch.
+
+    Buffers prune by watermark (left rows die once wm >= ts + upper,
+    right rows once wm >= ts - lower).  Single-parallelism, like the
+    rest of the columnar tier."""
+
+    def __init__(self, key_l: str, key_r: str, lower_ms: int,
+                 upper_ms: int, out_fields_l, out_fields_r):
+        super().__init__()
+        self.key_l = key_l
+        self.key_r = key_r
+        self.lower = lower_ms
+        self.upper = upper_ms
+        #: [(out_name, src_col)] per side
+        self.out_l = list(out_fields_l)
+        self.out_r = list(out_fields_r)
+        self._buf = [self._empty(), self._empty()]
+        self.current_watermark = -(2 ** 63)
+        # native fast path: the batched C++ join core probes per-key
+        # time-sorted buffers with phase-split slot resolution; the
+        # operator keeps append-only column storage per side and
+        # gathers emitted pairs by global row id.  (Row-id addressed
+        # storage is append-only; bounded inputs / replayed logs.)
+        self._native = None
+        self._store = None
+        try:
+            import flink_tpu.native as nat
+            if nat.available():
+                self._native = nat.NativeIntervalJoin(lower_ms, upper_ms)
+                self._store = [self._new_store(), self._new_store()]
+        except Exception:  # noqa: BLE001 — numpy path below
+            self._native = None
+
+    @staticmethod
+    def _new_store():
+        return {"cols": {}, "ts": None, "kh": None, "n": 0, "cap": 0}
+
+    def _store_append(self, side: int, batch: RecordBatch,
+                      kh: np.ndarray):
+        st = self._store[side]
+        n_new = len(batch)
+        need = st["n"] + n_new
+        if need > st["cap"]:
+            cap = max(1 << 16, 1 << int(need - 1).bit_length())
+            for name in batch.cols:
+                old = st["cols"].get(name)
+                arr = np.empty(cap, np.asarray(batch.cols[name]).dtype)
+                if old is not None:
+                    arr[:st["n"]] = old[:st["n"]]
+                st["cols"][name] = arr
+            for key in ("ts", "kh"):
+                old = st[key]
+                arr = np.empty(cap, np.int64 if key == "ts"
+                               else np.uint64)
+                if old is not None:
+                    arr[:st["n"]] = old[:st["n"]]
+                st[key] = arr
+            st["cap"] = cap
+        for name, col in batch.cols.items():
+            st["cols"][name][st["n"]:need] = np.asarray(col)
+        st["ts"][st["n"]:need] = np.asarray(batch.ts, np.int64)
+        st["kh"][st["n"]:need] = kh
+        st["n"] = need
+
+    @staticmethod
+    def _empty():
+        return {"cols": None, "ts": None, "kh": None,
+                "order": None, "sorted_kh": None}
+
+    def set_key_context(self, record):
+        pass
+
+    def _hash(self, col: np.ndarray) -> np.ndarray:
+        col = np.asarray(col)
+        if col.dtype.kind in "iu":
+            try:
+                import flink_tpu.native as nat
+                if nat.available():
+                    return nat.splitmix64(col.astype(np.uint64,
+                                                     copy=False))
+            except Exception:  # noqa: BLE001
+                pass
+        from flink_tpu.streaming.vectorized import hash_keys_np
+        return hash_keys_np(col)
+
+    def _append(self, side: int, batch: RecordBatch, kh: np.ndarray):
+        b = self._buf[side]
+        if b["cols"] is None:
+            b["cols"] = {k: np.asarray(v) for k, v in batch.cols.items()}
+            b["ts"] = np.asarray(batch.ts, np.int64)
+            b["kh"] = kh
+        else:
+            b["cols"] = {k: np.concatenate([b["cols"][k], batch.cols[k]])
+                         for k in b["cols"]}
+            b["ts"] = np.concatenate([b["ts"],
+                                      np.asarray(batch.ts, np.int64)])
+            b["kh"] = np.concatenate([b["kh"], kh])
+        b["order"] = None  # sort cache dirtied
+
+    def _sorted(self, side: int):
+        # NOTE: correctness fallback only (no native runtime): every
+        # append dirties the cache, so each probing batch re-argsorts
+        # the (watermark-pruned) buffer — O(B log B) per batch.  The
+        # native core is the performance path (counting-sorted batch,
+        # monotone two-pointer probes).
+        b = self._buf[side]
+        if b["order"] is None and b["kh"] is not None:
+            b["order"] = np.argsort(b["kh"], kind="stable")
+            b["sorted_kh"] = b["kh"][b["order"]]
+        return b
+
+    def process_element(self, record: StreamRecord):
+        tag, batch = record.value
+        if len(batch) == 0:
+            return
+        key_col = self.key_l if tag == 0 else self.key_r
+        kh = self._hash(batch.cols[key_col])
+        if self._native is not None:
+            self._store_append(tag, batch, kh)
+            lrows, rrows = self._native.push(
+                tag, kh, np.asarray(batch.ts, np.int64))
+            if len(lrows):
+                sl, sr = self._store[0], self._store[1]
+                # exact key equality: the native core joins on 64-bit
+                # hashes; a collision must not emit a false pair
+                eq = (sl["cols"][self.key_l][lrows]
+                      == sr["cols"][self.key_r][rrows])
+                if not eq.all():
+                    lrows, rrows = lrows[eq], rrows[eq]
+                    if not len(lrows):
+                        return
+                l_cols = {n: sl["cols"][c][lrows] for n, c in self.out_l}
+                r_cols = {n: sr["cols"][c][rrows] for n, c in self.out_r}
+                out_ts = np.maximum(sl["ts"][lrows], sr["ts"][rrows])
+                out = RecordBatch({**l_cols, **r_cols}, out_ts)
+                self.output.collect(
+                    StreamRecord(out, timestamp=int(out_ts.max())))
+            return
+        self._append(tag, batch, kh)
+        other = self._sorted(1 - tag)
+        if other["cols"] is None or not len(other["kh"]):
+            return
+        starts = np.searchsorted(other["sorted_kh"], kh, "left")
+        ends = np.searchsorted(other["sorted_kh"], kh, "right")
+        counts = ends - starts
+        total = int(counts.sum())
+        if total == 0:
+            return
+        mine = np.repeat(np.arange(len(kh)), counts)
+        cum0 = np.concatenate([[0], np.cumsum(counts)[:-1]])
+        offs = np.arange(total) - np.repeat(cum0, counts)
+        theirs = other["order"][np.repeat(starts, counts) + offs]
+        ts_mine = np.asarray(batch.ts, np.int64)[mine]
+        ts_other = other["ts"][theirs]
+        if tag == 0:
+            d = ts_other - ts_mine          # r.ts - l.ts
+        else:
+            d = ts_mine - ts_other
+        ok = (d >= self.lower) & (d <= self.upper)
+        # exact key equality (64-bit hash ties broken by content)
+        okey = self.key_l if tag == 1 else self.key_r
+        ok &= (np.asarray(batch.cols[key_col])[mine]
+               == other["cols"][okey][theirs])
+        if not ok.any():
+            return
+        mine, theirs = mine[ok], theirs[ok]
+        if tag == 0:
+            l_cols = {n: np.asarray(batch.cols[c])[mine]
+                      for n, c in self.out_l}
+            r_cols = {n: other["cols"][c][theirs] for n, c in self.out_r}
+            out_ts = np.maximum(ts_mine[ok], ts_other[ok])
+        else:
+            l_cols = {n: other["cols"][c][theirs] for n, c in self.out_l}
+            r_cols = {n: np.asarray(batch.cols[c])[mine]
+                      for n, c in self.out_r}
+            out_ts = np.maximum(ts_other[ok], ts_mine[ok])
+        out = RecordBatch({**l_cols, **r_cols}, out_ts)
+        self.output.collect(StreamRecord(out, timestamp=int(out_ts.max())))
+
+    def process_watermark(self, watermark: Watermark):
+        wm = watermark.timestamp
+        self.current_watermark = wm
+        if self._native is not None:
+            self._native.prune(wm)
+            self.output.emit_watermark(watermark)
+            return
+        for side, horizon in ((0, self.upper), (1, -self.lower)):
+            b = self._buf[side]
+            if b["cols"] is None:
+                continue
+            keep = b["ts"] + horizon > wm
+            if not keep.all():
+                b["cols"] = {k: v[keep] for k, v in b["cols"].items()}
+                b["ts"] = b["ts"][keep]
+                b["kh"] = b["kh"][keep]
+                b["order"] = None
+        self.output.emit_watermark(watermark)
+
+    # checkpoint: the buffers ARE the operator state
+    def snapshot_state(self, checkpoint_id=None) -> dict:
+        snap = super().snapshot_state(checkpoint_id)
+        if self._native is not None:
+            snap["iv_join_store"] = [
+                {"cols": {k: v[:s["n"]].copy()
+                          for k, v in s["cols"].items()},
+                 "ts": s["ts"][:s["n"]].copy() if s["ts"] is not None
+                 else np.empty(0, np.int64),
+                 "kh": s["kh"][:s["n"]].copy() if s["kh"] is not None
+                 else np.empty(0, np.uint64)}
+                for s in self._store]
+            snap["iv_join_watermark"] = self.current_watermark
+            return snap
+        snap["iv_join_buffers"] = [
+            None if b["cols"] is None else
+            {"cols": {k: v.copy() for k, v in b["cols"].items()},
+             "ts": b["ts"].copy(), "kh": b["kh"].copy()}
+            for b in self._buf]
+        return snap
+
+    def restore_state(self, snapshots) -> None:
+        super().restore_state(snapshots)
+        for s in snapshots:
+            if "iv_join_store" in s:
+                import flink_tpu.native as nat
+                if not nat.available():
+                    # native-format snapshot on a host without the
+                    # library: rebuild the numpy buffers instead
+                    self._native = None
+                    self._buf = []
+                    for st in s["iv_join_store"]:
+                        nb = self._empty()
+                        if len(st["ts"]):
+                            nb["cols"] = {k: np.asarray(v) for k, v
+                                          in st["cols"].items()}
+                            nb["ts"] = np.asarray(st["ts"], np.int64)
+                            nb["kh"] = np.asarray(st["kh"], np.uint64)
+                        self._buf.append(nb)
+                    continue
+                self._native = nat.NativeIntervalJoin(self.lower,
+                                                      self.upper)
+                self._store = [self._new_store(), self._new_store()]
+                # replay each side into the core — pairs produced by
+                # the replay were all emitted before the checkpoint
+                # barrier, so they are DROPPED (push drains them;
+                # left replays first, probing an empty right buffer)
+                for side, st in enumerate(s["iv_join_store"]):
+                    ts = np.asarray(st["ts"], np.int64)
+                    kh = np.asarray(st["kh"], np.uint64)
+                    if len(ts):
+                        self._store_append(
+                            side,
+                            RecordBatch(dict(st["cols"]), ts), kh)
+                        self._native.push(side, kh, ts)
+                wm = s.get("iv_join_watermark")
+                if wm is not None and wm > -(2 ** 63):
+                    self.current_watermark = wm
+                    self._native.prune(wm)
+                continue
+            if "iv_join_buffers" in s:
+                # numpy-format snapshot: the restored rows live in the
+                # numpy buffers, so the numpy path must serve them
+                # even when this host could build the native core
+                self._native = None
+                self._buf = []
+                for b in s["iv_join_buffers"]:
+                    nb = self._empty()
+                    if b is not None:
+                        nb["cols"] = {k: np.asarray(v)
+                                      for k, v in b["cols"].items()}
+                        nb["ts"] = np.asarray(b["ts"], np.int64)
+                        nb["kh"] = np.asarray(b["kh"], np.uint64)
+                    self._buf.append(nb)

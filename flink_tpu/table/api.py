@@ -34,6 +34,7 @@ from flink_tpu.table.expressions import (
     find_aggs,
     find_overs,
     output_name,
+    output_names,
     strip_alias,
     substitute,
 )
@@ -72,8 +73,36 @@ class Table:
         exprs = [self.t_env._expr(e) for e in exprs]
         if any(find_aggs(e) for e in exprs):
             raise SqlError("aggregates need group_by().window() or SQL")
-        names = [output_name(e, i) for i, e in enumerate(exprs)]
-        fns = [strip_alias(e).compile(self.schema) for e in exprs]
+        names = output_names(exprs)
+        inner = [strip_alias(e) for e in exprs]
+        if getattr(self, "columnar", False) and all(
+                isinstance(e, Column) and e.name in self.schema.index
+                for e in inner):
+            # pure column projection stays columnar: rename/select
+            # batch columns without exploding to rows (names resolve
+            # through the schema to the canonical batch column name)
+            src = [self.schema.fields[self.schema.index[e.name]]
+                   for e in inner]
+            from flink_tpu.streaming.columnar import RecordBatch
+
+            def project(b, names=tuple(names), src=tuple(src)):
+                return RecordBatch({n: b.cols[s]
+                                    for n, s in zip(names, src)}, b.ts)
+
+            t = Table(self.t_env,
+                      self.stream.map(project, name="columnar_select"),
+                      Schema(names))
+            t.columnar = True
+            # rowtime follows the projection: the new name if the
+            # rowtime column was selected (possibly renamed), None if
+            # the projection dropped it
+            rt = getattr(self, "rowtime", None)
+            canon_rt = (self.schema.fields[self.schema.index[rt]]
+                        if rt in self.schema.index else None)
+            t.rowtime = next((n for n, s in zip(names, src)
+                              if s == canon_rt), None)
+            return t
+        fns = [e.compile(self.schema) for e in inner]
         out = self._as_rows().stream.map(
             lambda row, fns=fns: tuple(f(row) for f in fns),
             name="select")
@@ -526,7 +555,7 @@ def _lower_windowed_agg(table: Table, keys: List[Expr], spec: WindowSpec,
 
     out_fns = [substitute(strip_alias(e), remap).compile(post_schema)
                for e in select]
-    out_names = [output_name(e, i) for i, e in enumerate(select)]
+    out_names = output_names(select)
     having_fn = (substitute(strip_alias(having), remap).compile(post_schema)
                  if having is not None else None)
 
@@ -638,7 +667,7 @@ def _lower_continuous_group_agg(table: Table, keys: List[Expr],
 
     out_fns = [substitute(strip_alias(e), remap).compile(post_schema)
                for e in select]
-    out_names = [output_name(e, i) for i, e in enumerate(select)]
+    out_names = output_names(select)
 
     from flink_tpu.core.state import ValueStateDescriptor
     from flink_tpu.streaming.operators import ProcessFunction
@@ -743,11 +772,11 @@ def _lower_join(t_env: "StreamTableEnvironment", q) -> Table:
     keeps unqualified names that are unambiguous."""
     if q.join.table not in t_env.tables:
         raise SqlError(f"unknown table {q.join.table!r}")
-    left = t_env.tables[q.table]._as_rows()
-    right = t_env.tables[q.join.table]._as_rows()
+    left_src = t_env.tables[q.table]
+    right_src = t_env.tables[q.join.table]
     la = q.table_alias or q.table
     ra = q.join.alias
-    lf, rf = left.schema.fields, right.schema.fields
+    lf, rf = left_src.schema.fields, right_src.schema.fields
 
     # name -> (side, position); qualified always, unqualified if unique
     resolve: Dict[str, tuple] = {}
@@ -767,8 +796,8 @@ def _lower_join(t_env: "StreamTableEnvironment", q) -> Table:
             raise SqlError(f"unknown or ambiguous join column {name!r}")
         return resolve[name]
 
-    l_rt = getattr(left, "rowtime", None)
-    r_rt = getattr(right, "rowtime", None)
+    l_rt = getattr(left_src, "rowtime", None)
+    r_rt = getattr(right_src, "rowtime", None)
     rt_names = set()
     if l_rt is not None:
         rt_names.update({l_rt, f"{la}.{l_rt}"})
@@ -847,6 +876,53 @@ def _lower_join(t_env: "StreamTableEnvironment", q) -> Table:
             "(unbounded stream joins would hold infinite state)")
 
     el, er = list(equi_l), list(equi_r)
+    fields = [f"{la}.{f}" for f in lf] + [f"{ra}.{f}" for f in rf]
+
+    def _joined_schema():
+        schema = Schema(fields)
+        # unqualified access for unambiguous names
+        for i, f in enumerate(lf):
+            if f not in rf:
+                schema.index.setdefault(f, i)
+        for i, f in enumerate(rf):
+            if f not in lf:
+                schema.index.setdefault(f, len(lf) + i)
+        return schema
+
+    # columnar fast path: both sides columnar, one equi key, no
+    # residual — the vectorized hash-join operator keeps RecordBatches
+    # end to end (the "windowed join on the columnar tier")
+    if (not residual and len(el) == 1
+            and getattr(left_src, "columnar", False)
+            and getattr(right_src, "columnar", False)
+            and left_src.stream.env.parallelism == 1):
+        from flink_tpu.streaming.columnar import (
+            ColumnarIntervalJoinOperator,
+        )
+        key_l, key_r = lf[el[0]], rf[er[0]]
+        tagged_l = left_src.stream.map(lambda b: (0, b),
+                                       name="cj_tag_left")
+        tagged_r = right_src.stream.map(lambda b: (1, b),
+                                        name="cj_tag_right")
+        unioned = tagged_l.union(tagged_r)
+        out_l = [(f"{la}.{f}", f) for f in lf]
+        out_r = [(f"{ra}.{f}", f) for f in rf]
+
+        def factory(key_l=key_l, key_r=key_r, lower=int(lower),
+                    upper=int(upper), out_l=tuple(out_l),
+                    out_r=tuple(out_r)):
+            return ColumnarIntervalJoinOperator(key_l, key_r, lower,
+                                                upper, out_l, out_r)
+
+        out = unioned._add_op("columnar_interval_join", factory,
+                              parallelism=1)
+        t = Table(t_env, out, _joined_schema())
+        t.columnar = True
+        t.rowtime = f"{la}.{l_rt}" if l_rt else None
+        return t
+
+    left = left_src._as_rows()
+    right = right_src._as_rows()
 
     def ksl(row):
         ks = tuple(row[p] for p in el)
@@ -860,16 +936,7 @@ def _lower_join(t_env: "StreamTableEnvironment", q) -> Table:
            .where(ksl).equal_to(ksr)
            .between(int(lower), int(upper))
            .apply(lambda l, r: (*l, *r), name="sql_interval_join"))
-    fields = [f"{la}.{f}" for f in lf] + [f"{ra}.{f}" for f in rf]
-    schema = Schema(fields)
-    # unqualified access for unambiguous names
-    for i, f in enumerate(lf):
-        if f not in rf:
-            schema.index.setdefault(f, i)
-    for i, f in enumerate(rf):
-        if f not in lf:
-            schema.index.setdefault(f, len(lf) + i)
-    t = Table(t_env, out, schema)
+    t = Table(t_env, out, _joined_schema())
     t.rowtime = f"{la}.{l_rt}" if l_rt else None
     for conj in residual:
         t = t.filter(conj)
@@ -928,7 +995,7 @@ def _lower_over_agg(table: Table, select: List[Expr]) -> Table:
 
     out_fns = [substitute(strip_alias(e), remap).compile(post_schema)
                for e in select]
-    out_names = [output_name(e, i) for i, e in enumerate(select)]
+    out_names = output_names(select)
 
     from flink_tpu.core.state import ValueStateDescriptor
     from flink_tpu.streaming.operators import ProcessFunction

@@ -261,8 +261,28 @@ def output_name(e: Expr, i: int) -> str:
     if isinstance(e, Alias):
         return e.name
     if isinstance(e, Column):
-        return e.name
+        # a qualified column projects under its simple name
+        # (SELECT a.lid -> output column "lid"), as in the reference
+        return e.name.split(".")[-1]
     return f"EXPR${i}"
+
+
+def output_names(exprs: Sequence[Expr]) -> List[str]:
+    """Output column names with collision recovery: when stripping
+    qualifiers makes two names collide (SELECT a.id, b.id), the later
+    ones keep their qualified form instead of silently shadowing."""
+    names: List[str] = []
+    seen = set()
+    for i, e in enumerate(exprs):
+        n = output_name(e, i)
+        if n in seen:
+            inner = strip_alias(e)
+            n = inner.name if isinstance(inner, Column) else f"{n}${i}"
+        while n in seen:  # pathological: qualified name collides too
+            n = f"{n}${i}"
+        seen.add(n)
+        names.append(n)
+    return names
 
 
 def strip_alias(e: Expr) -> Expr:

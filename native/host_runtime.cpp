@@ -64,6 +64,30 @@ struct ProbeTable {
       pos = (pos + 1) & mask;
     }
   }
+
+  // callers with unbounded key universes must grow (a full
+  // fixed-capacity table makes get_or_insert spin forever); the
+  // presized baselines never trigger it
+  void grow_if_needed(int64_t incoming) {
+    if ((next_slot + incoming) * 5
+        <= static_cast<int64_t>(hash.size()) * 3)
+      return;
+    size_t new_cap = hash.size();
+    while ((next_slot + incoming) * 5 > static_cast<int64_t>(new_cap) * 3)
+      new_cap *= 2;
+    std::vector<uint64_t> oh(std::move(hash));
+    std::vector<int64_t> os(std::move(slot));
+    hash.assign(new_cap, 0);
+    slot.assign(new_cap, -1);
+    mask = new_cap - 1;
+    for (size_t i = 0; i < oh.size(); ++i) {
+      if (oh[i] == 0) continue;
+      uint64_t pos = (oh[i] ^ (oh[i] >> 32)) & mask;
+      while (hash[pos] != 0) pos = (pos + 1) & mask;
+      hash[pos] = oh[i];
+      slot[pos] = os[i];
+    }
+  }
 };
 
 }  // namespace
@@ -1201,6 +1225,202 @@ int64_t intern_sum_t(FtInterner& it, FtWordSums& ws, const E* rows,
 }  // namespace
 
 extern "C" {
+
+// Per-record interval-join baseline: the reference's time-bounded
+// stream join work per record (the keyed join ProcessFunction —
+// probe the other side's per-key time-sorted buffer, binary-search
+// the time range, walk the matches), two time-sorted inputs merged
+// in event-time order.  Emission modeled as a checksum touch per
+// pair.  Returns elapsed seconds; pair count via out_pairs.
+double ft_interval_join_baseline(const uint64_t* kh_l, const int64_t* ts_l,
+                                 int64_t nl, const uint64_t* kh_r,
+                                 const int64_t* ts_r, int64_t nr,
+                                 int64_t lower, int64_t upper,
+                                 int64_t capacity_pow2,
+                                 int64_t* out_pairs) {
+  ProbeTable table(capacity_pow2);
+  std::vector<std::vector<int64_t>> buf_l, buf_r;  // per key slot
+  buf_l.reserve(1 << 12);
+  buf_r.reserve(1 << 12);
+  volatile int64_t sink = 0;
+  int64_t pairs = 0, il = 0, ir = 0;
+  double t0 = now_s();
+  while (il < nl || ir < nr) {
+    bool take_left = ir >= nr || (il < nl && ts_l[il] <= ts_r[ir]);
+    uint64_t kh = take_left ? kh_l[il] : kh_r[ir];
+    int64_t ts = take_left ? ts_l[il] : ts_r[ir];
+    int64_t s = table.get_or_insert(kh);
+    if (s >= static_cast<int64_t>(buf_l.size())) {
+      buf_l.resize(s + 1);
+      buf_r.resize(s + 1);
+    }
+    // probe the OTHER side's buffer for the time range
+    // (r.ts - l.ts in [lower, upper])
+    const std::vector<int64_t>& other = take_left ? buf_r[s] : buf_l[s];
+    int64_t lo = take_left ? ts + lower : ts - upper;
+    int64_t hi = take_left ? ts + upper : ts - lower;
+    auto a = std::lower_bound(other.begin(), other.end(), lo);
+    auto b = std::upper_bound(other.begin(), other.end(), hi);
+    for (auto it2 = a; it2 != b; ++it2) {
+      sink += *it2;  // emission touch per pair
+      ++pairs;
+    }
+    (take_left ? buf_l[s] : buf_r[s]).push_back(ts);
+    if (take_left) ++il; else ++ir;
+  }
+  (void)sink;
+  *out_pairs = pairs;
+  return now_s() - t0;
+}
+
+// Batched interval-join engine state: per-key time-sorted row
+// buffers, probed a BATCH at a time with the phases split — slot
+// resolution for the whole batch first (independent probes overlap
+// in the OoO core), then the per-row range searches, then emission —
+// where the per-record baseline above serializes hash -> probe ->
+// search -> emit for every record.  Pairs export as global row ids;
+// the Python side owns the column storage and gathers vectorized.
+
+}  // extern "C"
+
+namespace {
+
+struct IvKeyBuf {
+  std::vector<int64_t> ts;
+  std::vector<int64_t> row;
+  size_t head = 0;  // logical start (pruned prefix)
+};
+
+struct FtIvJoin {
+  int64_t lower, upper;
+  ProbeTable table;
+  std::vector<IvKeyBuf> buf[2];
+  std::vector<int64_t> pairs_l, pairs_r;
+  std::vector<int64_t> slots, counts, perm;  // phase scratch
+  int64_t next_row[2] = {0, 0};
+
+  FtIvJoin(int64_t lo, int64_t up, int64_t cap)
+      : lower(lo), upper(up), table(cap) {}
+};
+
+}  // namespace
+
+extern "C" {
+
+void* ft_ivjoin_new(int64_t lower, int64_t upper, int64_t capacity_pow2) {
+  return new FtIvJoin(lower, upper, capacity_pow2);
+}
+
+void ft_ivjoin_free(void* p) { delete static_cast<FtIvJoin*>(p); }
+
+// Push one batch for `side` (0=left, 1=right): probe the OTHER
+// side's buffers for pairs (r.ts - l.ts in [lower, upper]), then
+// buffer the batch's own rows.  Returns the number of pairs found
+// (fetch with ft_ivjoin_pairs).  Rows get global ids in push order.
+int64_t ft_ivjoin_push(void* p, int64_t side, const uint64_t* kh,
+                       const int64_t* ts, int64_t n) {
+  FtIvJoin& j = *static_cast<FtIvJoin*>(p);
+  j.table.grow_if_needed(n);
+  // phase 1: resolve every row's key slot (independent table probes)
+  j.slots.resize(n);
+  for (int64_t i = 0; i < n; ++i)
+    j.slots[i] = j.table.get_or_insert(kh[i]);
+  int64_t max_slot = j.table.next_slot;
+  if (max_slot > static_cast<int64_t>(j.buf[0].size())) {
+    j.buf[0].resize(max_slot);
+    j.buf[1].resize(max_slot);
+  }
+  // phase 2: stable counting sort of the batch by slot — rows of one
+  // key become one contiguous, still ts-sorted group (the input
+  // batch is time-sorted), so the probe walks each key's buffer ONCE
+  // with two monotone pointers instead of a binary search per row,
+  // and the appends become one bulk insert per touched key.  The
+  // per-record baseline re-probes and re-searches for every record.
+  j.counts.assign(max_slot + 1, 0);
+  for (int64_t i = 0; i < n; ++i) j.counts[j.slots[i]]++;
+  int64_t acc = 0;
+  for (int64_t s = 0; s <= max_slot; ++s) {
+    int64_t c = j.counts[s];
+    j.counts[s] = acc;
+    acc += c;
+  }
+  j.perm.resize(n);
+  {
+    std::vector<int64_t>& off = j.counts;  // running write offsets
+    for (int64_t i = 0; i < n; ++i) j.perm[off[j.slots[i]]++] = i;
+  }
+  // counts[s] now holds the END offset of slot s's group
+  std::vector<IvKeyBuf>& mine = j.buf[side];
+  std::vector<IvKeyBuf>& other = j.buf[1 - side];
+  int64_t base_row = j.next_row[side];
+  int64_t lo_off = side == 0 ? j.lower : -j.upper;
+  int64_t hi_off = side == 0 ? j.upper : -j.lower;
+  int64_t found0 = static_cast<int64_t>(j.pairs_l.size());
+  int64_t g = 0;
+  while (g < n) {
+    int64_t slot = j.slots[j.perm[g]];
+    int64_t g_end = j.counts[slot];
+    IvKeyBuf& ob = other[slot];
+    size_t lo = ob.head, hi = ob.head;
+    const size_t ob_n = ob.ts.size();
+    for (int64_t k = g; k < g_end; ++k) {
+      int64_t i = j.perm[k];
+      int64_t t = ts[i];
+      while (lo < ob_n && ob.ts[lo] < t + lo_off) ++lo;
+      if (hi < lo) hi = lo;
+      while (hi < ob_n && ob.ts[hi] <= t + hi_off) ++hi;
+      for (size_t m = lo; m < hi; ++m) {
+        if (side == 0) {
+          j.pairs_l.push_back(base_row + i);
+          j.pairs_r.push_back(ob.row[m]);
+        } else {
+          j.pairs_l.push_back(ob.row[m]);
+          j.pairs_r.push_back(base_row + i);
+        }
+      }
+    }
+    IvKeyBuf& mb = mine[slot];
+    for (int64_t k = g; k < g_end; ++k) {
+      int64_t i = j.perm[k];
+      mb.ts.push_back(ts[i]);
+      mb.row.push_back(base_row + i);
+    }
+    g = g_end;
+  }
+  j.next_row[side] += n;
+  return static_cast<int64_t>(j.pairs_l.size()) - found0;
+}
+
+// Export and clear the pending pair row ids.
+int64_t ft_ivjoin_pairs(void* p, int64_t* l_out, int64_t* r_out) {
+  FtIvJoin& j = *static_cast<FtIvJoin*>(p);
+  int64_t k = static_cast<int64_t>(j.pairs_l.size());
+  std::memcpy(l_out, j.pairs_l.data(), sizeof(int64_t) * k);
+  std::memcpy(r_out, j.pairs_r.data(), sizeof(int64_t) * k);
+  j.pairs_l.clear();
+  j.pairs_r.clear();
+  return k;
+}
+
+// Drop rows no longer joinable at watermark `wm` (left rows once
+// wm >= ts + upper, right rows once wm >= ts - lower); buffers use a
+// logical head + periodic compaction.
+void ft_ivjoin_prune(void* p, int64_t wm) {
+  FtIvJoin& j = *static_cast<FtIvJoin*>(p);
+  for (int side = 0; side < 2; ++side) {
+    int64_t horizon = side == 0 ? j.upper : -j.lower;
+    for (IvKeyBuf& b : j.buf[side]) {
+      size_t h = b.head;
+      while (h < b.ts.size() && b.ts[h] + horizon <= wm) ++h;
+      b.head = h;
+      if (b.head > 64 && b.head * 2 > b.ts.size()) {
+        b.ts.erase(b.ts.begin(), b.ts.begin() + b.head);
+        b.row.erase(b.row.begin(), b.row.begin() + b.head);
+        b.head = 0;
+      }
+    }
+  }
+}
 
 // Fused intern + windowed sum (the wordcount_str engine's ingest).
 // weights may be null (count semantics).  Returns the number of NEW
