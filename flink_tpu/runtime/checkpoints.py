@@ -51,41 +51,89 @@ class CheckpointStorage:
     def checkpoint_ids(self) -> List[int]:
         raise NotImplementedError
 
+    def materialize(self, task_snapshots):
+        """Resolve every SharedChunk to its full payload (savepoints
+        must be self-contained).  Chunks carrying payloads pass
+        through; elided ones fetch from this storage's registry."""
+        from flink_tpu.state.shared_registry import (ChunkRef,
+                                                     SharedChunk,
+                                                     map_chunks)
+
+        def fetch(c):
+            if isinstance(c, SharedChunk) and c.payload is not None:
+                return c.payload
+            return self._fetch_shared(c.hash)
+
+        return map_chunks(task_snapshots, fetch,
+                          kinds=(SharedChunk, ChunkRef))
+
+    def _fetch_shared(self, h: str):
+        raise KeyError(f"no shared chunk store for {h}")
+
 
 class MemoryCheckpointStorage(CheckpointStorage):
     """In-memory retained checkpoints (ref: MemoryStateBackend /
-    `jobmanager` shortcut in StateBackendLoader.java:92-109)."""
+    `jobmanager` shortcut in StateBackendLoader.java:92-109).
+    SharedChunk-wrapped state dedupes against retained checkpoints
+    (incremental checkpoints, SharedStateRegistry.java role)."""
 
     def __init__(self, retain: int = 1):
+        from flink_tpu.state.shared_registry import SharedStateRegistry
         self.retain = retain
         self._store: Dict[int, dict] = {}
+        self._chunks: Dict[str, Any] = {}
+        self.registry = SharedStateRegistry(
+            store=self._chunks.__setitem__,
+            delete=lambda h: self._chunks.pop(h, None),
+            exists=self._chunks.__contains__)
 
     def persist(self, checkpoint_id, metadata, task_snapshots):
+        tasks = self.registry.register_checkpoint(checkpoint_id,
+                                                  task_snapshots)
         self._store[checkpoint_id] = {
             "checkpoint_id": checkpoint_id,
             "metadata": metadata,
-            "tasks": task_snapshots,
+            "tasks": tasks,
         }
         for cid in sorted(self._store)[:-self.retain]:
             del self._store[cid]
+            self.registry.release_checkpoint(cid)
         # the reference MemoryStateBackend also serializes (handles are
-        # byte arrays), so measuring here is faithful, not extra cost
+        # byte arrays), so measuring here is faithful, not extra cost.
+        # Size = reference skeleton + chunks NEWLY stored by this
+        # checkpoint: unchanged (deduped) state is ~0 bytes
         try:
-            return len(pickle.dumps(task_snapshots,
+            size = len(pickle.dumps(tasks,
                                     protocol=pickle.HIGHEST_PROTOCOL))
+            for h in self.registry.last_new_hashes:
+                size += len(pickle.dumps(self._chunks[h],
+                                         protocol=pickle.HIGHEST_PROTOCOL))
+            return size
         except Exception:  # noqa: BLE001 — unpicklable state: size unknown
             return None
+
+    def _resolve(self, entry):
+        if entry is None:
+            return None
+        from flink_tpu.state.shared_registry import ChunkRef, map_chunks
+        return {**entry,
+                "tasks": map_chunks(entry["tasks"],
+                                    lambda r: self._chunks[r.hash]
+                                    if isinstance(r, ChunkRef) else r)}
 
     def latest(self):
         if not self._store:
             return None
-        return self._store[max(self._store)]
+        return self._resolve(self._store[max(self._store)])
 
     def load(self, checkpoint_id):
-        return self._store.get(checkpoint_id)
+        return self._resolve(self._store.get(checkpoint_id))
 
     def checkpoint_ids(self):
         return sorted(self._store)
+
+    def _fetch_shared(self, h):
+        return self._chunks[h]
 
 
 class FsCheckpointStorage(CheckpointStorage):
@@ -98,29 +146,76 @@ class FsCheckpointStorage(CheckpointStorage):
 
     def __init__(self, directory: str, retain: int = 1):
         from flink_tpu.core.fs import get_file_system
+        from flink_tpu.state.shared_registry import SharedStateRegistry
         self.fs, self.directory = get_file_system(directory)
         self.retain = retain
         self.fs.makedirs(self.directory)
+        self._shared_dir = f"{self.directory.rstrip('/')}/shared"
+        self.fs.makedirs(self._shared_dir)
+        self.registry = SharedStateRegistry(
+            store=self._store_chunk,
+            delete=self._delete_chunk,
+            exists=lambda h: self.fs.exists(f"{self._shared_dir}/{h}"))
+        self._adopted: Set[int] = set()
+        self._chunk_sizes: Dict[str, int] = {}
+        # fresh-process recovery: adopt EVERY retained checkpoint's
+        # chunk refs up front, so rotation decrefs (and eventually
+        # deletes) chunks of pre-restart checkpoints instead of
+        # orphaning them on disk
+        for cid in self.checkpoint_ids():
+            try:
+                with self.fs.open(self._path(cid), "rb") as f:
+                    entry = pickle.load(f)
+                self.registry.adopt_checkpoint(cid, entry["tasks"])
+                self._adopted.add(cid)
+            except Exception:  # noqa: BLE001 — unreadable old file:
+                pass           # rotation will still remove its chk-N
 
     def _path(self, checkpoint_id: int) -> str:
         return f"{self.directory.rstrip('/')}/chk-{checkpoint_id}"
 
+    def _store_chunk(self, h: str, payload) -> None:
+        tmp = f"{self._shared_dir}/{h}.part"
+        with self.fs.open(tmp, "wb") as f:
+            pickle.dump(payload, f, protocol=pickle.HIGHEST_PROTOCOL)
+            self._chunk_sizes[h] = f.tell()
+        self.fs.replace(tmp, f"{self._shared_dir}/{h}")
+
+    def _delete_chunk(self, h: str) -> None:
+        try:
+            self.fs.remove(f"{self._shared_dir}/{h}")
+        except OSError:
+            pass
+
+    def _fetch_chunk(self, h: str):
+        with self.fs.open(f"{self._shared_dir}/{h}", "rb") as f:
+            return pickle.load(f)
+
+    _fetch_shared = _fetch_chunk
+
     def persist(self, checkpoint_id, metadata, task_snapshots):
+        tasks = self.registry.register_checkpoint(checkpoint_id,
+                                                  task_snapshots)
         payload = {
             "checkpoint_id": checkpoint_id,
             "metadata": metadata,
-            "tasks": task_snapshots,
+            "tasks": tasks,
         }
         tmp = self._path(checkpoint_id) + ".part"
         with self.fs.open(tmp, "wb") as f:
             pickle.dump(payload, f, protocol=pickle.HIGHEST_PROTOCOL)
             size = f.tell()
+        # count chunks NEWLY written by this checkpoint (incremental
+        # bytes); deduped chunks cost nothing
+        size += sum(self._chunk_sizes.get(h, 0)
+                    for h in self.registry.last_new_hashes)
         self.fs.replace(tmp, self._path(checkpoint_id))
         for cid in self.checkpoint_ids()[:-self.retain]:
             try:
                 self.fs.remove(self._path(cid))
             except OSError:
                 pass
+            self.registry.release_checkpoint(cid)
         return size
 
     def latest(self):
@@ -128,11 +223,30 @@ class FsCheckpointStorage(CheckpointStorage):
         return self.load(ids[-1]) if ids else None
 
     def load(self, checkpoint_id):
+        from flink_tpu.state.shared_registry import ChunkRef, map_chunks
         path = self._path(checkpoint_id)
         if not self.fs.exists(path):
             return None
         with self.fs.open(path, "rb") as f:
-            return pickle.load(f)
+            entry = pickle.load(f)
+        if checkpoint_id not in self.registry._by_checkpoint \
+                and checkpoint_id not in self._adopted:
+            # recovery in a fresh process: re-register the retained
+            # checkpoint's chunk references so future retention
+            # rotation refcounts them correctly
+            self.registry.adopt_checkpoint(checkpoint_id,
+                                           entry["tasks"])
+            self._adopted.add(checkpoint_id)
+        cache: Dict[str, Any] = {}
+
+        def fetch(r):
+            if not isinstance(r, ChunkRef):
+                return r
+            if r.hash not in cache:
+                cache[r.hash] = self._fetch_chunk(r.hash)
+            return cache[r.hash]
+
+        return {**entry, "tasks": map_chunks(entry["tasks"], fetch)}
 
     def checkpoint_ids(self):
         ids = []
@@ -189,8 +303,17 @@ class CheckpointStats:
     def __init__(self, checkpoint_id: int, trigger_ms: float):
         self.checkpoint_id = checkpoint_id
         self.trigger_ms = trigger_ms
+        #: all acks in — the processing-loop-blocking (sync) part ends
+        self.sync_ms: Optional[float] = None
+        #: durably persisted (includes the async write)
         self.complete_ms: Optional[float] = None
         self.state_bytes = 0
+
+    @property
+    def sync_duration_ms(self) -> Optional[float]:
+        if self.sync_ms is None:
+            return None
+        return self.sync_ms - self.trigger_ms
 
     @property
     def duration_ms(self) -> Optional[float]:
@@ -269,7 +392,8 @@ class CheckpointCoordinator:
                  min_pause_ms: int = 0,
                  max_concurrent: int = 1,
                  clock: Callable[[], float] = None,
-                 metadata_extra: Optional[dict] = None):
+                 metadata_extra: Optional[dict] = None,
+                 async_persist: bool = False):
         #: merged into every completed checkpoint's metadata (e.g. the
         #: JobMaster's master_epoch + attempt — the provenance local
         #: recovery needs, since bare checkpoint ids are reused across
@@ -302,11 +426,28 @@ class CheckpointCoordinator:
         self._savepoint_cids: Dict[int, SavepointRequest] = {}
         #: vertex_id -> parallelism, recorded into savepoints
         self.vertex_parallelisms: Dict[int, int] = {}
+        # asynchronous snapshot materialization (ref: the async part
+        # of the backends' snapshot strategies — CopyOnWriteStateTable
+        # :41-84 lets processing continue while state materializes):
+        # acks are collected on the processing loop, but the persist
+        # (pickle + storage IO) runs on a single writer thread; the
+        # checkpoint COMPLETES (counted, operators notified) only when
+        # the write lands — drained back onto the loop thread, so the
+        # durable-then-notify 2PC ordering holds.  One write in
+        # flight; a second completion waits (maxConcurrent semantics).
+        self.async_persist = async_persist
+        self._writer: Optional[threading.Thread] = None
+        self._write_queue: deque = deque()
+        self._write_event = threading.Event()
+        self._done_queue: deque = deque()
+        self._inflight = 0
+        self._inflight_lock = threading.Lock()
 
     # ---- trigger ----------------------------------------------------
     def maybe_trigger(self) -> Optional[int]:
         """Called from the executor loop; triggers when the interval has
         elapsed (ref: the coordinator's ScheduledTrigger)."""
+        self._drain_completions()
         if self.stopped:
             return None
         now = self._clock()
@@ -392,14 +533,98 @@ class CheckpointCoordinator:
         self.pending.clear()
 
     def _complete(self, pc: PendingCheckpoint) -> None:
-        """(ref: completePendingCheckpoint :802)"""
+        """(ref: completePendingCheckpoint :802).  The sync part ends
+        here — acks are in; stats record it as sync_ms.  Persistence
+        runs on the writer thread (async_persist) and completion
+        bookkeeping + notifications drain back onto the loop."""
         del self.pending[pc.checkpoint_id]
         now = self._clock()
-        state_bytes = self.storage.persist(
-            pc.checkpoint_id,
-            {"timestamp": pc.timestamp, "mode": self.mode,
-             **self.metadata_extra},
-            pc.acks)
+        st = self.stats.get(pc.checkpoint_id)
+        if st is not None:
+            st.sync_ms = now
+        req = self._savepoint_cids.pop(pc.checkpoint_id, None)
+        if self.async_persist and req is None:
+            self._submit_write(pc)
+            return
+        # savepoints stay synchronous: the requester blocks on the
+        # result and expects a self-contained artifact.  Wait out any
+        # in-flight async write first — the storage/registry are not
+        # safe under concurrent persists, and completion order must
+        # stay ascending by checkpoint id
+        self._drain_completions(wait=True)
+        self._finish(pc, *self._do_persist(pc), req)
+
+    def _do_persist(self, pc: PendingCheckpoint):
+        try:
+            state_bytes = self.storage.persist(
+                pc.checkpoint_id,
+                {"timestamp": pc.timestamp, "mode": self.mode,
+                 **self.metadata_extra},
+                pc.acks)
+            return state_bytes, None
+        except Exception as e:  # noqa: BLE001 — a failed write aborts
+            # this checkpoint, not the job (ref: abort on IO failure)
+            return None, e
+
+    def _submit_write(self, pc: PendingCheckpoint) -> None:
+        if self._writer is None:
+            self._writer = threading.Thread(
+                target=self._writer_loop, name="checkpoint-writer",
+                daemon=True)
+            self._writer.start()
+        with self._inflight_lock:
+            self._inflight += 1
+        self._write_queue.append(pc)
+        self._write_event.set()
+
+    def _writer_loop(self) -> None:
+        while True:
+            self._write_event.wait(0.5)
+            while self._write_queue:
+                pc = self._write_queue.popleft()
+                result = self._do_persist(pc)
+                self._done_queue.append((pc, result))
+                with self._inflight_lock:
+                    self._inflight -= 1
+            self._write_event.clear()
+            if self.stopped and not self._write_queue:
+                return
+
+    def _drain_completions(self, wait: bool = False) -> None:
+        """Run completion bookkeeping for persisted checkpoints on the
+        CALLER's thread (the processing loop) — notifications must not
+        race operator state.  wait=True blocks until every in-flight
+        write lands (recovery / job end)."""
+        if wait:
+            while True:
+                with self._inflight_lock:
+                    if self._inflight == 0 and not self._write_queue:
+                        break
+                _time.sleep(0.001)
+        while self._done_queue:
+            pc, (state_bytes, err) = self._done_queue.popleft()
+            self._finish(pc, state_bytes, err, None)
+
+    def drain(self) -> None:
+        """Block until in-flight checkpoint writes complete and their
+        notifications have run (call from the loop thread before
+        recovery reads or job teardown)."""
+        self._drain_completions(wait=True)
+
+    def _finish(self, pc: PendingCheckpoint, state_bytes, err,
+                req: Optional[SavepointRequest]) -> None:
+        now = self._clock()
+        if err is not None:
+            # a failed persist fails the JOB (the reference's
+            # tolerable-failed-checkpoints default is 0): silent
+            # checkpoint stalls would let 2PC sinks commit against an
+            # ever-staler recovery point.  _finish always runs on the
+            # loop thread (sync path or drained), so the raise
+            # surfaces as a task/job failure
+            self.stats.pop(pc.checkpoint_id, None)
+            if req is not None:
+                req.fail(err)
+            raise err
         self.completed_count += 1
         self.latest_completed_id = pc.checkpoint_id
         self._last_completed_at = now
@@ -407,19 +632,20 @@ class CheckpointCoordinator:
         if st is not None:
             st.complete_ms = now
             st.state_bytes = state_bytes if state_bytes is not None else -1
-        req = self._savepoint_cids.pop(pc.checkpoint_id, None)
         if req is not None:
             try:
                 path = write_savepoint(
                     req.directory, pc.checkpoint_id,
                     {"timestamp": pc.timestamp, "savepoint": True},
-                    pc.acks, dict(self.vertex_parallelisms))
+                    self.storage.materialize(pc.acks),
+                    dict(self.vertex_parallelisms))
                 req.complete(path)
             except Exception as e:  # noqa: BLE001 — IO or pickling:
                 # the waiting client must get the error, not a timeout,
                 # and the job must not fail over a savepoint write
                 req.fail(e)
-        # commit signal (ref: notifyCheckpointComplete :883)
+        # commit signal (ref: notifyCheckpointComplete :883) — runs
+        # strictly after the durable write (2PC ordering)
         self._notify_complete(pc.checkpoint_id)
 
 

@@ -665,6 +665,7 @@ class JobMaster(RpcEndpoint):
                 trigger_sources=trigger_sources,
                 notify_complete=notify_complete,
                 min_pause_ms=cp_cfg.get("min_pause", 0),
+                async_persist=bool(cp_cfg.get("async_persist", False)),
                 metadata_extra={"master_epoch": self.master_epoch,
                                 "attempt": attempt},
             )
@@ -718,6 +719,10 @@ class JobMaster(RpcEndpoint):
         finally:
             if coordinator is not None:
                 self._live_coordinator = None
+                try:
+                    coordinator.drain()  # land in-flight async writes
+                except Exception:  # noqa: BLE001 — teardown: the attempt's
+                    pass               # outcome is already decided
                 self.checkpoints_completed += coordinator.completed_count
                 coordinator.stopped = True
         drain_acks()

@@ -307,6 +307,7 @@ class MiniCluster:
                 trigger_sources=trigger_sources,
                 notify_complete=notify_complete,
                 min_pause_ms=cfg.get("min_pause", 0),
+                async_persist=bool(cfg.get("async_persist", False)),
             )
             coordinator.vertex_parallelisms = {
                 vid: v.parallelism for vid, v in job_graph.vertices.items()}
@@ -339,6 +340,10 @@ class MiniCluster:
             gather_accumulators(all_tasks, result.accumulators)
         finally:
             if coordinator is not None:
+                try:
+                    coordinator.drain()  # land in-flight async writes
+                except Exception:  # noqa: BLE001 — teardown: the attempt's
+                    pass               # outcome is already decided
                 result.checkpoints_completed = (
                     getattr(result, "_cp_base", 0)
                     + coordinator.completed_count)

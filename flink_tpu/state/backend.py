@@ -43,28 +43,64 @@ VOID_NAMESPACE = ()
 class KeyedStateSnapshot:
     """Serialized keyed state, chunked per key group.
 
-    `key_group_bytes[kg]` is an opaque bytes blob for key group `kg`;
+    `key_group_bytes[kg]` is an opaque blob for key group `kg`;
     restore feeds each chunk whose key group falls in the new backend's
     range (ref: KeyGroupsStateHandle.java + KeyGroupRangeOffsets.java —
     here chunks are explicit instead of offsets into one stream).
+
+    Each key group's blob is wrapped as a content-addressed
+    SharedChunk: checkpoint storage stores every distinct chunk ONCE
+    across retained checkpoints, so an untouched key group contributes
+    ~0 bytes to the next checkpoint (the incremental-checkpoint seam,
+    ref: RocksDBKeyedStateBackend incremental snapshots +
+    SharedStateRegistry.java).  Consumers read via ``blobs()``, which
+    hands back raw bytes whether the snapshot is freshly taken
+    (wrapped), storage-resolved (raw), or mixed (after intersect).
     """
 
     __slots__ = ("key_group_bytes", "meta")
 
-    def __init__(self, key_group_bytes: Dict[int, bytes], meta: Optional[dict] = None):
+    def __init__(self, key_group_bytes: Dict[int, bytes],
+                 meta: Optional[dict] = None, wrap: bool = True):
+        if wrap:
+            from flink_tpu.state.shared_registry import SharedChunk
+            key_group_bytes = {
+                kg: b if isinstance(b, SharedChunk) else SharedChunk(b)
+                for kg, b in key_group_bytes.items()}
         self.key_group_bytes = key_group_bytes
         self.meta = meta or {}
 
+    def blobs(self):
+        """Yields (key_group, raw_bytes)."""
+        from flink_tpu.state.shared_registry import SharedChunk
+        for kg, b in self.key_group_bytes.items():
+            yield kg, (b.payload if isinstance(b, SharedChunk) else b)
+
     @property
     def total_bytes(self) -> int:
-        return sum(len(b) for b in self.key_group_bytes.values())
+        return sum(len(b) for _, b in self.blobs() if b is not None)
 
     def intersect(self, key_group_range: KeyGroupRange) -> "KeyedStateSnapshot":
         return KeyedStateSnapshot(
             {kg: b for kg, b in self.key_group_bytes.items()
              if key_group_range.contains(kg)},
             dict(self.meta),
+            wrap=False,
         )
+
+    def _map_chunks_(self, fn):
+        """shared_registry.map_chunks protocol: rebuild with every
+        chunk node replaced (registration / resolution)."""
+        from flink_tpu.state.shared_registry import ChunkRef, SharedChunk
+        mapped = {}
+        changed = False
+        for kg, b in self.key_group_bytes.items():
+            nb = fn(b) if isinstance(b, (SharedChunk, ChunkRef)) else b
+            changed = changed or nb is not b
+            mapped[kg] = nb
+        if not changed:
+            return self
+        return KeyedStateSnapshot(mapped, dict(self.meta), wrap=False)
 
 
 class KeyedStateBackend(abc.ABC):

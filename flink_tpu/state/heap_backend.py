@@ -342,7 +342,7 @@ class HeapKeyedStateBackend(KeyedStateBackend):
         for table in self._tables.values():
             table.by_namespace.clear()
         for snap in snapshots:
-            for kg, blob in snap.key_group_bytes.items():
+            for kg, blob in snap.blobs():
                 if not self.key_group_range.contains(kg):
                     continue
                 chunk = pickle.loads(blob)

@@ -591,7 +591,7 @@ class TpuKeyedStateBackend(KeyedStateBackend):
             dstate._pending_lo.clear()
         pending_device: Dict[str, list] = defaultdict(list)
         for snap in snapshots:
-            for kg, blob in snap.key_group_bytes.items():
+            for kg, blob in snap.blobs():
                 if not self.key_group_range.contains(kg):
                     continue
                 chunk = pickle.loads(blob)

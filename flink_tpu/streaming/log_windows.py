@@ -63,19 +63,23 @@ from flink_tpu.ops.sketches import (
 
 
 class _WindowLog:
-    """Columnar append log for one window (or pane)."""
+    """Columnar append log for one window (or pane).  ``version``
+    counts mutations — an unchanged version means the snapshot chunk
+    hash can be reused (incremental-checkpoint seam)."""
 
-    __slots__ = ("keys", "cols", "count")
+    __slots__ = ("keys", "cols", "count", "version")
 
     def __init__(self):
         self.keys: List[np.ndarray] = []
         self.cols: List[Tuple[np.ndarray, ...]] = []
         self.count = 0
+        self.version = 0
 
     def append(self, keys: np.ndarray, *cols: np.ndarray) -> None:
         self.keys.append(keys)
         self.cols.append(cols)
         self.count += len(keys)
+        self.version += 1
 
     def concat(self) -> Tuple[np.ndarray, Tuple[np.ndarray, ...]]:
         keys = (self.keys[0] if len(self.keys) == 1
@@ -105,18 +109,20 @@ class _SumTabLog:
     cardinality outgrows it (the sort+reduce fire then wins).  Same
     interface as _WindowLog."""
 
-    __slots__ = ("tab", "log", "max_distinct")
+    __slots__ = ("tab", "log", "max_distinct", "version")
 
     def __init__(self, max_distinct: int = 1 << 19):
         self.tab = nat.NativeSumTable()  # starts small, grows
         self.log: Optional[_WindowLog] = None
         self.max_distinct = max_distinct
+        self.version = 0
 
     @property
     def count(self) -> int:
         return self.tab.n if self.log is None else self.log.count
 
     def append(self, keys: np.ndarray, values: np.ndarray) -> None:
+        self.version += 1
         if self.log is None:
             values = np.asarray(values, np.float64)
             consumed = self.tab.ingest(keys, values, self.max_distinct)
@@ -373,6 +379,9 @@ class LogStructuredTumblingWindows:
         #: signed input keys ride as their uint64 bit pattern and view
         #: back at fire (locked on the first batch)
         self._keys_signed = None
+        #: window start -> (log version, chunk hash) — skips
+        #: re-hashing unchanged windows at snapshot time
+        self._chunk_cache: Dict[int, Tuple[int, str]] = {}
 
     # ---- ingestion --------------------------------------------------
     def process_batch(self, keys, timestamps, values=None,
@@ -440,11 +449,37 @@ class LogStructuredTumblingWindows:
 
     # ---- checkpoint integration ------------------------------------
     def snapshot(self) -> dict:
+        """Per-window compacted logs as content-addressed SharedChunks
+        — the storage stores each distinct chunk once across retained
+        checkpoints, so a window that received no records since the
+        last checkpoint re-uploads ~0 bytes (round-2 verdict item 4;
+        ref role: the RocksDB backend's per-SST incremental upload).
+        A version cache skips re-hashing untouched windows; payloads
+        stay attached so local-recovery restores never need the
+        storage registry."""
+        from flink_tpu.state.shared_registry import SharedChunk
         wins = {}
+        live_starts = set()
         for start, log in self.windows.items():
+            start = int(start)
+            live_starts.add(start)
+            cached = self._chunk_cache.get(start)
             keys, cols = log.concat()
-            wins[int(start)] = {"keys": keys.copy(),
-                                "cols": [c.copy() for c in cols]}
+            # ALWAYS copy: the payload may be stored by any retained
+            # checkpoint (even one whose predecessor aborted before
+            # registering), so it must never alias live arrays.  The
+            # version cache only skips the re-HASH.
+            payload = {"keys": keys.copy(),
+                       "cols": [c.copy() for c in cols]}
+            if cached is not None and cached[0] == log.version:
+                wins[start] = SharedChunk(payload, chunk_hash=cached[1])
+                continue
+            chunk = SharedChunk(payload)
+            self._chunk_cache[start] = (log.version, chunk.hash)
+            wins[start] = chunk
+        for start in list(self._chunk_cache):
+            if start not in live_starts:
+                del self._chunk_cache[start]
         return {"mode": self.mode.name, "size": self.size,
                 "watermark": self.watermark,
                 "num_late_dropped": self.num_late_dropped,
@@ -455,13 +490,17 @@ class LogStructuredTumblingWindows:
                 "fired_horizon": getattr(self, "_fired_horizon", None)}
 
     def restore(self, snap: dict) -> None:
+        from flink_tpu.state.shared_registry import SharedChunk
         self.watermark = snap["watermark"]
         self.num_late_dropped = snap["num_late_dropped"]
         self._keys_signed = snap.get("keys_signed")
         if snap.get("fired_horizon") is not None:
             self._fired_horizon = snap["fired_horizon"]
         self.windows = {}
+        self._chunk_cache = {}
         for start, w in snap["windows"].items():
+            if isinstance(w, SharedChunk):  # un-resolved (local) path
+                w = w.payload
             log = self.mode.new_log()
             log.append(np.asarray(w["keys"], np.uint64),
                        *(np.asarray(c) for c in w["cols"]))

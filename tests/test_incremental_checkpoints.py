@@ -1,0 +1,243 @@
+"""Incremental + asynchronous checkpoints (round-3, verdict item 4).
+
+ref: RocksDBKeyedStateBackend.java:342-381 (upload only new SSTs),
+SharedStateRegistry.java:42 (refcounted sharing),
+CopyOnWriteStateTable.java:41-84 (processing continues while the
+snapshot materializes)."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from flink_tpu.ops.device_agg import SumAggregate
+from flink_tpu.runtime.checkpoints import (
+    CheckpointCoordinator,
+    FsCheckpointStorage,
+    MemoryCheckpointStorage,
+)
+from flink_tpu.state.shared_registry import (
+    ChunkRef,
+    SharedChunk,
+    SharedStateRegistry,
+    content_hash,
+    find_chunks,
+)
+from flink_tpu.streaming.log_windows import LogStructuredTumblingWindows
+
+
+def _chunked_snapshot(payloads):
+    return {(1, 0): {"windows": {s: SharedChunk(p)
+                                 for s, p in payloads.items()}}}
+
+
+def test_unchanged_chunks_cost_zero_bytes():
+    """Checkpoint N+1 re-uploads nothing for unchanged chunks: the
+    persisted size collapses to references."""
+    storage = MemoryCheckpointStorage(retain=2)
+    big = {"keys": np.arange(200_000, dtype=np.uint64)}
+    size1 = storage.persist(1, {}, _chunked_snapshot({0: big}))
+    size2 = storage.persist(2, {}, _chunked_snapshot({0: big}))
+    assert size1 > 1_000_000          # the payload was written once
+    assert size2 < 2_000              # the repeat is a reference
+    # both checkpoints resolve to the full payload
+    for cid in (1, 2):
+        loaded = storage.load(cid)
+        w = loaded["tasks"][(1, 0)]["windows"][0]
+        assert np.array_equal(w["keys"], big["keys"])
+
+
+def test_chunk_refcount_and_retention():
+    storage = MemoryCheckpointStorage(retain=2)
+    a = {"x": np.ones(1000)}
+    b = {"x": np.zeros(1000)}
+    storage.persist(1, {}, _chunked_snapshot({0: a}))
+    storage.persist(2, {}, _chunked_snapshot({0: a, 1: b}))
+    assert len(storage._chunks) == 2
+    # checkpoint 3 drops chunk a's last reference once cp1 rotates out
+    storage.persist(3, {}, _chunked_snapshot({1: b}))
+    # cp1 evicted (retain=2); chunk a still referenced by cp2
+    assert len(storage._chunks) == 2
+    storage.persist(4, {}, _chunked_snapshot({1: b}))
+    # cp2 evicted -> chunk a unreferenced -> deleted
+    assert set(storage._chunks) == {content_hash(b)}
+
+
+def test_fs_storage_chunks_and_fresh_process_recovery(tmp_path):
+    d = str(tmp_path / "chk")
+    storage = FsCheckpointStorage(d, retain=2)
+    big = {"keys": np.arange(100_000, dtype=np.uint64)}
+    size1 = storage.persist(1, {}, _chunked_snapshot({0: big}))
+    size2 = storage.persist(2, {}, _chunked_snapshot({0: big}))
+    assert size2 < size1 / 50
+    # a FRESH storage over the same directory (process restart):
+    # load resolves chunks and adopts their refs for future rotation
+    s2 = FsCheckpointStorage(d, retain=2)
+    loaded = s2.latest()
+    w = loaded["tasks"][(1, 0)]["windows"][0]
+    assert np.array_equal(w["keys"], big["keys"])
+    # rotation after recovery eventually deletes the adopted chunk
+    small = {"k": np.ones(10)}
+    s2.persist(3, {}, _chunked_snapshot({1: small}))
+    s2.persist(4, {}, _chunked_snapshot({1: small}))
+    s2.persist(5, {}, _chunked_snapshot({1: small}))
+    assert s2.latest()["checkpoint_id"] == 5
+
+
+def test_payload_elision_requires_known_hash():
+    storage = MemoryCheckpointStorage(retain=2)
+    payload = {"x": np.ones(10)}
+    h = content_hash(payload)
+    with pytest.raises(KeyError, match="elided"):
+        storage.persist(1, {}, {(1, 0): SharedChunk(None, h)})
+    storage.persist(2, {}, {(1, 0): SharedChunk(payload)})
+    storage.persist(3, {}, {(1, 0): SharedChunk(None, h)})  # now fine
+    assert np.array_equal(storage.load(3)["tasks"][(1, 0)]["x"],
+                          payload["x"])
+
+
+def test_log_engine_unchanged_window_reuses_chunk_hash():
+    """The log tier's per-window chunks: a window with no new records
+    keeps its content hash (and skips re-hashing via the version
+    cache), so consecutive checkpoints dedupe it."""
+    eng = LogStructuredTumblingWindows(SumAggregate(np.float64), 1000)
+    keys = np.arange(5000, dtype=np.uint64)
+    eng.process_batch(keys, np.full(5000, 100), np.ones(5000))
+    eng.process_batch(keys[:10], np.full(10, 1100), np.ones(10))
+    s1 = eng.snapshot()
+    chunks1 = {}
+    for start, c in s1["windows"].items():
+        chunks1[start] = c.hash
+    # new data ONLY into window 1000
+    eng.process_batch(keys[:5], np.full(5, 1150), np.ones(5))
+    s2 = eng.snapshot()
+    assert s2["windows"][0].hash == chunks1[0]          # untouched
+    assert s2["windows"][1000].hash != chunks1[1000]    # grew
+    # storage-level: second checkpoint re-uploads only window 1000
+    storage = MemoryCheckpointStorage(retain=2)
+    sz1 = storage.persist(1, {}, {(1, 0): s1})
+    sz2 = storage.persist(2, {}, {(1, 0): s2})
+    assert sz2 < sz1 / 10
+    # and the restored engine equals a straight-through run
+    restored = LogStructuredTumblingWindows(SumAggregate(np.float64), 1000)
+    restored.restore(storage.load(2)["tasks"][(1, 0)])
+    restored.advance_watermark(10_000)
+    eng.advance_watermark(10_000)
+    assert sorted(map(tuple, restored.emitted)) == \
+        sorted(map(tuple, eng.emitted))
+
+
+def test_keyed_backend_per_key_group_chunks_dedupe():
+    """Heap/TPU backend snapshots chunk per key group; untouched key
+    groups dedupe across checkpoints."""
+    from flink_tpu.core.keygroups import KeyGroupRange
+    from flink_tpu.core.state import ValueStateDescriptor
+    from flink_tpu.state.heap_backend import HeapKeyedStateBackend
+    be = HeapKeyedStateBackend(KeyGroupRange(0, 127), 128)
+    desc = ValueStateDescriptor("v")
+    for k in range(2000):
+        be.set_current_key(k)
+        be.get_partitioned_state((), desc).update(k)
+    snap1 = be.snapshot()
+    storage = MemoryCheckpointStorage(retain=2)
+    sz1 = storage.persist(1, {}, {(1, 0): snap1})
+    # touch ONE key -> only its key group's chunk changes
+    be.set_current_key(7)
+    be.get_partitioned_state((), desc).update(-1)
+    snap2 = be.snapshot()
+    sz2 = storage.persist(2, {}, {(1, 0): snap2})
+    assert sz2 < sz1 / 4  # 1 kg chunk + the 128-entry ref skeleton
+    changed = [h for h in
+               {c.hash for c in find_chunks(snap2, [],
+                                            (SharedChunk,))}
+               - {c.hash for c in find_chunks(snap1, [],
+                                              (SharedChunk,))}]
+    assert len(changed) == 1  # exactly one key group re-uploaded
+
+
+class _SlowStorage(MemoryCheckpointStorage):
+    def __init__(self, delay_s):
+        super().__init__(retain=2)
+        self.delay_s = delay_s
+        self.persist_thread_names = []
+
+    def persist(self, checkpoint_id, metadata, task_snapshots):
+        self.persist_thread_names.append(threading.current_thread().name)
+        time.sleep(self.delay_s)
+        return super().persist(checkpoint_id, metadata, task_snapshots)
+
+
+def test_async_persist_off_barrier_path():
+    """Acks complete the sync phase immediately; the write lands on
+    the writer thread; notification runs after durability (2PC
+    ordering) on the loop thread via drain."""
+    notified = []
+    storage = _SlowStorage(0.15)
+    coord = CheckpointCoordinator(
+        interval_ms=None, mode="exactly_once", storage=storage,
+        expected_tasks={(1, 0)},
+        trigger_sources=lambda cid, ts, opts: None,
+        notify_complete=notified.append, async_persist=True)
+    cid = coord.trigger()
+    t0 = time.perf_counter()
+    coord.acknowledge((1, 0), cid, {"s": 1})
+    sync_elapsed = time.perf_counter() - t0
+    assert sync_elapsed < 0.05          # ack path did NOT block on IO
+    assert coord.completed_count == 0   # not yet durable
+    assert notified == []
+    st = coord.stats[cid]
+    assert st.sync_duration_ms is not None and st.complete_ms is None
+    coord.drain()                        # loop thread lands completion
+    assert coord.completed_count == 1
+    assert notified == [cid]
+    assert st.complete_ms is not None
+    assert st.duration_ms >= 150         # includes the slow write
+    assert st.sync_duration_ms < st.duration_ms
+    assert storage.persist_thread_names == ["checkpoint-writer"]
+
+
+def test_async_persist_visible_after_drain_for_recovery():
+    storage = _SlowStorage(0.1)
+    coord = CheckpointCoordinator(
+        interval_ms=None, mode="exactly_once", storage=storage,
+        expected_tasks={(1, 0)},
+        trigger_sources=lambda cid, ts, opts: None,
+        notify_complete=lambda cid: None, async_persist=True)
+    cid = coord.trigger()
+    coord.acknowledge((1, 0), cid, {"s": 42})
+    coord.drain()
+    latest = storage.latest()
+    assert latest is not None and latest["tasks"][(1, 0)]["s"] == 42
+
+
+def test_async_persist_end_to_end_job(tmp_path):
+    """A checkpointed job with async_persist on: completes, stats show
+    the sync (ack) phase separate from the durable completion, and
+    the final state restores."""
+    from flink_tpu.streaming.datastream import StreamExecutionEnvironment
+    from flink_tpu.streaming.sources import CollectSink
+    from flink_tpu.streaming.windowing import Time
+
+    records = [((i % 7, 1.0), (i % 500) * 4) for i in range(30_000)]
+    sink = CollectSink()
+    env = StreamExecutionEnvironment()
+    env.enable_checkpointing(5, async_persist=True)
+    env.set_checkpoint_storage("filesystem",
+                               directory=str(tmp_path / "chk"))
+
+    class TupleSum(SumAggregate):
+        def __init__(self):
+            super().__init__(np.float64)
+
+        def extract_value(self, v):
+            return v[1]
+
+    (env.from_collection(records, timestamped=True)
+        .key_by(lambda t: t[0])
+        .time_window(Time.seconds(2))
+        .aggregate(TupleSum())
+        .add_sink(sink))
+    result = env.execute("async-cp")
+    assert result.checkpoints_completed >= 1
+    assert sum(sink.values) == 30_000
