@@ -87,13 +87,27 @@ class QueryableStateClient:
         task thread (mutating it from here would corrupt in-flight
         writes, not just read stale data)."""
         backend, desc = self.registry.locate(state_name, key)
-        state = backend.get_partitioned_state(namespace, desc)
+        # get_or_create_keyed_state: binds the state object WITHOUT
+        # touching its current namespace — get_partitioned_state would
+        # call set_current_namespace on the shared object and corrupt
+        # the owner thread's in-flight writes (same hazard class as
+        # current_key, see below)
+        state = backend.get_or_create_keyed_state(desc)
         table = getattr(state, "_table", None)
-        if table is None:
-            raise NotImplementedError(
-                f"queryable reads need a table-backed state "
-                f"(heap backend); {type(state).__name__} is not")
-        value = table.get(key, namespace)
+        if table is not None:
+            value = table.get(key, namespace)
+        else:
+            # device-backed state (TPU backend): the gather read path
+            # — slot resolved by pure host reads, single-slot jitted
+            # result, serialized against state swaps (round-2 verdict
+            # item 5; ref: AbstractKeyedStateBackend.java:382-389 +
+            # KvStateServerHandler.java)
+            query = getattr(state, "query_by_key", None)
+            if query is None:
+                raise NotImplementedError(
+                    f"{type(state).__name__} supports neither table "
+                    f"nor device queryable reads")
+            value = query(key, namespace)
         if value is None and hasattr(desc, "get_default_value"):
             return desc.get_default_value()
         return value

@@ -25,6 +25,7 @@ KeyGroupRangeAssignment.java:47-56, StateAssignmentOperation.java).
 from __future__ import annotations
 
 import pickle
+import threading
 from collections import defaultdict
 from typing import Any, Dict, Iterable, List, Optional, Tuple
 
@@ -108,6 +109,10 @@ class DeviceAggregatingState(AggregatingState):
         self.host_tier: Dict[Tuple[Any, Any], Dict[str, np.ndarray]] = {}
         #: per-slot last-access stamps (approximate LRU clock)
         self._access_stamp: List[int] = [0] * initial_capacity
+        #: per-slot flag: some update has actually LANDED on device —
+        #: queryable reads must not surface the init accumulator of a
+        #: slot whose first adds are still pending (heap returns None)
+        self._slot_flushed = bytearray(initial_capacity)
         self._clock = 0
         #: observability: spill/promotion counters
         self.evictions = 0
@@ -126,6 +131,13 @@ class DeviceAggregatingState(AggregatingState):
         self._jit_merge = jax.jit(self.agg.merge_slots, donate_argnums=0)
         self._jit_clear = jax.jit(self.agg.clear_slots, donate_argnums=0)
         self._jit_result = jax.jit(self.agg.result)
+        # queryable-state reads come from foreign threads; every
+        # device_state REPLACEMENT donates the old tree's buffers, so
+        # a concurrent gather on the old tree would read freed memory.
+        # This lock serializes state swaps against query gathers (the
+        # owner thread's swap sites take it; cost is one uncontended
+        # acquire per micro-batch)
+        self._device_lock = threading.RLock()
 
     def _update_fn(self, state, slots, values, hi, lo, mask):
         return self.agg.update(state, slots, values, hi, lo, mask)
@@ -190,35 +202,47 @@ class DeviceAggregatingState(AggregatingState):
                                      for name in host_rows}
             del self.slot_index[entry]
             self.slot_meta[s] = None
-        self.device_state = self._jit_clear(self.device_state,
-                                            jnp.asarray(idx))
+        with self._device_lock:
+            self.device_state = self._jit_clear(self.device_state,
+                                                jnp.asarray(idx))
+            for s_ in victims:
+                self._slot_flushed[s_] = 0
         self._free.extend(victims)
         self.evictions += len(victims)
 
     def _promote(self, entry) -> int:
         """Host-tier entry accessed: lift its row back into HBM
-        (donated single-row upload — in-place, no full-array copy)."""
-        row = self.host_tier.pop(entry)
+        (donated single-row upload — in-place, no full-array copy).
+        The index entry publishes only AFTER the upload, inside the
+        lock: a concurrent query must see either the spilled row or
+        the uploaded slot, never a zeroed in-between slot."""
         if not self._free:
             self._make_room()
         slot = self._free.pop()
-        self.slot_index[entry] = slot
+        row = self.host_tier[entry]
+        with self._device_lock:
+            self.device_state = self._jit_upload(
+                self.device_state, jnp.int32(slot),
+                {name: jnp.asarray(val) for name, val in row.items()})
+            del self.host_tier[entry]
+            self.slot_index[entry] = slot
+            self._slot_flushed[slot] = 1
         self.slot_meta[slot] = entry
         # freshly promoted slots are HOT: stamp them or a later
         # promotion in the same batch could evict them right back
         self._clock += 1
         self._access_stamp[slot] = self._clock
-        self.device_state = self._jit_upload(
-            self.device_state, jnp.int32(slot),
-            {name: jnp.asarray(val) for name, val in row.items()})
         self.promotions += 1
         return slot
 
     def _grow(self, new_capacity: int) -> None:
         self._flush()
-        self.device_state = self.agg.grow_state(self.device_state, new_capacity)
+        with self._device_lock:
+            self.device_state = self.agg.grow_state(self.device_state,
+                                                    new_capacity)
         self._free.extend(range(new_capacity - 1, self.capacity - 1, -1))
         self._access_stamp.extend([0] * (new_capacity - self.capacity))
+        self._slot_flushed.extend(bytes(new_capacity - self.capacity))
         self.slot_meta.extend([None] * (new_capacity - self.capacity))
         self.capacity = new_capacity
 
@@ -286,6 +310,10 @@ class DeviceAggregatingState(AggregatingState):
         n = len(self._pending_slots)
         if n == 0:
             return
+        with self._device_lock:
+            self._flush_locked(n)
+
+    def _flush_locked(self, n: int) -> None:
         padded = _round_up_pow2(n)
         slots = np.zeros(padded, np.int32)
         slots[:n] = self._pending_slots
@@ -306,6 +334,8 @@ class DeviceAggregatingState(AggregatingState):
             lo = np.zeros(padded, np.uint32)
         self.device_state = self._jit_update(
             self.device_state, slots, values, hi, lo, mask)
+        for s_ in self._pending_slots:
+            self._slot_flushed[s_] = 1
         self._pending_slots.clear()
         self._pending_values.clear()
         self._pending_hi.clear()
@@ -354,6 +384,37 @@ class DeviceAggregatingState(AggregatingState):
             self.device_state, jnp.asarray(np.array(slots, np.int32))))
         return res, np.array(found, bool)
 
+    def query_by_key(self, key, namespace):
+        """Queryable-state read from a FOREIGN thread (ref:
+        AbstractKeyedStateBackend.java:382-389 getPartitionedState for
+        queries + KvStateServerHandler).  Dirty-read semantics match
+        the heap path: pending (unflushed) adds are invisible; no
+        owner-side structures mutate (no promotion, no access-stamp
+        touch).  The device gather serializes against state swaps via
+        the device lock."""
+        entry = (key, namespace)
+        with self._device_lock:
+            slot = self.slot_index.get(entry)
+            if slot is not None and not self._slot_flushed[slot]:
+                # the key's first adds are still pending: invisible
+                # (matches the heap path's None-for-absent contract)
+                slot = None
+            if slot is not None:
+                out = np.asarray(self._jit_result(
+                    self.device_state,
+                    jnp.asarray(np.array([slot], np.int32))))[0]
+                return out.item() if np.ndim(out) == 0 else out
+        row = self.host_tier.get(entry)
+        if row is not None:
+            # spilled entry: finalize its single row host-side (lift
+            # to a 1-slot state; compiles once per aggregate)
+            state1 = {name: jnp.asarray(val)[None]
+                      for name, val in row.items()}
+            out = np.asarray(self._jit_result(
+                state1, jnp.asarray(np.zeros(1, np.int32))))[0]
+            return out.item() if np.ndim(out) == 0 else out
+        return None
+
     # ---- lifecycle --------------------------------------------------
     def clear(self) -> None:
         entry = (self._backend.current_key, self._namespace)
@@ -362,8 +423,10 @@ class DeviceAggregatingState(AggregatingState):
         if slot is None:
             return
         self._flush()
-        self.device_state = self._jit_clear(
-            self.device_state, jnp.asarray(np.array([slot], np.int32)))
+        with self._device_lock:
+            self.device_state = self._jit_clear(
+                self.device_state, jnp.asarray(np.array([slot], np.int32)))
+            self._slot_flushed[slot] = 0
         self.slot_meta[slot] = None
         self._free.append(slot)
 
@@ -383,7 +446,11 @@ class DeviceAggregatingState(AggregatingState):
         padded = _round_up_pow2(n)
         arr = np.full(padded, slots[0], np.int32)
         arr[:n] = slots
-        self.device_state = self._jit_clear(self.device_state, jnp.asarray(arr))
+        with self._device_lock:
+            self.device_state = self._jit_clear(self.device_state,
+                                                jnp.asarray(arr))
+            for s_ in slots:
+                self._slot_flushed[s_] = 0
         self._free.extend(slots)
 
     def merge_namespaces(self, target, sources) -> None:
@@ -426,9 +493,14 @@ class DeviceAggregatingState(AggregatingState):
             return
         dsts = np.full(len(src_slots), dst, np.int32)
         srcs = np.array(src_slots, np.int32)
-        self.device_state = self._jit_merge(
-            self.device_state, jnp.asarray(dsts), jnp.asarray(srcs))
-        self.device_state = self._jit_clear(self.device_state, jnp.asarray(srcs))
+        with self._device_lock:
+            self.device_state = self._jit_merge(
+                self.device_state, jnp.asarray(dsts), jnp.asarray(srcs))
+            self.device_state = self._jit_clear(self.device_state,
+                                                jnp.asarray(srcs))
+            self._slot_flushed[dst] = 1
+            for s_ in src_slots:
+                self._slot_flushed[s_] = 0
         self._free.extend(src_slots)
 
     # ---- snapshot ---------------------------------------------------
@@ -473,11 +545,14 @@ class DeviceAggregatingState(AggregatingState):
             for name, val in row.items():
                 rows[name].append(val)
         idx = jnp.asarray(np.array(slots, np.int32))
-        new_state = dict(self.device_state)
-        for name, vals in rows.items():
-            new_state[name] = new_state[name].at[idx].set(
-                jnp.asarray(np.stack(vals)))
-        self.device_state = new_state
+        with self._device_lock:
+            new_state = dict(self.device_state)
+            for name, vals in rows.items():
+                new_state[name] = new_state[name].at[idx].set(
+                    jnp.asarray(np.stack(vals)))
+            self.device_state = new_state
+            for s_ in slots:
+                self._slot_flushed[s_] = 1
 
     def active_entries(self) -> Iterable[Tuple[Any, Any]]:
         yield from self.slot_index.keys()

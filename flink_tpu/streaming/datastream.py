@@ -648,7 +648,22 @@ class KeyedStream(DataStream):
             def process_element(self, record):
                 from flink_tpu.state.backend import VOID_NAMESPACE
                 self._qstate.set_current_namespace(VOID_NAMESPACE)
-                self._qstate.update(record.value)
+                # ValueState-backed (default): last value wins;
+                # aggregating/reducing descriptors accumulate instead
+                # (the reference registers any InternalKvState kind)
+                if hasattr(self._qstate, "update"):
+                    self._qstate.update(record.value)
+                else:
+                    self._qstate.add(record.value)
+
+            def close(self):
+                # device states micro-batch their adds; make the final
+                # values visible to queries once the task stops
+                flush_all = getattr(self.keyed_backend, "flush_all",
+                                    None)
+                if flush_all is not None:
+                    flush_all()
+                super().close()
 
         class _Noop:
             def process_element(self, value, ctx, out):

@@ -102,3 +102,119 @@ def test_custom_registry_isolated():
     client = QueryableStateClient(reg)
     with pytest.raises(KeyError):
         client.get_kv_state("anything", 1)
+
+
+def test_query_device_backed_state():
+    """Queryable reads against the TPU backend's device aggregation
+    state (round-2 verdict item 5: the read path used to raise
+    NotImplementedError for device-backed state)."""
+    import numpy as np
+    from flink_tpu.core.keygroups import KeyGroupRange
+    from flink_tpu.core.state import AggregatingStateDescriptor
+    from flink_tpu.ops.device_agg import SumAggregate
+    from flink_tpu.state.tpu_backend import TpuKeyedStateBackend
+
+    be = TpuKeyedStateBackend(KeyGroupRange(0, 127), 128)
+    desc = AggregatingStateDescriptor("dev_sum", SumAggregate(np.float64))
+    st = be.get_partitioned_state((), desc)
+    for k, v in [("a", 2.0), ("b", 5.0), ("a", 3.0)]:
+        be.set_current_key(k)
+        st.add(v)
+    DEFAULT_REGISTRY.register("dev_sum", KeyGroupRange(0, 127), be, desc)
+    client = QueryableStateClient()
+    # pending adds flushed by the owner; queries see the device value
+    st._flush()
+    assert client.get_kv_state("dev_sum", "a", namespace=()) == 5.0
+    assert client.get_kv_state("dev_sum", "b", namespace=()) == 5.0
+    assert client.get_kv_state("dev_sum", "nope", namespace=()) is None
+    # dirty-read semantics: an unflushed add is invisible
+    be.set_current_key("a")
+    st.add(10.0)
+    assert client.get_kv_state("dev_sum", "a", namespace=()) == 5.0
+    st._flush()
+    assert client.get_kv_state("dev_sum", "a", namespace=()) == 15.0
+
+
+def test_query_device_state_spilled_to_host_tier():
+    """A key evicted to the host-RAM spill tier still answers queries
+    (served from its spilled row, no promotion, no owner mutation)."""
+    import numpy as np
+    from flink_tpu.core.keygroups import KeyGroupRange
+    from flink_tpu.core.state import AggregatingStateDescriptor
+    from flink_tpu.ops.device_agg import SumAggregate
+    from flink_tpu.state.tpu_backend import TpuKeyedStateBackend
+
+    be = TpuKeyedStateBackend(KeyGroupRange(0, 127), 128,
+                              initial_capacity=8, microbatch=2,
+                              max_device_slots=8)
+    desc = AggregatingStateDescriptor("spill_sum",
+                                      SumAggregate(np.float64))
+    st = be.get_partitioned_state((), desc)
+    keys = [f"k{i}" for i in range(40)]
+    st.add_batch(keys, (), np.arange(40, dtype=np.float64))
+    st._flush()
+    assert st.evictions > 0
+    spilled = next(iter(st.host_tier))[0] if st.host_tier else None
+    assert spilled is not None
+    DEFAULT_REGISTRY.register("spill_sum", KeyGroupRange(0, 127), be,
+                              desc)
+    client = QueryableStateClient()
+    promotions_before = st.promotions
+    v = client.get_kv_state("spill_sum", spilled, namespace=())
+    assert v == float(spilled[1:])      # value == key index
+    assert st.promotions == promotions_before  # read did not promote
+    # a device-resident key answers too
+    resident = st.slot_meta[[s for s in range(st.capacity)
+                             if st.slot_meta[s] is not None][0]][0]
+    assert client.get_kv_state("spill_sum", resident,
+                               namespace=()) == float(resident[1:])
+
+
+def test_query_device_state_through_job_api():
+    """as_queryable_state with a device aggregate through the
+    DataStream API: the end-to-end registration + read path."""
+    import numpy as np
+    from flink_tpu.core.state import AggregatingStateDescriptor
+    from flink_tpu.ops.device_agg import SumAggregate
+
+    class TupleSum(SumAggregate):
+        def __init__(self):
+            super().__init__(np.float64)
+
+        def extract_value(self, v):
+            return v[1]
+
+    env = StreamExecutionEnvironment()
+    env.set_state_backend("tpu")
+    (env.from_collection([("a", 1.0), ("b", 5.0), ("a", 3.0)])
+        .key_by(lambda v: v[0])
+        .as_queryable_state(
+            "dev_totals",
+            AggregatingStateDescriptor("dev_totals", TupleSum())))
+    env.execute("queryable-device")
+    client = QueryableStateClient()
+    assert client.get_kv_state("dev_totals", "a") == 4.0
+    assert client.get_kv_state("dev_totals", "b") == 5.0
+
+
+def test_query_new_key_with_only_pending_adds_is_invisible():
+    """A key whose FIRST adds are still in the pending micro-batch
+    must read as absent (None / default), not as the init accumulator
+    (code-review regression: a fresh slot in slot_index surfaced 0.0
+    before anything had flushed)."""
+    import numpy as np
+    from flink_tpu.core.keygroups import KeyGroupRange
+    from flink_tpu.core.state import AggregatingStateDescriptor
+    from flink_tpu.ops.device_agg import SumAggregate
+    from flink_tpu.state.tpu_backend import TpuKeyedStateBackend
+
+    be = TpuKeyedStateBackend(KeyGroupRange(0, 127), 128)
+    desc = AggregatingStateDescriptor("pend_sum", SumAggregate(np.float64))
+    st = be.get_partitioned_state((), desc)
+    be.set_current_key("fresh")
+    st.add(7.0)                       # pending, never flushed
+    DEFAULT_REGISTRY.register("pend_sum", KeyGroupRange(0, 127), be, desc)
+    client = QueryableStateClient()
+    assert client.get_kv_state("pend_sum", "fresh", namespace=()) is None
+    st._flush()
+    assert client.get_kv_state("pend_sum", "fresh", namespace=()) == 7.0
