@@ -385,23 +385,33 @@ def bench_sql(n_events=1 << 22, n_keys=500_000, precision=12):
     base_rate = best_of(lambda: nat.heap_tumbling_baseline(
         kh, vh, None, "hll", precision=precision, capacity=2 * n_keys))
 
-    env = StreamExecutionEnvironment()
-    t_env = StreamTableEnvironment.create(env)
-    t_env.register_table(
-        "ev", t_env.from_columns({"k": keys, "u": users, "ts": ts},
-                                 rowtime="ts"))
-    out = t_env.sql_query(
-        "SELECT k, APPROX_COUNT_DISTINCT(u) AS d "
-        "FROM ev GROUP BY TUMBLE(ts, INTERVAL '1' SECOND), k")
-    assert getattr(out, "columnar", False), \
-        "sql bench plan fell off the columnar tier"
-    sink = ColumnarCollectSink()
-    out.to_append_stream(batched=True).add_sink(sink)
-    t0 = time.perf_counter()
-    env.execute("bench-sql")
-    elapsed = time.perf_counter() - t0
-    assert sink.total_rows() > 0.9 * n_keys, sink.total_rows()
-    return n_events / elapsed, base_rate
+    # one-time process init outside the timed region (run_engine's
+    # warmup excludes the same costs for the engine-level configs):
+    # the finish-tier link probe and the backend client
+    from flink_tpu.ops import link_probe
+    link_probe.measure()
+
+    def one_run():
+        env = StreamExecutionEnvironment()
+        t_env = StreamTableEnvironment.create(env)
+        t_env.register_table(
+            "ev", t_env.from_columns({"k": keys, "u": users, "ts": ts},
+                                     rowtime="ts"))
+        out = t_env.sql_query(
+            "SELECT k, APPROX_COUNT_DISTINCT(u) AS d "
+            "FROM ev GROUP BY TUMBLE(ts, INTERVAL '1' SECOND), k")
+        assert getattr(out, "columnar", False), \
+            "sql bench plan fell off the columnar tier"
+        sink = ColumnarCollectSink()
+        out.to_append_stream(batched=True).add_sink(sink)
+        t0 = time.perf_counter()
+        env.execute("bench-sql")
+        elapsed = time.perf_counter() - t0
+        assert sink.total_rows() > 0.9 * n_keys, sink.total_rows()
+        return n_events / elapsed
+
+    one_run()  # warm (parser/planner/source/engine code paths)
+    return best_of(one_run, reps=3), base_rate
 
 
 def bench_sql_join(n_each=1 << 21, n_keys=100_000, bound_ms=500,

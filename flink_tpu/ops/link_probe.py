@@ -69,15 +69,24 @@ def _measure() -> Dict[str, float]:
             del arr
         return best
 
-    # best-of-5: the result is cached for the process, so one
-    # contended sample must not misclassify the link (observed 20x
-    # swings on shared machines — the best sample is the least
-    # contended estimate of the link itself)
-    h2d = best_of(_PROBE_BYTES, 5)
-    if h2d > 1.0:
-        # fast link: 8 MB is RTT-overhead-dominated at multi-GB/s
-        # (0.4 ms payload vs dispatch+readback latency) — re-measure
-        # with a payload big enough to amortize it
+    # staged payloads: slow links must not pay seconds of probing
+    # (1 MB x3 is <=300 ms even at 0.01 GB/s contention), while fast
+    # links escalate until the payload amortizes dispatch+readback
+    # RTT.  The escalation gates sit far BELOW the stage's payload
+    # bandwidth ceiling: a fast-but-high-RTT link reads artificially
+    # low on a small payload (1 MB at 20 GB/s with ~1 ms RTT measures
+    # <1 GB/s), so any reading that RTT alone could explain escalates
+    # to the next payload.  best-of per stage: the result is cached
+    # for the process, so one contended sample must not misclassify
+    # the link (observed 20x swings on shared machines).
+    h2d = best_of(_PROBE_BYTES // 8, 3)
+    if h2d > 0.2:
+        # 1 MB above 0.2 GB/s is <=5 ms/transfer — could be pure RTT
+        # on a multi-GB/s link; re-measure with 8 MB
+        h2d = max(h2d, best_of(_PROBE_BYTES, 3))
+    if h2d > DEVICE_FINISH_MIN_H2D_GBPS / 4:
+        # within RTT-reach of the decision threshold: confirm with a
+        # payload big enough to amortize per-transfer overhead
         h2d = max(h2d, best_of(8 * _PROBE_BYTES, 3))
     # no d2h figure: reading back a just-transferred buffer can be
     # served from a host-side copy on remote attachments (measured

@@ -107,6 +107,20 @@ class ColumnarSource(SourceFunction):
     def cancel(self) -> None:
         self._running = False
 
+    def __deepcopy__(self, memo):
+        # per-attempt source cloning must not copy the input columns
+        # (the source only ever slices them — views, no mutation);
+        # a fresh cursor is all a clone needs
+        clone = ColumnarSource.__new__(ColumnarSource)
+        clone.cols = self.cols
+        clone.rowtime = self.rowtime
+        clone.chunk = self.chunk
+        clone.ooo_slack_ms = self.ooo_slack_ms
+        clone._running = True
+        clone.offset = self.offset
+        clone._final_watermark = self._final_watermark
+        return clone
+
     # checkpoint hooks (CheckpointedFunction-shaped source state)
     def snapshot_function_state(self, checkpoint_id=None) -> dict:
         return {"offset": self.offset,
@@ -444,17 +458,10 @@ class ColumnarIntervalJoinOperator(StreamOperator):
         pass
 
     def _hash(self, col: np.ndarray) -> np.ndarray:
-        col = np.asarray(col)
-        if col.dtype.kind in "iu":
-            try:
-                import flink_tpu.native as nat
-                if nat.available():
-                    return nat.splitmix64(col.astype(np.uint64,
-                                                     copy=False))
-            except Exception:  # noqa: BLE001
-                pass
+        # hash_keys_np routes integral arrays through the native
+        # splitmix64 itself
         from flink_tpu.streaming.vectorized import hash_keys_np
-        return hash_keys_np(col)
+        return hash_keys_np(np.asarray(col))
 
     def _append(self, side: int, batch: RecordBatch, kh: np.ndarray):
         b = self._buf[side]

@@ -49,6 +49,13 @@ def hash_keys_np(keys) -> np.ndarray:
         arr = arr.astype(np.int64)
     if arr.dtype.kind in "iu":
         if arr.ndim == 1:
+            try:
+                import flink_tpu.native as nat
+                if nat.available():
+                    return nat.splitmix64(arr.astype(np.uint64,
+                                                     copy=False))
+            except Exception:  # noqa: BLE001 — numpy twin below
+                pass
             return splitmix64_np(arr.astype(np.uint64))
         h = np.zeros(len(arr), np.uint64)
         for j in range(arr.shape[1]):
