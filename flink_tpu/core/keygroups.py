@@ -107,6 +107,18 @@ def assign_key_groups_np(hashes64: np.ndarray, max_parallelism: int) -> np.ndarr
     return (h % np.uint64(max_parallelism)).astype(np.int32)
 
 
+def assign_operator_indexes_np(hashes64: np.ndarray,
+                               max_parallelism: int,
+                               parallelism: int) -> np.ndarray:
+    """Vectorized hash -> key group -> operator subtask index (the
+    twin of assign_key_groups_np + compute_operator_index_for_key_group
+    and of the C++ ft_key_groups kernel — ONE place for the range
+    arithmetic)."""
+    kg = assign_key_groups_np(hashes64, max_parallelism)
+    return (kg.astype(np.int64) * parallelism
+            // max_parallelism).astype(np.int32)
+
+
 def compute_operator_index_for_key_group(
     max_parallelism: int, parallelism: int, key_group: int
 ) -> int:

@@ -1156,6 +1156,19 @@ def compute_restore_assignments(vertex_parallelisms: Dict[int, int],
     for (vid, idx) in task_snaps:
         old_par[vid] = max(old_par.get(vid, 0), idx + 1)
     out: Dict[Tuple[int, int], List[dict]] = {}
+    # a snapshot vertex with no live counterpart means the topology
+    # changed shape between runs (e.g. a re-plan inserted/removed a
+    # node, shifting vertex ids): its state would silently vanish —
+    # make that loud (the reference's uid-matching raises here)
+    orphaned = set(old_par) - set(vertex_parallelisms)
+    if orphaned:
+        import warnings
+        warnings.warn(
+            f"checkpoint state for vertices {sorted(orphaned)} has no "
+            f"matching vertex in the restored topology and will be "
+            f"DROPPED (did the plan shape change — e.g. a columnar "
+            f"plan re-lowered at a different parallelism?)",
+            stacklevel=2)
     for vid, new_p in vertex_parallelisms.items():
         if old_par.get(vid, 0) == 0:
             continue  # vertex had no snapshot (e.g. newly added)

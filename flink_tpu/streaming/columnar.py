@@ -17,11 +17,14 @@ available directly via
 ``StreamExecutionEnvironment`` sources built from
 :class:`ColumnarSource`.
 
-Scope: single-parallelism pipelines (a RecordBatch crosses operator
-edges whole; splitting batches across key-groups belongs to the mesh
-path, flink_tpu/parallel/).  Plans that don't fit fall back to the
+Parallelism: RecordBatches cross forward edges whole; a keyBy edge at
+parallelism > 1 goes through :class:`BatchKeyGroupSplitOperator` (one
+hash pass + one mask per target subtask — the columnar keyBy
+exchange).  Plans that don't fit the tier fall back to the
 row-at-a-time path — same split the reference drew between codegen'd
-and interpreted operators.
+and interpreted operators.  NOTE: re-lowering a columnar plan at a
+DIFFERENT parallelism changes the topology shape, so checkpoints do
+not carry across such a change (the runtime warns on restore).
 """
 
 from __future__ import annotations
@@ -246,7 +249,11 @@ class ColumnarWindowOperator(StreamOperator):
 
     # ---- input ------------------------------------------------------
     def process_element(self, record: StreamRecord):
-        batch: RecordBatch = record.value
+        batch = record.value
+        if isinstance(batch, tuple):
+            # (target, sub_batch) carrier from the key-group split
+            # exchange (parallelism > 1)
+            batch = batch[1]
         if len(batch) == 0:
             return
         keys = batch.cols[self.key_col]
@@ -368,6 +375,57 @@ class ColumnarWindowOperator(StreamOperator):
                     if hasattr(self.engine, "fired"):
                         self.engine.emit_arrays = True
                 self.engine.restore(s["columnar_engine"])
+
+
+class BatchKeyGroupSplitOperator(StreamOperator):
+    """The keyBy exchange for RecordBatch flow at parallelism > 1:
+    splits each batch by key-group-derived target subtask (the same
+    range-partition arithmetic as KeyGroupRangeAssignment, computed
+    vectorized in C++ — nat.key_groups), emitting (target, sub_batch)
+    carriers the downstream custom partitioner routes by tag.  The
+    columnar answer to the reference's per-record hash partitioner:
+    one hash pass and one mask per target instead of a channel choice
+    per record (round-2 verdict item 7)."""
+
+    def __init__(self, key_col: str, max_parallelism: int, n_out: int):
+        super().__init__()
+        if n_out < 2:
+            raise ValueError("the split exchange exists only for "
+                             "parallelism > 1")
+        self.key_col = key_col
+        self.max_parallelism = max_parallelism
+        self.n_out = n_out
+
+    def set_key_context(self, record):
+        pass
+
+    def process_element(self, record: StreamRecord):
+        batch: RecordBatch = record.value
+        if len(batch) == 0:
+            return
+        from flink_tpu.streaming.vectorized import hash_keys_np
+        kh = hash_keys_np(np.asarray(batch.cols[self.key_col]))
+        try:
+            import flink_tpu.native as nat
+            targets = nat.key_groups(kh, self.max_parallelism,
+                                     self.n_out)
+        except Exception:  # noqa: BLE001 — numpy twin of ft_key_groups
+            from flink_tpu.core.keygroups import (
+                assign_operator_indexes_np,
+            )
+            targets = assign_operator_indexes_np(
+                kh, self.max_parallelism, self.n_out)
+        ts = np.asarray(batch.ts, np.int64) if batch.ts is not None \
+            else None
+        for t in range(self.n_out):
+            m = targets == t
+            if not m.any():
+                continue
+            sub = RecordBatch({k: np.asarray(v)[m]
+                               for k, v in batch.cols.items()},
+                              None if ts is None else ts[m])
+            self.output.collect(StreamRecord((int(t), sub),
+                                             record.timestamp))
 
 
 class ColumnarIntervalJoinOperator(StreamOperator):

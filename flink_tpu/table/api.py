@@ -410,13 +410,12 @@ def _try_columnar_windowed_agg(table: Table, keys: List[Expr],
                                having: Optional[Expr]) -> Optional[Table]:
     """Columnar physical plan: single group key, single device-eligible
     aggregate over a plain column, projection of key/agg/window-props
-    only, columnar source, parallelism 1.  Compiles onto
+    only, columnar source; at parallelism > 1 the keyBy edge goes
+    through the batch key-group split exchange.  Compiles onto
     ColumnarWindowOperator — whole RecordBatches feed the window
     engine, fires leave as RecordBatches (streaming/columnar.py).
     Returns None when the plan doesn't fit (row path takes over)."""
     if having is not None or not getattr(table, "columnar", False):
-        return None
-    if table.stream.env.parallelism != 1:
         return None
     key_exprs = [strip_alias(k) for k in keys]
     if len(key_exprs) != 1 or not isinstance(key_exprs[0], Column):
@@ -464,15 +463,35 @@ def _try_columnar_windowed_agg(table: Table, keys: List[Expr],
             return None
         out_names.append(nm)
     assigner = _assigner_for(spec)
-    from flink_tpu.streaming.columnar import ColumnarWindowOperator
+    from flink_tpu.streaming.columnar import (
+        BatchKeyGroupSplitOperator,
+        ColumnarWindowOperator,
+    )
 
     def factory(assigner=assigner, agg=agg, key_col=key_col,
                 input_col=input_col, out_fields=tuple(out_fields)):
         return ColumnarWindowOperator(assigner, agg, key_col, input_col,
                                       out_fields)
 
-    out = table.stream._add_op("columnar_window_agg", factory,
-                               parallelism=1)
+    par = table.stream.env.parallelism
+    if par == 1:
+        out = table.stream._add_op("columnar_window_agg", factory,
+                                   parallelism=1)
+    else:
+        # parallelism > 1: the keyBy exchange splits each batch by
+        # key-group-derived target (one hash pass + one mask per
+        # subtask, C++ key-group arithmetic) and a tag partitioner
+        # routes the sub-batches — RecordBatches flow through the
+        # shuffle whole (round-2 verdict item 7)
+        max_par = table.stream.env.max_parallelism
+
+        def split_factory(key_col=key_col, max_par=max_par, par=par):
+            return BatchKeyGroupSplitOperator(key_col, max_par, par)
+
+        split = table.stream._add_op("columnar_keyby_split",
+                                     split_factory, parallelism=1)
+        out = split.partition_custom(lambda tagged, n: tagged[0]) \
+            ._add_op("columnar_window_agg", factory, parallelism=par)
     t = Table(t_env, out, Schema(out_names))
     t.columnar = True
     return t
