@@ -1,0 +1,446 @@
+"""Mesh-sharded log-structured window engines: the winning combiner
+tier over the device mesh.
+
+The log-structured engines (streaming/log_windows.py) are the
+framework's fastest windowed-aggregation tier, but each instance is a
+single-host engine.  This module scales them the same way the
+reference scales ALL keyed state — a keyBy exchange that routes every
+record to the subtask owning its key group (KeyGroupStreamPartitioner
+→ Netty, ref flink-runtime/.../io/network/partition/consumer/
+SingleInputGate.java; range arithmetic KeyGroupRangeAssignment.java:115)
+— except the exchange here is ONE jitted SPMD program over the mesh
+axis: records pack into opaque uint32 lanes, a shard_map step buckets
+them by key-group-derived target shard and `lax.all_to_all`s the
+buckets over ICI, and each host shard appends its received records to
+its OWN log engine.  Fires are embarrassingly parallel per-shard C++
+log fires (radix sort + segmented reduce); key groups partition keys
+disjointly, so per-shard results are exactly the single-host results.
+
+Design notes:
+- The exchange payload is *bit-pattern* lanes (u64 key, i64 ts, f64
+  value, u64 value-hash, each as two uint32 lanes).  The device step
+  does no arithmetic on the payload — only the bucketize/sort by
+  target — so no precision is lost to the TPU's 32-bit default, and
+  one compiled program serves every aggregate mode.
+- Targets are computed on the host with the SAME key-group arithmetic
+  the row runtime uses (native ft_key_groups / keygroups numpy twin),
+  so a mesh job and a MiniCluster job agree on key placement.
+- The static worst case of the exchange is every record targeting one
+  shard, so the received buffer is [n_shards, G] for a G-row step —
+  the all_to_all tax measured in BENCH_NOTES.md's scaling table.
+- On a multi-host pod each host would consume only its addressable
+  shards' outputs; this process consumes all shards (single-host
+  runtime, virtual or tunnel-attached mesh).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from flink_tpu.ops.device_agg import DeviceAggregateFunction
+from flink_tpu.ops.sketches import CountMinSketchAggregate
+
+
+def _split_u64(a: np.ndarray):
+    a = np.ascontiguousarray(a, np.uint64)
+    return ((a >> np.uint64(32)).astype(np.uint32),
+            (a & np.uint64(0xFFFFFFFF)).astype(np.uint32))
+
+
+def _join_u64(hi: np.ndarray, lo: np.ndarray) -> np.ndarray:
+    return (hi.astype(np.uint64) << np.uint64(32)) | lo.astype(np.uint64)
+
+
+def _make_lane_exchange(mesh, axis: str):
+    """The ICI leg of the keyBy exchange: ONE jitted shard_map program
+    that is a pure `lax.all_to_all` over pre-bucketed lanes.
+
+    Division of labor: the HOST packs each source shard's rows into
+    per-target buckets (a counting partition — cheap, and the logs are
+    host-resident anyway), the DEVICE program moves the buckets over
+    the mesh axis.  The collective is the only thing that must ride
+    ICI, so the compiled step contains nothing else — no sort, no
+    scatter — which keeps the exchange at fabric bandwidth instead of
+    device-sort speed.
+
+    Buckets are CAPPED at `bucket_cap` rows per (source, target) pair
+    instead of the static worst case m = G // S — with balanced key
+    groups each bucket holds ~m/S rows, so a cap of a few times the
+    mean cuts the exchanged volume from S×m to S×cap per device (the
+    padding tax in BENCH_NOTES.md's scaling table).  Rows that
+    overflow a bucket take the out-of-band path (see _run_step).
+
+    fn(bucks [S, S, cap, K] u32, counts [S, S] i32) →
+      (recv [S, S, cap, K], recv_counts [S, S]) where recv[j][s] is
+    the bucket source s sent to shard j (count rows valid)."""
+    import jax
+    try:
+        from jax import shard_map
+    except ImportError:  # older jax
+        from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    def local(bucks_blk, counts_blk):
+        # bucks_blk: [1, S, cap, K] (this source's buckets, one per
+        # target); all_to_all sends bucket t to device t and stacks
+        # the received buckets on the same dim, now indexed by source
+        ex = lambda x: jax.lax.all_to_all(  # noqa: E731
+            x, axis, split_axis=1, concat_axis=1)
+        return ex(bucks_blk), ex(counts_blk)
+
+    return jax.jit(shard_map(
+        local, mesh=mesh,
+        in_specs=(P(axis), P(axis)),
+        out_specs=(P(axis), P(axis))))
+
+
+class _MeshShardedLogEngine:
+    """Generic wrapper: N per-shard log engines behind the all_to_all
+    lane exchange.  Presents the standard engine interface
+    (process_batch / flush / advance_watermark / emitted / fired /
+    snapshot / restore) so DeviceWindowOperator and
+    ColumnarWindowOperator route to it unchanged."""
+
+    def __init__(self, mesh, axis: str, shard_factory,
+                 agg: DeviceAggregateFunction,
+                 max_parallelism: int = 128, step_batch: int = 8192,
+                 bucket_factor: float = 4.0):
+        self.mesh = mesh
+        self.axis = axis
+        self.agg = agg
+        self.n_shards = mesh.shape[axis]
+        self.max_parallelism = max_parallelism
+        if max_parallelism < self.n_shards:
+            raise ValueError("max_parallelism < mesh shards")
+        # G must be divisible by the shard count (data-parallel slices)
+        self.step_batch = -(-step_batch // self.n_shards) * self.n_shards
+        self.shards = [shard_factory() for _ in range(self.n_shards)]
+        self.needs_value = bool(agg.needs_value)
+        self.needs_value_hash = bool(agg.needs_value_hash)
+        self.n_lanes = 4 + (2 if self.needs_value else 0) \
+            + (2 if self.needs_value_hash else 0)
+        m = self.step_batch // self.n_shards
+        # per-(source, target) bucket capacity: balanced traffic puts
+        # ~m/S rows in each bucket; cap at bucket_factor× the mean
+        # (never above the worst case m) and route the rare overflow
+        # out of band (see _run_step)
+        self.bucket_cap = min(
+            m, max(1, int(bucket_factor * m / self.n_shards)))
+        self._exchange = _make_lane_exchange(mesh, axis)
+        # reusable send buffer; rows beyond counts[s, t] are stale
+        # garbage that travels but is never read on the receive side
+        self._buck_buf = np.zeros(
+            (self.n_shards, self.n_shards, self.bucket_cap,
+             self.n_lanes), np.uint32)
+        #: rows that overflowed a bucket and took the out-of-band path
+        self.num_overflow_routed = 0
+        self._keys_signed: Optional[bool] = None
+        # pending rows not yet exchanged (lists of per-batch arrays)
+        self._p_lanes: List[np.ndarray] = []
+        self._p_tgt: List[np.ndarray] = []
+        self._p_n = 0
+        self.emit = None
+        self.emitted: List[Any] = []
+        self.emit_arrays = False
+        self.fired: List[Any] = []
+
+    # ---- ingestion --------------------------------------------------
+    def process_batch(self, keys, timestamps, values=None,
+                      key_hashes=None, value_hashes=None) -> None:
+        keys = np.asarray(keys)
+        if not np.issubdtype(keys.dtype, np.integer):
+            raise TypeError("mesh log engine requires integer keys")
+        signed = bool(np.issubdtype(keys.dtype, np.signedinteger))
+        if self._keys_signed is None:
+            self._keys_signed = signed
+        elif self._keys_signed != signed:
+            raise TypeError("key dtype signedness changed mid-stream")
+        keys_u64 = (keys.astype(np.int64, copy=False).view(np.uint64)
+                    if signed else keys.astype(np.uint64, copy=False))
+        ts = np.asarray(timestamps, np.int64)
+        if key_hashes is None:
+            from flink_tpu.streaming.vectorized import hash_keys_np
+            key_hashes = hash_keys_np(keys)
+        tgt = self._targets(np.asarray(key_hashes, np.uint64))
+        lanes = [*_split_u64(keys_u64), *_split_u64(ts.view(np.uint64))]
+        if self.needs_value:
+            vals = (np.ones(len(keys), np.float64) if values is None
+                    else np.asarray(values, np.float64))
+            lanes.extend(_split_u64(vals.view(np.uint64)))
+        if self.needs_value_hash:
+            if value_hashes is None:
+                from flink_tpu.streaming.vectorized import hash_keys_np
+                value_hashes = hash_keys_np(np.asarray(values))
+            lanes.extend(_split_u64(np.asarray(value_hashes, np.uint64)))
+        self._p_lanes.append(np.stack(lanes, axis=-1))
+        self._p_tgt.append(tgt.astype(np.int32, copy=False))
+        self._p_n += len(keys)
+        while self._p_n >= self.step_batch:
+            self._drain_one_step()
+
+    def _targets(self, hashes64: np.ndarray) -> np.ndarray:
+        try:
+            import flink_tpu.native as nat
+            return nat.key_groups(hashes64, self.max_parallelism,
+                                  self.n_shards)
+        except Exception:  # noqa: BLE001 — numpy twin
+            from flink_tpu.core.keygroups import (
+                assign_operator_indexes_np,
+            )
+            return assign_operator_indexes_np(
+                hashes64, self.max_parallelism, self.n_shards)
+
+    def _concat_pending(self):
+        lanes = (self._p_lanes[0] if len(self._p_lanes) == 1
+                 else np.concatenate(self._p_lanes))
+        tgt = (self._p_tgt[0] if len(self._p_tgt) == 1
+               else np.concatenate(self._p_tgt))
+        return lanes, tgt
+
+    def _drain_one_step(self) -> None:
+        lanes, tgt = self._concat_pending()
+        G = self.step_batch
+        self._run_step(lanes[:G], tgt[:G],
+                       np.ones(G, bool))
+        rest_lanes, rest_tgt = lanes[G:], tgt[G:]
+        self._p_lanes = [rest_lanes] if len(rest_lanes) else []
+        self._p_tgt = [rest_tgt] if len(rest_tgt) else []
+        self._p_n = len(rest_lanes)
+
+    def flush(self, grow_to: Optional[int] = None) -> None:
+        """Exchange every pending row (the final partial step pads to
+        the compiled G with masked rows)."""
+        if self._p_n == 0:
+            return
+        lanes, tgt = self._concat_pending()
+        self._p_lanes, self._p_tgt, self._p_n = [], [], 0
+        G = self.step_batch
+        for off in range(0, len(lanes), G):
+            chunk_l, chunk_t = lanes[off:off + G], tgt[off:off + G]
+            n = len(chunk_l)
+            if n < G:
+                pad_l = np.zeros((G - n, self.n_lanes), np.uint32)
+                chunk_l = np.concatenate([chunk_l, pad_l])
+                chunk_t = np.concatenate(
+                    [chunk_t, np.zeros(G - n, np.int32)])
+            mask = np.zeros(G, bool)
+            mask[:n] = True
+            self._run_step(chunk_l, chunk_t, mask)
+
+    def _run_step(self, lanes: np.ndarray, tgt: np.ndarray,
+                  mask: np.ndarray) -> None:
+        """One G-row exchange step: host counting-partition into
+        per-(source, target) buckets, device all_to_all, per-shard
+        delivery.  Each source slice models one ingest host's rows
+        (data-parallel split of the batch)."""
+        S, cap = self.n_shards, self.bucket_cap
+        m = len(lanes) // S
+        bucks = self._buck_buf
+        counts = np.zeros((S, S), np.int32)
+        overflow = []           # (target, rows) beyond the bucket cap
+        for s in range(S):
+            sl = slice(s * m, (s + 1) * m)
+            sl_t, sl_m = tgt[sl], mask[sl]
+            # one stable sort per slice groups rows by target (O(m log
+            # m) independent of S; masked padding rows sort last as
+            # virtual target S and never ship)
+            tgt_eff = np.where(sl_m, sl_t, S)
+            order = np.argsort(tgt_eff, kind="stable")
+            sl_sorted = lanes[sl][order]
+            run_counts = np.bincount(tgt_eff, minlength=S + 1)
+            off = 0
+            for t in range(S):
+                n_t = int(run_counts[t])
+                rows = sl_sorted[off:off + n_t]
+                off += n_t
+                c = min(n_t, cap)
+                bucks[s, t, :c] = rows[:c]
+                counts[s, t] = c
+                if n_t > c:
+                    overflow.append((t, rows[c:]))
+        recv, rcounts = self._exchange(bucks, counts)
+        recv = np.asarray(recv)
+        rcounts = np.asarray(rcounts)
+        for j in range(S):
+            parts = [recv[j, s, :rcounts[j, s]]
+                     for s in range(S) if rcounts[j, s]]
+            if parts:
+                self._deliver(j, parts[0] if len(parts) == 1
+                              else np.concatenate(parts))
+        # bucket-cap overflow: live rows the exchange could not fit.
+        # This single-host runtime owns every shard engine, so they
+        # route host-side; a multi-host runtime would re-send them on
+        # the next step (a bounded tail by construction).
+        for t, rows in overflow:
+            self.num_overflow_routed += len(rows)
+            self._deliver(int(t), rows)
+
+    def _deliver(self, shard: int, rows: np.ndarray) -> None:
+        keys_u64 = _join_u64(rows[:, 0], rows[:, 1])
+        keys = (keys_u64.view(np.int64) if self._keys_signed
+                else keys_u64)
+        ts = _join_u64(rows[:, 2], rows[:, 3]).view(np.int64)
+        lane = 4
+        values = None
+        if self.needs_value:
+            values = _join_u64(rows[:, lane],
+                               rows[:, lane + 1]).view(np.float64)
+            lane += 2
+        vh = None
+        if self.needs_value_hash:
+            vh = _join_u64(rows[:, lane], rows[:, lane + 1])
+        self.shards[shard].process_batch(keys, ts, values,
+                                         value_hashes=vh)
+
+    # ---- firing -----------------------------------------------------
+    def advance_watermark(self, watermark: int) -> int:
+        self.flush()
+        fired = 0
+        for sh in self.shards:
+            sh.emit_arrays = self.emit_arrays
+            sh.emit = None
+            fired += sh.advance_watermark(watermark)
+            if self.emit_arrays:
+                self.fired.extend(sh.fired)
+                del sh.fired[:]
+            else:
+                if self.emit is not None:
+                    for k, r, s, e in sh.emitted:
+                        self.emit(k, r, s, e)
+                else:
+                    self.emitted.extend(sh.emitted)
+                del sh.emitted[:]
+        return fired
+
+    @property
+    def num_late_dropped(self) -> int:
+        # all late drops happen inside the shard engines (the wrapper
+        # never inspects timestamps)
+        return sum(sh.num_late_dropped for sh in self.shards)
+
+    @property
+    def watermark(self) -> int:
+        return max(sh.watermark for sh in self.shards)
+
+    # ---- checkpoint -------------------------------------------------
+    def snapshot(self) -> dict:
+        lanes, tgt = (self._concat_pending() if self._p_n
+                      else (np.zeros((0, self.n_lanes), np.uint32),
+                            np.zeros(0, np.int32)))
+        return {"mesh_log": True,
+                "n_shards": self.n_shards,
+                "keys_signed": self._keys_signed,
+                "pending_lanes": lanes.copy(),
+                "pending_tgt": tgt.copy(),
+                "shards": [sh.snapshot() for sh in self.shards]}
+
+    def restore(self, snap: dict) -> None:
+        if snap["n_shards"] != self.n_shards:
+            raise ValueError(
+                f"mesh log checkpoint was taken at {snap['n_shards']} "
+                f"shards; this mesh has {self.n_shards} (re-shard the "
+                "mesh or restore on a matching one)")
+        self._keys_signed = snap["keys_signed"]
+        self._p_lanes = ([snap["pending_lanes"]]
+                         if len(snap["pending_lanes"]) else [])
+        self._p_tgt = ([snap["pending_tgt"]]
+                       if len(snap["pending_tgt"]) else [])
+        self._p_n = len(snap["pending_lanes"])
+        for sh, s in zip(self.shards, snap["shards"]):
+            sh.restore(s)
+
+    def block_until_ready(self) -> None:
+        """Host-tier shard state is always materialized."""
+
+
+class MeshLogTumblingWindows(_MeshShardedLogEngine):
+    """keyBy().window(Tumbling).aggregate over the mesh: all_to_all
+    keyBy exchange + per-shard log-structured fires."""
+
+    def __init__(self, aggregate: DeviceAggregateFunction,
+                 window_size_ms: int, mesh, axis: str = "kg",
+                 max_parallelism: int = 128, step_batch: int = 8192,
+                 finish_tier: str = "auto"):
+        from flink_tpu.streaming.log_windows import (
+            LogStructuredTumblingWindows,
+        )
+        super().__init__(
+            mesh, axis,
+            lambda: LogStructuredTumblingWindows(
+                aggregate, window_size_ms, finish_tier=finish_tier),
+            aggregate, max_parallelism, step_batch)
+        self.size = window_size_ms
+
+
+class MeshLogSlidingWindows(_MeshShardedLogEngine):
+    """Sliding windows over the mesh: per-shard pane logs (one append
+    per record regardless of overlap), exchange as above."""
+
+    def __init__(self, aggregate: DeviceAggregateFunction,
+                 window_size_ms: int, slide_ms: int, mesh,
+                 axis: str = "kg", max_parallelism: int = 128,
+                 step_batch: int = 8192, finish_tier: str = "auto"):
+        from flink_tpu.streaming.log_windows import (
+            LogStructuredSlidingWindows,
+        )
+        super().__init__(
+            mesh, axis,
+            lambda: LogStructuredSlidingWindows(
+                aggregate, window_size_ms, slide_ms,
+                finish_tier=finish_tier),
+            aggregate, max_parallelism, step_batch)
+        self.size = window_size_ms
+        self.slide = slide_ms
+
+
+class MeshLogSessionWindows(_MeshShardedLogEngine):
+    """Session windows over the mesh.  Sessions are per-key and key
+    groups partition keys disjointly, so per-shard gap merging is
+    exactly the single-host semantics (MergingWindowSet.java:156)."""
+
+    def __init__(self, aggregate: CountMinSketchAggregate, gap_ms: int,
+                 mesh, axis: str = "kg", max_parallelism: int = 128,
+                 step_batch: int = 8192):
+        from flink_tpu.streaming.log_windows import (
+            LogStructuredSessionWindows,
+        )
+        super().__init__(
+            mesh, axis,
+            lambda: LogStructuredSessionWindows(aggregate, gap_ms),
+            aggregate, max_parallelism, step_batch)
+        self.gap = gap_ms
+
+
+def mesh_log_engine_for_assigner(assigner, agg: DeviceAggregateFunction,
+                                 mesh, axis: str = "kg",
+                                 max_parallelism: int = 128):
+    """Mesh-sharded log tier for this assigner+aggregate, or None when
+    the cell decomposition / assigner shape doesn't fit (same scope as
+    log_engine_for_assigner: integer keys, HLL/Sum/Quantile cells,
+    Count-Min sessions)."""
+    from flink_tpu.streaming.windowing import (
+        EventTimeSessionWindows,
+        SlidingEventTimeWindows,
+        TumblingEventTimeWindows,
+    )
+    try:
+        if isinstance(assigner, TumblingEventTimeWindows) \
+                and assigner.offset == 0:
+            return MeshLogTumblingWindows(
+                agg, assigner.size, mesh, axis=axis,
+                max_parallelism=max_parallelism)
+        if (isinstance(assigner, SlidingEventTimeWindows)
+                and assigner.offset == 0
+                and assigner.size % assigner.slide == 0):
+            return MeshLogSlidingWindows(
+                agg, assigner.size, assigner.slide, mesh, axis=axis,
+                max_parallelism=max_parallelism)
+        if isinstance(assigner, EventTimeSessionWindows):
+            return MeshLogSessionWindows(
+                agg, assigner.gap, mesh, axis=axis,
+                max_parallelism=max_parallelism)
+    except (TypeError, ValueError, RuntimeError):
+        pass  # unsupported cell decomposition / params / no native lib
+    return None

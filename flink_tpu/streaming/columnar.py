@@ -189,7 +189,8 @@ class ColumnarWindowOperator(StreamOperator):
     def __init__(self, assigner, agg: DeviceAggregateFunction,
                  key_col: str, input_col: Optional[str],
                  out_fields: Sequence[tuple],
-                 initial_capacity: int = 1 << 14):
+                 initial_capacity: int = 1 << 14,
+                 mesh=None, mesh_axis: str = "kg"):
         super().__init__()
         self.assigner = assigner
         self.agg = agg
@@ -197,6 +198,12 @@ class ColumnarWindowOperator(StreamOperator):
         self.input_col = input_col
         self.out_fields = list(out_fields)
         self.initial_capacity = initial_capacity
+        #: with a mesh, the keyBy exchange is lax.all_to_all over the
+        #: mesh axis and the aggregation shards over per-shard log
+        #: engines (parallel/mesh_log.py) — the plan then stays at
+        #: parallelism 1 and the mesh provides the scale axis
+        self.mesh = mesh
+        self.mesh_axis = mesh_axis
         self.engine = None
         self.num_late_records_dropped = 0
 
@@ -219,6 +226,14 @@ class ColumnarWindowOperator(StreamOperator):
                     "aggregate required)")
             return eng
         eng = None
+        if self.mesh is not None and np.issubdtype(key_dtype, np.integer):
+            from flink_tpu.parallel.mesh_log import (
+                mesh_log_engine_for_assigner,
+            )
+            eng = mesh_log_engine_for_assigner(
+                self.assigner, self.agg, self.mesh, axis=self.mesh_axis)
+            if eng is not None:
+                return eng
         if key_dtype.kind in "US":
             eng = self._string_engine()
             if eng is not None:
@@ -340,9 +355,12 @@ class ColumnarWindowOperator(StreamOperator):
         snap = super().snapshot_state(checkpoint_id)
         if self.engine is not None:
             snap["columnar_engine"] = self.engine.snapshot()
+            from flink_tpu.parallel.mesh_log import _MeshShardedLogEngine
             from flink_tpu.streaming import log_windows as lw
             if isinstance(self.engine, lw.StringSumTumblingWindows):
                 snap["columnar_tier"] = "string_sum"
+            elif isinstance(self.engine, _MeshShardedLogEngine):
+                snap["columnar_tier"] = "mesh_log"
             elif isinstance(self.engine, (lw.LogStructuredTumblingWindows,
                                           lw.LogStructuredSessionWindows)):
                 snap["columnar_tier"] = "log"
@@ -366,6 +384,23 @@ class ColumnarWindowOperator(StreamOperator):
                             raise RuntimeError(
                                 "checkpoint was taken on the fused "
                                 "string-sum tier, unavailable here")
+                    elif tier == "mesh_log":
+                        from flink_tpu.parallel.mesh_log import (
+                            mesh_log_engine_for_assigner,
+                        )
+                        if self.mesh is None:
+                            raise RuntimeError(
+                                "checkpoint was taken on the mesh log "
+                                "tier; restoring requires a mesh "
+                                "(env.set_mesh)")
+                        self.engine = mesh_log_engine_for_assigner(
+                            self.assigner, self.agg, self.mesh,
+                            axis=self.mesh_axis)
+                        if self.engine is None:
+                            raise RuntimeError(
+                                "checkpoint was taken on the mesh log "
+                                "tier, which is unavailable here "
+                                "(native runtime required)")
                     else:
                         is_log = tier == "log"
                         key_dtype = (np.dtype(np.uint64) if is_log

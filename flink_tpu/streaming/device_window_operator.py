@@ -181,21 +181,10 @@ class DeviceWindowOperator(StreamOperator):
 
     # ---- lifecycle --------------------------------------------------
     def open(self):
-        if self.mesh is None and not assigner_supported(self.assigner):
+        if not assigner_supported(self.assigner):
             # fail fast at open, not at the first flush
             raise ValueError(
                 f"no device engine for assigner {self.assigner!r}")
-        if self.mesh is not None:
-            # mesh jobs pick the sharded engine up front; single-chip
-            # jobs defer tier selection to the first flush (the log
-            # combiner tier needs the key dtype)
-            self.engine = engine_for_assigner(self.assigner, self.agg,
-                                              self.initial_capacity,
-                                              mesh=self.mesh,
-                                              mesh_axis=self.mesh_axis)
-            if self.engine is None:
-                raise ValueError(
-                    f"no device engine for assigner {self.assigner!r}")
         self.collector = TimestampedCollector(self.output)
         # metric parity with the scalar WindowOperator (ref:
         # WindowOperator.java:138 numLateRecordsDropped); reset = this
@@ -239,10 +228,29 @@ class DeviceWindowOperator(StreamOperator):
         decomposition (string keys reach it through the interner);
         string-keyed tumbling sums get the fused wordcount engine;
         everything else (and every aggregate the log tier doesn't
-        cover) runs the device-resident scatter tier."""
+        cover) runs the device-resident scatter tier.  With a mesh,
+        the sharded twins take over: the mesh log tier (all_to_all
+        keyBy exchange + per-shard log fires, parallel/mesh_log.py)
+        when eligible, else the sharded scatter engines."""
         if self.engine is not None:
             return
-        if keys_arr.dtype.kind in "US" and keys_arr.ndim == 1 \
+        if self.mesh is not None:
+            if np.issubdtype(keys_arr.dtype, np.integer):
+                from flink_tpu.parallel.mesh_log import (
+                    mesh_log_engine_for_assigner,
+                )
+                self.engine = mesh_log_engine_for_assigner(
+                    self.assigner, self.agg, self.mesh,
+                    axis=self.mesh_axis)
+            if self.engine is None:
+                self.engine = engine_for_assigner(
+                    self.assigner, self.agg, self.initial_capacity,
+                    mesh=self.mesh, mesh_axis=self.mesh_axis)
+            if self.engine is None:
+                raise ValueError(
+                    f"no device engine for assigner {self.assigner!r}")
+        if self.engine is None \
+                and keys_arr.dtype.kind in "US" and keys_arr.ndim == 1 \
                 and self._wants_fused_string_sum():
             self.engine = string_sum_engine_for_assigner(self.assigner,
                                                          self.agg)
@@ -374,10 +382,13 @@ class DeviceWindowOperator(StreamOperator):
         self._flush_buffer()
         snap = super().snapshot_state(checkpoint_id)
         if self.engine is not None:
+            from flink_tpu.parallel.mesh_log import _MeshShardedLogEngine
             from flink_tpu.streaming import log_windows as lw
             snap["device_engine"] = self.engine.snapshot()
             if isinstance(self.engine, lw.StringSumTumblingWindows):
                 snap["device_tier"] = "string_sum"
+            elif isinstance(self.engine, _MeshShardedLogEngine):
+                snap["device_tier"] = "mesh_log"
             elif isinstance(self.engine, (lw.LogStructuredTumblingWindows,
                                           lw.LogStructuredSessionWindows)):
                 snap["device_tier"] = "log"
@@ -423,7 +434,25 @@ class DeviceWindowOperator(StreamOperator):
                                 "checkpoint was taken on the log engine "
                                 "tier, which is unavailable here (native "
                                 "runtime required)")
+                    elif s.get("device_tier") == "mesh_log":
+                        from flink_tpu.parallel.mesh_log import (
+                            mesh_log_engine_for_assigner,
+                        )
+                        if self.mesh is None:
+                            raise RuntimeError(
+                                "checkpoint was taken on the mesh log "
+                                "tier; restoring requires a mesh "
+                                "(env.set_mesh)")
+                        self.engine = mesh_log_engine_for_assigner(
+                            self.assigner, self.agg, self.mesh,
+                            axis=self.mesh_axis)
+                        if self.engine is None:
+                            raise RuntimeError(
+                                "checkpoint was taken on the mesh log "
+                                "tier, which is unavailable here "
+                                "(native runtime required)")
                     else:
                         self.engine = engine_for_assigner(
-                            self.assigner, self.agg, self.initial_capacity)
+                            self.assigner, self.agg, self.initial_capacity,
+                            mesh=self.mesh, mesh_axis=self.mesh_axis)
                 self.engine.restore(s["device_engine"])

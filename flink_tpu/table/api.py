@@ -468,10 +468,20 @@ def _try_columnar_windowed_agg(table: Table, keys: List[Expr],
         ColumnarWindowOperator,
     )
 
+    # with a mesh set (and task parallelism 1), the keyBy exchange
+    # rides the mesh axis (lax.all_to_all + per-shard log engines,
+    # parallel/mesh_log.py) instead of the TCP split exchange — the
+    # mesh IS the scale axis
+    env = table.stream.env
+    mesh = env.mesh if env.parallelism == 1 else None
+    mesh_axis = env.mesh_axis
+
     def factory(assigner=assigner, agg=agg, key_col=key_col,
-                input_col=input_col, out_fields=tuple(out_fields)):
+                input_col=input_col, out_fields=tuple(out_fields),
+                mesh=mesh, mesh_axis=mesh_axis):
         return ColumnarWindowOperator(assigner, agg, key_col, input_col,
-                                      out_fields)
+                                      out_fields, mesh=mesh,
+                                      mesh_axis=mesh_axis)
 
     par = table.stream.env.parallelism
     if par == 1:
