@@ -25,7 +25,16 @@ class ExecutionEnvironment:
 
     def __init__(self):
         self.parallelism = 4
+        self.max_parallelism = 128
         self._sinks: List[Tuple["DataSet", Callable[[List[Any]], None]]] = []
+        #: distributed execution: run plans as BatchNodeOperator chains
+        #: on the streaming runtime (batch/distributed.py — the
+        #: BatchTask.java:239 analogue) instead of the local evaluator
+        self._mini_cluster_workers: Optional[int] = None
+        self._remote_cluster: Optional[str] = None
+        self._checkpoint_interval: Optional[int] = None
+        self._restart_attempts = 3
+        self._restart_delay_ms = 0
 
     @staticmethod
     def get_execution_environment() -> "ExecutionEnvironment":
@@ -34,6 +43,37 @@ class ExecutionEnvironment:
     def set_parallelism(self, n: int) -> "ExecutionEnvironment":
         self.parallelism = n
         return self
+
+    # ---- distributed execution ------------------------------------------
+    def use_mini_cluster(self, n_workers: int) -> "ExecutionEnvironment":
+        """Execute plans as streaming jobs on an in-process MiniCluster
+        with `n_workers` task executors (subtask fan-out, keyBy
+        shuffles, failure recovery — ref BatchTask over the shared
+        runtime)."""
+        self._mini_cluster_workers = n_workers
+        return self
+
+    def use_remote_cluster(self, address: str) -> "ExecutionEnvironment":
+        """Execute plans on a running JobManager (host:port)."""
+        self._remote_cluster = address
+        return self
+
+    def enable_checkpointing(self, interval_ms: int,
+                             restart_attempts: int = 3,
+                             delay_ms: int = 0) -> "ExecutionEnvironment":
+        """Barrier-checkpoint the distributed batch job: buffered node
+        inputs ride checkpoints, so a mid-job process kill resumes
+        without reprocessing finished inputs.  Checkpoint cost is the
+        buffered data — guarded by BatchNodeOperator's buffer limit;
+        for large inputs leave checkpointing off (recovery then
+        restarts from the sources)."""
+        self._checkpoint_interval = interval_ms
+        self._restart_attempts = restart_attempts
+        self._restart_delay_ms = delay_ms
+        return self
+
+    def _distributed(self) -> bool:
+        return bool(self._mini_cluster_workers or self._remote_cluster)
 
     # ---- sources ------------------------------------------------------
     def from_collection(self, data: Iterable[Any]) -> "DataSet":
@@ -77,21 +117,32 @@ class DataSet:
         self.size_estimate = size_estimate
         self.detail = detail
         self._cache: Optional[List[Any]] = None
+        #: distributed placement (batch/distributed.py ship strategies):
+        #: "any" = data-parallel on arbitrary partitions; a dist_keys
+        #: tuple (one KeySelector per input) = data-parallel behind a
+        #: hash key-partitioned exchange; None = gather to parallelism 1
+        self.dist_mode: Optional[str] = None
+        self.dist_keys = None
 
     # ---- plan building -------------------------------------------------
-    def _derive(self, op, fn, inputs=None, size=None, detail="") -> "DataSet":
-        return DataSet(self.env, op,
+    def _derive(self, op, fn, inputs=None, size=None, detail="",
+                dist=None, dist_keys=None) -> "DataSet":
+        node = DataSet(self.env, op,
                        tuple(inputs) if inputs is not None else (self,),
                        fn, size_estimate=size, detail=detail)
+        node.dist_mode = dist
+        node.dist_keys = dist_keys
+        return node
 
     def map(self, fn) -> "DataSet":
         return self._derive("map", lambda ins: [fn(x) for x in ins[0]],
-                            size=self.size_estimate)
+                            size=self.size_estimate, dist="any")
 
     def flat_map(self, fn) -> "DataSet":
         return self._derive(
             "flat_map",
-            lambda ins: [y for x in ins[0] for y in (fn(x) or [])])
+            lambda ins: [y for x in ins[0] for y in (fn(x) or [])],
+            dist="any")
 
     def map_partition(self, fn) -> "DataSet":
         """fn(iterable) -> iterable, applied per parallel partition
@@ -105,11 +156,12 @@ class DataSet:
             for i in range(0, len(data), n):
                 out.extend(fn(data[i:i + n]) or [])
             return out
-        return self._derive("map_partition", run)
+        return self._derive("map_partition", run, dist="any")
 
     def filter(self, fn) -> "DataSet":
         return self._derive("filter",
-                            lambda ins: [x for x in ins[0] if fn(x)])
+                            lambda ins: [x for x in ins[0] if fn(x)],
+                            dist="any")
 
     def distinct(self, key_selector=None) -> "DataSet":
         ks = as_key_selector(key_selector) if key_selector else None
@@ -123,11 +175,13 @@ class DataSet:
                     seen.add(k)
                     out.append(x)
             return out
-        return self._derive("distinct", run)
+        route_ks = ks if ks is not None \
+            else as_key_selector(lambda x: x)
+        return self._derive("distinct", run, dist_keys=(route_ks,))
 
     def union(self, other: "DataSet") -> "DataSet":
         return self._derive("union", lambda ins: ins[0] + ins[1],
-                            inputs=(self, other))
+                            inputs=(self, other), dist="any")
 
     def cross(self, other: "DataSet") -> "DataSet":
         return CrossOperator(self, other)
@@ -183,10 +237,10 @@ class DataSet:
         # kept for API parity and plan display
         ks = as_key_selector(key_selector)
         return self._derive("partition_by_hash", lambda ins: ins[0],
-                            detail="hash")
+                            detail="hash", dist_keys=(ks,))
 
     def rebalance(self) -> "DataSet":
-        return self._derive("rebalance", lambda ins: ins[0])
+        return self._derive("rebalance", lambda ins: ins[0], dist="any")
 
     #: records above which sort_partition spills through the external
     #: sorter (the managed-memory budget analogue)
@@ -208,7 +262,7 @@ class DataSet:
                                    reverse=not ascending,
                                    memory_budget=budget)
 
-        return self._derive("sort_partition", run)
+        return self._derive("sort_partition", run, dist="any")
 
     def first(self, n: int) -> "DataSet":
         return self._derive("first", lambda ins: ins[0][:n], size=n)
@@ -244,8 +298,29 @@ class DataSet:
 
     # ---- evaluation ------------------------------------------------------
     def _evaluate(self) -> List[Any]:
+        if self.env._distributed() and not self._needs_local_evaluator():
+            from flink_tpu.batch.distributed import run_distributed
+            return run_distributed(self)
         from flink_tpu.batch.optimizer import optimize
         return optimize(self).execute()
+
+    def _needs_local_evaluator(self) -> bool:
+        """BSP iterations re-evaluate sub-plans per superstep against
+        cached handles — a control pattern the local evaluator owns;
+        plans containing them evaluate locally even on a cluster
+        environment (the scoping note in batch/distributed.py)."""
+        from flink_tpu.batch.distributed import LOCAL_ONLY_OPS
+        seen = set()
+
+        def scan(node) -> bool:
+            if id(node) in seen:
+                return False
+            seen.add(id(node))
+            if node.op in LOCAL_ONLY_OPS:
+                return True
+            return any(scan(i) for i in node.inputs)
+
+        return scan(self)
 
     def explain(self) -> str:
         from flink_tpu.batch.optimizer import optimize
@@ -287,7 +362,8 @@ class GroupedDataSet:
                     acc = fn(acc, x)
                 out.append(acc)
             return out
-        return self.ds._derive("group_reduce", run, detail="hash-group")
+        return self.ds._derive("group_reduce", run, detail="hash-group",
+                               dist_keys=(grouped.ks,))
 
     def reduce_group(self, fn) -> DataSet:
         grouped = self
@@ -298,6 +374,7 @@ class GroupedDataSet:
                 out.extend(fn(g) or [])
             return out
         return self.ds._derive("group_reduce_group", run,
+                               dist_keys=(grouped.ks,),
                                detail="hash-group")
 
     def aggregate(self, agg: str, field) -> DataSet:
@@ -325,7 +402,9 @@ class GroupedDataSet:
                     row[field] = v
                 out.append(tuple(row) if isinstance(g[-1], tuple) else row)
             return out
-        return self.ds._derive("group_aggregate", run, detail="hash-group")
+        return self.ds._derive("group_aggregate", run,
+                               detail="hash-group",
+                               dist_keys=(grouped.ks,))
 
     def first(self, n: int) -> DataSet:
         grouped = self
@@ -335,7 +414,8 @@ class GroupedDataSet:
             for g in grouped._groups(ins[0]).values():
                 out.extend(g[:n])
             return out
-        return self.ds._derive("group_first", run)
+        return self.ds._derive("group_first", run,
+                               dist_keys=(grouped.ks,))
 
 
 class _KeyedTwoInput:
@@ -401,8 +481,12 @@ class JoinOperator(_KeyedTwoInput):
                                        else fn(None, x))
             return out
 
-        return DataSet(self.left.env, "join", (self.left, self.right), run,
-                       detail=f"hash-join outer={self.outer}")
+        node = DataSet(self.left.env, "join", (self.left, self.right),
+                       run, detail=f"hash-join outer={self.outer}")
+        # equi-join: a hash key-partitioned exchange on both inputs
+        # gives every subtask complete key groups
+        node.dist_keys = (ks1, ks2)
+        return node
 
     # joining without apply yields pairs
     def project_first(self) -> DataSet:
@@ -430,8 +514,11 @@ class CoGroupOperator(_KeyedTwoInput):
                 out.extend(fn(g1.get(k, []), g2.get(k, [])) or [])
             return out
 
-        return DataSet(self.left.env, "co_group",
-                       (self.left, self.right), run, detail="hash-cogroup")
+        node = DataSet(self.left.env, "co_group",
+                       (self.left, self.right), run,
+                       detail="hash-cogroup")
+        node.dist_keys = (ks1, ks2)
+        return node
 
 
 class CrossOperator:

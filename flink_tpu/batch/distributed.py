@@ -30,6 +30,11 @@ import pickle
 from typing import Any, Callable, Dict, List, Optional
 
 from flink_tpu.core.keygroups import assign_key_to_parallel_operator
+
+#: plan ops the distributed runner cannot host (BSP iterations
+#: re-evaluate sub-plans against cached handles — local evaluator
+#: control flow); DataSet._needs_local_evaluator consults the same set
+LOCAL_ONLY_OPS = ("iterate", "delta_iterate", "iterate_head")
 from flink_tpu.streaming.elements import (
     MAX_TIMESTAMP,
     StreamRecord,
@@ -45,11 +50,21 @@ class BatchNodeOperator(StreamOperator):
     edge).  Buffers ride barrier checkpoints, so a process kill
     mid-job resumes without reprocessing finished inputs."""
 
+    #: elements a subtask may carry into ONE checkpoint; beyond it the
+    #: snapshot would serialize a dataset-sized buffer per checkpoint,
+    #: so the guard fails fast with the remedy (disable checkpointing —
+    #: recovery then restarts from the bounded sources)
+    CHECKPOINT_BUFFER_LIMIT = 1 << 20
+
     def __init__(self, fn: Callable[[List[List[Any]]], List[Any]],
-                 n_inputs: int):
+                 n_inputs: int,
+                 checkpoint_buffer_limit: Optional[int] = None):
         super().__init__()
         self.fn = fn
         self.n_inputs = n_inputs
+        self.checkpoint_buffer_limit = (
+            self.CHECKPOINT_BUFFER_LIMIT if checkpoint_buffer_limit is None
+            else checkpoint_buffer_limit)
         self.buffers: List[List[Any]] = [[] for _ in range(n_inputs)]
         self._done = False
 
@@ -70,6 +85,15 @@ class BatchNodeOperator(StreamOperator):
         self.output.emit_watermark(watermark)
 
     def snapshot_state(self, checkpoint_id: Optional[int] = None) -> dict:
+        buffered = sum(len(b) for b in self.buffers)
+        if buffered > self.checkpoint_buffer_limit:
+            raise RuntimeError(
+                f"batch node buffers {buffered} elements, over the "
+                f"checkpoint guard ({self.checkpoint_buffer_limit}); "
+                "checkpointing a batch job snapshots its in-flight "
+                "buffers — for inputs this size run with checkpointing "
+                "DISABLED (recovery restarts from the bounded sources) "
+                "or raise BatchNodeOperator.CHECKPOINT_BUFFER_LIMIT")
         snap = super().snapshot_state(checkpoint_id)
         snap["batch_buffers"] = pickle.dumps(
             (self.buffers, self._done), protocol=pickle.HIGHEST_PROTOCOL)
@@ -87,10 +111,6 @@ class BatchNodeOperator(StreamOperator):
         self.buffers = merged
 
 
-class _TagSink:
-    pass
-
-
 def run_distributed(root) -> List[Any]:
     """Execute the plan rooted at `root` as a streaming job on the
     environment's MiniCluster / remote cluster; returns the root's
@@ -106,16 +126,26 @@ def run_distributed(root) -> List[Any]:
         senv.use_remote_cluster(benv._remote_cluster)
     if getattr(benv, "_checkpoint_interval", None):
         senv.enable_checkpointing(benv._checkpoint_interval)
-        senv.set_restart_strategy(
-            "fixed_delay",
-            restart_attempts=getattr(benv, "_restart_attempts", 3),
-            delay_ms=getattr(benv, "_restart_delay_ms", 0))
+    # restart strategy applies with checkpointing OFF too: the inputs
+    # are bounded, so recovery without a checkpoint replays the
+    # sources from the start (the remedy the checkpoint-buffer guard
+    # points large jobs at)
+    senv.set_restart_strategy(
+        "fixed_delay",
+        restart_attempts=getattr(benv, "_restart_attempts", 3),
+        delay_ms=getattr(benv, "_restart_delay_ms", 0))
     par = benv.parallelism
     senv.set_parallelism(par)
+    if getattr(benv, "max_parallelism", None):
+        senv.set_max_parallelism(benv.max_parallelism)
 
     streams: Dict[int, Any] = {}
 
     def tag(stream, index: int):
+        # sources and BatchNodeOperators already emit (0, v) carriers,
+        # so tag 0 is the identity — only union inputs > 0 re-tag
+        if index == 0:
+            return stream
         return stream.map(lambda tv, i=index: (i, tv[1]),
                           name=f"batch_tag_{index}")
 
@@ -124,14 +154,19 @@ def run_distributed(root) -> List[Any]:
         if nid in streams:
             return streams[nid]
         mode = getattr(node, "dist_mode", None)
-        if node.op in ("iterate", "iterate_delta") or mode == "local":
+        if node.op in LOCAL_ONLY_OPS or mode == "local":
             raise NotImplementedError(
                 f"DataSet op {node.op!r} runs on the local evaluator "
                 f"only; drop use_mini_cluster for this pipeline")
         if not node.inputs:
             # source: materialize locally, ship via from_collection
+            # (an env-provided factory may substitute an equivalent
+            # source — the fault-injection seam the reference's FT
+            # tests use by wrapping user sources)
             items = [(0, v) for v in node.fn([])]
-            s = senv.from_collection(items)
+            factory = getattr(benv, "_distributed_source_factory", None)
+            s = (factory(senv, items) if factory is not None
+                 else senv.from_collection(items))
             streams[nid] = s
             return s
         ins = [build(up) for up in node.inputs]

@@ -1,0 +1,140 @@
+"""Distributed batch execution IT: a DataSet plan running as
+BatchNodeOperator chains on a REAL multi-process cluster, with a
+SIGKILL mid-job (the batch twin of
+AbstractTaskManagerProcessFailureRecoveryTest — SURVEY.md §4.4;
+execution model ref: BatchTask.java:239,461-503)."""
+
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+
+from flink_tpu.batch import ExecutionEnvironment
+from flink_tpu.runtime.cluster import (
+    JobManagerProcess,
+    TaskManagerProcess,
+)
+from flink_tpu.streaming.sources import FromCollectionSource
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+TM_SCRIPT = """
+import sys
+from flink_tpu.cli import main
+sys.exit(main(["taskmanager", "--master", sys.argv[1],
+               "--slots", sys.argv[2], "--tm-id", sys.argv[3]]))
+"""
+
+
+def _spawn_tm(jm_address, slots, tm_id):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.pathsep.join(
+        [REPO_ROOT, os.path.join(REPO_ROOT, "tests"),
+         env.get("PYTHONPATH", "")])
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    return subprocess.Popen(
+        [sys.executable, "-c", TM_SCRIPT, jm_address, str(slots), tm_id],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        cwd=REPO_ROOT, env=env)
+
+
+class BatchMarkerGatedSource(FromCollectionSource):
+    """Holds back the input tail until a marker file appears, so the
+    kill always lands mid-job with checkpoints flowing (the temp-file
+    coordination of the reference's process-failure recovery tests)."""
+
+    HOLD = 200
+
+    def __init__(self, items, marker_path):
+        super().__init__(items)
+        self.marker_path = marker_path
+
+    def emit_step(self, ctx, max_records):
+        if not os.path.exists(self.marker_path) \
+                and self.offset >= len(self.items) - self.HOLD:
+            time.sleep(0.002)
+            return True  # alive but holding the tail back
+        return super().emit_step(ctx, max_records)
+
+
+def test_batch_job_survives_taskmanager_kill():
+    """groupBy().reduce over a remote cluster; SIGKILL one TM while the
+    source is gated mid-stream; the job fails over and the batch result
+    is exact."""
+    jm = JobManagerProcess()
+    survivor = TaskManagerProcess(jm.address, num_slots=4,
+                                  tm_id="a-survivor")
+    victim = _spawn_tm(jm.address, 4, "z-victim")
+    marker = os.path.join(tempfile.mkdtemp(), "killed.marker")
+    data = [(i % 6, 1) for i in range(3000)]
+    try:
+        deadline = time.monotonic() + 30.0
+        ov = {}
+        while time.monotonic() < deadline:
+            ov = jm.resource_manager.run_async(
+                jm.resource_manager.cluster_overview).get(5.0)
+            if ov["task_executors"] >= 2:
+                break
+            time.sleep(0.05)
+        assert ov["task_executors"] >= 2, "victim TM never registered"
+
+        env = ExecutionEnvironment.get_execution_environment()
+        env.use_remote_cluster(jm.address)
+        env.set_parallelism(2)
+        env.enable_checkpointing(20, restart_attempts=5, delay_ms=50)
+        env._distributed_source_factory = (
+            lambda senv, items, m=marker:
+            senv.add_source(BatchMarkerGatedSource(items, m),
+                            name="gated_batch_source"))
+
+        result_box = {}
+
+        def run():
+            try:
+                result_box["out"] = (
+                    env.from_collection(data)
+                    .group_by(lambda t: t[0])
+                    .reduce(lambda a, b: (a[0], a[1] + b[1]))
+                    .collect())
+            except Exception as exc:  # noqa: BLE001 — surfaced below
+                result_box["err"] = exc
+
+        t = threading.Thread(target=run, daemon=True)
+        t.start()
+
+        # wait for the job to appear and complete >= 1 checkpoint
+        dispatcher = jm.dispatcher
+        job_id = None
+        deadline = time.monotonic() + 60.0
+        while time.monotonic() < deadline:
+            jobs = dispatcher.run_async(dispatcher.list_jobs).get(5.0)
+            running = [j for j in jobs if j["state"] == "RUNNING"]
+            if running:
+                job_id = running[0]["job_id"]
+                status = dispatcher.run_async(
+                    dispatcher.request_job_status, job_id).get(5.0)
+                if status["checkpoints_completed"] >= 1:
+                    break
+            time.sleep(0.02)
+        assert job_id is not None, "batch job never started RUNNING"
+        assert status["checkpoints_completed"] >= 1, \
+            "no checkpoint completed before the kill"
+
+        os.kill(victim.pid, signal.SIGKILL)
+        victim.wait(10.0)
+        with open(marker, "w") as f:
+            f.write("killed")
+
+        t.join(timeout=120.0)
+        assert not t.is_alive(), "batch job did not finish after kill"
+        if "err" in result_box:
+            raise result_box["err"]
+        assert sorted(result_box["out"]) == [(k, 500) for k in range(6)]
+    finally:
+        if victim.poll() is None:
+            victim.kill()
+        survivor.stop()
+        jm.stop()
