@@ -595,13 +595,22 @@ class ColumnarIntervalJoinOperator(StreamOperator):
             if len(lrows):
                 sl, sr = self._store[0], self._store[1]
                 # exact key equality: the native core joins on 64-bit
-                # hashes; a collision must not emit a false pair
-                eq = (sl["cols"][self.key_l][lrows]
-                      == sr["cols"][self.key_r][rrows])
-                if not eq.all():
-                    lrows, rrows = lrows[eq], rrows[eq]
-                    if not len(lrows):
-                        return
+                # hashes.  INTEGER keys hash via splitmix64 of their
+                # 64-bit pattern — a BIJECTION, so collisions are
+                # impossible and the recheck is skipped.  The two
+                # sides must share signedness (a negative's bit
+                # pattern aliases a huge unsigned); strings and
+                # composites hash lossily and always verify.
+                lkd = sl["cols"][self.key_l].dtype
+                rkd = sr["cols"][self.key_r].dtype
+                int_keys = lkd.kind == rkd.kind and lkd.kind in "iu"
+                if not int_keys:
+                    eq = (sl["cols"][self.key_l][lrows]
+                          == sr["cols"][self.key_r][rrows])
+                    if not eq.all():
+                        lrows, rrows = lrows[eq], rrows[eq]
+                        if not len(lrows):
+                            return
                 l_cols = {n: sl["cols"][c][lrows] for n, c in self.out_l}
                 r_cols = {n: sr["cols"][c][rrows] for n, c in self.out_r}
                 out_ts = np.maximum(sl["ts"][lrows], sr["ts"][rrows])

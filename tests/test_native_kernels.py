@@ -196,3 +196,43 @@ def test_string_baseline_runs():
     words = np.asarray([f"w{i % 100}" for i in range(5000)])
     rate = nat.heap_tumbling_baseline_str(words, np.ones(5000))
     assert rate > 0
+
+
+def test_ivjoin_many_small_batches_with_pruning():
+    """Streaming-lifetime shape for the LSM join core: thousands of
+    tiny pushes with the watermark keeping pace — results must match
+    one big push, and tails must keep folding (bounded run count is
+    what the IV_MAX_TAILS merge trigger guarantees)."""
+    import numpy as np
+    import flink_tpu.native as nat
+    if not nat.available():
+        import pytest
+        pytest.skip("native runtime required")
+    rng = np.random.default_rng(5)
+    n = 40_000
+    lk = nat.splitmix64(rng.integers(0, 300, n).astype(np.uint64))
+    lts = np.sort(rng.integers(0, 200_000, n).astype(np.int64))
+    rk = nat.splitmix64(rng.integers(0, 300, n).astype(np.uint64))
+    rts = np.sort(rng.integers(0, 200_000, n).astype(np.int64))
+
+    # reference: one push per side, no pruning
+    big = nat.NativeIntervalJoin(-50, 50)
+    bl, br = big.push(0, lk, lts)
+    bl2, br2 = big.push(1, rk, rts)
+    want = set(zip(bl.tolist(), br.tolist())) \
+        | set(zip(bl2.tolist(), br2.tolist()))
+
+    # 800 interleaved pushes of 100 rows with a trailing watermark
+    # (prunes rows already matched — emitted pairs are unaffected)
+    small = nat.NativeIntervalJoin(-50, 50)
+    got = set()
+    step = 100
+    for off in range(0, n, step):
+        for side, (k, t) in ((0, (lk, lts)), (1, (rk, rts))):
+            l, r = small.push(side, k[off:off + step],
+                              t[off:off + step])
+            got.update(zip(l.tolist(), r.tolist()))
+        wm = int(min(lts[min(off + step, n) - 1],
+                     rts[min(off + step, n) - 1])) - 200
+        small.prune(wm)
+    assert got == want and len(want) > 2_000
