@@ -234,7 +234,13 @@ class _HllMode:
         ranks_p[:n_cells] = ranks
         ends_p = np.ones(pk, np.int32)
         ends_p[:n_keys] = ends
-        out = np.asarray(self._jit_finish(ranks_p, ends_p,
+        # explicit device_put: passing numpy args through jit stages
+        # them through a much slower per-argument path on the tunnel
+        # backend (measured 902 ms vs 14 ms for 20 MB — BENCH_NOTES
+        # round 4); the put also starts the H2D before dispatch
+        dev = jax.devices()[0]
+        out = np.asarray(self._jit_finish(jax.device_put(ranks_p, dev),
+                                          jax.device_put(ends_p, dev),
                                           np.int32(n_cells),
                                           np.int32(n_keys)))
         return out[:n_keys].astype(np.float64)
