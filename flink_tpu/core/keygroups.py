@@ -239,3 +239,29 @@ class KeyGroupRangeOffsets:
     def __iter__(self) -> Iterator[Tuple[int, int]]:
         for kg in self.key_group_range:
             yield kg, self.get_key_group_offset(kg)
+
+
+def make_key_group_keep_fn(max_parallelism: int, num_subtasks: int,
+                           subtask_index: int):
+    """Vectorized ownership filter for rescaled state restores: keys
+    (any array hash_keys_np accepts — integer bit-patterns or word
+    arrays) → bool mask of the keys whose key group routes to
+    `subtask_index`.  ONE definition shared by every engine-carrying
+    operator so restored state and live-record routing can never
+    disagree (ref: KeyGroupRangeAssignment + StateAssignmentOperation's
+    re-split).  None when a single subtask owns everything."""
+    if num_subtasks <= 1:
+        return None
+
+    def keep(keys):
+        from flink_tpu.streaming.vectorized import hash_keys_np
+        kh = hash_keys_np(np.asarray(keys))
+        try:
+            import flink_tpu.native as nat
+            tgt = nat.key_groups(kh, max_parallelism, num_subtasks)
+        except Exception:  # noqa: BLE001 — numpy twin
+            tgt = assign_operator_indexes_np(kh, max_parallelism,
+                                             num_subtasks)
+        return tgt == subtask_index
+
+    return keep

@@ -564,7 +564,11 @@ class JobMaster(RpcEndpoint):
         if restore_from is not None:
             restore_map = compute_restore_assignments(
                 {vid: v.parallelism for vid, v in jg.vertices.items()},
-                restore_from)
+                restore_from,
+                vertex_uids={vid: {n.uid for n in v.chain}
+                             for vid, v in jg.vertices.items()},
+                allow_non_restored=getattr(
+                    jg, "allow_non_restored_state", False))
             md = restore_from.get("metadata", {})
             if restore_from.get("checkpoint_id") is not None \
                     and md.get("master_epoch") is not None:

@@ -336,6 +336,7 @@ class SubtaskInstance:
                 operator_id=node.uid,
                 subtask_index=subtask_index,
                 num_subtasks=vertex.parallelism,
+                max_parallelism=max_parallelism,
             )
             if metrics_group is not None:
                 op.metrics = metrics_group.add_group(node.uid)
@@ -1130,16 +1131,60 @@ def merge_accumulators(into: Dict[str, Any], accs: Dict[str, Any]) -> None:
             into[name] = value
 
 
+def _op_snap_has_state(opsnap: dict) -> bool:
+    """Does one operator's snapshot carry anything whose loss would
+    change results?  Standard keys check their payloads; any custom
+    key (engine state, function state, buffers) counts."""
+    for k, v in opsnap.items():
+        if k == "keyed":
+            if getattr(v, "key_group_bytes", None):
+                return True
+        elif k == "operator":
+            if getattr(v, "list_states", None) \
+                    or getattr(v, "broadcast_states", None):
+                return True
+        elif k == "timers":
+            if isinstance(v, dict) and (v.get("event") or v.get("proc")):
+                return True
+        elif k == "restore_old_parallelism":
+            continue
+        else:
+            return True
+    return False
+
+
+def _vertex_has_state(snaps: List[dict]) -> bool:
+    return any(_op_snap_has_state(op)
+               for s in snaps
+               for op in s.get("operators", {}).values())
+
+
 def compute_restore_assignments(vertex_parallelisms: Dict[int, int],
-                                restore_from: dict
+                                restore_from: dict,
+                                vertex_uids: Optional[Dict[int, set]] = None,
+                                allow_non_restored: bool = False
                                 ) -> Dict[Tuple[int, int], List[dict]]:
     """Map a checkpoint/savepoint's task snapshots onto (possibly
     rescaled) subtasks (ref: StateAssignmentOperation.java — key-group
     range re-split on rescale).  Returns task_key -> snapshot list.
 
+    Vertex identity: with `vertex_uids` (new-graph vid -> set of chain
+    operator uids), old vertices match new ones by OPERATOR-UID
+    OVERLAP — the snapshot itself records which operator uids it
+    holds, so state survives topology re-shapes (a re-lowered plan
+    inserting/removing nodes, or chaining changes splitting a vertex;
+    ref: the uid matching of StateAssignmentOperation + the
+    `uid()`/`setUidHash` contract).  An old vertex carrying REAL state
+    that matches nothing raises unless allow_non_restored (the
+    reference's --allowNonRestoredState); stateless unmatched
+    snapshots drop silently.  Without vertex_uids the mapping is
+    positional (vid == vid).
+
     Same parallelism → one-to-one.  Parallelism changed:
     - keyed state + timers go to every new subtask (backends and timer
-      services filter by their key-group range);
+      services filter by their key-group range); each per-operator
+      snapshot is annotated with `restore_old_parallelism` so
+      engine-carrying operators can re-split their own keyed state;
     - operator list state re-splits round-robin
       (RoundRobinOperatorStateRepartitioner);
     - CheckpointedFunction ('function') state assigns each OLD
@@ -1155,60 +1200,104 @@ def compute_restore_assignments(vertex_parallelisms: Dict[int, int],
     old_par: Dict[int, int] = dict(restore_from.get("parallelisms") or {})
     for (vid, idx) in task_snaps:
         old_par[vid] = max(old_par.get(vid, 0), idx + 1)
-    out: Dict[Tuple[int, int], List[dict]] = {}
-    # a snapshot vertex with no live counterpart means the topology
-    # changed shape between runs (e.g. a re-plan inserted/removed a
-    # node, shifting vertex ids): its state would silently vanish —
-    # make that loud (the reference's uid-matching raises here)
-    orphaned = set(old_par) - set(vertex_parallelisms)
-    if orphaned:
+
+    def vsnaps_of(vid):
+        return [task_snaps[(vid, i)] for i in range(old_par[vid])
+                if (vid, i) in task_snaps]
+
+    # old vid -> new vids it feeds
+    edges: Dict[int, List[int]] = {}
+    if vertex_uids is None:
+        for vid in old_par:
+            if vid in vertex_parallelisms:
+                edges[vid] = [vid]
+    else:
+        for vid in old_par:
+            uids = {op_id for s in vsnaps_of(vid)
+                    for op_id in s.get("operators", {})}
+            edges[vid] = [nvid for nvid, nuids in vertex_uids.items()
+                          if uids & nuids]
+    # orphan detection is OPERATOR-granular when uids are available: a
+    # vertex may match via one pinned uid while a chained operator's
+    # positional uid shifted — that operator's state would pass the
+    # vertex check yet be silently filtered out by operator-id
+    # matching at restore time
+    if vertex_uids is not None:
+        live_uids = set()
+        for uids in vertex_uids.values():
+            live_uids |= uids
+        orphan_ops = sorted({
+            op_id
+            for vid in old_par
+            for s in vsnaps_of(vid)
+            for op_id, opsnap in s.get("operators", {}).items()
+            if op_id not in live_uids and _op_snap_has_state(opsnap)})
+        detail = (
+            f"checkpoint state for operators {orphan_ops} matches no "
+            f"operator uid in the restored topology (did the plan "
+            f"shape change without stable .uid()s?)")
+    else:
+        orphaned = [vid for vid in old_par
+                    if vid not in vertex_parallelisms]
+        orphan_ops = sorted(vid for vid in orphaned
+                            if _vertex_has_state(vsnaps_of(vid)))
+        detail = (
+            f"checkpoint state for vertices {orphan_ops} matches no "
+            f"vertex in the restored topology")
+    if orphan_ops:
+        if not allow_non_restored:
+            raise RuntimeError(
+                detail + "; restoring would silently drop state. Set "
+                "allow_non_restored_state to proceed without it.")
         import warnings
-        warnings.warn(
-            f"checkpoint state for vertices {sorted(orphaned)} has no "
-            f"matching vertex in the restored topology and will be "
-            f"DROPPED (did the plan shape change — e.g. a columnar "
-            f"plan re-lowered at a different parallelism?)",
-            stacklevel=2)
-    for vid, new_p in vertex_parallelisms.items():
+        warnings.warn(detail + "; DROPPED (allow_non_restored_state)",
+                      stacklevel=2)
+
+    out: Dict[Tuple[int, int], List[dict]] = {}
+    for vid, new_vids in edges.items():
         if old_par.get(vid, 0) == 0:
             continue  # vertex had no snapshot (e.g. newly added)
-        if old_par[vid] == new_p:
+        for nvid in new_vids:
+            new_p = vertex_parallelisms[nvid]
+            if old_par[vid] == new_p:
+                for i in range(new_p):
+                    if (vid, i) in task_snaps:
+                        out.setdefault((nvid, i), []).append(
+                            task_snaps[(vid, i)])
+                continue
+            # rescale: split out operator + function state, broadcast
+            # the keyed/timer remainder (annotated with the old
+            # parallelism so operators can key-group-filter)
+            vsnaps = vsnaps_of(vid)
+            stripped = []
+            op_state_parts: Dict[str, List] = {}
+            fn_states: Dict[str, List] = {}
+            for snap in vsnaps:
+                ops = {}
+                for op_id, opsnap in snap.get("operators", {}).items():
+                    cp = {k: v for k, v in opsnap.items()
+                          if k not in ("operator", "function")}
+                    cp["restore_old_parallelism"] = old_par[vid]
+                    ops[op_id] = cp
+                    if "operator" in opsnap:
+                        op_state_parts.setdefault(op_id, []).append(
+                            opsnap["operator"])
+                    if "function" in opsnap:
+                        fn_states.setdefault(op_id, []).append(
+                            opsnap["function"])
+                stripped.append({"operators": ops})
+            redistributed = {
+                op_id: OperatorStateSnapshot.redistribute(parts, new_p)
+                for op_id, parts in op_state_parts.items()}
             for i in range(new_p):
-                if (vid, i) in task_snaps:
-                    out[(vid, i)] = [task_snaps[(vid, i)]]
-            continue
-        # rescale: split out operator + function state, broadcast the
-        # keyed/timer remainder
-        vsnaps = [task_snaps[(vid, i)] for i in range(old_par[vid])
-                  if (vid, i) in task_snaps]
-        stripped = []
-        op_state_parts: Dict[str, List] = {}
-        fn_states: Dict[str, List] = {}
-        for snap in vsnaps:
-            ops = {}
-            for op_id, opsnap in snap.get("operators", {}).items():
-                cp = {k: v for k, v in opsnap.items()
-                      if k not in ("operator", "function")}
-                ops[op_id] = cp
-                if "operator" in opsnap:
-                    op_state_parts.setdefault(op_id, []).append(
-                        opsnap["operator"])
-                if "function" in opsnap:
-                    fn_states.setdefault(op_id, []).append(
-                        opsnap["function"])
-            stripped.append({"operators": ops})
-        redistributed = {
-            op_id: OperatorStateSnapshot.redistribute(parts, new_p)
-            for op_id, parts in op_state_parts.items()}
-        for i in range(new_p):
-            extras = [{"operators": {
-                op_id: {"operator": parts[i]}
-                for op_id, parts in redistributed.items()}}]
-            for op_id, states in fn_states.items():
-                for fstate in states[i::new_p]:
-                    extras.append({"operators": {op_id:
-                                                 {"function": fstate}}})
-            out[(vid, i)] = stripped + extras
+                extras = [{"operators": {
+                    op_id: {"operator": parts[i]}
+                    for op_id, parts in redistributed.items()}}]
+                for op_id, states in fn_states.items():
+                    for fstate in states[i::new_p]:
+                        extras.append({"operators": {op_id:
+                                                     {"function": fstate}}})
+                out.setdefault((nvid, i), []).extend(stripped + extras)
     return out
 
 
@@ -1217,7 +1306,11 @@ def assign_restore_snapshots(job_graph: JobGraph, restore_from: dict,
                              ) -> None:
     mapping = compute_restore_assignments(
         {vid: v.parallelism for vid, v in job_graph.vertices.items()},
-        restore_from)
+        restore_from,
+        vertex_uids={vid: {n.uid for n in v.chain}
+                     for vid, v in job_graph.vertices.items()},
+        allow_non_restored=getattr(job_graph,
+                                   "allow_non_restored_state", False))
     for sts in subtasks.values():
         for st in sts:
             snaps = mapping.get(st.task_key)

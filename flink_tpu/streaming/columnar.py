@@ -112,16 +112,13 @@ class ColumnarSource(SourceFunction):
 
     def __deepcopy__(self, memo):
         # per-attempt source cloning must not copy the input columns
-        # (the source only ever slices them — views, no mutation);
-        # a fresh cursor is all a clone needs
-        clone = ColumnarSource.__new__(ColumnarSource)
-        clone.cols = self.cols
-        clone.rowtime = self.rowtime
-        clone.chunk = self.chunk
-        clone.ooo_slack_ms = self.ooo_slack_ms
+        # (the source only ever slices them — views, no mutation); a
+        # fresh cursor is all a clone needs.  type(self), not
+        # ColumnarSource: a subclass (e.g. a test's gated source) must
+        # survive the per-attempt clone
+        clone = type(self).__new__(type(self))
+        clone.__dict__.update(self.__dict__)
         clone._running = True
-        clone.offset = self.offset
-        clone._final_watermark = self._final_watermark
         return clone
 
     # checkpoint hooks (CheckpointedFunction-shaped source state)
@@ -368,48 +365,76 @@ class ColumnarWindowOperator(StreamOperator):
                 snap["columnar_tier"] = "vectorized"
         return snap
 
+    def _kg_keep_fn(self):
+        """Key-group-range filter for rescaled restores (the shared
+        definition, so re-split state lands exactly where the split
+        exchange routes live records)."""
+        from flink_tpu.core.keygroups import make_key_group_keep_fn
+        return make_key_group_keep_fn(self.max_parallelism,
+                                      self.num_subtasks,
+                                      self.subtask_index)
+
+    def _build_engine_for_tier(self, tier):
+        if tier == "string_sum":
+            eng = self._string_engine()
+            if eng is None:
+                raise RuntimeError(
+                    "checkpoint was taken on the fused string-sum "
+                    "tier, unavailable here")
+            return eng
+        if tier == "mesh_log":
+            from flink_tpu.parallel.mesh_log import (
+                mesh_log_engine_for_assigner,
+            )
+            if self.mesh is None:
+                raise RuntimeError(
+                    "checkpoint was taken on the mesh log tier; "
+                    "restoring requires a mesh (env.set_mesh)")
+            eng = mesh_log_engine_for_assigner(
+                self.assigner, self.agg, self.mesh,
+                axis=self.mesh_axis)
+            if eng is None:
+                raise RuntimeError(
+                    "checkpoint was taken on the mesh log tier, which "
+                    "is unavailable here (native runtime required)")
+            return eng
+        is_log = tier == "log"
+        key_dtype = (np.dtype(np.uint64) if is_log
+                     else np.dtype(object))
+        return self._make_engine(key_dtype, require_log=is_log)
+
     def restore_state(self, snapshots) -> None:
         super().restore_state(snapshots)
-        if len(snapshots) > 1:
+        engine_snaps = [s for s in snapshots if "columnar_engine" in s]
+        if not engine_snaps:
+            return
+        tiers = {s.get("columnar_tier") for s in engine_snaps}
+        if len(tiers) > 1:
             raise ValueError(
-                "columnar window operator restores at the checkpointed "
-                "parallelism only")
-        for s in snapshots:
-            if "columnar_engine" in s:
-                if self.engine is None:
-                    tier = s.get("columnar_tier")
-                    if tier == "string_sum":
-                        self.engine = self._string_engine()
-                        if self.engine is None:
-                            raise RuntimeError(
-                                "checkpoint was taken on the fused "
-                                "string-sum tier, unavailable here")
-                    elif tier == "mesh_log":
-                        from flink_tpu.parallel.mesh_log import (
-                            mesh_log_engine_for_assigner,
-                        )
-                        if self.mesh is None:
-                            raise RuntimeError(
-                                "checkpoint was taken on the mesh log "
-                                "tier; restoring requires a mesh "
-                                "(env.set_mesh)")
-                        self.engine = mesh_log_engine_for_assigner(
-                            self.assigner, self.agg, self.mesh,
-                            axis=self.mesh_axis)
-                        if self.engine is None:
-                            raise RuntimeError(
-                                "checkpoint was taken on the mesh log "
-                                "tier, which is unavailable here "
-                                "(native runtime required)")
-                    else:
-                        is_log = tier == "log"
-                        key_dtype = (np.dtype(np.uint64) if is_log
-                                     else np.dtype(object))
-                        self.engine = self._make_engine(
-                            key_dtype, require_log=is_log)
-                    if hasattr(self.engine, "fired"):
-                        self.engine.emit_arrays = True
-                self.engine.restore(s["columnar_engine"])
+                f"snapshots span engine tiers {sorted(tiers)}; cannot "
+                "merge across tiers")
+        tier = tiers.pop()
+        rescaled = any(
+            s.get("restore_old_parallelism", self.num_subtasks)
+            != self.num_subtasks for s in engine_snaps)
+        if self.engine is None:
+            self.engine = self._build_engine_for_tier(tier)
+            if hasattr(self.engine, "fired"):
+                self.engine.emit_arrays = True
+        if not rescaled and len(engine_snaps) == 1:
+            self.engine.restore(engine_snaps[0]["columnar_engine"])
+            return
+        # parallelism changed: merge the old subtasks' engine states
+        # and keep only this subtask's key groups (ref:
+        # StateAssignmentOperation key-group re-split)
+        if not hasattr(self.engine, "restore_many"):
+            raise ValueError(
+                f"the {tier!r} engine tier cannot re-split its state "
+                "across a parallelism change; restore at the "
+                "checkpointed parallelism")
+        self.engine.restore_many(
+            [s["columnar_engine"] for s in engine_snaps],
+            keep_fn=self._kg_keep_fn())
 
 
 class BatchKeyGroupSplitOperator(StreamOperator):

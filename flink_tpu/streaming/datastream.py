@@ -205,13 +205,19 @@ class StreamExecutionEnvironment:
         self.failover_strategy = strategy
         return self
 
-    def set_savepoint_restore(self, path: str) -> "StreamExecutionEnvironment":
+    def set_savepoint_restore(self, path: str,
+                              allow_non_restored_state: bool = False
+                              ) -> "StreamExecutionEnvironment":
         """Start the next execution from a savepoint — the
         `flink run -s <path>` contract.  Restoring at a different
         parallelism re-splits keyed state by key-group range and
         operator list state round-robin (ref: SavepointRestoreSettings
-        + StateAssignmentOperation)."""
+        + StateAssignmentOperation).  Snapshot state whose operator
+        uids match nothing in the new topology FAILS the restore
+        unless allow_non_restored_state (the reference's
+        --allowNonRestoredState)."""
         self.savepoint_restore_path = path
+        self.allow_non_restored_state = allow_non_restored_state
         return self
 
     # ---- sources ----------------------------------------------------
@@ -260,6 +266,8 @@ class StreamExecutionEnvironment:
             }
         jg.savepoint_restore_path = getattr(
             self, "savepoint_restore_path", None)
+        jg.allow_non_restored_state = getattr(
+            self, "allow_non_restored_state", False)
         return jg
 
     def set_latency_tracking_interval(self, interval_ms: Optional[int]

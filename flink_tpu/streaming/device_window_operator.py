@@ -401,13 +401,52 @@ class DeviceWindowOperator(StreamOperator):
             snap["string_key_directory"] = list(self._id_to_key)
         return snap
 
+    def _kg_keep_fn(self):
+        """Key-group filter for rescaled restores (the shared
+        definition, so re-split engine state lands where the runtime's
+        keyBy partitioner routes live records)."""
+        from flink_tpu.core.keygroups import make_key_group_keep_fn
+        return make_key_group_keep_fn(self.max_parallelism,
+                                      self.num_subtasks,
+                                      self.subtask_index)
+
     def restore_state(self, snapshots) -> None:
         super().restore_state(snapshots)
-        if len(snapshots) > 1:
-            raise ValueError(
-                "device window operator cannot merge snapshots from a "
-                "parallelism change (engine state is not key-grouped); "
-                "restore at the checkpointed parallelism")
+        engine_snaps = [s for s in snapshots if "device_engine" in s]
+        rescaled = any(
+            s.get("restore_old_parallelism", self.num_subtasks)
+            != self.num_subtasks for s in snapshots)
+        if rescaled or len(engine_snaps) > 1:
+            if any(s.get("string_key_directory") is not None
+                   for s in snapshots):
+                raise ValueError(
+                    "device window operator cannot re-split "
+                    "dictionary-encoded string-keyed engine state "
+                    "across a parallelism change; restore at the "
+                    "checkpointed parallelism")
+            tiers = {s.get("device_tier") for s in engine_snaps}
+            if len(tiers) > 1:
+                raise ValueError(
+                    f"snapshots span engine tiers {sorted(tiers)}")
+            if engine_snaps:
+                tier = tiers.pop()
+                if self.engine is None:
+                    if tier == "log":
+                        self.engine = log_engine_for_assigner(
+                            self.assigner, self.agg)
+                    elif tier == "string_sum":
+                        self.engine = string_sum_engine_for_assigner(
+                            self.assigner, self.agg)
+                    if self.engine is None \
+                            or not hasattr(self.engine, "restore_many"):
+                        raise ValueError(
+                            f"the {tier!r} engine tier cannot re-split "
+                            "its state across a parallelism change; "
+                            "restore at the checkpointed parallelism")
+                self.engine.restore_many(
+                    [s["device_engine"] for s in engine_snaps],
+                    keep_fn=self._kg_keep_fn())
+            return
         for s in snapshots:
             if s.get("string_key_directory") is not None:
                 import flink_tpu.native as nat

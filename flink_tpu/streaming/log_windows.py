@@ -496,21 +496,47 @@ class LogStructuredTumblingWindows:
                 "fired_horizon": getattr(self, "_fired_horizon", None)}
 
     def restore(self, snap: dict) -> None:
+        self.restore_many([snap])
+
+    def restore_many(self, snaps, keep_fn=None) -> None:
+        """Restore from one snapshot — or MERGE several after a
+        parallelism change, keeping only the rows this subtask owns
+        (`keep_fn`: uint64 key bit-patterns → bool mask, the
+        key-group-range filter; ref StateAssignmentOperation.java's
+        key-group re-split).  Merging is exact because a window's
+        state IS its log: concatenation then fire-time sort/reduce
+        equals any other grouping of the same rows."""
         from flink_tpu.state.shared_registry import SharedChunk
-        self.watermark = snap["watermark"]
-        self.num_late_dropped = snap["num_late_dropped"]
-        self._keys_signed = snap.get("keys_signed")
-        if snap.get("fired_horizon") is not None:
-            self._fired_horizon = snap["fired_horizon"]
+        self.watermark = max(s["watermark"] for s in snaps)
+        self.num_late_dropped = sum(s["num_late_dropped"] for s in snaps)
+        signed = {s["keys_signed"] for s in snaps
+                  if s.get("keys_signed") is not None}
+        if len(signed) > 1:
+            raise ValueError("snapshots disagree on key signedness")
+        self._keys_signed = signed.pop() if signed else None
+        horizons = [s["fired_horizon"] for s in snaps
+                    if s.get("fired_horizon") is not None]
+        if horizons:
+            self._fired_horizon = max(horizons)
         self.windows = {}
         self._chunk_cache = {}
-        for start, w in snap["windows"].items():
-            if isinstance(w, SharedChunk):  # un-resolved (local) path
-                w = w.payload
-            log = self.mode.new_log()
-            log.append(np.asarray(w["keys"], np.uint64),
-                       *(np.asarray(c) for c in w["cols"]))
-            self.windows[int(start)] = log
+        for snap in snaps:
+            for start, w in snap["windows"].items():
+                if isinstance(w, SharedChunk):  # un-resolved (local)
+                    w = w.payload
+                keys = np.asarray(w["keys"], np.uint64)
+                cols = [np.asarray(c) for c in w["cols"]]
+                if keep_fn is not None:
+                    m = keep_fn(keys)
+                    if not m.all():
+                        keys = keys[m]
+                        cols = [c[m] for c in cols]
+                if not len(keys):
+                    continue
+                log = self.windows.get(int(start))
+                if log is None:
+                    log = self.windows[int(start)] = self.mode.new_log()
+                log.append(keys, *cols)
 
     def block_until_ready(self) -> None:
         """Host-tier state is always materialized."""
@@ -645,6 +671,37 @@ class StringSumTumblingWindows:
             ws.load(np.asarray(w["ids"], np.int64),
                     np.asarray(w["sums"], np.float64))
             self.windows[int(start)] = ws
+
+    def restore_many(self, snaps, keep_fn=None) -> None:
+        """Merge snapshots after a parallelism change: ids are dense
+        PER-SUBTASK, so each snapshot's ids translate back to words
+        through its own directory and re-intern here; sums are
+        additive, so re-adding merges exactly.  keep_fn filters WORD
+        arrays to this subtask's key groups."""
+        if len(snaps) == 1 and keep_fn is None:
+            self.restore(snaps[0])
+            return
+        self.watermark = max(s["watermark"] for s in snaps)
+        self.num_late_dropped = sum(s["num_late_dropped"] for s in snaps)
+        self.directory = []
+        self._dir_arr = None
+        self.interner = nat.NativeStringInterner()
+        self.windows = {}
+        for snap in snaps:
+            directory = np.asarray(snap["directory"], dtype=object)
+            for start, w in snap["windows"].items():
+                ids = np.asarray(w["ids"], np.int64)
+                if not len(ids):
+                    continue
+                words = directory[ids].astype(np.str_)
+                sums = np.asarray(w["sums"], np.float64)
+                if keep_fn is not None:
+                    m = keep_fn(words)
+                    if not m.any():
+                        continue
+                    if not m.all():
+                        words, sums = words[m], sums[m]
+                self._ingest(int(start), words, sums)
 
     def block_until_ready(self) -> None:
         """Host-tier state is always materialized."""
@@ -824,13 +881,34 @@ class LogStructuredSessionWindows:
                 "vh": cat(self._log_vh, np.uint64)}
 
     def restore(self, snap: dict) -> None:
-        self.watermark = snap["watermark"]
-        self.num_late_dropped = snap["num_late_dropped"]
-        self._keys_signed = snap.get("keys_signed")
-        self._log_keys = [snap["keys"]] if len(snap["keys"]) else []
-        self._log_ts = [snap["ts"]] if len(snap["ts"]) else []
-        self._log_w = [snap["w"]] if len(snap["w"]) else []
-        self._log_vh = [snap["vh"]] if len(snap["vh"]) else []
+        self.restore_many([snap])
+
+    def restore_many(self, snaps, keep_fn=None) -> None:
+        """Restore/merge retained open-session events, filtered to
+        this subtask's key groups on rescale (sessions are per-key, so
+        a key-partitioned split of the event log is exact)."""
+        self.watermark = max(s["watermark"] for s in snaps)
+        self.num_late_dropped = sum(s["num_late_dropped"] for s in snaps)
+        signed = {s["keys_signed"] for s in snaps
+                  if s.get("keys_signed") is not None}
+        if len(signed) > 1:
+            raise ValueError("snapshots disagree on key signedness")
+        self._keys_signed = signed.pop() if signed else None
+        self._log_keys, self._log_ts = [], []
+        self._log_w, self._log_vh = [], []
+        for snap in snaps:
+            keys = np.asarray(snap["keys"], np.uint64)
+            if not len(keys):
+                continue
+            m = keep_fn(keys) if keep_fn is not None else None
+            if m is not None and not m.any():
+                continue
+            sel = (lambda a: a) if m is None or m.all() \
+                else (lambda a, m=m: np.asarray(a)[m])
+            self._log_keys.append(sel(keys))
+            self._log_ts.append(sel(snap["ts"]))
+            self._log_w.append(sel(snap["w"]))
+            self._log_vh.append(sel(snap["vh"]))
 
     def block_until_ready(self) -> None:
         """Host-tier state is always materialized."""

@@ -145,7 +145,8 @@ class StreamOperator(abc.ABC):
               key_selector: Optional[KeySelector] = None,
               operator_id: str = "",
               subtask_index: int = 0,
-              num_subtasks: int = 1) -> None:
+              num_subtasks: int = 1,
+              max_parallelism: int = 128) -> None:
         self.output = output
         self.keyed_backend = keyed_backend
         self.operator_state_backend = operator_state_backend or OperatorStateBackend()
@@ -154,6 +155,7 @@ class StreamOperator(abc.ABC):
         self.operator_id = operator_id or type(self).__name__
         self.subtask_index = subtask_index
         self.num_subtasks = num_subtasks
+        self.max_parallelism = max_parallelism
         if keyed_backend is not None and processing_time_service is not None:
             self.timer_service = InternalTimerService(
                 f"{self.operator_id}-timers", keyed_backend,

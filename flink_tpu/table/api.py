@@ -483,6 +483,16 @@ def _try_columnar_windowed_agg(table: Table, keys: List[Expr],
                                       out_fields, mesh=mesh,
                                       mesh_axis=mesh_axis)
 
+    # stable operator uid: state must survive re-lowering the same
+    # query at a DIFFERENT parallelism (the topology gains/loses the
+    # split node, shifting positional ids) — restore matches vertices
+    # by operator uid, so the window operator names itself by query
+    # order + logical shape, not topology position
+    seq = t_env._columnar_uid_seq = getattr(
+        t_env, "_columnar_uid_seq", -1) + 1
+    agg_uid = (f"columnar-window-agg:{seq}:{key_col}:"
+               f"{site.name}:{input_col}")
+
     par = table.stream.env.parallelism
     if par == 1:
         out = table.stream._add_op("columnar_window_agg", factory,
@@ -502,6 +512,7 @@ def _try_columnar_windowed_agg(table: Table, keys: List[Expr],
                                      split_factory, parallelism=1)
         out = split.partition_custom(lambda tagged, n: tagged[0]) \
             ._add_op("columnar_window_agg", factory, parallelism=par)
+    out.node.uid = agg_uid
     t = Table(t_env, out, Schema(out_names))
     t.columnar = True
     return t

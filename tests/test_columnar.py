@@ -7,6 +7,7 @@ import pytest
 
 from flink_tpu.streaming.columnar import (
     ColumnarCollectSink,
+    ColumnarSource,
     ColumnarWindowOperator,
     RecordBatch,
 )
@@ -335,3 +336,114 @@ def test_columnar_parallelism_2_on_minicluster():
     row = run_rowpath(keys, ts, users)
     want = sorted((int(k), round(float(d))) for k, d in row.values)
     assert got == want
+
+
+# ---------------------------------------------------------------------
+# rescale: checkpoint the columnar SQL plan at par 2, restore at par 4
+# (round-3 verdict item 5 — the state used to be warned away)
+# ---------------------------------------------------------------------
+
+class GatedColumnarSource(ColumnarSource):
+    """Emits the first FREE_ROWS, then idles until released — keeps
+    the job alive while the test takes a savepoint mid-stream (the
+    PausingSource pattern, batch-columnar edition)."""
+
+    released = False
+    FREE_ROWS = 0
+
+    @classmethod
+    def reset(cls, free_rows):
+        cls.released = False
+        cls.FREE_ROWS = free_rows
+
+    def emit_step(self, ctx, max_records):
+        if not type(self).released and self.offset >= type(self).FREE_ROWS:
+            import time as _t
+            _t.sleep(0.001)
+            return True
+        return super().emit_step(ctx, max_records)
+
+
+def _sql_rescale_build(par, keys, ts, users, savepoint=None):
+    from flink_tpu.table.api import Schema, Table
+
+    env = StreamExecutionEnvironment()
+    env.set_parallelism(par)
+    env.enable_checkpointing(10)
+    if savepoint is not None:
+        env.set_savepoint_restore(savepoint)
+    t_env = StreamTableEnvironment.create(env)
+    cols = {"k": keys, "u": users, "ts": ts}
+    stream = env.add_source(
+        GatedColumnarSource(cols, "ts", chunk=1024),
+        name="columnar_source")
+    t = Table(t_env, stream, Schema(list(cols)))
+    t.rowtime = "ts"
+    t.columnar = True
+    t.col_dtypes = {k: np.asarray(v).dtype for k, v in cols.items()}
+    t_env.register_table("ev", t)
+    out = t_env.sql_query(
+        "SELECT k, SUM(u) AS s, TUMBLE_START(ts) AS ws "
+        "FROM ev GROUP BY TUMBLE(ts, INTERVAL '1' SECOND), k")
+    assert getattr(out, "columnar", False)
+    sink = ColumnarCollectSink()
+    out.to_append_stream(batched=True).add_sink(sink)
+    return env, sink
+
+
+def test_columnar_sql_rescale_par2_to_par4(tmp_path):
+    """Checkpoint a columnar SQL job at parallelism 2, restore the
+    savepoint at parallelism 4: engine state re-splits by key group
+    (restore_many + keep_fn) and the totals are exact — no warning,
+    no dropped state (ref: StateAssignmentOperation + the stable-uid
+    contract)."""
+    keys, ts, users = synth(20_000, 60, 5000, seed=31)
+    users = users.astype(np.float64)
+    truth = {}
+    for k, u, t in zip(keys.tolist(), users.tolist(), ts.tolist()):
+        kk = (int(k), t - t % 1000)
+        truth[kk] = truth.get(kk, 0.0) + u
+
+    # gate after ONE chunk: the watermark stays inside the first
+    # window, so nothing fires before the savepoint and run 2 alone
+    # must reproduce every window (the PausingSource construction —
+    # the source keeps emitting between barrier and stop, so anything
+    # fired pre-stop would double-count against the savepoint state)
+    GatedColumnarSource.reset(free_rows=1024)
+    env, _ = _sql_rescale_build(2, keys, ts, users)
+    client = env.execute_async("sql-rescale-origin")
+    path = client.stop_with_savepoint(str(tmp_path / "sp"))
+
+    GatedColumnarSource.released = True
+    env2, sink2 = _sql_rescale_build(4, keys, ts, users, savepoint=path)
+    env2.execute("sql-rescale-par4")
+    got = {}
+    for k, s, ws in sink2.rows():
+        got[(int(k), int(ws))] = got.get((int(k), int(ws)), 0.0) + float(s)
+    assert got == {k: pytest.approx(v) for k, v in truth.items()}
+
+
+def test_columnar_sql_rescale_down_par2_to_par1(tmp_path):
+    """Scale DOWN across the topology-shape change (par 2 has the
+    split exchange node, par 1 does not): vertex matching by operator
+    uid carries the window state over; the two old engines merge."""
+    keys, ts, users = synth(12_000, 40, 4000, seed=32)
+    users = users.astype(np.float64)
+    truth = {}
+    for k, u, t in zip(keys.tolist(), users.tolist(), ts.tolist()):
+        kk = (int(k), t - t % 1000)
+        truth[kk] = truth.get(kk, 0.0) + u
+
+    GatedColumnarSource.reset(free_rows=1024)
+    env, _ = _sql_rescale_build(2, keys, ts, users)
+    client = env.execute_async("sql-rescale-origin-down")
+    path = client.stop_with_savepoint(str(tmp_path / "spd"))
+
+    GatedColumnarSource.released = True
+    env2, sink2 = _sql_rescale_build(1, keys, ts, users, savepoint=path)
+    env2.execute("sql-rescale-par1")
+    got = {}
+    for k, s, ws in sink2.rows():
+        got[(int(k), int(ws))] = got.get((int(k), int(ws)), 0.0) \
+            + float(s)
+    assert got == {k: pytest.approx(v) for k, v in truth.items()}

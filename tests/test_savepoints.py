@@ -229,3 +229,54 @@ def test_function_state_assigned_exactly_once_on_rescale():
              for snap_list in mapping2.values() for s in snap_list
              for op in [s["operators"].get("op", {})] if "function" in op]
     assert sorted(seen2) == [0, 1, 2]
+
+
+def test_stateful_orphan_fails_restore_unless_allowed():
+    """Snapshot state whose operator uid matches nothing in the new
+    topology FAILS the restore; allow_non_restored=True downgrades to
+    a warning and drops it; stateless unmatched snapshots drop
+    silently (ref: --allowNonRestoredState)."""
+    import warnings
+
+    from flink_tpu.runtime.local import compute_restore_assignments
+
+    restore = {"tasks": {(7, 0): {"operators": {
+        "stateful-op": {"my_engine_state": {"x": 1}},
+        "stateless-op": {},
+    }}}}
+    new_uids = {1: {"some-other-op"}}
+    with pytest.raises(RuntimeError, match="stateful-op"):
+        compute_restore_assignments({1: 1}, restore,
+                                    vertex_uids=new_uids)
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        out = compute_restore_assignments({1: 1}, restore,
+                                          vertex_uids=new_uids,
+                                          allow_non_restored=True)
+    assert any("DROPPED" in str(x.message) for x in w)
+    assert out == {}
+
+    # stateless orphans never raise or warn
+    restore2 = {"tasks": {(7, 0): {"operators": {"stateless-op": {}}}}}
+    with warnings.catch_warnings(record=True) as w2:
+        warnings.simplefilter("always")
+        assert compute_restore_assignments(
+            {1: 1}, restore2, vertex_uids=new_uids) == {}
+    assert not w2
+
+
+def test_chained_operator_orphan_detected_inside_matched_vertex():
+    """Operator-granular orphan check: a vertex can match via one
+    pinned uid while a chained operator's shifted uid strands its
+    state — that must fail too, not silently filter."""
+    from flink_tpu.runtime.local import compute_restore_assignments
+
+    restore = {"tasks": {(3, 0): {"operators": {
+        "pinned-agg": {"engine": {"windows": 1}},
+        "op-4-sink": {"function": {"pending": ["txn"]}},
+    }}}}
+    # the new vertex carries the pinned uid but the sink became op-3
+    new_uids = {2: {"pinned-agg", "op-3-sink"}}
+    with pytest.raises(RuntimeError, match="op-4-sink"):
+        compute_restore_assignments({2: 1}, restore,
+                                    vertex_uids=new_uids)
