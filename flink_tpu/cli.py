@@ -1,8 +1,14 @@
 """Command-line front end (ref: flink-clients CliFrontend.java + the
-bin/flink script — run/list/cancel/info verbs, scaled to the
-in-process runtime).
+bin/flink script).
 
     python -m flink_tpu run <script.py> [args...]   execute a job script
+    python -m flink_tpu list --master H:P            list cluster jobs
+    python -m flink_tpu cancel --master H:P <job>    cancel a running job
+                                   [-s DIR]          ... with a savepoint
+    python -m flink_tpu savepoint --master H:P <job> <dir>
+                                                     trigger a savepoint
+    python -m flink_tpu stop --master H:P <job> --savepoint-dir DIR
+                                                     savepoint then stop
     python -m flink_tpu info                         version + devices
     python -m flink_tpu bench [config]               run the benchmark
     python -m flink_tpu jobmanager [--port P]        start a cluster master
@@ -66,10 +72,112 @@ def main(argv=None) -> int:
         return _jobmanager(rest)
     if verb == "taskmanager":
         return _taskmanager(rest)
+    if verb == "list":
+        return _list(rest)
+    if verb == "cancel":
+        return _cancel(rest)
+    if verb == "savepoint":
+        return _savepoint(rest)
+    if verb == "stop":
+        return _stop(rest)
     print(f"unknown command {verb!r}; "
-          f"try: run | info | bench | jobmanager | taskmanager",
+          f"try: run | list | cancel | savepoint | stop | info | bench "
+          f"| jobmanager | taskmanager",
           file=sys.stderr)
     return 2
+
+
+def _client(master, secret=None):
+    from flink_tpu.runtime.cluster import RemoteExecutor
+    return RemoteExecutor(master, secret=secret)
+
+
+def _ops_parser(prog, job_arg=True):
+    import argparse
+    ap = argparse.ArgumentParser(prog=f"flink_tpu {prog}")
+    ap.add_argument("--master", required=True,
+                    help="jobmanager host:port")
+    ap.add_argument("--secret", default=None)
+    if job_arg:
+        ap.add_argument("job_id")
+    return ap
+
+
+def _list(rest) -> int:
+    """(ref: CliFrontend list / `flink list`)"""
+    ap = _ops_parser("list", job_arg=False)
+    ap.add_argument("--all", action="store_true",
+                    help="include finished jobs")
+    args = ap.parse_args(rest)
+    client = _client(args.master, args.secret)
+    try:
+        jobs = client.list_jobs()
+    finally:
+        client.stop()
+    shown = 0
+    for j in jobs:
+        if not args.all and j.get("state") not in ("RUNNING", "CREATED",
+                                                   "RESTARTING"):
+            continue
+        print(f"{j['job_id']}  {j.get('state'):<10}  "
+              f"restarts={j.get('restarts', 0)}  "
+              f"checkpoints={j.get('checkpoints_completed', 0)}  "
+              f"{j.get('job_name', '')}")
+        shown += 1
+    if shown == 0:
+        print("(no jobs)" if args.all else
+              "(no running jobs; --all includes finished)")
+    return 0
+
+
+def _cancel(rest) -> int:
+    """(ref: CliFrontend cancel [-s])"""
+    ap = _ops_parser("cancel")
+    ap.add_argument("-s", "--with-savepoint", metavar="DIR", default=None,
+                    help="take a savepoint before cancelling")
+    args = ap.parse_args(rest)
+    client = _client(args.master, args.secret)
+    try:
+        if args.with_savepoint:
+            path = client.stop_with_savepoint(args.job_id,
+                                              args.with_savepoint)
+            print(f"savepoint written to {path}")
+        else:
+            client.cancel(args.job_id)
+        print(f"cancelled {args.job_id}")
+    finally:
+        client.stop()
+    return 0
+
+
+def _savepoint(rest) -> int:
+    """(ref: CliFrontend savepoint <job> <dir>)"""
+    ap = _ops_parser("savepoint")
+    ap.add_argument("directory")
+    args = ap.parse_args(rest)
+    client = _client(args.master, args.secret)
+    try:
+        path = client.trigger_savepoint(args.job_id, args.directory)
+    finally:
+        client.stop()
+    print(f"savepoint written to {path}")
+    return 0
+
+
+def _stop(rest) -> int:
+    """(ref: CliFrontend stop — savepoint then stop; this runtime's
+    stop is cancel-with-savepoint, i.e. no drain phase)"""
+    ap = _ops_parser("stop")
+    ap.add_argument("--savepoint-dir", required=True)
+    args = ap.parse_args(rest)
+    client = _client(args.master, args.secret)
+    try:
+        path = client.stop_with_savepoint(args.job_id,
+                                          args.savepoint_dir)
+    finally:
+        client.stop()
+    print(f"stopped {args.job_id}; savepoint at {path}")
+    return 0
 
 
 def _shell(rest) -> int:

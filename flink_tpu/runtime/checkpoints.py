@@ -420,6 +420,9 @@ class CheckpointCoordinator:
         self.stats: Dict[int, CheckpointStats] = {}
         self.STATS_RETAIN = 128
         self.stopped = False
+        #: excludes client savepoint triggers against teardown (a
+        #: request must either land in a live queue or fail fast)
+        self._sp_lock = threading.Lock()
         #: queued SavepointRequests (thread-safe append from clients)
         self._savepoint_queue: deque = deque()
         #: in-flight savepoint checkpoints: cid -> request
@@ -498,17 +501,29 @@ class CheckpointCoordinator:
 
     def trigger_savepoint(self, directory: str) -> SavepointRequest:
         """Thread-safe entry for clients: the request is serviced on
-        the executor loop's next maybe_trigger."""
+        the executor loop's next maybe_trigger.  A request against a
+        stopped coordinator fails immediately instead of queueing
+        where no loop will ever service it (the teardown's
+        fail_pending_savepoints and this check exclude each other via
+        the savepoint lock, so no request can slip into a dead
+        queue)."""
         request = SavepointRequest(directory)
-        self._savepoint_queue.append(request)
+        with self._sp_lock:
+            if self.stopped:
+                request.fail(RuntimeError(
+                    "job attempt ended before the savepoint completed"))
+                return request
+            self._savepoint_queue.append(request)
         return request
 
     def fail_pending_savepoints(self, error: BaseException) -> None:
-        while self._savepoint_queue:
-            self._savepoint_queue.popleft().fail(error)
-        for req in self._savepoint_cids.values():
-            req.fail(error)
-        self._savepoint_cids.clear()
+        with self._sp_lock:
+            self.stopped = True
+            while self._savepoint_queue:
+                self._savepoint_queue.popleft().fail(error)
+            for req in self._savepoint_cids.values():
+                req.fail(error)
+            self._savepoint_cids.clear()
 
     # ---- acks -------------------------------------------------------
     def acknowledge(self, task_key: Tuple[int, int], checkpoint_id: int,

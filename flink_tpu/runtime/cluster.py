@@ -258,7 +258,8 @@ class Dispatcher(RpcEndpoint):
     (ref: Dispatcher.java:200 submitJob → :229 createJobManagerRunner)."""
 
     RPC_METHODS = ("submit_job", "request_job_status", "request_job_result",
-                   "cancel_job", "list_jobs")
+                   "cancel_job", "list_jobs", "trigger_savepoint",
+                   "savepoint_status")
 
     def __init__(self, rpc_service: RpcService, blob: BlobServer,
                  archive_dir: Optional[str] = None,
@@ -279,6 +280,10 @@ class Dispatcher(RpcEndpoint):
         #: retention tier — the live JobMaster endpoint/thread and the
         #: graph blob are released when a job ends)
         self._archived: Dict[str, dict] = {}
+        #: savepoint request outcomes survive archival: with
+        #: cancel-with-savepoint the job goes terminal the moment the
+        #: savepoint completes, racing the client's status poll
+        self._archived_savepoints: Dict[str, Dict[str, dict]] = {}
 
     def submit_job(self, job_graph_blob: bytes, job_config: dict) -> str:
         job_id = f"job-{uuid.uuid4().hex[:12]}"
@@ -324,6 +329,10 @@ class Dispatcher(RpcEndpoint):
             return
         snapshot = master.status_snapshot()
         self._archived[job_id] = snapshot
+        if master._savepoints:
+            self._archived_savepoints[job_id] = {
+                req_id: master.savepoint_status(req_id)
+                for req_id in master._savepoints}
         self._rpc.stop_server(master)
         self._blob.delete_blob(master.blob_key)
         if self._ha_store is not None:
@@ -366,6 +375,28 @@ class Dispatcher(RpcEndpoint):
                     if k not in ("result", "error_blob")}}
                 for jid, snap in self._archived.items()]
         return live + done
+
+    # ---- savepoints (ref: ClusterClient.triggerSavepoint /
+    # cancelWithSavepoint behind the `flink savepoint` / `cancel -s` /
+    # `stop` CLI verbs; async trigger-id protocol like the REST API) --
+    def trigger_savepoint(self, job_id: str, directory: str,
+                          stop: bool = False) -> str:
+        """Starts a savepoint; returns a request id to poll with
+        savepoint_status.  stop=True cancels the job once the
+        savepoint completes (cancel-with-savepoint semantics)."""
+        master = self._masters.get(job_id)
+        if master is None:
+            raise RpcException(f"unknown or finished job: {job_id}")
+        return master.trigger_savepoint_async(directory, stop=stop)
+
+    def savepoint_status(self, job_id: str, request_id: str) -> dict:
+        master = self._masters.get(job_id)
+        if master is not None:
+            return master.savepoint_status(request_id)
+        archived = self._archived_savepoints.get(job_id, {})
+        if request_id in archived:
+            return archived[request_id]
+        raise RpcException(f"unknown or finished job: {job_id}")
 
 
 # =====================================================================
@@ -411,6 +442,45 @@ class JobMaster(RpcEndpoint):
         self._live_coordinator: Optional[CheckpointCoordinator] = None
         #: terminal-state callback (the Dispatcher archives this job)
         self.on_terminal = None
+        #: async savepoint requests by id (the CLI/REST trigger-id
+        #: protocol: trigger returns an id, status polls it)
+        self._savepoints: Dict[str, Any] = {}
+
+    # -- savepoints ---------------------------------------------------
+    def trigger_savepoint_async(self, directory: str,
+                                stop: bool = False) -> str:
+        coordinator = self._live_coordinator
+        if coordinator is None:
+            raise RpcException(
+                "savepoints require checkpointing to be enabled and a "
+                "running job attempt")
+        request = coordinator.trigger_savepoint(directory)
+        req_id = f"sp-{uuid.uuid4().hex[:8]}"
+        self._savepoints[req_id] = request
+        if stop:
+            # cancel-with-savepoint: cancellation lands only after the
+            # savepoint completes (at-least-once for the window
+            # between, as with the reference's cancelWithSavepoint)
+            def _stop_after():
+                try:
+                    request.wait(300.0)
+                except Exception:  # noqa: BLE001 — savepoint failed:
+                    return  # keep the job running (ref semantics)
+                self.cancel_requested = True
+
+            threading.Thread(target=_stop_after, daemon=True,
+                             name=f"sp-stop-{req_id}").start()
+        return req_id
+
+    def savepoint_status(self, request_id: str) -> dict:
+        request = self._savepoints.get(request_id)
+        if request is None:
+            raise RpcException(f"unknown savepoint request {request_id}")
+        if not request._event.is_set():
+            return {"state": "IN_PROGRESS"}
+        if request.error is not None:
+            return {"state": "FAILED", "error": str(request.error)}
+        return {"state": "COMPLETED", "path": request.path}
 
     # -- RPC surface for TaskExecutors --------------------------------
     def acknowledge_checkpoint(self, attempt: int, task_key, cid: int,
@@ -729,6 +799,11 @@ class JobMaster(RpcEndpoint):
                     pass               # outcome is already decided
                 self.checkpoints_completed += coordinator.completed_count
                 coordinator.stopped = True
+                # a savepoint in flight when the attempt ends must
+                # fail, not hang IN_PROGRESS (clients poll it; the
+                # cancel-with-savepoint waiter blocks on it)
+                coordinator.fail_pending_savepoints(RuntimeError(
+                    "job attempt ended before the savepoint completed"))
         drain_acks()
 
         # ---- end-of-job phases: workers stopped, endpoint-threaded --
@@ -1439,6 +1514,38 @@ class RemoteExecutor:
     def cancel(self, job_id: str) -> None:
         dispatcher = self._rpc.connect(self._resolve(), DISPATCHER)
         dispatcher.sync.cancel_job(job_id)
+
+    def list_jobs(self) -> List[dict]:
+        dispatcher = self._rpc.connect(self._resolve(), DISPATCHER)
+        return dispatcher.sync.list_jobs()
+
+    def trigger_savepoint(self, job_id: str, directory: str,
+                          timeout: float = 60.0, stop: bool = False
+                          ) -> str:
+        """Blocks until the savepoint is written; returns its path
+        (ClusterClient.triggerSavepoint over the async trigger-id
+        protocol)."""
+        dispatcher = self._rpc.connect(self._resolve(), DISPATCHER)
+        req_id = dispatcher.sync.trigger_savepoint(job_id, directory,
+                                                   stop)
+        deadline = _time.monotonic() + timeout
+        while _time.monotonic() < deadline:
+            status = dispatcher.sync.savepoint_status(job_id, req_id)
+            if status["state"] == "COMPLETED":
+                return status["path"]
+            if status["state"] == "FAILED":
+                raise RuntimeError(
+                    f"savepoint failed: {status['error']}")
+            _time.sleep(0.02)
+        raise TimeoutError(
+            f"savepoint {req_id} still in progress after {timeout}s")
+
+    def stop_with_savepoint(self, job_id: str, directory: str,
+                            timeout: float = 60.0) -> str:
+        """Savepoint, then cancel (ref: `flink cancel -s` /
+        ClusterClient.cancelWithSavepoint)."""
+        return self.trigger_savepoint(job_id, directory, timeout,
+                                      stop=True)
 
     def stop(self) -> None:
         self._rpc.stop()

@@ -80,3 +80,144 @@ def test_cli_info_and_run(tmp_path, capsys):
     assert "45" in capsys.readouterr().out
     assert cli_main(["nope"]) == 2
     assert cli_main([]) == 0
+
+
+# ---------------------------------------------------------------------
+# ops verbs against a live cluster: run -> list -> savepoint ->
+# cancel [-s] (ref: CliFrontend.java list/savepoint/cancel/stop)
+# ---------------------------------------------------------------------
+
+def test_cli_ops_verbs_against_live_cluster(tmp_path, capsys):
+    import numpy as np
+
+    from flink_tpu.runtime.cluster import (
+        JobManagerProcess,
+        TaskManagerProcess,
+    )
+
+    class GatedSource(SourceFunction):
+        """Emits 2000 records, then idles until released (class gate)
+        — keeps the job alive while the test drives the ops verbs."""
+
+        released = False
+        HOLD_AT = 2000
+
+        def __init__(self, n=8000):
+            self.n = n
+            self.offset = 0
+            self._running = True
+
+        def run(self, ctx):
+            while self.emit_step(ctx, 64):
+                pass
+
+        def emit_step(self, ctx, max_records):
+            from flink_tpu.streaming.elements import MAX_WATERMARK
+            if not self._running:
+                return False
+            if not type(self).released \
+                    and self.offset >= type(self).HOLD_AT:
+                time.sleep(0.002)
+                return True
+            end = min(self.offset + max_records, self.n)
+            for i in range(self.offset, end):
+                ctx.collect_with_timestamp((i % 5, 1.0), i)
+            self.offset = end
+            if self.offset >= self.n:
+                ctx.emit_watermark(MAX_WATERMARK)
+                return False
+            return True
+
+        def cancel(self):
+            self._running = False
+
+        def snapshot_function_state(self, checkpoint_id=None):
+            return {"offset": self.offset}
+
+        def restore_function_state(self, state):
+            self.offset = state["offset"]
+
+    jm = JobManagerProcess()
+    tm = TaskManagerProcess(jm.address, num_slots=2, tm_id="cli-tm")
+    executor = None
+    try:
+        env = StreamExecutionEnvironment()
+        env.use_remote_cluster(jm.address)
+        env.enable_checkpointing(20)
+        (env.add_source(GatedSource(), name="gated")
+            .map(lambda v: v)
+            .add_sink(CollectSink()))
+        executor = env._make_executor()
+        job_id = executor.submit(env.get_job_graph())
+
+        # wait until RUNNING with >= 1 checkpoint (savepoint needs a
+        # live coordinator)
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline:
+            st = jm.dispatcher.run_async(
+                jm.dispatcher.request_job_status, job_id).get(5.0)
+            if st["state"] == "RUNNING" \
+                    and st["checkpoints_completed"] >= 1:
+                break
+            time.sleep(0.02)
+        assert st["state"] == "RUNNING", st
+
+        # list: the job shows as RUNNING
+        assert cli_main(["list", "--master", jm.address]) == 0
+        out = capsys.readouterr().out
+        assert job_id in out and "RUNNING" in out
+
+        # savepoint: triggers + completes, file exists
+        spdir = str(tmp_path / "sp")
+        assert cli_main(["savepoint", "--master", jm.address,
+                         job_id, spdir]) == 0
+        out = capsys.readouterr().out
+        assert "savepoint written to" in out
+        path = out.split("savepoint written to ", 1)[1].strip()
+        import os
+        assert os.path.exists(path)
+
+        # cancel -s: savepoint then cancel; job goes terminal
+        sp2 = str(tmp_path / "sp2")
+        assert cli_main(["cancel", "--master", jm.address, job_id,
+                         "-s", sp2]) == 0
+        out = capsys.readouterr().out
+        assert "cancelled" in out
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline:
+            st = jm.dispatcher.run_async(
+                jm.dispatcher.request_job_status, job_id).get(5.0)
+            if st["state"] in ("CANCELED", "FINISHED", "FAILED"):
+                break
+            time.sleep(0.02)
+        assert st["state"] == "CANCELED", st
+
+        # list --all shows the terminal job
+        assert cli_main(["list", "--master", jm.address, "--all"]) == 0
+        out = capsys.readouterr().out
+        assert job_id in out and "CANCELED" in out
+
+        # restore from the cancel -s savepoint finishes the stream
+        # exactly-once (the savepoint is genuinely usable)
+        sp2_files = os.listdir(sp2)
+        assert sp2_files, "cancel -s left no savepoint file"
+        env2 = StreamExecutionEnvironment()
+        env2.use_remote_cluster(jm.address)
+        env2.enable_checkpointing(20)
+        env2.set_savepoint_restore(os.path.join(sp2, sp2_files[0]))
+        GatedSource.released = True
+        sink2 = CollectSink()
+        (env2.add_source(GatedSource(), name="gated")
+            .map(lambda v: v)
+            .add_sink(sink2))
+        result = env2.execute("resume-from-cancel-s")
+        collected = result.accumulators["collected"]
+        total = sum(v[1] for v in collected)
+        offset_restored = 8000 - len(collected)
+        assert total == len(collected) and offset_restored >= 0
+    finally:
+        GatedSource.released = False  # class gate: re-runs start held
+        if executor is not None:
+            executor.stop()
+        tm.stop()
+        jm.stop()
