@@ -107,6 +107,13 @@ def _ensure_loaded() -> Optional[ctypes.CDLL]:
             u64p, i64p, i64p, f64p,
             u64p, i64p, f32p, u64p, c.POINTER(c.c_int64)]
         lib.ft_session_log_fire.restype = c.c_int64
+        lib.ft_session_log_fire2.argtypes = [
+            u64p, i64p, f32p, u64p, c.c_int64,
+            u64p, i64p, f32p, u64p, c.c_int64,
+            c.c_int64, c.c_int64, c.c_int, c.c_int,
+            u64p, i64p, i64p, f64p,
+            u64p, i64p, f32p, u64p, c.POINTER(c.c_int64)]
+        lib.ft_session_log_fire2.restype = c.c_int64
         lib.ft_intern_new.argtypes = [c.c_int64]
         lib.ft_intern_new.restype = c.c_void_p
         lib.ft_intern_free.argtypes = [c.c_void_p]
@@ -333,15 +340,29 @@ def qsketch_log_fire(keys: np.ndarray, buckets: np.ndarray,
 
 def session_log_fire(keys: np.ndarray, ts: np.ndarray, weights: np.ndarray,
                      vhs: np.ndarray, gap_ms: int, watermark: int,
-                     depth: int, width: int):
+                     depth: int, width: int, retained=None):
     """Close every session whose end-1 <= watermark: returns
-    (closed keys, starts, ends, totals, retained (keys, ts, w, vh))."""
+    (closed keys, starts, ends, totals, retained (keys, ts, w, vh)).
+    `retained` is the previous fire's retained tuple, in (key, ts)
+    order — EXACTLY as this function returned it (the ordering is
+    load-bearing: the kernel merges it as a key-major stream).  Pass
+    it back verbatim; do not re-sort or merge it host-side."""
     lib = _ensure_loaded()
-    n = len(keys)
     keys = np.ascontiguousarray(keys, np.uint64)
     ts = np.ascontiguousarray(ts, np.int64)
     weights = np.ascontiguousarray(weights, np.float32)
     vhs = np.ascontiguousarray(vhs, np.uint64)
+    if retained is None:
+        pk = np.empty(0, np.uint64)
+        pt = np.empty(0, np.int64)
+        pw = np.empty(0, np.float32)
+        pv = np.empty(0, np.uint64)
+    else:
+        pk = np.ascontiguousarray(retained[0], np.uint64)
+        pt = np.ascontiguousarray(retained[1], np.int64)
+        pw = np.ascontiguousarray(retained[2], np.float32)
+        pv = np.ascontiguousarray(retained[3], np.uint64)
+    n = len(keys) + len(pk)
     ok = np.empty(n, np.uint64)
     os_ = np.empty(n, np.int64)
     oe = np.empty(n, np.int64)
@@ -351,8 +372,10 @@ def session_log_fire(keys: np.ndarray, ts: np.ndarray, weights: np.ndarray,
     rw = np.empty(n, np.float32)
     rv = np.empty(n, np.uint64)
     n_ret = ctypes.c_int64(0)
-    n_closed = lib.ft_session_log_fire(
-        keys, ts, weights, vhs, n, gap_ms, watermark, depth, width,
+    n_closed = lib.ft_session_log_fire2(
+        keys, ts, weights, vhs, len(keys),
+        pk, pt, pw, pv, len(pk),
+        gap_ms, watermark, depth, width,
         ok, os_, oe, ot, rk, rt, rw, rv, ctypes.byref(n_ret))
     r = n_ret.value
     return (ok[:n_closed], os_[:n_closed], oe[:n_closed], ot[:n_closed],

@@ -799,6 +799,11 @@ class LogStructuredSessionWindows:
         self._log_ts: List[np.ndarray] = []
         self._log_w: List[np.ndarray] = []
         self._log_vh: List[np.ndarray] = []
+        #: open-session rows carried from the last fire, in (key, ts)
+        #: order exactly as the kernel returned them — passed back
+        #: verbatim (the kernel merges them as a key-major stream;
+        #: re-sorting here would corrupt the merge)
+        self._ret: Optional[Tuple[np.ndarray, ...]] = None
 
     def process_batch(self, keys, timestamps, values=None,
                       key_hashes=None, value_hashes=None) -> None:
@@ -841,20 +846,25 @@ class LogStructuredSessionWindows:
 
     def advance_watermark(self, watermark: int) -> int:
         self.watermark = watermark
-        if not self._log_keys:
+        if not self._log_keys and self._ret is None:
             return 0
-        keys = np.concatenate(self._log_keys)
-        ts = np.concatenate(self._log_ts)
-        w = np.concatenate(self._log_w)
-        vh = np.concatenate(self._log_vh)
+        cat = (lambda xs, dt: xs[0] if len(xs) == 1
+               else (np.concatenate(xs) if xs
+                     else np.empty(0, dt)))
+        keys = cat(self._log_keys, np.uint64)
+        ts = cat(self._log_ts, np.int64)
+        w = cat(self._log_w, np.float32)
+        vh = cat(self._log_vh, np.uint64)
+        # the kernel merges the retained set (key-major, verbatim from
+        # the last fire) with the ts-sorted feed itself — no host-side
+        # merge/sort pass exists on this path, and retained rows are
+        # never re-sorted across fires
         ok, os_, oe, ot, retained = nat.session_log_fire(
             keys, ts, w, vh, self.gap, watermark,
-            self.agg.depth, self.agg.width)
-        rk, rt, rw, rv = retained
-        self._log_keys = [rk] if len(rk) else []
-        self._log_ts = [rt] if len(rt) else []
-        self._log_w = [rw] if len(rw) else []
-        self._log_vh = [rv] if len(rv) else []
+            self.agg.depth, self.agg.width, retained=self._ret)
+        self._ret = retained if len(retained[0]) else None
+        self._log_keys, self._log_ts = [], []
+        self._log_w, self._log_vh = [], []
         totals = ot.astype(np.int64)
         ok = _keys_out(self, ok)
         if self.emit_arrays:
@@ -870,15 +880,19 @@ class LogStructuredSessionWindows:
         return len(ok)
 
     def snapshot(self) -> dict:
-        cat = (lambda xs, dt: np.concatenate(xs) if xs
-               else np.empty(0, dt))
+        ret = self._ret or (np.empty(0, np.uint64),
+                            np.empty(0, np.int64),
+                            np.empty(0, np.float32),
+                            np.empty(0, np.uint64))
+        cat = (lambda xs, extra: np.concatenate([extra, *xs])
+               if xs else extra.copy())
         return {"watermark": self.watermark,
                 "num_late_dropped": self.num_late_dropped,
                 "keys_signed": self._keys_signed,
-                "keys": cat(self._log_keys, np.uint64),
-                "ts": cat(self._log_ts, np.int64),
-                "w": cat(self._log_w, np.float32),
-                "vh": cat(self._log_vh, np.uint64)}
+                "keys": cat(self._log_keys, ret[0]),
+                "ts": cat(self._log_ts, ret[1]),
+                "w": cat(self._log_w, ret[2]),
+                "vh": cat(self._log_vh, ret[3])}
 
     def restore(self, snap: dict) -> None:
         self.restore_many([snap])
@@ -896,6 +910,7 @@ class LogStructuredSessionWindows:
         self._keys_signed = signed.pop() if signed else None
         self._log_keys, self._log_ts = [], []
         self._log_w, self._log_vh = [], []
+        self._ret = None
         for snap in snaps:
             keys = np.asarray(snap["keys"], np.uint64)
             if not len(keys):

@@ -286,7 +286,13 @@ Rec* radix_sort_by_key(Rec* recs, Rec* scratch, int64_t n) {
   uint64_t key_or = 0;
   for (int64_t i = 0; i < n; ++i) key_or |= recs[i].key;
   int bits = 64 - (key_or ? __builtin_clzll(key_or) : 63);
-  const int DIGIT = 11;
+  // small key domains (dictionary ids, modest raw keys) sort in ONE
+  // counting pass with a wider histogram instead of two 11-bit
+  // passes — but only when the batch is large relative to the
+  // histogram (a 2 MB zeroed counts array would dominate a small
+  // sort)
+  const int DIGIT = (bits > 11 && bits <= 18
+                     && n >= (int64_t(1) << bits)) ? bits : 11;
   const int R = 1 << DIGIT;
   int passes = (bits + DIGIT - 1) / DIGIT;
   if (passes == 0) passes = 1;
@@ -614,54 +620,156 @@ int64_t ft_qsketch_log_fire(const uint64_t* keys, const uint16_t* buckets,
 // (key, start, end, total) is emitted.  Open sessions' events are
 // copied to the retained log.  Returns n_closed; *n_retained gets the
 // retained count.  Output buffers sized n.
-int64_t ft_session_log_fire(const uint64_t* keys, const int64_t* ts,
-                            const float* weights, const uint64_t* vhs,
-                            int64_t n, int64_t gap_ms, int64_t watermark,
-                            int depth, int width,
-                            uint64_t* out_keys, int64_t* out_start,
-                            int64_t* out_end, double* out_total,
-                            uint64_t* ret_keys, int64_t* ret_ts,
-                            float* ret_w, uint64_t* ret_vh,
-                            int64_t* n_retained) {
+// Two-segment session fire: `keys..vhs` is the batch feed (usually
+// ts-sorted — sources emit in event-time order), `rkeys..rvhs` is the
+// RETAINED set carried from the previous fire, in (key, ts) order —
+// exactly the order the walk emits, so retained rows are NEVER
+// re-sorted: each fire radix-sorts only the NEW rows and merges two
+// key-major streams.  That keeps long-gap workloads linear (a
+// ts-ordered retained contract re-sorted the whole open set every
+// fire — measured 0.39x at gap 5s before this shape).
+int64_t ft_session_log_fire2(const uint64_t* keys, const int64_t* ts,
+                             const float* weights, const uint64_t* vhs,
+                             int64_t n_new,
+                             const uint64_t* rkeys, const int64_t* rts,
+                             const float* rw, const uint64_t* rvhs,
+                             int64_t n_ret_in,
+                             int64_t gap_ms, int64_t watermark,
+                             int depth, int width,
+                             uint64_t* out_keys, int64_t* out_start,
+                             int64_t* out_end, double* out_total,
+                             uint64_t* ret_keys, int64_t* ret_ts,
+                             float* ret_w, uint64_t* ret_vh,
+                             int64_t* n_retained) {
+  const int64_t n = n_new + n_ret_in;
   struct Ev { uint64_t key; int64_t idx; };
-  // sort by ts (stable) then by key (stable) -> (key, ts) order;
-  // the sign-bit flip makes signed ts order correctly under the
-  // unsigned radix
-  std::vector<Ev> buf(n), scratch(n);
-  for (int64_t i = 0; i < n; ++i)
-    buf[i] = {static_cast<uint64_t>(ts[i]) ^ 0x8000000000000000ull, i};
-  Ev* s1 = radix_sort_by_key(buf.data(), scratch.data(), n);
-  // rewrite keys for the second pass, preserving the ts-sorted idx
-  Ev* other = (s1 == buf.data()) ? scratch.data() : buf.data();
-  for (int64_t i = 0; i < n; ++i) other[i] = {keys[s1[i].idx], s1[i].idx};
-  Ev* sorted = radix_sort_by_key(other, s1, n);
+  // NEW rows: target order (key, ts).  The feed is usually already
+  // ts-sorted, so ONE stable radix sort by key suffices — the ts
+  // pass runs only when a linear scan finds disorder.  (Measured
+  // alternative: carrying the 32-byte payload through the sort loses
+  // to the 16-byte (key, idx) sort + one materialize pass at the
+  // chunked sizes the engine feeds.)  Retained ts precede new ts for
+  // any key (the feed is globally event-time ordered), so per-key
+  // concatenation retained-then-new stays ts-sorted.
+  bool new_sorted = true;
+  for (int64_t i = 1; i < n_new; ++i)
+    if (ts[i] < ts[i - 1]) { new_sorted = false; break; }
+  if (new_sorted && n_ret_in && n_new) {
+    // per-key retained-then-new concatenation is ts-ordered only if
+    // no new row predates a retained row (holds for in-order feeds:
+    // each batch starts at or after the previous batch's max ts)
+    int64_t ret_max = rts[0];
+    for (int64_t i = 1; i < n_ret_in; ++i)
+      ret_max = std::max(ret_max, rts[i]);
+    if (ts[0] < ret_max) new_sorted = false;
+  }
+  std::vector<Ev> buf, scratch;
+  std::vector<int64_t> sts;
+  std::vector<float> sw;
+  std::vector<uint64_t> svh;
+  Ev* sorted = nullptr;
+  int64_t n_sorted;
+  if (new_sorted) {
+    n_sorted = n_new;
+    buf.resize(n_new);
+    scratch.resize(n_new);
+    for (int64_t i = 0; i < n_new; ++i) buf[i] = {keys[i], i};
+    sorted = radix_sort_by_key(buf.data(), scratch.data(), n_new);
+    sts.resize(n_new);
+    sw.resize(n_new);
+    svh.resize(n_new);
+    for (int64_t i = 0; i < n_new; ++i) {
+      int64_t idx = sorted[i].idx;
+      sts[i] = ts[idx];
+      sw[i] = weights[idx];
+      svh[i] = vhs[idx];
+    }
+  } else {
+    // out-of-order feed (rare): pool BOTH segments and (ts, key)
+    // double-sort — correctness path, not the fast one
+    n_sorted = n;
+    std::vector<int64_t> mts(n);
+    std::vector<float> mw(n);
+    std::vector<uint64_t> mkeys(n), mvh(n);
+    std::memcpy(mts.data(), ts, sizeof(int64_t) * n_new);
+    std::memcpy(mw.data(), weights, sizeof(float) * n_new);
+    std::memcpy(mkeys.data(), keys, sizeof(uint64_t) * n_new);
+    std::memcpy(mvh.data(), vhs, sizeof(uint64_t) * n_new);
+    if (n_ret_in) {
+      std::memcpy(mts.data() + n_new, rts, sizeof(int64_t) * n_ret_in);
+      std::memcpy(mw.data() + n_new, rw, sizeof(float) * n_ret_in);
+      std::memcpy(mkeys.data() + n_new, rkeys,
+                  sizeof(uint64_t) * n_ret_in);
+      std::memcpy(mvh.data() + n_new, rvhs,
+                  sizeof(uint64_t) * n_ret_in);
+    }
+    buf.resize(n);
+    scratch.resize(n);
+    for (int64_t i = 0; i < n; ++i)
+      buf[i] = {static_cast<uint64_t>(mts[i]) ^ 0x8000000000000000ull, i};
+    Ev* s1 = radix_sort_by_key(buf.data(), scratch.data(), n);
+    Ev* other = (s1 == buf.data()) ? scratch.data() : buf.data();
+    for (int64_t i = 0; i < n; ++i)
+      other[i] = {mkeys[s1[i].idx], s1[i].idx};
+    sorted = radix_sort_by_key(other, s1, n);
+    sts.resize(n);
+    sw.resize(n);
+    svh.resize(n);
+    for (int64_t i = 0; i < n; ++i) {
+      int64_t idx = sorted[i].idx;
+      sts[i] = mts[idx];
+      sw[i] = mw[idx];
+      svh[i] = mvh[idx];
+    }
+    n_ret_in = 0;  // pooled above; the merge below sees one stream
+  }
 
   std::vector<int32_t> cm(static_cast<size_t>(depth) * width, 0);
   std::vector<int32_t> cm_touched;
   cm_touched.reserve(1024);
+  // per-key scratch run: retained rows of the key, then new rows
+  std::vector<int64_t> run_ts;
+  std::vector<float> run_w;
+  std::vector<uint64_t> run_vh;
   int64_t n_closed = 0, n_ret = 0;
-  int64_t i = 0;
-  while (i < n) {
-    uint64_t k = sorted[i].key;
-    int64_t run_end = i;
-    while (run_end < n && sorted[run_end].key == k) ++run_end;
+  int64_t ia = 0, ib = 0;  // cursors: retained stream / sorted new
+  while (ia < n_ret_in || ib < n_sorted) {
+    uint64_t k;
+    if (ia >= n_ret_in) k = sorted[ib].key;
+    else if (ib >= n_sorted) k = rkeys[ia];
+    else k = std::min(rkeys[ia], sorted[ib].key);
+    run_ts.clear();
+    run_w.clear();
+    run_vh.clear();
+    while (ia < n_ret_in && rkeys[ia] == k) {
+      run_ts.push_back(rts[ia]);
+      run_w.push_back(rw[ia]);
+      run_vh.push_back(rvhs[ia]);
+      ++ia;
+    }
+    while (ib < n_sorted && sorted[ib].key == k) {
+      run_ts.push_back(sts[ib]);
+      run_w.push_back(sw[ib]);
+      run_vh.push_back(svh[ib]);
+      ++ib;
+    }
+    const int64_t run_n = static_cast<int64_t>(run_ts.size());
     // split the run into sessions at gaps
-    int64_t a = i;
-    while (a < run_end) {
+    int64_t a = 0;
+    while (a < run_n) {
       int64_t b = a + 1;
-      int64_t last = ts[sorted[a].idx];
-      while (b < run_end && ts[sorted[b].idx] - last <= gap_ms) {
-        last = ts[sorted[b].idx];
+      int64_t last = run_ts[a];
+      while (b < run_n && run_ts[b] - last <= gap_ms) {
+        last = run_ts[b];
         ++b;
       }
-      int64_t sess_start = ts[sorted[a].idx];
+      int64_t sess_start = run_ts[a];
       int64_t sess_end = last + gap_ms;
       if (sess_end - 1 <= watermark) {
         double total = 0.0;
         for (int64_t j = a; j < b; ++j) {
-          int64_t idx = sorted[j].idx;
-          total += static_cast<double>(weights[idx]);
-          uint64_t h = vhs[idx];
+          total += static_cast<double>(run_w[j]);
+          uint64_t h = run_vh[j];
           for (int d = 0; d < depth; ++d) {
             uint64_t hd = splitmix64(h + 0x9E3779B97F4A7C15ull *
                                      static_cast<uint64_t>(d));
@@ -681,20 +789,36 @@ int64_t ft_session_log_fire(const uint64_t* keys, const int64_t* ts,
         ++n_closed;
       } else {
         for (int64_t j = a; j < b; ++j) {
-          int64_t idx = sorted[j].idx;
-          ret_keys[n_ret] = keys[idx];
-          ret_ts[n_ret] = ts[idx];
-          ret_w[n_ret] = weights[idx];
-          ret_vh[n_ret] = vhs[idx];
+          ret_keys[n_ret] = k;
+          ret_ts[n_ret] = run_ts[j];
+          ret_w[n_ret] = run_w[j];
+          ret_vh[n_ret] = run_vh[j];
           ++n_ret;
         }
       }
       a = b;
     }
-    i = run_end;
   }
   *n_retained = n_ret;
   return n_closed;
+}
+
+// Single-segment compatibility entry (no retained input).
+int64_t ft_session_log_fire(const uint64_t* keys, const int64_t* ts,
+                            const float* weights, const uint64_t* vhs,
+                            int64_t n, int64_t gap_ms, int64_t watermark,
+                            int depth, int width,
+                            uint64_t* out_keys, int64_t* out_start,
+                            int64_t* out_end, double* out_total,
+                            uint64_t* ret_keys, int64_t* ret_ts,
+                            float* ret_w, uint64_t* ret_vh,
+                            int64_t* n_retained) {
+  return ft_session_log_fire2(keys, ts, weights, vhs, n,
+                              nullptr, nullptr, nullptr, nullptr, 0,
+                              gap_ms, watermark, depth, width,
+                              out_keys, out_start, out_end, out_total,
+                              ret_keys, ret_ts, ret_w, ret_vh,
+                              n_retained);
 }
 
 // ---- compiled heap-backend baselines --------------------------------------

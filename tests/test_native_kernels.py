@@ -236,3 +236,70 @@ def test_ivjoin_many_small_batches_with_pruning():
                      rts[min(off + step, n) - 1])) - 200
         small.prune(wm)
     assert got == want and len(want) > 2_000
+
+
+def test_session_fire_two_segment_retained_merge():
+    """The retained tuple from one fire feeds the next verbatim
+    (key-major contract): chained two-segment fires must produce
+    exactly the sessions of one big fire."""
+    import numpy as np
+    import flink_tpu.native as nat
+    if not nat.available():
+        import pytest
+        pytest.skip("native runtime required")
+    rng = np.random.default_rng(17)
+    n = 30_000
+    keys = rng.integers(0, 500, n).astype(np.uint64)
+    ts = np.sort(rng.integers(0, 100_000, n)).astype(np.int64)
+    w = np.ones(n, np.float32)
+    vh = nat.splitmix64(rng.integers(0, 1 << 30, n).astype(np.uint64))
+
+    # oracle: single fire over everything
+    ok, os_, oe, ot, _ = nat.session_log_fire(keys, ts, w, vh,
+                                              800, 10**9, 4, 128)
+    want = {(int(k), int(s), int(e)): t
+            for k, s, e, t in zip(ok, os_, oe, ot)}
+
+    # chained: 6 chunked fires, retained tuple passed back verbatim
+    got = {}
+    ret = None
+    chunk = n // 6 + 1
+    for off in range(0, n, chunk):
+        hi = min(off + chunk, n)
+        wm = int(ts[hi - 1]) - 1500 if hi < n else 10**9
+        ok, os_, oe, ot, ret = nat.session_log_fire(
+            keys[off:hi], ts[off:hi], w[off:hi], vh[off:hi],
+            800, wm, 4, 128, retained=ret)
+        for k, s, e, t in zip(ok, os_, oe, ot):
+            got[(int(k), int(s), int(e))] = t
+        if len(ret[0]) == 0:
+            ret = None
+    assert got == want and len(want) > 1000
+
+
+def test_session_fire_guard_demotes_predating_rows():
+    """A new row that predates a retained row (out-of-order across the
+    fire boundary) must demote the kernel to the pooled double-sort —
+    sessions still merge correctly."""
+    import numpy as np
+    import flink_tpu.native as nat
+    if not nat.available():
+        import pytest
+        pytest.skip("native runtime required")
+    k = np.array([7, 7], np.uint64)
+    w = np.ones(2, np.float32)
+    vh = nat.splitmix64(np.array([1, 2], np.uint64))
+    # fire 1: both rows open (watermark behind), retained comes back
+    _, _, _, _, ret = nat.session_log_fire(
+        k, np.array([1000, 1400], np.int64), w, vh, 500, 0, 2, 64)
+    assert len(ret[0]) == 2
+    # fire 2: a new row at ts=700 PREDATES retained max (1400) and
+    # bridges nothing; plus a row at 1650 extending the session
+    k2 = np.array([7, 7], np.uint64)
+    ok, os_, oe, ot, ret2 = nat.session_log_fire(
+        k2, np.array([700, 1650], np.int64), w, vh[:2], 500, 10**9,
+        2, 64, retained=ret)
+    got = {(int(s), int(e)): int(t) for s, e, t in zip(os_, oe, ot)}
+    # 700 joins [1000,1400,1650] because 1000-700 <= 500: one session
+    # [700, 2150) of 4 events
+    assert got == {(700, 2150): 4}, got
