@@ -87,9 +87,13 @@ def main(argv=None) -> int:
     return 2
 
 
-def _client(master, secret=None):
+def _client(master, secret=None, tls_dir=None):
     from flink_tpu.runtime.cluster import RemoteExecutor
-    return RemoteExecutor(master, secret=secret)
+    tls = None
+    if tls_dir:
+        from flink_tpu.runtime.tls import TlsConfig
+        tls = TlsConfig.from_dir(tls_dir, create=False)
+    return RemoteExecutor(master, secret=secret, tls=tls)
 
 
 def _ops_parser(prog, job_arg=True):
@@ -98,6 +102,9 @@ def _ops_parser(prog, job_arg=True):
     ap.add_argument("--master", required=True,
                     help="jobmanager host:port")
     ap.add_argument("--secret", default=None)
+    ap.add_argument("--tls-dir", default=None,
+                    help="directory with tls.crt/tls.key (mutual TLS "
+                         "to a --tls-dir cluster)")
     if job_arg:
         ap.add_argument("job_id")
     return ap
@@ -109,7 +116,7 @@ def _list(rest) -> int:
     ap.add_argument("--all", action="store_true",
                     help="include finished jobs")
     args = ap.parse_args(rest)
-    client = _client(args.master, args.secret)
+    client = _client(args.master, args.secret, args.tls_dir)
     try:
         jobs = client.list_jobs()
     finally:
@@ -136,7 +143,7 @@ def _cancel(rest) -> int:
     ap.add_argument("-s", "--with-savepoint", metavar="DIR", default=None,
                     help="take a savepoint before cancelling")
     args = ap.parse_args(rest)
-    client = _client(args.master, args.secret)
+    client = _client(args.master, args.secret, args.tls_dir)
     try:
         if args.with_savepoint:
             path = client.stop_with_savepoint(args.job_id,
@@ -155,7 +162,7 @@ def _savepoint(rest) -> int:
     ap = _ops_parser("savepoint")
     ap.add_argument("directory")
     args = ap.parse_args(rest)
-    client = _client(args.master, args.secret)
+    client = _client(args.master, args.secret, args.tls_dir)
     try:
         path = client.trigger_savepoint(args.job_id, args.directory)
     finally:
@@ -170,7 +177,7 @@ def _stop(rest) -> int:
     ap = _ops_parser("stop")
     ap.add_argument("--savepoint-dir", required=True)
     args = ap.parse_args(rest)
-    client = _client(args.master, args.secret)
+    client = _client(args.master, args.secret, args.tls_dir)
     try:
         path = client.stop_with_savepoint(args.job_id,
                                           args.savepoint_dir)
@@ -227,10 +234,19 @@ def _jobmanager(rest) -> int:
     ap.add_argument("--ha-dir", default=None,
                     help="shared HA directory: leader election + "
                          "submitted-job recovery (standbys campaign)")
+    ap.add_argument("--tls-dir", default=None,
+                    help="enable mutual TLS on RPC + data planes; "
+                         "tls.crt/tls.key in this directory "
+                         "(generated self-signed on first use)")
     args = ap.parse_args(rest)
+    tls = None
+    if args.tls_dir:
+        from flink_tpu.runtime.tls import TlsConfig
+        tls = TlsConfig.from_dir(args.tls_dir)
     jm = JobManagerProcess(args.host, args.port,
                            archive_dir=args.archive_dir,
-                           secret=args.secret, ha_dir=args.ha_dir)
+                           secret=args.secret, ha_dir=args.ha_dir,
+                           tls=tls)
     print(f"jobmanager listening at {jm.address}", flush=True)
     try:
         while True:
@@ -256,12 +272,20 @@ def _taskmanager(rest) -> int:
     ap.add_argument("--host", default="127.0.0.1")
     ap.add_argument("--tm-id", default=None)
     ap.add_argument("--secret", default=None)
+    ap.add_argument("--tls-dir", default=None,
+                    help="enable mutual TLS (same tls.crt/tls.key as "
+                         "the jobmanager)")
     args = ap.parse_args(rest)
     if (args.master is None) == (args.ha_dir is None):
         print("pass exactly one of --master / --ha-dir", file=sys.stderr)
         return 2
+    tls = None
+    if args.tls_dir:
+        from flink_tpu.runtime.tls import TlsConfig
+        tls = TlsConfig.from_dir(args.tls_dir, create=False)
     tm = TaskManagerProcess(args.master, args.slots, args.host, args.tm_id,
-                            secret=args.secret, ha_dir=args.ha_dir)
+                            secret=args.secret, ha_dir=args.ha_dir,
+                            tls=tls)
     print(f"taskmanager {tm.tm_id} registered with {tm.jm_address} "
           f"(rpc {tm.rpc.address}, data {tm.data_server.address})",
           flush=True)

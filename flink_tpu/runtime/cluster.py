@@ -890,7 +890,7 @@ class _JobAttempt:
     STEP_BUDGET = 256
     SOURCE_BATCH = 128
 
-    def __init__(self, job_id: str, attempt: int):
+    def __init__(self, job_id: str, attempt: int, tls=None):
         self.job_id = job_id
         self.attempt = attempt
         self.subtasks: List[SubtaskInstance] = []
@@ -899,7 +899,7 @@ class _JobAttempt:
         self.threaded_sources: List[SubtaskInstance] = []
         self.non_sources: List[SubtaskInstance] = []
         self.by_key: Dict[Tuple[int, int], SubtaskInstance] = {}
-        self.data_client = DataClient()
+        self.data_client = DataClient(tls=tls)
         self.pts = PolledProcessingTimeService()
         self.notifications: deque = deque()
         self.error: Optional[BaseException] = None
@@ -1011,11 +1011,13 @@ class TaskExecutor(RpcEndpoint):
                    "notify_checkpoint_complete")
 
     def __init__(self, tm_id: str, rpc_service: RpcService,
-                 data_server: DataServer, num_slots: int = 2):
+                 data_server: DataServer, num_slots: int = 2,
+                 tls=None):
         super().__init__(f"te-{tm_id}")
         self.tm_id = tm_id
         self._rpc = rpc_service
         self.data_server = data_server
+        self.tls = tls
         self.num_slots = num_slots
         self.metrics = MetricRegistry()
         self._attempts: Dict[str, _JobAttempt] = {}  # job_id -> live attempt
@@ -1066,7 +1068,7 @@ class TaskExecutor(RpcEndpoint):
             self._blob_cache[blob_key] = blob
         job_graph: JobGraph = cloudpickle.loads(blob)
 
-        att = _JobAttempt(job_id, attempt)
+        att = _JobAttempt(job_id, attempt, tls=self.tls)
         att.master_epoch = epoch
         att.jm_gateway = self._rpc.connect(tdd["jm_address"], tdd["jm_name"])
         mine: Set[Tuple[int, int]] = {tuple(a) for a in tdd["assignments"]}
@@ -1328,8 +1330,8 @@ class JobManagerProcess:
     def __init__(self, bind_host: str = "127.0.0.1", port: int = 0,
                  archive_dir: Optional[str] = None,
                  secret: Optional[str] = None,
-                 ha_dir: Optional[str] = None):
-        self.rpc = RpcService(bind_host, port, secret=secret)
+                 ha_dir: Optional[str] = None, tls=None):
+        self.rpc = RpcService(bind_host, port, secret=secret, tls=tls)
         self.blob = BlobServer()
         self.resource_manager = ResourceManager(self.rpc)
         ha_store = None
@@ -1372,15 +1374,16 @@ class TaskManagerProcess:
     def __init__(self, jm_address: Optional[str] = None, num_slots: int = 2,
                  bind_host: str = "127.0.0.1", tm_id: Optional[str] = None,
                  secret: Optional[str] = None,
-                 ha_dir: Optional[str] = None):
+                 ha_dir: Optional[str] = None, tls=None):
         assert (jm_address is None) != (ha_dir is None), \
             "pass exactly one of jm_address / ha_dir"
         self.tm_id = tm_id or f"tm-{uuid.uuid4().hex[:8]}"
         self.num_slots = num_slots
-        self.rpc = RpcService(bind_host, 0, secret=secret)
-        self.data_server = DataServer(bind_host, 0)
+        self.rpc = RpcService(bind_host, 0, secret=secret, tls=tls)
+        self.data_server = DataServer(bind_host, 0, tls=tls)
         self.task_executor = TaskExecutor(self.tm_id, self.rpc,
-                                          self.data_server, num_slots)
+                                          self.data_server, num_slots,
+                                          tls=tls)
         self.rpc.start_server(self.task_executor)
         self.ha_dir = ha_dir
         self._running = True
@@ -1443,7 +1446,7 @@ class RemoteExecutor:
                  channel_capacity: int = DEFAULT_CHANNEL_CAPACITY,
                  metric_registry=None, latency_interval_ms=None,
                  secret: Optional[str] = None,
-                 ha_dir: Optional[str] = None):
+                 ha_dir: Optional[str] = None, tls=None):
         assert jm_address is not None or ha_dir is not None
         self.ha_dir = ha_dir
         self.jm_address = jm_address
@@ -1452,7 +1455,7 @@ class RemoteExecutor:
         self.restart_strategy_config = restart_strategy or {"strategy": "none"}
         self.channel_capacity = channel_capacity
         self.metrics = metric_registry or MetricRegistry()
-        self._rpc = RpcService(secret=secret)
+        self._rpc = RpcService(secret=secret, tls=tls)
 
     def execute(self, job_graph: JobGraph) -> JobExecutionResult:
         job_id = self.submit(job_graph)

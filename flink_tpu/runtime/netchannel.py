@@ -248,7 +248,13 @@ class DataServer:
     registering its router routes, or an early PartitionRequest — and
     bound by key."""
 
-    def __init__(self, bind_host: str = "127.0.0.1", port: int = 0):
+    def __init__(self, bind_host: str = "127.0.0.1", port: int = 0,
+                 tls=None):
+        #: TlsConfig | None — mirrors the RPC plane: mutual-TLS
+        #: handshake per accepted consumer connection (the reference
+        #: secures the Netty data plane with the same internal SSL
+        #: material as akka RPC)
+        self._tls_server_ctx = tls.server_context() if tls else None
         self._server = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._server.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._server.bind((bind_host, port))
@@ -304,7 +310,35 @@ class DataServer:
             except OSError:
                 return
             conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-            self._connections.append(_ProducerConnection(conn, self))
+            if self._tls_server_ctx is not None:
+                threading.Thread(
+                    target=self._tls_accept, args=(conn,), daemon=True,
+                    name=f"dataplane-tls-{self.port}").start()
+            else:
+                self._connections.append(_ProducerConnection(conn, self))
+
+    def _tls_accept(self, conn) -> None:
+        """Handshake off the accept loop; plaintext peers are refused
+        by the handshake itself."""
+        import ssl as _ssl
+        try:
+            conn = self._tls_server_ctx.wrap_socket(conn,
+                                                    server_side=True)
+        except (_ssl.SSLError, OSError):
+            try:
+                conn.close()
+            except OSError:
+                pass
+            return
+        if not self._running:
+            # stop() ran while the handshake was in flight: a
+            # connection appended now would never be closed
+            try:
+                conn.close()
+            except OSError:
+                pass
+            return
+        self._connections.append(_ProducerConnection(conn, self))
 
     def stop(self) -> None:
         self._running = False
@@ -340,7 +374,8 @@ class DataClient:
     server, multiplexing that producer's channels (the SingleInputGate
     + RemoteInputChannel + credit announcements)."""
 
-    def __init__(self):
+    def __init__(self, tls=None):
+        self._tls_client_ctx = tls.client_context() if tls else None
         self._lock = threading.Lock()
         #: address -> (socket, write_lock)
         self._conns: Dict[str, Tuple[socket.socket, threading.Lock]] = {}
@@ -360,6 +395,9 @@ class DataClient:
                 sock = socket.create_connection((host, int(port)),
                                                 timeout=10.0)
                 sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                if self._tls_client_ctx is not None:
+                    sock = self._tls_client_ctx.wrap_socket(
+                        sock, server_hostname=host)
                 sock.settimeout(None)
                 wlock = threading.Lock()
                 sock_entry = (sock, wlock)

@@ -35,6 +35,7 @@ from __future__ import annotations
 import itertools
 import queue
 import socket
+import ssl
 import struct
 import threading
 import traceback
@@ -284,8 +285,16 @@ class RpcService:
     `--secret` on the jobmanager/taskmanager entry points)."""
 
     def __init__(self, bind_host: str = "127.0.0.1", port: int = 0,
-                 secret: Optional[str] = None):
+                 secret: Optional[str] = None, tls=None):
         self.secret = secret
+        #: TlsConfig | None — with TLS set, every accepted connection
+        #: must complete a MUTUAL handshake before any frame is read,
+        #: and outgoing gateways wrap their sockets the same way;
+        #: plaintext peers fail the handshake (runtime/tls.py; ref
+        #: SecurityUtils/SSLUtils internal connectivity)
+        self.tls = tls
+        self._tls_server_ctx = tls.server_context() if tls else None
+        self._tls_client_ctx = tls.client_context() if tls else None
         self._endpoints: Dict[str, RpcEndpoint] = {}
         self._lock = threading.Lock()
         self._server = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
@@ -326,6 +335,18 @@ class RpcService:
             t.start()
 
     def _serve_conn(self, conn: socket.socket) -> None:
+        if self._tls_server_ctx is not None:
+            try:
+                # handshake on the serve thread so a slow (or
+                # plaintext) peer never blocks the accept loop
+                conn = self._tls_server_ctx.wrap_socket(
+                    conn, server_side=True)
+            except (ssl.SSLError, OSError):
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+                return
         write_lock = threading.Lock()
         try:
             while True:
@@ -407,7 +428,8 @@ class RpcService:
         with self._lock:
             client = self._clients.get(address)
             if client is None or client.dead:
-                client = _ClientConnection(address)
+                client = _ClientConnection(address,
+                                           self._tls_client_ctx)
                 self._clients[address] = client
             return client
 
@@ -431,11 +453,14 @@ class _ClientConnection:
     """One multiplexed TCP connection to a remote RpcService; pending
     calls matched to responses by id."""
 
-    def __init__(self, address: str):
+    def __init__(self, address: str, tls_ctx=None):
         host, port = address.rsplit(":", 1)
         self.address = address
         self._sock = socket.create_connection((host, int(port)), timeout=10.0)
         self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        if tls_ctx is not None:
+            self._sock = tls_ctx.wrap_socket(self._sock,
+                                             server_hostname=host)
         self._sock.settimeout(None)
         self._write_lock = threading.Lock()
         self._pending: Dict[int, RpcFuture] = {}

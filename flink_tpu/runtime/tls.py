@@ -1,0 +1,167 @@
+"""Transport security for the control + data planes.
+
+The reference wires SSL through SecurityUtils.java +
+SSLUtils.java: INTERNAL connectivity (akka RPC, netty data plane,
+blob server) uses one shared keystore/truststore pair distributed to
+every node, mutual authentication required, hostname verification
+off (nodes address each other by dynamic IPs).  This module is that
+design over Python `ssl`: one :class:`TlsConfig` names the cert/key
+(and CA, defaulting to the cert itself for the self-signed case),
+every node loads the same files, and both sides of every connection
+present and verify certificates.  Authentication (who may submit
+jobs) stays with the shared cluster secret — TLS is transport
+privacy + peer identity, the secret is authn, matching the split in
+the reference (security.ssl.* options vs the authn layer).
+
+Certificate generation uses `cryptography` when importable and falls
+back to the `openssl` CLI; both produce a key + self-signed cert pair
+suitable for cluster-internal mutual TLS.
+"""
+
+from __future__ import annotations
+
+import os
+import ssl
+import subprocess
+from typing import Optional
+
+
+class TlsConfig:
+    """Paths to PEM cert/key (+ CA bundle; defaults to the cert — the
+    self-signed shared-keystore deployment).  Builds the server and
+    client SSLContexts with MUTUAL verification."""
+
+    def __init__(self, cert_path: str, key_path: str,
+                 ca_path: Optional[str] = None):
+        self.cert_path = cert_path
+        self.key_path = key_path
+        self.ca_path = ca_path or cert_path
+
+    @staticmethod
+    def from_dir(directory: str, create: bool = True) -> "TlsConfig":
+        """Load `tls.crt` / `tls.key` from `directory` — the one-flag
+        deployment path (`--tls-dir`).  With create=True (the
+        jobmanager's bootstrap convenience) missing material is
+        generated under an O_EXCL lock so concurrently starting nodes
+        cannot mint mismatched pairs; with create=False (workers and
+        clients, where a typo'd path must not silently become a fresh
+        untrusted cert) missing files raise."""
+        cert = os.path.join(directory, "tls.crt")
+        key = os.path.join(directory, "tls.key")
+        if os.path.exists(cert) and os.path.exists(key):
+            return TlsConfig(cert, key)
+        if not create:
+            raise FileNotFoundError(
+                f"no tls.crt/tls.key in {directory!r} — point --tls-dir "
+                "at the cluster's shared TLS material (the jobmanager "
+                "generates it on first start)")
+        return TlsConfig.generate_self_signed(directory)
+
+    @staticmethod
+    def generate_self_signed(directory: str,
+                             common_name: str = "flink-tpu-internal"
+                             ) -> "TlsConfig":
+        """Write tls.key + tls.crt (self-signed, 10 years) into
+        `directory` and return the config.  Single-creator: an O_EXCL
+        lock elects one generator; everyone else waits for the files.
+        Key material is born 0600 and both files appear atomically
+        (tmp + rename), so no reader ever sees a half-written or
+        world-readable key."""
+        import time
+
+        os.makedirs(directory, exist_ok=True)
+        cert = os.path.join(directory, "tls.crt")
+        key = os.path.join(directory, "tls.key")
+        if os.path.exists(cert) and os.path.exists(key):
+            return TlsConfig(cert, key)
+        lock = os.path.join(directory, ".tls.lock")
+        try:
+            os.close(os.open(lock, os.O_CREAT | os.O_EXCL | os.O_WRONLY))
+            i_create = True
+        except FileExistsError:
+            i_create = False
+        if not i_create:
+            deadline = time.monotonic() + 30.0
+            while time.monotonic() < deadline:
+                if os.path.exists(cert) and os.path.exists(key):
+                    return TlsConfig(cert, key)
+                time.sleep(0.05)
+            raise TimeoutError(
+                f"another process holds {lock!r} but the TLS material "
+                "never appeared")
+        try:
+            kt, ct = key + ".tmp", cert + ".tmp"
+            # the key file is 0600 from birth (no chmod window)
+            os.close(os.open(kt, os.O_CREAT | os.O_WRONLY, 0o600))
+            try:
+                TlsConfig._generate_cryptography(ct, kt, common_name)
+            except ImportError:
+                subprocess.run(
+                    ["openssl", "req", "-x509", "-newkey", "rsa:2048",
+                     "-keyout", kt, "-out", ct, "-days", "3650",
+                     "-nodes", "-subj", f"/CN={common_name}"],
+                    check=True, capture_output=True)
+            os.chmod(kt, 0o600)  # tools may have replaced the inode
+            os.rename(kt, key)
+            os.rename(ct, cert)
+        finally:
+            try:
+                os.unlink(lock)
+            except OSError:
+                pass
+        return TlsConfig(cert, key)
+
+    @staticmethod
+    def _generate_cryptography(cert_path: str, key_path: str,
+                               common_name: str) -> None:
+        import datetime
+
+        from cryptography import x509
+        from cryptography.hazmat.primitives import hashes, serialization
+        from cryptography.hazmat.primitives.asymmetric import rsa
+        from cryptography.x509.oid import NameOID
+
+        key = rsa.generate_private_key(public_exponent=65537,
+                                       key_size=2048)
+        name = x509.Name(
+            [x509.NameAttribute(NameOID.COMMON_NAME, common_name)])
+        now = datetime.datetime.now(datetime.timezone.utc)
+        cert = (x509.CertificateBuilder()
+                .subject_name(name)
+                .issuer_name(name)
+                .public_key(key.public_key())
+                .serial_number(x509.random_serial_number())
+                .not_valid_before(now - datetime.timedelta(minutes=5))
+                .not_valid_after(now + datetime.timedelta(days=3650))
+                .add_extension(x509.BasicConstraints(ca=True,
+                                                     path_length=None),
+                               critical=True)
+                .sign(key, hashes.SHA256()))
+        with open(key_path, "wb") as f:
+            f.write(key.private_bytes(
+                serialization.Encoding.PEM,
+                serialization.PrivateFormat.TraditionalOpenSSL,
+                serialization.NoEncryption()))
+        with open(cert_path, "wb") as f:
+            f.write(cert.public_bytes(serialization.Encoding.PEM))
+
+    # ---- contexts ---------------------------------------------------
+    def server_context(self) -> ssl.SSLContext:
+        ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+        ctx.load_cert_chain(self.cert_path, self.key_path)
+        ctx.load_verify_locations(self.ca_path)
+        # mutual TLS: a peer without a CA-signed cert is refused at
+        # the handshake (internal connectivity, SSLUtils-style)
+        ctx.verify_mode = ssl.CERT_REQUIRED
+        return ctx
+
+    def client_context(self) -> ssl.SSLContext:
+        ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
+        ctx.load_cert_chain(self.cert_path, self.key_path)
+        ctx.load_verify_locations(self.ca_path)
+        # nodes address each other by dynamic host:port — identity is
+        # the shared certificate, not the hostname (the reference's
+        # internal SSL also skips hostname verification)
+        ctx.check_hostname = False
+        ctx.verify_mode = ssl.CERT_REQUIRED
+        return ctx

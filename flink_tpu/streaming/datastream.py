@@ -178,14 +178,18 @@ class StreamExecutionEnvironment:
         self.num_task_managers = num_task_managers
         return self
 
-    def use_remote_cluster(self, jm_address: str
+    def use_remote_cluster(self, jm_address: str, secret=None, tls=None
                            ) -> "StreamExecutionEnvironment":
         """Submit to a running cluster's Dispatcher at
         "host:port" (ref: RemoteStreamEnvironment /
         ClusterClient.run — flink_tpu.runtime.cluster).  The job graph
         is cloudpickled and shipped via the blob server; results come
-        back through accumulators."""
+        back through accumulators.  `secret` authenticates against a
+        --secret cluster; `tls` (a runtime.tls.TlsConfig) speaks
+        mutual TLS to a --tls-dir cluster."""
         self.remote_address = jm_address
+        self.remote_secret = secret
+        self.remote_tls = tls
         return self
 
     def set_restart_strategy(self, strategy: str, **kw) -> "StreamExecutionEnvironment":
@@ -295,7 +299,10 @@ class StreamExecutionEnvironment:
         if self.remote_address is not None:
             from flink_tpu.runtime.cluster import RemoteExecutor
             kw.pop("processing_time_service", None)
-            self._last_executor = RemoteExecutor(self.remote_address, **kw)
+            self._last_executor = RemoteExecutor(
+                self.remote_address,
+                secret=getattr(self, "remote_secret", None),
+                tls=getattr(self, "remote_tls", None), **kw)
         elif self.num_task_managers is not None:
             from flink_tpu.runtime.minicluster import MiniCluster
             self._last_executor = MiniCluster(

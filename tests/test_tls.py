@@ -1,0 +1,133 @@
+"""Transport security (ref: SecurityUtils.java / SSLUtils.java
+internal connectivity — round-2 verdict item 10): mutual TLS on the
+RPC control plane and the netchannel data plane, shared self-signed
+material, plaintext refused."""
+
+import socket
+import threading
+
+import pytest
+
+from flink_tpu.runtime.rpc import RpcEndpoint, RpcService
+from flink_tpu.runtime.tls import TlsConfig
+
+
+class Echo(RpcEndpoint):
+    RPC_METHODS = ("echo",)
+
+    def __init__(self):
+        super().__init__("echo")
+
+    def echo(self, x):
+        return x
+
+
+@pytest.fixture(scope="module")
+def tls(tmp_path_factory):
+    return TlsConfig.generate_self_signed(
+        str(tmp_path_factory.mktemp("tls")))
+
+
+def test_tls_rpc_handshake_and_call(tls):
+    server = RpcService(tls=tls)
+    server.start_server(Echo())
+    client = RpcService(tls=tls)
+    try:
+        gw = client.connect(server.address, "echo")
+        assert gw.sync.echo({"n": 41}) == {"n": 41}
+    finally:
+        client.stop()
+        server.stop()
+
+
+def test_plaintext_client_refused_by_tls_server(tls):
+    server = RpcService(tls=tls)
+    server.start_server(Echo())
+    plain = RpcService()  # no tls
+    try:
+        gw = plain.connect(server.address, "echo", timeout=3.0)
+        with pytest.raises(Exception):
+            gw.sync.echo(1)
+    finally:
+        plain.stop()
+        server.stop()
+
+
+def test_raw_socket_gets_no_data_from_tls_server(tls):
+    """A plaintext peer can connect TCP but the handshake fails before
+    any frame is served — the socket closes without application
+    data."""
+    server = RpcService(tls=tls)
+    server.start_server(Echo())
+    try:
+        s = socket.create_connection(
+            (server.host, server.port), timeout=3.0)
+        s.sendall(b"\x00\x00\x00\x04junk")
+        s.settimeout(3.0)
+        try:
+            data = s.recv(4096)
+        except (TimeoutError, OSError):
+            data = b""
+        # either an immediate close or a TLS alert — never a frame
+        assert b"result" not in data and b"payload" not in data
+        s.close()
+    finally:
+        server.stop()
+
+
+def test_wrong_certificate_refused(tls, tmp_path):
+    """Mutual TLS: a client with its OWN self-signed cert (not the
+    cluster's) fails verification."""
+    other = TlsConfig.generate_self_signed(str(tmp_path / "other"))
+    server = RpcService(tls=tls)
+    server.start_server(Echo())
+    intruder = RpcService(tls=other)
+    try:
+        with pytest.raises(Exception):
+            gw = intruder.connect(server.address, "echo", timeout=3.0)
+            gw.sync.echo(1)
+    finally:
+        intruder.stop()
+        server.stop()
+
+
+def test_full_job_over_tls_cluster(tls):
+    """A real JM + TM + client, all three planes (RPC control, blob,
+    credit data plane) under mutual TLS — the job runs end to end."""
+    from flink_tpu.runtime.cluster import (
+        JobManagerProcess,
+        TaskManagerProcess,
+    )
+    from flink_tpu.streaming.datastream import StreamExecutionEnvironment
+    from flink_tpu.streaming.sources import CollectSink
+
+    jm = JobManagerProcess(tls=tls)
+    tm = TaskManagerProcess(jm.address, num_slots=4, tm_id="tls-tm",
+                            tls=tls)
+    try:
+        env = StreamExecutionEnvironment()
+        env.use_remote_cluster(jm.address, tls=tls)
+        env.set_parallelism(2)  # exercises the TLS data plane exchange
+        sink = CollectSink()
+        (env.from_collection(list(range(2000)))
+            .map(lambda x: x * 2)
+            .key_by(lambda x: x % 7)
+            .map(lambda x: x)
+            .add_sink(sink))
+        result = env.execute("tls-job")
+        assert sum(result.accumulators["collected"]) == \
+            sum(2 * x for x in range(2000))
+    finally:
+        tm.stop()
+        jm.stop()
+
+
+def test_tls_dir_roundtrip(tmp_path):
+    """from_dir generates material once and reloads it after."""
+    cfg = TlsConfig.from_dir(str(tmp_path / "d"))
+    cfg2 = TlsConfig.from_dir(str(tmp_path / "d"))
+    assert cfg.cert_path == cfg2.cert_path
+    with open(cfg.cert_path) as f:
+        assert "BEGIN CERTIFICATE" in f.read()
+    ctx = cfg.server_context()
+    assert ctx.verify_mode.name == "CERT_REQUIRED"
