@@ -960,6 +960,10 @@ class LocalExecutor:
 
         client.executor_state = {
             "subtasks": subtasks, "coordinator": coordinator,
+            # checkpoints completed by PRIOR attempts: live views add
+            # the current coordinator's count so totals never reset
+            # across restarts (same accumulation as the result object)
+            "checkpoints_base": getattr(result, "_cp_base", 0),
         }
 
         for s in threaded_sources:

@@ -315,7 +315,21 @@ class DataServer:
                     target=self._tls_accept, args=(conn,), daemon=True,
                     name=f"dataplane-tls-{self.port}").start()
             else:
+                self._adopt(conn)
+
+    def _adopt(self, conn) -> None:
+        """Register an accepted (and handshaken) connection — under
+        the server lock so a concurrent stop() either sees it in
+        _connections and closes it, or we see _running False and
+        close it ourselves (no leak window)."""
+        with self._lock:
+            if self._running:
                 self._connections.append(_ProducerConnection(conn, self))
+                return
+        try:
+            conn.close()
+        except OSError:
+            pass
 
     def _tls_accept(self, conn) -> None:
         """Handshake off the accept loop; plaintext peers are refused
@@ -330,19 +344,13 @@ class DataServer:
             except OSError:
                 pass
             return
-        if not self._running:
-            # stop() ran while the handshake was in flight: a
-            # connection appended now would never be closed
-            try:
-                conn.close()
-            except OSError:
-                pass
-            return
-        self._connections.append(_ProducerConnection(conn, self))
+        self._adopt(conn)
 
     def stop(self) -> None:
-        self._running = False
-        for c in list(self._connections):
+        with self._lock:
+            self._running = False
+            conns = list(self._connections)
+        for c in conns:
             c.close()
         try:
             self._server.close()

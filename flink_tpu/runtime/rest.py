@@ -11,10 +11,123 @@ from __future__ import annotations
 
 import json
 import threading
+import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, Optional
 
 from flink_tpu.runtime.metrics import MetricRegistry, PrometheusTextReporter
+
+#: the dashboard (ref: flink-runtime-web/web-dashboard — scaled to one
+#: dependency-free page over the JSON routes below).  Status colors
+#: always pair with a glyph + label (never color alone); all text
+#: wears ink tokens; the backpressure meter is a single-hue fill with
+#: the numeric value printed beside it.
+_DASHBOARD_HTML = """<!doctype html>
+<html lang="en"><head><meta charset="utf-8">
+<title>flink_tpu dashboard</title>
+<style>
+ :root { --ink:#1a1a19; --ink2:#555550; --muted:#8a8a84;
+         --surface:#ffffff; --panel:#f6f6f4; --line:#e3e3df;
+         --good:#0ca30c; --warning:#fab219; --serious:#ec835a;
+         --critical:#d03b3b; --meter:#4a79c4; }
+ @media (prefers-color-scheme: dark) {
+   :root { --ink:#f0f0ee; --ink2:#b5b5af; --muted:#80807a;
+           --surface:#1a1a19; --panel:#242422; --line:#3a3a37; } }
+ body { margin:0; padding:24px; background:var(--surface);
+        color:var(--ink);
+        font:14px/1.5 system-ui,-apple-system,sans-serif; }
+ h1 { font-size:18px; margin:0 0 16px; }
+ h2 { font-size:14px; margin:20px 0 8px; color:var(--ink2); }
+ .tiles { display:flex; gap:12px; flex-wrap:wrap; }
+ .tile { background:var(--panel); border:1px solid var(--line);
+         border-radius:8px; padding:12px 18px; min-width:120px; }
+ .tile .num { font-size:26px; font-weight:600; }
+ .tile .lbl { color:var(--muted); font-size:12px; }
+ table { border-collapse:collapse; width:100%; max-width:860px; }
+ th { text-align:left; color:var(--muted); font-weight:500;
+      font-size:12px; padding:4px 10px 4px 0;
+      border-bottom:1px solid var(--line); }
+ td { padding:5px 10px 5px 0; border-bottom:1px solid var(--line); }
+ .status { font-weight:600; }
+ .meter { display:inline-block; width:120px; height:8px;
+          background:var(--line); border-radius:4px;
+          vertical-align:middle; margin-right:8px; }
+ .meter > i { display:block; height:100%; background:var(--meter);
+              border-radius:4px; }
+ .mono { font-variant-numeric:tabular-nums; }
+ footer { margin-top:24px; color:var(--muted); font-size:12px; }
+</style></head><body>
+<h1>flink_tpu</h1>
+<div class="tiles" id="tiles"></div>
+<h2>Jobs</h2>
+<div id="jobs"></div>
+<footer>auto-refreshes every 2 s &middot; JSON at /jobs, /metrics,
+/jobs/&lt;name&gt;/detail</footer>
+<script>
+const STATUS = {
+  RUNNING:  {glyph:'\\u25B6', color:'var(--good)'},
+  FINISHED: {glyph:'\\u2713', color:'var(--ink2)'},
+  FAILED:   {glyph:'\\u2715', color:'var(--critical)'},
+  CANCELED: {glyph:'\\u25A0', color:'var(--serious)'},
+};
+const esc = s => String(s).replace(/[&<>]/g,
+  c => ({'&':'&amp;','<':'&lt;','>':'&gt;'}[c]));
+function badge(st) {
+  const s = STATUS[st] || {glyph:'?', color:'var(--muted)'};
+  return `<span class="status" style="color:${s.color}">` +
+         `${s.glyph} ${esc(st)}</span>`;
+}
+async function j(path) { return (await fetch(path)).json(); }
+async function refresh() {
+  try {
+    const jobs = await j('/jobs');
+    const names = Object.keys(jobs);
+    const metrics = await j('/metrics');
+    const detailList = await Promise.all(names.map(n =>
+      j('/jobs/' + encodeURIComponent(n) + '/detail')
+        .catch(() => jobs[n])));
+    const details = Object.fromEntries(
+      names.map((n, i) => [n, detailList[i]]));
+    const running = names.filter(n => jobs[n].status === 'RUNNING');
+    const cps = names.reduce((a, n) =>
+      a + ((details[n].checkpoints || {}).completed || 0), 0);
+    document.getElementById('tiles').innerHTML = [
+      [names.length, 'jobs'], [running.length, 'running'],
+      [cps, 'checkpoints'], [Object.keys(metrics).length, 'metrics'],
+    ].map(([n, l]) =>
+      `<div class="tile"><div class="num mono">${n}</div>` +
+      `<div class="lbl">${l}</div></div>`).join('');
+    document.getElementById('jobs').innerHTML = names.map(n => {
+      const d = details[n];
+      const verts = (d.vertices || []).map(v => {
+        const bp = (d.backpressure || {})[String(v.id)] || {};
+        const r = bp.max_ratio ?? null;
+        const meter = r === null ? '' :
+          `<span class="meter"><i style="width:${Math.round(r*100)}%">` +
+          `</i></span><span class="mono">${(r*100).toFixed(0)}%` +
+          `${bp.level ? ' (' + esc(bp.level) + ')' : ''}</span>`;
+        return `<tr><td class="mono">${v.id}</td>` +
+               `<td>${esc(v.name)}</td>` +
+               `<td class="mono">${v.parallelism}</td>` +
+               `<td>${meter}</td></tr>`;
+      }).join('');
+      const recent = ((d.checkpoints || {}).recent || []).slice(-5)
+        .map(c => `#${c.id} ${c.duration_ms ?? '?'} ms ` +
+                  `${(c.bytes / 1024).toFixed(0)} KiB`)
+        .join(' &middot; ');
+      return `<h2>${esc(n)} ${badge(d.status)}</h2>` +
+        `<table><tr><th>id</th><th>vertex</th><th>par</th>` +
+        `<th>backpressure</th></tr>${verts}</table>` +
+        `<p class="mono" style="color:var(--ink2)">checkpoints: ` +
+        `${(d.checkpoints || {}).completed ?? 0}` +
+        `${recent ? ' &middot; recent: ' + recent : ''}</p>`;
+    }).join('') || '<p style="color:var(--muted)">no tracked jobs</p>';
+  } catch (e) { /* monitor restarting; retry next tick */ }
+}
+refresh();
+setInterval(refresh, 2000);
+</script></body></html>
+"""
 
 
 class WebMonitor:
@@ -66,6 +179,8 @@ class WebMonitor:
 
     # ---- routing -----------------------------------------------------
     def _route(self, path: str):
+        if path == "/web":
+            return _DASHBOARD_HTML, "text/html; charset=utf-8"
         if path in ("/", "/overview"):
             return {"jobs": len(self.jobs),
                     "metrics": len(self.registry.dump())}, "application/json"
@@ -78,26 +193,77 @@ class WebMonitor:
             self.prometheus.report(self.registry.dump())
             return self.prometheus.render(), "text/plain; version=0.0.4"
         if path.startswith("/jobs/") and path.endswith("/backpressure"):
-            job = path[len("/jobs/"):-len("/backpressure")]
+            job = urllib.parse.unquote(
+                path[len("/jobs/"):-len("/backpressure")])
             if job not in self.jobs:
                 raise KeyError(path)
             from flink_tpu.runtime.backpressure import sample_client
             stats = sample_client(self.jobs[job])
             return ({str(vid): s for vid, s in stats.items()},
                     "application/json")
+        if path.startswith("/jobs/") and path.endswith("/detail"):
+            job = urllib.parse.unquote(
+                path[len("/jobs/"):-len("/detail")])
+            if job not in self.jobs:
+                raise KeyError(path)
+            return self._job_detail(job), "application/json"
         if path.startswith("/jobs/") and path.endswith("/metrics"):
-            job = path[len("/jobs/"):-len("/metrics")]
+            job = urllib.parse.unquote(
+                path[len("/jobs/"):-len("/metrics")])
             dump = {k: v for k, v in self.registry.dump().items()
                     if k.startswith(job + ".")}
             if not dump and job not in self.jobs:
                 raise KeyError(path)
             return dump, "application/json"
         if path.startswith("/jobs/"):
-            job = path[len("/jobs/"):]
+            job = urllib.parse.unquote(path[len("/jobs/"):])
             if job not in self.jobs:
                 raise KeyError(path)
             return self._job_status(self.jobs[job]), "application/json"
         raise KeyError(path)
+
+    def _job_detail(self, name: str) -> dict:
+        """Vertices, checkpoint stats, and backpressure for one job —
+        the data the dashboard page renders (ref: the job-detail
+        handlers behind flink-runtime-web)."""
+        client = self.jobs[name]
+        detail = dict(self._job_status(client))
+        state = getattr(client, "executor_state", None) or {}
+        subtasks = state.get("subtasks") or {}
+        vertices = []
+        for vid, sts in sorted(subtasks.items()):
+            v = getattr(sts[0], "vertex", None) if sts else None
+            chain = getattr(v, "chain", None)
+            vertices.append({
+                "id": vid,
+                "name": " -> ".join(n.name for n in chain)
+                if chain else f"vertex-{vid}",
+                "parallelism": len(sts),
+            })
+        detail["vertices"] = vertices
+        coordinator = state.get("coordinator")
+        cps = {"completed": state.get("checkpoints_base", 0),
+               "recent": []}
+        if coordinator is not None:
+            cps["completed"] += getattr(coordinator, "completed_count", 0)
+            stats = getattr(coordinator, "stats", {}) or {}
+            for cid in sorted(stats)[-10:]:
+                st = stats[cid]
+                cps["recent"].append({
+                    "id": st.checkpoint_id,
+                    "duration_ms": (
+                        round(st.complete_ms - st.trigger_ms, 1)
+                        if st.complete_ms is not None else None),
+                    "bytes": st.state_bytes,
+                })
+        detail["checkpoints"] = cps
+        try:
+            from flink_tpu.runtime.backpressure import sample_client
+            detail["backpressure"] = {
+                str(vid): s for vid, s in sample_client(client).items()}
+        except Exception:  # noqa: BLE001 — job may be terminal
+            detail["backpressure"] = {}
+        return detail
 
     @staticmethod
     def _job_status(client) -> dict:

@@ -75,20 +75,40 @@ class TlsConfig:
         if os.path.exists(cert) and os.path.exists(key):
             return TlsConfig(cert, key)
         lock = os.path.join(directory, ".tls.lock")
-        try:
-            os.close(os.open(lock, os.O_CREAT | os.O_EXCL | os.O_WRONLY))
-            i_create = True
-        except FileExistsError:
-            i_create = False
+
+        def try_lock() -> bool:
+            try:
+                os.close(os.open(lock,
+                                 os.O_CREAT | os.O_EXCL | os.O_WRONLY))
+                return True
+            except FileExistsError:
+                return False
+
+        i_create = try_lock()
         if not i_create:
             deadline = time.monotonic() + 30.0
             while time.monotonic() < deadline:
                 if os.path.exists(cert) and os.path.exists(key):
                     return TlsConfig(cert, key)
+                # a generator that died mid-write leaves a stale lock
+                # forever — break it once it is clearly abandoned
+                try:
+                    stale = (time.time() - os.path.getmtime(lock)) > 60.0
+                except OSError:
+                    stale = True  # lock vanished: re-contend
+                if stale:
+                    try:
+                        os.unlink(lock)
+                    except OSError:
+                        pass
+                    if try_lock():
+                        i_create = True
+                        break
                 time.sleep(0.05)
-            raise TimeoutError(
-                f"another process holds {lock!r} but the TLS material "
-                "never appeared")
+            if not i_create:
+                raise TimeoutError(
+                    f"another process holds {lock!r} but the TLS "
+                    "material never appeared")
         try:
             kt, ct = key + ".tmp", cert + ".tmp"
             # the key file is 0600 from birth (no chmod window)

@@ -110,6 +110,8 @@ class StreamExecutionEnvironment:
         self.num_task_managers: Optional[int] = None
         #: "host:port" of a running Dispatcher → RemoteExecutor
         self.remote_address: Optional[str] = None
+        self.remote_secret: Optional[str] = None
+        self.remote_tls = None
         self._last_executor = None
         self._executed = False
 
@@ -300,9 +302,8 @@ class StreamExecutionEnvironment:
             from flink_tpu.runtime.cluster import RemoteExecutor
             kw.pop("processing_time_service", None)
             self._last_executor = RemoteExecutor(
-                self.remote_address,
-                secret=getattr(self, "remote_secret", None),
-                tls=getattr(self, "remote_tls", None), **kw)
+                self.remote_address, secret=self.remote_secret,
+                tls=self.remote_tls, **kw)
         elif self.num_task_managers is not None:
             from flink_tpu.runtime.minicluster import MiniCluster
             self._last_executor = MiniCluster(

@@ -221,3 +221,69 @@ def test_cli_ops_verbs_against_live_cluster(tmp_path, capsys):
             executor.stop()
         tm.stop()
         jm.stop()
+
+
+def test_dashboard_page_and_job_detail(tmp_path):
+    """/web serves the dashboard; /jobs/<name>/detail carries
+    vertices, checkpoint stats, and backpressure for a live job
+    (ref: flink-runtime-web, scaled to one static page)."""
+    from flink_tpu.runtime.metrics import MetricRegistry
+
+    class Trickle(SourceFunction):
+        def __init__(self, n=4000):
+            self.n = n
+            self.offset = 0
+            self._running = True
+
+        def run(self, ctx):
+            while self.emit_step(ctx, 64):
+                pass
+
+        def emit_step(self, ctx, max_records):
+            from flink_tpu.streaming.elements import MAX_WATERMARK
+            if not self._running:
+                return False
+            end = min(self.offset + max_records, self.n)
+            for i in range(self.offset, end):
+                ctx.collect_with_timestamp((i % 3, 1.0), i)
+            self.offset = end
+            time.sleep(0.001)
+            if self.offset >= self.n:
+                ctx.emit_watermark(MAX_WATERMARK)
+                return False
+            return True
+
+        def cancel(self):
+            self._running = False
+
+    registry = MetricRegistry()
+    monitor = WebMonitor(registry).start()
+    try:
+        env = StreamExecutionEnvironment()
+        env.enable_checkpointing(10)
+        (env.add_source(Trickle(), name="trickle")
+            .map(lambda v: v, name="ident")
+            .add_sink(CollectSink()))
+        client = env.execute_async("dash-job")
+        monitor.track_job("dash-job", client)
+
+        html, ctype = _get(monitor.port, "/web")
+        assert "text/html" in ctype
+        assert "flink_tpu dashboard" in html and "/detail" in html
+
+        deadline = time.time() + 20
+        detail = {}
+        while time.time() < deadline:
+            detail, _ = _get(monitor.port, "/jobs/dash-job/detail")
+            if detail.get("vertices") \
+                    and detail["checkpoints"]["completed"] >= 1:
+                break
+            time.sleep(0.05)
+        assert detail["status"] in ("RUNNING", "FINISHED")
+        assert any("trickle" in v["name"] for v in detail["vertices"])
+        assert detail["checkpoints"]["completed"] >= 1
+        assert detail["checkpoints"]["recent"], detail["checkpoints"]
+        assert "backpressure" in detail
+        client.wait(30.0)
+    finally:
+        monitor.stop()
