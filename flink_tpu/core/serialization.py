@@ -64,6 +64,17 @@ class TypeSerializer(Generic[T], abc.ABC):
         (ref: TypeSerializerConfigSnapshot compatibility checks)."""
         return snapshot.serializer_name == type(self).__name__
 
+    def migrate_value(self, value: T,
+                      restored: "SerializerConfigSnapshot") -> T:
+        """Transform a value restored from state written under
+        `restored`'s (compatible) configuration into this serializer's
+        current shape — the COMPATIBLE_AFTER_MIGRATION leg of the
+        reference's TypeSerializerSchemaCompatibility.  Backends call
+        it for every restored value of a state whose recorded config
+        differs from the registered serializer's.  Default: identity
+        (most serializers are compatible as-is)."""
+        return value
+
     # numpy/JAX mapping for the TPU backend's struct-of-arrays layout.
     def numpy_dtype(self) -> Optional[np.dtype]:
         """dtype if values of this type embed losslessly into a numpy

@@ -326,6 +326,9 @@ class MiniCluster:
         client.executor_state = {
             "subtasks": subtasks, "coordinator": coordinator,
             "task_managers": tms,
+            # live checkpoint views add the current coordinator's
+            # count to this — totals survive restarts (see local.py)
+            "checkpoints_base": getattr(result, "_cp_base", 0),
         }
 
         for s in threaded_sources:

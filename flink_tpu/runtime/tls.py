@@ -91,19 +91,27 @@ class TlsConfig:
                 if os.path.exists(cert) and os.path.exists(key):
                     return TlsConfig(cert, key)
                 # a generator that died mid-write leaves a stale lock
-                # forever — break it once it is clearly abandoned
+                # forever — break it once it is clearly abandoned.
+                # The steal is an atomic RENAME: exactly one contender
+                # wins the rename, removes the carcass, and re-enters
+                # the O_EXCL contest (two unlink-then-create stealers
+                # could otherwise both generate, interleaving renames
+                # into a mismatched key/cert pair).
                 try:
                     stale = (time.time() - os.path.getmtime(lock)) > 60.0
                 except OSError:
-                    stale = True  # lock vanished: re-contend
+                    stale = False  # lock vanished: creator finished
+                    # or aborted — loop re-checks files / re-contends
                 if stale:
+                    carcass = lock + f".stale.{os.getpid()}"
                     try:
-                        os.unlink(lock)
+                        os.rename(lock, carcass)
+                        os.unlink(carcass)
                     except OSError:
-                        pass
-                    if try_lock():
-                        i_create = True
-                        break
+                        pass  # another contender won the steal
+                if not os.path.exists(lock) and try_lock():
+                    i_create = True
+                    break
                 time.sleep(0.05)
             if not i_create:
                 raise TimeoutError(

@@ -232,10 +232,14 @@ class KeyedStateBackend(abc.ABC):
                 self._restored_serializer_cfgs[name] = cfg
                 d = self._descriptors.get(name)
                 if d is not None:
-                    self._check_serializer_against_restored(d)
+                    # check only — values have not loaded yet; the
+                    # restore's tail runs _apply_restored_migrations
+                    self._check_serializer_against_restored(
+                        d, migrate=False)
 
     def _check_serializer_against_restored(self,
-                                           descriptor: StateDescriptor
+                                           descriptor: StateDescriptor,
+                                           migrate: bool = True
                                            ) -> None:
         from flink_tpu.core.serialization import StateMigrationException
         cfg = self._restored_serializer_cfgs.get(descriptor.name)
@@ -247,6 +251,40 @@ class KeyedStateBackend(abc.ABC):
                 f"{cfg.serializer_name!r}; the registered serializer "
                 f"{type(ser).__name__!r} cannot read it (ref: "
                 f"TypeSerializerConfigSnapshot compatibility)")
+        # COMPATIBLE_AFTER_MIGRATION: a changed-but-readable config
+        # (e.g. an evolved record schema) migrates the state's values
+        # once, at whichever comes later — bind or restore.  The
+        # recorded config is then replaced so a re-bind can never
+        # migrate twice (double resolution would overwrite real
+        # values with defaults).
+        if migrate and cfg is not None and ser is not None \
+                and cfg != ser.snapshot_configuration():
+            self._migrate_state_values(descriptor, ser, cfg)
+            self._restored_serializer_cfgs[descriptor.name] = \
+                ser.snapshot_configuration()
+
+    def _migrate_state_values(self, descriptor: StateDescriptor,
+                              serializer, restored_cfg) -> None:
+        """Backend hook: rewrite the descriptor's restored values via
+        serializer.migrate_value.  Backends that materialize restored
+        values as live objects (the heap/tpu host tables) override;
+        byte-oriented stores resolve lazily through the serializer
+        itself and need nothing here.  (Takes the DESCRIPTOR, not the
+        name: at bind time the registry entry does not exist yet.)"""
+
+    def _apply_restored_migrations(self) -> None:
+        """Called by restore() AFTER values load: migrate every
+        already-bound state whose recorded config differs (the
+        bind-before-restore order; restore-before-bind migrates at
+        bind via _check_serializer_against_restored)."""
+        for name, d in self._descriptors.items():
+            cfg = self._restored_serializer_cfgs.get(name)
+            ser = getattr(d, "serializer", None)
+            if cfg is not None and ser is not None \
+                    and cfg != ser.snapshot_configuration():
+                self._migrate_state_values(d, ser, cfg)
+                self._restored_serializer_cfgs[name] = \
+                    ser.snapshot_configuration()
 
     # ---- snapshot / restore (ref: Snapshotable) ---------------------
     @abc.abstractmethod
@@ -262,3 +300,26 @@ class KeyedStateBackend(abc.ABC):
 
     def dispose(self) -> None:
         self._states.clear()
+
+
+def migrate_table_values(table, descriptor, serializer,
+                         restored_cfg) -> None:
+    """Shared value-migration pass over a live StateTable: the
+    descriptor's TYPE decides the stored shape — a LIST state stores a
+    Python list of elements and a MAP state a dict of entries, so the
+    ELEMENT serializer's migrate_value maps over them; everything else
+    stores one value (the reference's per-element migration in
+    StateTableByKeyGroupReaders)."""
+    kind = getattr(descriptor, "TYPE", "value")
+    if kind == "list":
+        def mig(v):
+            return [serializer.migrate_value(x, restored_cfg) for x in v]
+    elif kind == "map":
+        def mig(v):
+            return {k: serializer.migrate_value(x, restored_cfg)
+                    for k, x in v.items()}
+    else:
+        def mig(v):
+            return serializer.migrate_value(v, restored_cfg)
+    for namespace, key, value in list(table.entries()):
+        table.put(key, namespace, mig(value))

@@ -319,6 +319,18 @@ class HeapKeyedStateBackend(KeyedStateBackend):
         t = self._tables.get(state_name)
         return list(t.keys(namespace)) if t else []
 
+    def _migrate_state_values(self, descriptor, serializer,
+                              restored_cfg) -> None:
+        """Rewrite restored table values through the serializer's
+        migration hook (heap values are live objects, so migration is
+        an in-place, state-TYPE-aware table pass)."""
+        from flink_tpu.state.backend import migrate_table_values
+        table = self._tables.get(descriptor.name)
+        if table is None:
+            return
+        migrate_table_values(table, descriptor, serializer,
+                             restored_cfg)
+
     # ---- snapshot / restore -----------------------------------------
     def snapshot(self) -> KeyedStateSnapshot:
         """Serialize every (state, namespace, key, value) entry into
@@ -359,6 +371,7 @@ class HeapKeyedStateBackend(KeyedStateBackend):
                     continue
                 for name, namespace, key, value in chunk:
                     self._table(name).put(key, namespace, value)
+        self._apply_restored_migrations()
 
     def dispose(self) -> None:
         super().dispose()

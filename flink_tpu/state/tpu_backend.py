@@ -701,6 +701,21 @@ class TpuKeyedStateBackend(KeyedStateBackend):
                 table = self._table(name)
                 for key, namespace, row in entries:
                     table.put(key, namespace, row)
+        self._apply_restored_migrations()
+
+    def _migrate_state_values(self, descriptor, serializer,
+                              restored_cfg) -> None:
+        """Value migration for HOST-table states (the same pass as the
+        heap backend); device-resident states are numeric accumulator
+        rows the record serializers never apply to, so only live host
+        tables migrate."""
+        from flink_tpu.state.backend import migrate_table_values
+        name = descriptor.name
+        table = self._tables.get(name)
+        if table is None or name in self._device_states:
+            return
+        migrate_table_values(table, descriptor, serializer,
+                             restored_cfg)
 
     def flush_all(self) -> None:
         """Barrier hook: push all pending micro-batches to HBM before a

@@ -298,6 +298,14 @@ class AbstractUdfStreamOperator(StreamOperator):
             )
             self.user_function.set_runtime_context(ctx)
             self.user_function.open(None)
+        # CheckpointedFunction-style operator-state access for plain
+        # functions (ref: FunctionInitializationContext — the seam the
+        # Kafka/Kinesis consumers use for UNION offset state).  Called
+        # AFTER restore_state has repopulated the backend when the
+        # runtime opens operators post-restore.
+        fn = self.user_function
+        if hasattr(fn, "initialize_state"):
+            fn.initialize_state(self)
 
     def finish(self):
         fn = self.user_function

@@ -1,0 +1,251 @@
+"""Schema-evolving record format (the flink-avro role) + the
+Kinesis-shaped sharded stream connector (round-3 verdict item 10)."""
+
+import numpy as np
+import pytest
+
+from flink_tpu.core.records import (
+    RecordSchema,
+    RecordSerializer,
+)
+from flink_tpu.core.serialization import StateMigrationException
+
+
+V1 = RecordSchema([("user", "long"), ("name", "string"),
+                   ("score", "long")])
+V2 = RecordSchema([("user", "long"), ("name", "string"),
+                   ("score", "double"),          # long -> double
+                   ("country", "string", "??")])  # added, with default
+
+
+def test_record_roundtrip_and_defaults():
+    s = RecordSerializer(V2)
+    rec = {"user": 7, "name": "ada", "score": 9.5, "country": "pe"}
+    assert s.deserialize_from_bytes(s.serialize_to_bytes(rec)) == rec
+    # missing field with default fills in on write
+    out = s.deserialize_from_bytes(
+        s.serialize_to_bytes({"user": 1, "name": "x", "score": 0.0}))
+    assert out["country"] == "??"
+    with pytest.raises(KeyError):
+        s.serialize_to_bytes({"user": 1})  # name has no default
+
+
+def test_schema_evolution_resolution():
+    writer = RecordSerializer(V1)
+    old_bytes = writer.serialize_to_bytes(
+        {"user": 42, "name": "grace", "score": 100})
+
+    reader = RecordSerializer(V2)
+    assert reader.ensure_compatibility(writer.snapshot_configuration())
+    out = reader.deserialize_from_bytes(old_bytes)
+    assert out == {"user": 42, "name": "grace", "score": 100.0,
+                   "country": "??"}
+    assert isinstance(out["score"], float)  # promoted
+    # new writes coexist with old bytes under the same serializer
+    new_bytes = reader.serialize_to_bytes(
+        {"user": 1, "name": "n", "score": 2.0, "country": "de"})
+    assert reader.deserialize_from_bytes(new_bytes)["country"] == "de"
+    assert reader.deserialize_from_bytes(old_bytes)["user"] == 42
+
+
+def test_incompatible_evolutions_rejected():
+    v1 = RecordSerializer(V1)
+    snap = v1.snapshot_configuration()
+    # added field WITHOUT default
+    bad1 = RecordSerializer(RecordSchema(
+        [("user", "long"), ("name", "string"), ("score", "long"),
+         ("email", "string")]))
+    assert not bad1.ensure_compatibility(snap)
+    # illegal type change
+    bad2 = RecordSerializer(RecordSchema(
+        [("user", "string"), ("name", "string"), ("score", "long")]))
+    assert not bad2.ensure_compatibility(snap)
+    # dropped field is fine (writer field skipped)
+    ok = RecordSerializer(RecordSchema([("user", "long")]))
+    assert ok.ensure_compatibility(snap)
+
+
+def test_state_backend_migration_end_to_end():
+    """Keyed state written under schema v1, restored under v2: the
+    migration seam resolves old values; an incompatible reader raises
+    StateMigrationException (the flink-avro state-evolution story)."""
+    from flink_tpu.core.keygroups import KeyGroupRange
+    from flink_tpu.core.state import ValueStateDescriptor
+    from flink_tpu.state.heap_backend import HeapKeyedStateBackend
+
+    rng = KeyGroupRange(0, 127)
+    b1 = HeapKeyedStateBackend(rng, 128)
+    d1 = ValueStateDescriptor("profile", serializer=RecordSerializer(V1))
+    st1 = b1.get_or_create_keyed_state(d1)
+    b1.set_current_key("u1")
+    st1.update({"user": 1, "name": "ada", "score": 10})
+    b1.set_current_key("u2")
+    st1.update({"user": 2, "name": "bob", "score": 20})
+    snap = b1.snapshot()
+
+    # restore under the EVOLVED schema
+    b2 = HeapKeyedStateBackend(rng, 128)
+    d2 = ValueStateDescriptor("profile", serializer=RecordSerializer(V2))
+    st2 = b2.get_or_create_keyed_state(d2)
+    b2.restore([snap])
+    b2.set_current_key("u1")
+    assert st2.value() == {"user": 1, "name": "ada", "score": 10.0,
+                           "country": "??"}
+    # post-restore writes under v2 coexist with migrated v1 values
+    b2.set_current_key("u3")
+    st2.update({"user": 3, "name": "eve", "score": 1.5,
+                "country": "fr"})
+    assert st2.value()["country"] == "fr"
+    b2.set_current_key("u2")
+    assert st2.value()["score"] == 20.0
+
+    # an INCOMPATIBLE reader fails the restore loudly
+    b3 = HeapKeyedStateBackend(rng, 128)
+    bad = RecordSchema([("user", "long"), ("name", "string"),
+                        ("score", "long"), ("email", "string")])
+    b3.get_or_create_keyed_state(
+        ValueStateDescriptor("profile", serializer=RecordSerializer(bad)))
+    with pytest.raises(StateMigrationException):
+        b3.restore([snap])
+
+
+# ---------------------------------------------------------------------
+# sharded stream connector
+# ---------------------------------------------------------------------
+
+def _fill_stream(path, n_shards=4, per_shard=200):
+    from flink_tpu.connectors.sharded_stream import FileShardedStream
+    stream = FileShardedStream(str(path))
+    expected = []
+    for s in range(n_shards):
+        stream.create_shard(f"s{s}")
+    for i in range(per_shard):
+        for s in range(n_shards):
+            v = (s, i)
+            stream.put(f"s{s}", v)
+            expected.append(v)
+    return stream, expected
+
+
+def test_sharded_stream_reads_all_shards(tmp_path):
+    from flink_tpu.connectors.sharded_stream import ShardedStreamSource
+    from flink_tpu.streaming.datastream import StreamExecutionEnvironment
+    from flink_tpu.streaming.sources import CollectSink
+
+    _, expected = _fill_stream(tmp_path / "stream")
+    env = StreamExecutionEnvironment()
+    sink = CollectSink()
+    env.add_source(ShardedStreamSource(str(tmp_path / "stream")),
+                   name="sharded").add_sink(sink)
+    env.execute("sharded-read")
+    assert sorted(sink.values) == sorted(expected)
+
+
+def test_sharded_stream_discovers_new_shards(tmp_path):
+    """A shard created after consumption began is discovered and
+    consumed (the resharding story)."""
+    from flink_tpu.connectors.sharded_stream import (
+        FileShardedStream,
+        ShardedStreamSource,
+    )
+    from flink_tpu.streaming.datastream import StreamExecutionEnvironment
+    from flink_tpu.streaming.sources import CollectSink
+
+    stream, expected = _fill_stream(tmp_path / "s2", n_shards=2,
+                                    per_shard=50)
+
+    class DiscoveringSource(ShardedStreamSource):
+        DISCOVER_EVERY = 2
+        injected = False
+
+        def emit_step(self, ctx, max_records):
+            if not type(self).injected and self._steps >= 1:
+                type(self).injected = True
+                late = FileShardedStream(self.path)
+                late.create_shard("late")
+                for i in range(25):
+                    late.put("late", (99, i))
+            return super().emit_step(ctx, max_records)
+
+    DiscoveringSource.injected = False
+    env = StreamExecutionEnvironment()
+    sink = CollectSink()
+    env.add_source(DiscoveringSource(str(tmp_path / "s2")),
+                   name="sharded").add_sink(sink)
+    env.execute("sharded-discover")
+    got = sorted(sink.values)
+    assert got == sorted(expected + [(99, i) for i in range(25)])
+
+
+def test_sharded_stream_rescale_keeps_offsets(tmp_path):
+    """Offsets ride UNION state: savepoint at par 1, restore at par 2
+    — every shard resumes after its checkpointed sequence number,
+    exactly-once (FlinkKinesisConsumer's state story)."""
+    import time
+
+    from flink_tpu.connectors.sharded_stream import ShardedStreamSource
+    from flink_tpu.streaming.datastream import StreamExecutionEnvironment
+    from flink_tpu.streaming.sources import CollectSink
+
+    _, expected = _fill_stream(tmp_path / "s3", n_shards=4,
+                               per_shard=300)
+
+    class GatedShardedSource(ShardedStreamSource):
+        released = False
+
+        def emit_step(self, ctx, max_records):
+            # one productive step, then hold: the savepoint barrier
+            # always lands during the hold, so nothing is emitted
+            # post-barrier and run-1 + run-2 partition the stream
+            if not type(self).released and self._steps >= 1:
+                time.sleep(0.002)
+                return True
+            return super().emit_step(ctx, max_records)
+
+    GatedShardedSource.released = False
+    env = StreamExecutionEnvironment()
+    env.enable_checkpointing(10)
+    sink1 = CollectSink()
+    env.add_source(GatedShardedSource(str(tmp_path / "s3")),
+                   name="sharded").add_sink(sink1)
+    client = env.execute_async("sharded-origin")
+    path = client.stop_with_savepoint(str(tmp_path / "sp"))
+
+    GatedShardedSource.released = True
+    env2 = StreamExecutionEnvironment()
+    env2.enable_checkpointing(10)
+    env2.set_savepoint_restore(path)
+    env2.set_parallelism(2)  # RESCALE
+    sink2 = CollectSink()
+    env2.add_source(GatedShardedSource(str(tmp_path / "s3")),
+                    name="sharded", parallelism=2).add_sink(sink2)
+    env2.execute("sharded-rescaled")
+    # run-1 records + run-2 records = exactly the stream, no dupes
+    assert sorted(sink1.values + sink2.values) == sorted(expected)
+
+
+def test_list_state_migration_maps_over_elements():
+    """ListState stores a LIST of records; migration maps the element
+    serializer over it instead of treating the list as one record."""
+    from flink_tpu.core.keygroups import KeyGroupRange
+    from flink_tpu.core.state import ListStateDescriptor
+    from flink_tpu.state.heap_backend import HeapKeyedStateBackend
+
+    rng = KeyGroupRange(0, 127)
+    b1 = HeapKeyedStateBackend(rng, 128)
+    st1 = b1.get_or_create_keyed_state(
+        ListStateDescriptor("events", serializer=RecordSerializer(V1)))
+    b1.set_current_key("k")
+    st1.add({"user": 1, "name": "a", "score": 5})
+    st1.add({"user": 2, "name": "b", "score": 6})
+    snap = b1.snapshot()
+
+    b2 = HeapKeyedStateBackend(rng, 128)
+    st2 = b2.get_or_create_keyed_state(
+        ListStateDescriptor("events", serializer=RecordSerializer(V2)))
+    b2.restore([snap])
+    b2.set_current_key("k")
+    assert st2.get() == [
+        {"user": 1, "name": "a", "score": 5.0, "country": "??"},
+        {"user": 2, "name": "b", "score": 6.0, "country": "??"},
+    ]
