@@ -30,3 +30,7 @@ __all__ = [
     "JdbcOutputFormat",
     "JdbcSink",
 ]
+from flink_tpu.connectors.sharded_stream import (
+    FileShardedStream,
+    ShardedStreamSource,
+)

@@ -13,10 +13,18 @@ from flink_tpu.parallel.mesh_agg import (
     MeshWindowAggregation,
     make_sharded_step,
 )
+from flink_tpu.parallel.mesh_log import (
+    MeshLogSessionWindows,
+    MeshLogSlidingWindows,
+    MeshLogTumblingWindows,
+    mesh_log_engine_for_assigner,
+)
 from flink_tpu.parallel.mesh_windows import (
     MeshSlidingWindows,
     MeshTumblingWindows,
 )
 
 __all__ = ["MeshWindowAggregation", "make_sharded_step",
-           "MeshTumblingWindows", "MeshSlidingWindows"]
+           "MeshTumblingWindows", "MeshSlidingWindows",
+           "MeshLogTumblingWindows", "MeshLogSlidingWindows",
+           "MeshLogSessionWindows", "mesh_log_engine_for_assigner"]
