@@ -101,6 +101,14 @@ def _ensure_loaded() -> Optional[ctypes.CDLL]:
             u64p, u16p, c.c_int64, c.c_int, f64p, c.c_int,
             c.c_double, c.c_int64, c.c_double, u64p, f64p]
         lib.ft_qsketch_log_fire.restype = c.c_int64
+        u32p = np.ctypeslib.ndpointer(np.uint32, flags="C_CONTIGUOUS")
+        lib.ft_qsketch_log_fire2.argtypes = [
+            u64p, u16p, u32p, c.c_int64, c.c_int, f64p, c.c_int,
+            c.c_double, c.c_int64, c.c_double, u64p, f64p]
+        lib.ft_qsketch_log_fire2.restype = c.c_int64
+        lib.ft_qsketch_log_compact.argtypes = [
+            u64p, u16p, u32p, c.c_int64, c.c_int, u64p, u16p, u32p]
+        lib.ft_qsketch_log_compact.restype = c.c_int64
         lib.ft_session_log_fire.argtypes = [
             u64p, i64p, f32p, u64p, c.c_int64, c.c_int64, c.c_int64,
             c.c_int, c.c_int,
@@ -322,9 +330,11 @@ class NativeSumTable:
 
 def qsketch_log_fire(keys: np.ndarray, buckets: np.ndarray,
                      n_buckets: int, quantiles, log_gamma: float,
-                     offset: int, mid_corr: float):
+                     offset: int, mid_corr: float, counts=None):
     """Per distinct key, the requested quantiles from its logged
-    DDSketch buckets (key-sorted).  Returns (keys, q [n_keys, n_q])."""
+    DDSketch buckets (key-sorted).  `counts` weights each cell
+    (compacted logs); None = raw cells, weight 1.  Returns
+    (keys, q [n_keys, n_q])."""
     lib = _ensure_loaded()
     n = len(keys)
     keys = np.ascontiguousarray(keys, np.uint64)
@@ -332,10 +342,44 @@ def qsketch_log_fire(keys: np.ndarray, buckets: np.ndarray,
     q = np.ascontiguousarray(quantiles, np.float64)
     ok = np.empty(n, np.uint64)
     out = np.empty(n * len(q), np.float64)
-    n_keys = lib.ft_qsketch_log_fire(keys, buckets, n, n_buckets,
-                                     q, len(q), log_gamma, offset,
-                                     mid_corr, ok, out)
+    if counts is None:
+        n_keys = lib.ft_qsketch_log_fire(keys, buckets, n, n_buckets,
+                                         q, len(q), log_gamma, offset,
+                                         mid_corr, ok, out)
+    else:
+        if n >= 1 << 32:
+            # the weighted kernel carries the cell index in a 32-bit
+            # field; beyond that it would silently gather wrong cells
+            raise ValueError(
+                "weighted quantile fire supports < 2^32 cells per "
+                "window; lower compact_threshold so the log compacts")
+        counts = np.ascontiguousarray(counts, np.uint32)
+        n_keys = lib.ft_qsketch_log_fire2(keys, buckets, counts, n,
+                                          n_buckets, q, len(q),
+                                          log_gamma, offset, mid_corr,
+                                          ok, out)
     return ok[:n_keys], out[:n_keys * len(q)].reshape(n_keys, len(q))
+
+
+def qsketch_log_compact(keys: np.ndarray, buckets: np.ndarray,
+                        counts, n_buckets: int):
+    """Collapse (key, bucket) duplicates into count cells — bounds a
+    window's quantile log at keys x buckets cells.  `counts` weights
+    existing cells (None = 1).  Returns (keys, buckets, counts)."""
+    lib = _ensure_loaded()
+    n = len(keys)
+    keys = np.ascontiguousarray(keys, np.uint64)
+    buckets = np.ascontiguousarray(buckets, np.uint16)
+    if counts is None:
+        counts = np.ones(n, np.uint32)
+    else:
+        counts = np.ascontiguousarray(counts, np.uint32)
+    ok = np.empty(n, np.uint64)
+    ob = np.empty(n, np.uint16)
+    oc = np.empty(n, np.uint32)
+    n_out = lib.ft_qsketch_log_compact(keys, buckets, counts, n,
+                                       n_buckets, ok, ob, oc)
+    return ok[:n_out].copy(), ob[:n_out].copy(), oc[:n_out].copy()
 
 
 def session_log_fire(keys: np.ndarray, ts: np.ndarray, weights: np.ndarray,

@@ -213,10 +213,14 @@ class QuantileSketchAggregate(DeviceAggregateFunction):
         hist = state["hist"][slots].astype(jnp.float32)          # [S, B]
         cum = jnp.cumsum(hist, axis=-1)
         total = cum[..., -1:]
-        # bucket midpoint values (geometric mean of bucket bounds)
+        # canonical DDSketch bucket estimate 2*gamma^b/(gamma+1):
+        # symmetric +-alpha relative error over the bucket's value
+        # range (the earlier sqrt-midpoint x 2g/(g+1) form was biased
+        # sqrt(gamma) high — worst case 2*alpha at the lower edge,
+        # violating the documented (gamma-1)/2 bound)
         b = jnp.arange(self.buckets, dtype=jnp.float32)
-        bucket_val = jnp.exp((b - 0.5 + self.offset) * self.log_gamma) * \
-            (2.0 / (1.0 + 1.0 / self.gamma))
+        bucket_val = jnp.exp((b + self.offset) * self.log_gamma) * \
+            (2.0 / (1.0 + self.gamma))
         bucket_val = bucket_val.at[0].set(0.0)
         outs = []
         for q in self.quantiles:

@@ -67,13 +67,18 @@ class _WindowLog:
     counts mutations — an unchanged version means the snapshot chunk
     hash can be reused (incremental-checkpoint seam)."""
 
-    __slots__ = ("keys", "cols", "count", "version")
+    __slots__ = ("keys", "cols", "count", "version", "compacted_size")
 
     def __init__(self):
         self.keys: List[np.ndarray] = []
         self.cols: List[Tuple[np.ndarray, ...]] = []
         self.count = 0
         self.version = 0
+        #: cell count right after the last compaction — compaction
+        #: re-arms only once the log has grown well past it, so a log
+        #: whose compacted floor sits above the threshold (many keys x
+        #: buckets) cannot re-sort itself on every ingest batch
+        self.compacted_size = 0
 
     def append(self, keys: np.ndarray, *cols: np.ndarray) -> None:
         self.keys.append(keys)
@@ -99,6 +104,11 @@ class _WindowLog:
         self.keys = [ck]
         self.cols = [ccols]
         self.count = len(ck)
+        self.compacted_size = self.count
+
+    def should_compact(self, threshold: int) -> bool:
+        return (self.count > threshold
+                and self.count >= 2 * self.compacted_size)
 
 
 class _SumTabLog:
@@ -147,6 +157,10 @@ class _SumTabLog:
         if self.log is not None:
             self.log.compact(mode)
 
+    def should_compact(self, threshold: int) -> bool:
+        return self.log is not None \
+            and self.log.should_compact(threshold)
+
 
 # ---------------------------------------------------------------------
 # per-aggregate cell decompositions
@@ -155,6 +169,10 @@ class _SumTabLog:
 class _HllMode:
     name = "hll"
     can_compact = True
+
+    @staticmethod
+    def upgrade_cols(cols):
+        return cols
 
     def new_log(self):
         return _WindowLog()
@@ -250,6 +268,10 @@ class _SumMode:
     name = "sum"
     can_compact = True
 
+    @staticmethod
+    def upgrade_cols(cols):
+        return cols
+
     def __init__(self, agg: SumAggregate, finish_tier: str):
         self.agg = agg
 
@@ -270,11 +292,11 @@ class _SumMode:
 
 class _QuantileMode:
     name = "quantile"
-    #: no count-combining compaction yet — a compact() that returns the
-    #: log unchanged would make every over-threshold ingest batch
-    #: re-concatenate the whole log (quadratic), so compaction is
-    #: disabled; the log is bounded by events-per-window
-    can_compact = False
+    #: count-combining compaction: (key, bucket) duplicates collapse
+    #: into count cells, bounding a window's log at keys x buckets
+    #: cells regardless of event volume (the round-2 gap).  Cells are
+    #: (bucket u16, count u32); raw appends carry count 1.
+    can_compact = True
 
     def new_log(self):
         return _WindowLog()
@@ -283,6 +305,14 @@ class _QuantileMode:
         if agg.buckets > (1 << 16):
             raise ValueError("log engine supports <= 65536 buckets")
         self.agg = agg
+
+    @staticmethod
+    def upgrade_cols(cols):
+        """Pre-count-cell checkpoints logged (bucket,) only — raw
+        cells, weight 1."""
+        if len(cols) == 1:
+            return [cols[0], np.ones(len(cols[0]), np.uint32)]
+        return cols
 
     def make_cols(self, values, value_hashes):
         # numpy twin of QuantileSketchAggregate._bucket_of (f32 math to
@@ -294,14 +324,31 @@ class _QuantileMode:
         b = 1 + np.floor(logs).astype(np.int32) - agg.offset
         b = np.clip(b, 1, agg.buckets - 1)
         b = np.where(v <= agg.min_value, 0, b)
-        return (b.astype(np.uint16),)
+        return (b.astype(np.uint16), np.ones(len(v), np.uint32))
+
+    def compact(self, keys, cols):
+        ck, cb, cc = nat.qsketch_log_compact(keys, cols[0], cols[1],
+                                             self.agg.buckets)
+        return ck, (cb, cc)
 
     def fire(self, keys, cols):
         agg = self.agg
-        mid_corr = 2.0 / (1.0 + 1.0 / agg.gamma)
+        # the kernel computes gamma^(b-0.5) * mid_corr; folding
+        # sqrt(gamma) into the correction yields the canonical
+        # DDSketch estimate 2*gamma^b/(gamma+1) (symmetric +-alpha —
+        # see QuantileSketchAggregate.result)
+        mid_corr = 2.0 * float(np.sqrt(agg.gamma)) / (1.0 + agg.gamma)
+        # never-compacted logs are all count-1 cells: the unweighted
+        # kernel path carries the bucket inside the sorted record
+        # (sequential walk, no per-cell gather) — one vectorized scan
+        # decides, which is noise next to the sort it saves on
+        counts = cols[1]
+        if (counts == 1).all():
+            counts = None
         ks, q = nat.qsketch_log_fire(keys, cols[0], agg.buckets,
                                      agg.quantiles, agg.log_gamma,
-                                     agg.offset, mid_corr)
+                                     agg.offset, mid_corr,
+                                     counts=counts)
         return ks, q
 
 
@@ -417,7 +464,8 @@ class LogStructuredTumblingWindows:
             else:
                 mask = starts == start
                 log.append(keys[mask], *(c[mask] for c in cols))
-            if self.mode.can_compact and log.count > self.compact_threshold:
+            if self.mode.can_compact \
+                    and log.should_compact(self.compact_threshold):
                 log.compact(self.mode)
 
     def flush(self, grow_to: Optional[int] = None) -> None:
@@ -525,7 +573,8 @@ class LogStructuredTumblingWindows:
                 if isinstance(w, SharedChunk):  # un-resolved (local)
                     w = w.payload
                 keys = np.asarray(w["keys"], np.uint64)
-                cols = [np.asarray(c) for c in w["cols"]]
+                cols = self.mode.upgrade_cols(
+                    [np.asarray(c) for c in w["cols"]])
                 if keep_fn is not None:
                     m = keep_fn(keys)
                     if not m.all():

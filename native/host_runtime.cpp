@@ -549,15 +549,77 @@ int64_t ft_sumtab_export(void* p, uint64_t* keys_out, double* sums_out) {
 // scratch.  bucket value = exp((b - 0.5 + offset) * log_gamma) *
 // mid_corr, bucket 0 = 0 (same formula as QuantileSketchAggregate
 // .result).  out_q is [n_keys x n_q] row-major.  Returns n_keys.
-int64_t ft_qsketch_log_fire(const uint64_t* keys, const uint16_t* buckets,
-                            int64_t n, int n_buckets,
-                            const double* quantiles, int n_q,
-                            double log_gamma, int64_t offset,
-                            double mid_corr,
-                            uint64_t* out_keys, double* out_q) {
+// Count-combining compaction for the quantile log: (key, bucket)
+// duplicates collapse into one cell carrying a count, bounding a
+// window's log at keys x buckets cells regardless of event volume
+// (the count-compaction the round-2 notes flagged as missing — the
+// chained-combiner role of AggregateUtil.scala's pre-aggregation for
+// the DDSketch decomposition).  `counts` may be null (raw cells,
+// weight 1).  Returns the compacted cell count; output buffers
+// sized n.
+int64_t ft_qsketch_log_compact(const uint64_t* keys,
+                               const uint16_t* buckets,
+                               const uint32_t* counts, int64_t n,
+                               int n_buckets,
+                               uint64_t* out_keys, uint16_t* out_buckets,
+                               uint32_t* out_counts) {
+  struct KI { uint64_t key; int64_t idx; };
+  std::vector<KI> buf(n), scratch(n);
+  for (int64_t j = 0; j < n; ++j) buf[j] = {keys[j], j};
+  KI* sorted = radix_sort_by_key(buf.data(), scratch.data(), n);
+  std::vector<int64_t> acc(n_buckets, 0);
+  std::vector<uint16_t> touched;
+  touched.reserve(256);
+  int64_t out = 0;
+  int64_t i = 0;
+  while (i < n) {
+    uint64_t k = sorted[i].key;
+    touched.clear();
+    for (; i < n && sorted[i].key == k; ++i) {
+      int64_t idx = sorted[i].idx;
+      uint16_t b = buckets[idx];
+      if (acc[b] == 0) touched.push_back(b);
+      acc[b] += counts ? static_cast<int64_t>(counts[idx]) : 1;
+    }
+    std::sort(touched.begin(), touched.end());
+    for (uint16_t b : touched) {
+      int64_t c = acc[b];
+      acc[b] = 0;
+      // u32 count cells: counts beyond 2^32-1 split across cells
+      // (exact; astronomically rare)
+      while (c > 0) {
+        uint32_t take = static_cast<uint32_t>(
+            c > 0xFFFFFFFFll ? 0xFFFFFFFFll : c);
+        out_keys[out] = k;
+        out_buckets[out] = b;
+        out_counts[out] = take;
+        ++out;
+        c -= take;
+      }
+    }
+  }
+  return out;
+}
+
+// Weighted quantile fire: `cell_counts` may be null (raw cells,
+// weight 1 — the original path).
+int64_t ft_qsketch_log_fire2(const uint64_t* keys, const uint16_t* buckets,
+                             const uint32_t* cell_counts,
+                             int64_t n, int n_buckets,
+                             const double* quantiles, int n_q,
+                             double log_gamma, int64_t offset,
+                             double mid_corr,
+                             uint64_t* out_keys, double* out_q) {
+  // raw cells ride the sort as (key, bucket) records — sequential
+  // reads in the walk; weighted (compacted) cells are few, so the
+  // per-cell index gather there is cheap
   std::vector<HllRec> buf(n), scratch(n);
-  for (int64_t i = 0; i < n; ++i)
-    buf[i] = {keys[i], static_cast<uint32_t>(buckets[i])};
+  for (int64_t j = 0; j < n; ++j) {
+    uint32_t aux = cell_counts
+        ? static_cast<uint32_t>(j)                 // index of the cell
+        : static_cast<uint32_t>(buckets[j]);       // the bucket itself
+    buf[j] = {keys[j], aux};
+  }
   HllRec* sorted = radix_sort_by_key(buf.data(), scratch.data(), n);
   // bucket midpoint values precomputed once (one exp per BUCKET, not
   // one per key x quantile — singleton-heavy fires are exp-bound
@@ -578,10 +640,19 @@ int64_t ft_qsketch_log_fire(const uint64_t* keys, const uint16_t* buckets,
     touched.clear();
     int64_t total = 0;
     for (; i < n && sorted[i].key == k; ++i) {
-      uint16_t b = static_cast<uint16_t>(sorted[i].aux & 0xFFFF);
+      uint16_t b;
+      int64_t w;
+      if (cell_counts) {
+        int64_t idx = static_cast<int64_t>(sorted[i].aux);
+        b = buckets[idx];
+        w = static_cast<int64_t>(cell_counts[idx]);
+      } else {
+        b = static_cast<uint16_t>(sorted[i].aux & 0xFFFF);
+        w = 1;
+      }
       if (counts[b] == 0) touched.push_back(b);
-      ++counts[b];
-      ++total;
+      counts[b] += w;
+      total += w;
     }
     if (touched.size() == 1) {
       // all mass in one bucket: every quantile answers it
@@ -607,6 +678,18 @@ int64_t ft_qsketch_log_fire(const uint64_t* keys, const uint16_t* buckets,
     for (uint16_t b : touched) counts[b] = 0;
   }
   return n_keys;
+}
+
+// Unweighted compatibility entry (the original symbol).
+int64_t ft_qsketch_log_fire(const uint64_t* keys, const uint16_t* buckets,
+                            int64_t n, int n_buckets,
+                            const double* quantiles, int n_q,
+                            double log_gamma, int64_t offset,
+                            double mid_corr,
+                            uint64_t* out_keys, double* out_q) {
+  return ft_qsketch_log_fire2(keys, buckets, nullptr, n, n_buckets,
+                              quantiles, n_q, log_gamma, offset,
+                              mid_corr, out_keys, out_q);
 }
 
 // Session-window fire over an event log (config #4 shape:

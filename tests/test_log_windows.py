@@ -372,3 +372,73 @@ def test_signed_negative_keys_roundtrip():
     eng3.restore(eng2.snapshot())
     eng3.advance_watermark(5000)
     assert {int(k): float(r) for k, r, s, e in eng3.emitted} == got
+
+
+def test_quantile_log_compaction_exact_and_bounded():
+    """Count-cell compaction: quantiles with a tiny compact threshold
+    equal the uncompacted run, and the compacted log is bounded by
+    keys x buckets cells regardless of event volume."""
+    import numpy as np
+
+    from flink_tpu.ops.sketches import QuantileSketchAggregate
+    from flink_tpu.streaming.log_windows import (
+        LogStructuredTumblingWindows,
+    )
+
+    rng = np.random.default_rng(8)
+    n, n_keys = 200_000, 40
+    keys = rng.integers(0, n_keys, n).astype(np.int64)
+    ts = np.sort(rng.integers(0, 1000, n)).astype(np.int64)
+    vals = rng.gamma(2.0, 25.0, n)
+
+    def run(threshold):
+        agg = QuantileSketchAggregate(quantiles=(0.5, 0.9, 0.99))
+        eng = LogStructuredTumblingWindows(agg, 1000,
+                                           compact_threshold=threshold)
+        half = n // 2
+        eng.process_batch(keys[:half], ts[:half], vals[:half])
+        max_cells = max((lg.count for lg in eng.windows.values()),
+                        default=0)
+        eng.process_batch(keys[half:], ts[half:], vals[half:])
+        eng.advance_watermark(10_000)
+        return ({(int(k), int(s)): tuple(np.round(v, 9))
+                 for k, v, s, _ in eng.emitted}, max_cells)
+
+    got, cells_small = run(threshold=10_000)     # compacts repeatedly
+    want, _ = run(threshold=1 << 30)             # never compacts
+    assert got == want and len(got) == n_keys
+    # bounded: after compaction the log holds at most keys x buckets
+    agg = QuantileSketchAggregate(quantiles=(0.5,))
+    assert cells_small <= 2 * n_keys * agg.buckets
+
+
+def test_quantile_snapshot_upgrades_old_single_column_logs():
+    """Pre-count-cell checkpoints logged (bucket,) only; restore
+    upgrades them to count cells and keeps firing exactly."""
+    import numpy as np
+
+    from flink_tpu.ops.sketches import QuantileSketchAggregate
+    from flink_tpu.streaming.log_windows import (
+        LogStructuredTumblingWindows,
+    )
+
+    agg = QuantileSketchAggregate(quantiles=(0.5,))
+    eng = LogStructuredTumblingWindows(agg, 1000)
+    keys = np.arange(50, dtype=np.int64) % 5
+    ts = np.zeros(50, np.int64)
+    vals = np.linspace(1.0, 100.0, 50)
+    eng.process_batch(keys, ts, vals)
+    snap = eng.snapshot()
+    # rewrite the snapshot into the OLD single-column format
+    from flink_tpu.state.shared_registry import SharedChunk
+    for start, chunk in snap["windows"].items():
+        payload = chunk.payload if isinstance(chunk, SharedChunk) \
+            else chunk
+        payload["cols"] = [payload["cols"][0]]  # drop the count column
+    eng2 = LogStructuredTumblingWindows(agg, 1000)
+    eng2.restore(snap)
+    for e in (eng, eng2):
+        e.advance_watermark(10_000)
+    got = {(int(k), int(s)): tuple(v) for k, v, s, _ in eng2.emitted}
+    want = {(int(k), int(s)): tuple(v) for k, v, s, _ in eng.emitted}
+    assert got == want and len(got) == 5
