@@ -2,6 +2,13 @@
 flink-examples-batch ConnectedComponents — the canonical delta
 iteration)."""
 
+import os as _os
+import sys as _sys
+
+_sys.path.insert(0, _os.path.dirname(_os.path.dirname(
+    _os.path.abspath(__file__))))  # run from anywhere
+
+
 from flink_tpu.batch import ExecutionEnvironment
 
 

@@ -3,6 +3,13 @@ synthetic follower graph (the gelly examples role — ref:
 flink-libraries/flink-gelly-examples).  Every superstep is one jitted
 segment-sum over the whole edge list."""
 
+import os as _os
+import sys as _sys
+
+_sys.path.insert(0, _os.path.dirname(_os.path.dirname(
+    _os.path.abspath(__file__))))  # run from anywhere
+
+
 import numpy as np
 
 from flink_tpu.graph import ConnectedComponents, Graph, PageRank, TriangleCount

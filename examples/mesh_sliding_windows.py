@@ -12,6 +12,13 @@ collective); window fires merge the slide-granularity pane regions
 shard-locally and gather only the fired results.
 """
 
+import os as _os
+import sys as _sys
+
+_sys.path.insert(0, _os.path.dirname(_os.path.dirname(
+    _os.path.abspath(__file__))))  # run from anywhere
+
+
 import os
 
 import numpy as np

@@ -2,6 +2,13 @@
 recommendations (the flink-ml examples role).  Fits run as jitted
 device loops — full-batch matmuls on the MXU."""
 
+import os as _os
+import sys as _sys
+
+_sys.path.insert(0, _os.path.dirname(_os.path.dirname(
+    _os.path.abspath(__file__))))  # run from anywhere
+
+
 import numpy as np
 
 from flink_tpu.ml import ALS, StandardScaler, SVM

@@ -13,6 +13,13 @@ and accumulates its count) — the round-2 verdict's "real wordcount
 over strings runs the slow path" gap, closed and measured here.
 """
 
+import os as _os
+import sys as _sys
+
+_sys.path.insert(0, _os.path.dirname(_os.path.dirname(
+    _os.path.abspath(__file__))))  # run from anywhere
+
+
 import argparse
 import time
 

@@ -6,6 +6,13 @@ RecordBatch vectorized path (streaming/columnar.py) — the planner's
 Blink-style physical optimization.  Results arrive as RecordBatches.
 """
 
+import os as _os
+import sys as _sys
+
+_sys.path.insert(0, _os.path.dirname(_os.path.dirname(
+    _os.path.abspath(__file__))))  # run from anywhere
+
+
 import numpy as np
 
 from flink_tpu.streaming.columnar import ColumnarCollectSink

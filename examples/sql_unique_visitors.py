@@ -2,6 +2,13 @@
 the DataStreamGroupWindowAggregate lowering; the HLL UDAF rides the
 TPU device path for single-aggregate queries)."""
 
+import os as _os
+import sys as _sys
+
+_sys.path.insert(0, _os.path.dirname(_os.path.dirname(
+    _os.path.abspath(__file__))))  # run from anywhere
+
+
 import numpy as np
 
 from flink_tpu.streaming.datastream import StreamExecutionEnvironment

@@ -2,6 +2,13 @@
 path (BASELINE.md config #2 shape): keyBy(page) → tumbling window →
 APPROX COUNT DISTINCT(user) on the vectorized device engine."""
 
+import os as _os
+import sys as _sys
+
+_sys.path.insert(0, _os.path.dirname(_os.path.dirname(
+    _os.path.abspath(__file__))))  # run from anywhere
+
+
 import numpy as np
 
 from flink_tpu.ops.sketches import HyperLogLogAggregate
