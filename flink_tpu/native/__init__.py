@@ -78,6 +78,8 @@ def _ensure_loaded() -> Optional[ctypes.CDLL]:
         lib.ft_index_export.restype = c.c_int64
         u16p = np.ctypeslib.ndpointer(np.uint16, flags="C_CONTIGUOUS")
         u8p = np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS")
+        lib.ft_hll_make_cells.argtypes = [
+            u64p, c.c_int64, c.c_int, u16p, u8p]
         lib.ft_hll_log_compact.argtypes = [
             u64p, u16p, u8p, c.c_int64, c.c_int,
             u64p, u16p, u8p, i32p, c.POINTER(c.c_int64)]
@@ -326,6 +328,24 @@ class NativeSumTable:
         sums = np.empty(n, np.float64)
         k = _lib.ft_sumtab_export(self._h, keys, sums)
         return keys[:k], sums[:k]
+
+
+def hll_make_cells(value_hashes: np.ndarray, precision: int):
+    """(register u16, rank u8) cells from u64 value hashes — one C++
+    pass (the ingest twin of HyperLogLogAggregate.compress_value_hash
+    for precision <= 16)."""
+    if precision > 16:
+        # the numpy twin widens registers to uint32 above 16 bits;
+        # this kernel's u16 output would silently alias them
+        raise ValueError("hll_make_cells supports precision <= 16; "
+                         "use compress_value_hash for wider registers")
+    lib = _ensure_loaded()
+    vh = np.ascontiguousarray(value_hashes, np.uint64)
+    n = len(vh)
+    regs = np.empty(n, np.uint16)
+    ranks = np.empty(n, np.uint8)
+    lib.ft_hll_make_cells(vh, n, precision, regs, ranks)
+    return regs, ranks
 
 
 def qsketch_log_fire(keys: np.ndarray, buckets: np.ndarray,
