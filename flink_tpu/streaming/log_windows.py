@@ -62,6 +62,14 @@ from flink_tpu.ops.sketches import (
 )
 
 
+def _is_single_window(starts: np.ndarray) -> bool:
+    """One vectorized pass deciding the common replayed-log shape
+    (every record in one window) without np.unique's sort — shared by
+    the generic and string tumbling engines."""
+    return bool(len(starts)) and starts[0] == starts[-1] \
+        and bool((starts == starts[0]).all())
+
+
 class _WindowLog:
     """Columnar append log for one window (or pane).  ``version``
     counts mutations — an unchanged version means the snapshot chunk
@@ -459,7 +467,9 @@ class LogStructuredTumblingWindows:
                 value_hashes = np.asarray(value_hashes)[live]
 
         cols = self.mode.make_cols(values, value_hashes)
-        uniq_starts = np.unique(starts)
+        # skip np.unique's sort for the common single-window batch
+        uniq_starts = (starts[:1] if _is_single_window(starts)
+                       else np.unique(starts))
         for start in uniq_starts:
             log = self.windows.get(int(start))
             if log is None:
@@ -635,10 +645,10 @@ class StringSumTumblingWindows:
         starts = ts - np.mod(ts, self.size)
         # single-window batch (the replayed-log shape): skip the
         # unique sort and the masks — they cost more than the fused
-        # kernel saves.  One vectorized equality pass decides.
-        if len(starts) and starts[0] == starts[-1] \
+        # kernel saves
+        if _is_single_window(starts) \
                 and int(starts[0]) + self.lateness_horizon - 1 \
-                > self.watermark and (starts == starts[0]).all():
+                > self.watermark:
             self._ingest(int(starts[0]), keys, values)
             return
         live = starts + self.lateness_horizon - 1 > self.watermark

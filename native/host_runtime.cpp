@@ -420,6 +420,23 @@ int64_t ft_hll_log_fire(const uint64_t* keys, const uint16_t* regs,
   return n_keys;
 }
 
+// HLL cell precompute: (register, rank) from 64-bit value hashes in
+// one pass (rank = clz of the high 32 bits + 1; register = low bits
+// masked) — the numpy twin (compress_value_hash) pays ~8 array
+// passes incl. a float log2 for the same result.
+void ft_hll_make_cells(const uint64_t* vh, int64_t n, int precision,
+                       uint16_t* regs, uint8_t* ranks) {
+  const uint32_t mask = (1u << precision) - 1u;
+  for (int64_t i = 0; i < n; ++i) {
+    uint64_t h = vh[i];
+    uint32_t hi = static_cast<uint32_t>(h >> 32);
+    ranks[i] = static_cast<uint8_t>(
+        (hi == 0 ? 32 : __builtin_clz(hi)) + 1);
+    regs[i] = static_cast<uint16_t>(static_cast<uint32_t>(h) & mask);
+  }
+}
+
+
 // Sum-log fire (word-count / rolling-sum shape): per distinct key, the
 // sum of its logged values.  Returns n_keys; outputs key-sorted.
 int64_t ft_sum_log_fire(const uint64_t* keys, const double* values,
@@ -549,22 +566,6 @@ int64_t ft_sumtab_export(void* p, uint64_t* keys_out, double* sums_out) {
 // scratch.  bucket value = exp((b - 0.5 + offset) * log_gamma) *
 // mid_corr, bucket 0 = 0 (same formula as QuantileSketchAggregate
 // .result).  out_q is [n_keys x n_q] row-major.  Returns n_keys.
-// HLL cell precompute: (register, rank) from 64-bit value hashes in
-// one pass (rank = clz of the high 32 bits + 1; register = low bits
-// masked) — the numpy twin (compress_value_hash) pays ~8 array
-// passes incl. a float log2 for the same result.
-void ft_hll_make_cells(const uint64_t* vh, int64_t n, int precision,
-                       uint16_t* regs, uint8_t* ranks) {
-  const uint32_t mask = (1u << precision) - 1u;
-  for (int64_t i = 0; i < n; ++i) {
-    uint64_t h = vh[i];
-    uint32_t hi = static_cast<uint32_t>(h >> 32);
-    ranks[i] = static_cast<uint8_t>(
-        (hi == 0 ? 32 : __builtin_clz(hi)) + 1);
-    regs[i] = static_cast<uint16_t>(static_cast<uint32_t>(h) & mask);
-  }
-}
-
 // Count-combining compaction for the quantile log: (key, bucket)
 // duplicates collapse into one cell carrying a count, bounding a
 // window's log at keys x buckets cells regardless of event volume
