@@ -18,6 +18,10 @@ from flink_tpu.connectors.jdbc import (
     JdbcOutputFormat,
     JdbcSink,
 )
+from flink_tpu.connectors.sharded_stream import (
+    FileShardedStream,
+    ShardedStreamSource,
+)
 
 __all__ = [
     "FilePartitionedLog",
@@ -29,8 +33,6 @@ __all__ = [
     "JdbcInputFormat",
     "JdbcOutputFormat",
     "JdbcSink",
+    "FileShardedStream",
+    "ShardedStreamSource",
 ]
-from flink_tpu.connectors.sharded_stream import (
-    FileShardedStream,
-    ShardedStreamSource,
-)

@@ -331,6 +331,7 @@ class _MeshShardedLogEngine:
                             np.zeros(0, np.int32)))
         return {"mesh_log": True,
                 "n_shards": self.n_shards,
+                "max_parallelism": self.max_parallelism,
                 "keys_signed": self._keys_signed,
                 "pending_lanes": lanes.copy(),
                 "pending_tgt": tgt.copy(),
@@ -342,6 +343,16 @@ class _MeshShardedLogEngine:
                 f"mesh log checkpoint was taken at {snap['n_shards']} "
                 f"shards; this mesh has {self.n_shards} (re-shard the "
                 "mesh or restore on a matching one)")
+        # key→shard routing is hash % max_parallelism-derived: a
+        # mismatch would silently split each key's state across shards
+        snap_mp = snap.get("max_parallelism", 128)  # pre-r5 snapshots
+        # were necessarily taken at the old hard-wired default of 128
+        if snap_mp != self.max_parallelism:
+            raise ValueError(
+                f"mesh log checkpoint was taken at max_parallelism="
+                f"{snap_mp}; this operator is configured "
+                f"{self.max_parallelism} — keys would route to "
+                "different shards than the ones holding their state")
         self._keys_signed = snap["keys_signed"]
         self._p_lanes = ([snap["pending_lanes"]]
                          if len(snap["pending_lanes"]) else [])

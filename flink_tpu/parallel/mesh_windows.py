@@ -245,6 +245,7 @@ class MeshTumblingWindows:
         self.mesh = mesh
         self.axis = axis
         self.n_shards = mesh.shape[axis]
+        self.max_parallelism = max_parallelism
         self.ring = ring
         #: ring slots handed to windows; subclasses may reserve a
         #: suffix of the ring for scratch regions
@@ -472,6 +473,7 @@ class MeshTumblingWindows:
         return {
             "table": jax.tree_util.tree_map(np.asarray, self.table),
             "state": {k: np.asarray(v) for k, v in self.state.items()},
+            "max_parallelism": self.max_parallelism,
             "watermark": self.watermark,
             "num_late_dropped": self.num_late_dropped,
             "ring_window": list(self.ring_window),
@@ -488,6 +490,15 @@ class MeshTumblingWindows:
         }
 
     def restore(self, snap: dict) -> None:
+        # key→shard routing derives from max_parallelism: a mismatch
+        # would silently route keys away from their restored state
+        snap_mp = snap.get("max_parallelism", 128)  # pre-r5 snapshots
+        # were necessarily taken at the old hard-wired default of 128
+        if snap_mp != self.max_parallelism:
+            raise ValueError(
+                f"mesh window checkpoint was taken at max_parallelism="
+                f"{snap_mp}; this operator is configured "
+                f"{self.max_parallelism}")
         self.table = DeviceHashTable(*[jnp.asarray(a) for a in snap["table"]])
         self.state = {k: jnp.asarray(v) for k, v in snap["state"].items()}
         self.watermark = snap["watermark"]

@@ -324,15 +324,21 @@ class Dispatcher(RpcEndpoint):
         return n
 
     def _archive_job(self, job_id: str) -> None:
-        master = self._masters.pop(job_id, None)
+        master = self._masters.get(job_id)
         if master is None:
             return
+        # publish the archived views BEFORE dropping the live master:
+        # dispatcher RPCs serialize on the mailbox thread so nothing
+        # interleaves today, but this ordering keeps "the job is always
+        # visible somewhere" true by construction rather than by the
+        # threading model (list_jobs dedupes the overlap window)
         snapshot = master.status_snapshot()
         self._archived[job_id] = snapshot
         if master._savepoints:
             self._archived_savepoints[job_id] = {
                 req_id: master.savepoint_status(req_id)
                 for req_id in master._savepoints}
+        self._masters.pop(job_id, None)
         self._rpc.stop_server(master)
         self._blob.delete_blob(master.blob_key)
         if self._ha_store is not None:
@@ -370,10 +376,12 @@ class Dispatcher(RpcEndpoint):
     def list_jobs(self) -> List[dict]:
         live = [{"job_id": jid, **m.status_snapshot(light=True)}
                 for jid, m in self._masters.items()]
+        live_ids = {j["job_id"] for j in live}
         done = [{"job_id": jid,
                  **{k: v for k, v in snap.items()
                     if k not in ("result", "error_blob")}}
-                for jid, snap in self._archived.items()]
+                for jid, snap in self._archived.items()
+                if jid not in live_ids]
         return live + done
 
     # ---- savepoints (ref: ClusterClient.triggerSavepoint /

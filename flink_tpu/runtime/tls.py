@@ -23,6 +23,7 @@ from __future__ import annotations
 import os
 import ssl
 import subprocess
+import uuid
 from typing import Optional
 
 
@@ -75,13 +76,28 @@ class TlsConfig:
         if os.path.exists(cert) and os.path.exists(key):
             return TlsConfig(cert, key)
         lock = os.path.join(directory, ".tls.lock")
+        # ownership token: the directory may be shared storage mounted
+        # by many nodes (and containerised nodes are all PID 1), so a
+        # bare PID neither names this generator uniquely nor keeps its
+        # tmp paths distinct — a random token does both
+        owner = uuid.uuid4().hex
 
         def try_lock() -> bool:
             try:
-                os.close(os.open(lock,
-                                 os.O_CREAT | os.O_EXCL | os.O_WRONLY))
+                fd = os.open(lock, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+                # stamp ownership so a stalled generator resuming after
+                # its lock was stolen never unlinks the stealer's lock
+                os.write(fd, owner.encode())
+                os.close(fd)
                 return True
             except FileExistsError:
+                return False
+
+        def i_own_lock() -> bool:
+            try:
+                with open(lock, "rb") as f:
+                    return f.read().strip() == owner.encode()
+            except OSError:
                 return False
 
         i_create = try_lock()
@@ -118,7 +134,12 @@ class TlsConfig:
                     f"another process holds {lock!r} but the TLS "
                     "material never appeared")
         try:
-            kt, ct = key + ".tmp", cert + ".tmp"
+            # owner-unique tmp names: a stale-lock loser exiting late
+            # must only ever clean up its OWN in-flight files, never
+            # the stealer's (shared names would let A's finally unlink
+            # B's half-written pair mid-generation)
+            kt = f"{key}.{owner}.tmp"
+            ct = f"{cert}.{owner}.tmp"
             # the key file is 0600 from birth (no chmod window)
             os.close(os.open(kt, os.O_CREAT | os.O_WRONLY, 0o600))
             try:
@@ -130,13 +151,36 @@ class TlsConfig:
                      "-nodes", "-subj", f"/CN={common_name}"],
                     check=True, capture_output=True)
             os.chmod(kt, 0o600)  # tools may have replaced the inode
+            if not i_own_lock():
+                # we stalled so long the lock was stolen: a stealer is
+                # (or was) generating its own pair.  Renaming ours now
+                # could interleave with its renames into a mismatched
+                # key/cert pair — discard ours and take the stealer's.
+                deadline = time.monotonic() + 30.0
+                while time.monotonic() < deadline:
+                    if os.path.exists(cert) and os.path.exists(key):
+                        return TlsConfig(cert, key)
+                    time.sleep(0.05)
+                raise TimeoutError(
+                    f"lock on {lock!r} was stolen mid-generation and "
+                    "the stealer's TLS material never appeared")
             os.rename(kt, key)
             os.rename(ct, cert)
         finally:
-            try:
-                os.unlink(lock)
-            except OSError:
-                pass
+            # a failed generator must leave a clean directory (no stray
+            # .tmp files) so the next contender can start fresh; the
+            # lock is released only by its owner (ours may have been
+            # stolen and replaced while we stalled)
+            for p in (kt, ct):
+                try:
+                    os.unlink(p)
+                except OSError:
+                    pass
+            if i_own_lock():
+                try:
+                    os.unlink(lock)
+                except OSError:
+                    pass
         return TlsConfig(cert, key)
 
     @staticmethod

@@ -228,7 +228,8 @@ class ColumnarWindowOperator(StreamOperator):
                 mesh_log_engine_for_assigner,
             )
             eng = mesh_log_engine_for_assigner(
-                self.assigner, self.agg, self.mesh, axis=self.mesh_axis)
+                self.assigner, self.agg, self.mesh, axis=self.mesh_axis,
+                max_parallelism=self.max_parallelism)
             if eng is not None:
                 return eng
         if key_dtype.kind in "US":
@@ -392,7 +393,8 @@ class ColumnarWindowOperator(StreamOperator):
                     "restoring requires a mesh (env.set_mesh)")
             eng = mesh_log_engine_for_assigner(
                 self.assigner, self.agg, self.mesh,
-                axis=self.mesh_axis)
+                axis=self.mesh_axis,
+                max_parallelism=self.max_parallelism)
             if eng is None:
                 raise RuntimeError(
                     "checkpoint was taken on the mesh log tier, which "

@@ -241,11 +241,13 @@ class DeviceWindowOperator(StreamOperator):
                 )
                 self.engine = mesh_log_engine_for_assigner(
                     self.assigner, self.agg, self.mesh,
-                    axis=self.mesh_axis)
+                    axis=self.mesh_axis,
+                    max_parallelism=self.max_parallelism)
             if self.engine is None:
                 self.engine = engine_for_assigner(
                     self.assigner, self.agg, self.initial_capacity,
-                    mesh=self.mesh, mesh_axis=self.mesh_axis)
+                    mesh=self.mesh, mesh_axis=self.mesh_axis,
+                    max_parallelism=self.max_parallelism)
             if self.engine is None:
                 raise ValueError(
                     f"no device engine for assigner {self.assigner!r}")
@@ -484,7 +486,8 @@ class DeviceWindowOperator(StreamOperator):
                                 "(env.set_mesh)")
                         self.engine = mesh_log_engine_for_assigner(
                             self.assigner, self.agg, self.mesh,
-                            axis=self.mesh_axis)
+                            axis=self.mesh_axis,
+                            max_parallelism=self.max_parallelism)
                         if self.engine is None:
                             raise RuntimeError(
                                 "checkpoint was taken on the mesh log "
@@ -493,5 +496,6 @@ class DeviceWindowOperator(StreamOperator):
                     else:
                         self.engine = engine_for_assigner(
                             self.assigner, self.agg, self.initial_capacity,
-                            mesh=self.mesh, mesh_axis=self.mesh_axis)
+                            mesh=self.mesh, mesh_axis=self.mesh_axis,
+                            max_parallelism=self.max_parallelism)
                 self.engine.restore(s["device_engine"])

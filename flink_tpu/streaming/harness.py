@@ -54,6 +54,7 @@ class OneInputStreamOperatorTestHarness:
             operator_state_backend=OperatorStateBackend(),
             processing_time_service=self.processing_time_service,
             key_selector=key_selector,
+            max_parallelism=max_parallelism,
         )
         self._open = False
 

@@ -136,6 +136,9 @@ class StreamOperator(abc.ABC):
         self.key_selector: Optional[KeySelector] = None
         self.operator_id: str = ""
         self.metrics = None  # OperatorMetricGroup, set by task layer
+        self.subtask_index: int = 0
+        self.num_subtasks: int = 1
+        self.max_parallelism: int = 128
 
     # ---- wiring -----------------------------------------------------
     def setup(self, output: Output,
