@@ -363,6 +363,112 @@ def bench_session_cm(n_events=1 << 21, n_keys=100_000):
 
 
 # ---------------------------------------------------------------------
+# generic_agg — ARBITRARY Python AggregateFunction on the generic
+# vectorized log tier (streaming/generic_agg.py): a custom streaming
+# log-sum-exp (log-probability accumulation; float32 (max, scaled-sum)
+# accumulator, two exps per record) over tumbling 1s windows, 1M keys.
+# The baseline does the identical per-record work compiled: probe +
+# stable (m, s) update with two expf calls
+# (ref: WindowOperator.java:291-421 per-record contract).
+# ---------------------------------------------------------------------
+
+from flink_tpu.core.functions import AggregateFunction
+
+
+class _StreamingLogSumExp(AggregateFunction):
+    """The bench's custom aggregate — deliberately a plain Python
+    AggregateFunction no engine tier knows about (the generic tier's
+    lift probe discovers its array semantics at runtime)."""
+
+    def create_accumulator(self):
+        return (np.float32(-np.inf), np.float32(0.0))
+
+    def add(self, x, acc):
+        m, s = acc
+        m2 = np.maximum(m, x)
+        return (m2, s * np.exp(m - m2) + np.exp(x - m2))
+
+    def get_result(self, acc):
+        m, s = acc
+        return m + np.log(s)
+
+    def merge(self, a, b):
+        m = np.maximum(a[0], b[0])
+        return (m, a[1] * np.exp(a[0] - m) + b[1] * np.exp(b[0] - m))
+
+
+class _MeanMaxAgg(AggregateFunction):
+    """Adversarial MINIMAL custom aggregate (3-double tuple, no math)
+    for the generic_agg_minimal diagnostic — see BENCH_NOTES.md
+    "Round 5" for why this shape cannot beat a compiled probe loop on
+    a 1-core host."""
+
+    def create_accumulator(self):
+        return (0.0, 0.0, -np.inf)
+
+    def add(self, v, acc):
+        s, c, m = acc
+        return (s + v, c + 1.0, np.maximum(m, v))
+
+    def get_result(self, acc):
+        s, c, m = acc
+        return (s / c, m)
+
+    def merge(self, a, b):
+        return (a[0] + b[0], a[1] + b[1], np.maximum(a[2], b[2]))
+
+
+def bench_generic_agg_minimal(n_events=1 << 23, n_keys=1_000_000):
+    """Diagnostic (NOT in the default suite — run `python bench.py
+    generic_agg_minimal`): the worst case for the generic tier, a
+    trivial (sum, count, max) accumulator where the compiled baseline
+    is latency-optimal.  Reproduces the ~0.5x figure documented in
+    BENCH_NOTES.md "Round 5"."""
+    from flink_tpu.streaming.generic_agg import GenericLogTumblingWindows
+
+    rng = np.random.default_rng(17)
+    keys = rng.integers(0, n_keys, n_events).astype(np.uint64)
+    ts = np.sort(rng.integers(0, 1000, n_events).astype(np.int64))
+    vals = rng.random(n_events)
+    kh = nat.splitmix64(keys)
+    base_n = 1 << 22
+    base_rate = best_of(lambda: nat.heap_tumbling_meanmax_baseline(
+        kh[:base_n], vals[:base_n], capacity=2 * n_keys))
+    eng = GenericLogTumblingWindows(_MeanMaxAgg(), 1000,
+                                    compact_threshold=n_events)
+    eng.emit_arrays = True
+    rate = run_engine(eng, keys, ts, vals, None, horizon=999, reps=4)
+    assert eng.mode == "lifted", eng.mode
+    return rate, base_rate
+
+
+def bench_generic_agg(n_events=1 << 23, n_keys=1_000_000):
+    """Generic vectorized tier vs compiled per-record baseline on a
+    custom Python aggregate (VERDICT r4 item 1)."""
+    from flink_tpu.streaming.generic_agg import GenericLogTumblingWindows
+
+    rng = np.random.default_rng(17)
+    keys = rng.integers(0, n_keys, n_events).astype(np.uint64)
+    ts = np.sort(rng.integers(0, 1000, n_events).astype(np.int64))
+    scores = (rng.random(n_events) * 4).astype(np.float32)
+    kh = nat.splitmix64(keys)
+    base_n = 1 << 22
+    base_rate = best_of(lambda: nat.heap_tumbling_lse_baseline(
+        kh[:base_n], scores[:base_n], capacity=2 * n_keys))
+
+    # whole-window fold config: the 1s window folds once at fire (the
+    # compaction threshold is the documented memory/throughput knob)
+    eng = GenericLogTumblingWindows(_StreamingLogSumExp(), 1000,
+                                    compact_threshold=n_events)
+    eng.emit_arrays = True
+    rate = run_engine(eng, keys, ts, scores, None, horizon=999, reps=4)
+    assert eng.mode == "lifted", eng.mode
+    fired = sum(len(k) for k, *_ in eng.fired)
+    assert fired > 0.9 * min(n_keys, n_events), fired
+    return rate, base_rate
+
+
+# ---------------------------------------------------------------------
 # Config #5 — SQL: APPROX_COUNT_DISTINCT GROUP BY TUMBLE through the
 # full framework path (parser → planner → DeviceWindowOperator →
 # streaming executor); measures the per-record framework overhead on
@@ -486,13 +592,19 @@ def main():
         ("hll_device", bench_hll_device),
         ("sliding_quantile", bench_sliding_quantile),
         ("session_cm", bench_session_cm),
+        ("generic_agg", bench_generic_agg),
         ("sql", bench_sql),
         ("sql_join", bench_sql_join),
     ]
+    # diagnostics: runnable by name, excluded from the default suite
+    # (they document measured LIMITS, not headline configs)
+    extras = [("generic_agg_minimal", bench_generic_agg_minimal)]
     only = sys.argv[1] if len(sys.argv) > 1 else None
-    if only is not None and only not in {n for n, _ in suite}:
+    if only is not None and only in {n for n, _ in extras}:
+        suite = extras
+    elif only is not None and only not in {n for n, _ in suite}:
         log(f"[bench] unknown config {only!r}; "
-            f"choose from {[n for n, _ in suite]}")
+            f"choose from {[n for n, _ in suite + extras]}")
         sys.exit(2)
     for name, fn in suite:
         if only and name != only:

@@ -53,6 +53,21 @@ def _ensure_loaded() -> Optional[ctypes.CDLL]:
         lib.ft_heap_tumbling_baseline.argtypes = [
             u64p, u64p, f64p, c.c_int64, c.c_int, c.c_int, c.c_int64]
         lib.ft_heap_tumbling_baseline.restype = c.c_double
+        lib.ft_heap_tumbling_meanmax_baseline.argtypes = [
+            u64p, f64p, c.c_int64, c.c_int64]
+        lib.ft_heap_tumbling_meanmax_baseline.restype = c.c_double
+        lib.ft_heap_tumbling_lse_baseline.argtypes = [
+            u64p, f32p, c.c_int64, c.c_int64]
+        lib.ft_heap_tumbling_lse_baseline.restype = c.c_double
+        lib.ft_argsort_u64.argtypes = [u64p, c.c_int64, i64p]
+        lib.ft_fold_prep.argtypes = [u64p, c.c_int64, i64p, i64p, i64p,
+                                     u64p]
+        lib.ft_fold_prep.restype = c.c_int64
+        lib.ft_group_cols.argtypes = [
+            u64p, c.c_int64, c.c_int64, i64p,
+            c.POINTER(c.c_void_p), c.POINTER(c.c_void_p), c.c_void_p,
+            i64p, i64p, u64p]
+        lib.ft_group_cols.restype = c.c_int64
         lib.ft_heap_windowed_hll_baseline.argtypes = [
             u64p, u64p, i64p, c.c_int64, c.c_int64, c.c_int, c.c_int64]
         lib.ft_heap_windowed_hll_baseline.restype = c.c_double
@@ -469,6 +484,99 @@ def heap_tumbling_baseline(kh: np.ndarray, vh: Optional[np.ndarray],
     elapsed = lib.ft_heap_tumbling_baseline(
         kh, vh, values, n, 1 if kind == "hll" else 0, precision, cap)
     return n / elapsed
+
+
+def heap_tumbling_meanmax_baseline(kh: np.ndarray, values: np.ndarray,
+                                   capacity: Optional[int] = None) -> float:
+    """Per-record heap-backend work for a 3-field tuple accumulator
+    (sum, count, max) — the generic-aggregate baseline.  Returns
+    records/second."""
+    lib = _ensure_loaded()
+    n = len(kh)
+    cap = _pow2_at_least(capacity or 2 * n)
+    elapsed = lib.ft_heap_tumbling_meanmax_baseline(
+        np.ascontiguousarray(kh, np.uint64),
+        np.ascontiguousarray(values, np.float64), n, cap)
+    return n / elapsed
+
+
+def fold_prep(keys: np.ndarray):
+    """Fused fire-path grouping for the generic-aggregate tier: stable
+    radix argsort + segment detection + length-descending segment
+    layout in one C++ pass.  Returns (order, seg_starts, seg_lens,
+    ukeys) with segments in length-descending order."""
+    lib = _ensure_loaded()
+    keys = np.ascontiguousarray(keys, np.uint64)
+    n = len(keys)
+    order = np.empty(n, np.int64)
+    seg_starts = np.empty(n, np.int64)
+    seg_lens = np.empty(n, np.int64)
+    ukeys = np.empty(n, np.uint64)
+    n_seg = lib.ft_fold_prep(keys, n, order, seg_starts, seg_lens,
+                             ukeys)
+    return (order, seg_starts[:n_seg], seg_lens[:n_seg],
+            ukeys[:n_seg])
+
+
+def group_cols(keys: np.ndarray, cols=(), want_order: bool = True):
+    """Small-domain (keys < 2^22) grouping with payload columns
+    co-scattered in the same counting-sort pass: returns (order,
+    scols, seg_starts, seg_lens, ukeys) with segments in
+    length-descending order, or None when the key domain exceeds the
+    histogram or a column isn't a 4/8-byte numeric.  order is None
+    when not requested (the lifted fold doesn't need it once the
+    columns are co-scattered)."""
+    lib = _ensure_loaded()
+    keys = np.ascontiguousarray(keys, np.uint64)
+    n = len(keys)
+    for col in cols:
+        if col.dtype.itemsize not in (4, 8) or col.dtype.kind not in "fiu":
+            return None
+    cols = [np.ascontiguousarray(col) for col in cols]
+    scols = [np.empty(n, col.dtype) for col in cols]
+    nc = len(cols)
+    elem = np.asarray([col.dtype.itemsize for col in cols], np.int64) \
+        if nc else np.zeros(1, np.int64)
+    src = (ctypes.c_void_p * max(nc, 1))(
+        *[col.ctypes.data for col in cols] or [None])
+    dst = (ctypes.c_void_p * max(nc, 1))(
+        *[s.ctypes.data for s in scols] or [None])
+    order = np.empty(n, np.int64) if want_order else None
+    seg_starts = np.empty(n, np.int64)
+    seg_lens = np.empty(n, np.int64)
+    ukeys = np.empty(n, np.uint64)
+    n_seg = lib.ft_group_cols(
+        keys, n, nc, elem, src, dst,
+        order.ctypes.data if want_order else None,
+        seg_starts, seg_lens, ukeys)
+    if n_seg < 0:
+        return None
+    return (order, scols, seg_starts[:n_seg], seg_lens[:n_seg],
+            ukeys[:n_seg])
+
+
+def heap_tumbling_lse_baseline(kh: np.ndarray, values: np.ndarray,
+                               capacity=None) -> float:
+    """Per-record heap-backend work for the streaming log-sum-exp
+    aggregate (probe + stable (max, scaled-sum) update, two expf per
+    record).  Returns records/second."""
+    lib = _ensure_loaded()
+    n = len(kh)
+    cap = _pow2_at_least(capacity or 2 * n)
+    elapsed = lib.ft_heap_tumbling_lse_baseline(
+        np.ascontiguousarray(kh, np.uint64),
+        np.ascontiguousarray(values, np.float32), n, cap)
+    return n / elapsed
+
+
+def argsort_u64(keys: np.ndarray) -> np.ndarray:
+    """Stable argsort of a u64 column via the C++ adaptive radix sort
+    (~5x numpy's stable comparison argsort at 8M 64-bit keys)."""
+    lib = _ensure_loaded()
+    keys = np.ascontiguousarray(keys, np.uint64)
+    out = np.empty(len(keys), np.int64)
+    lib.ft_argsort_u64(keys, len(keys), out)
+    return out
 
 
 def heap_windowed_hll_baseline(kh: np.ndarray, vh: np.ndarray,

@@ -813,6 +813,28 @@ class WindowedStream:
                     name, factory, parallelism=1,
                     key_selector=self._keyed.key_selector, chaining="head")
             return self._keyed._add_keyed_op(name, factory, chaining="head")
+        # arbitrary Python aggregates with the same eligible window
+        # shapes ride the generic vectorized log tier (sort + diagonal
+        # -round fold of the user's add over numpy columns) instead of
+        # the per-record scalar WindowOperator
+        from flink_tpu.streaming.generic_agg import (
+            GenericWindowOperator,
+            is_generic_eligible,
+        )
+        if (self._device_enabled
+                and self._keyed.env.time_characteristic == "event"
+                and is_generic_eligible(
+                    self._assigner, aggregate_function, self._trigger,
+                    self._evictor, self._allowed_lateness,
+                    self._late_tag, window_function)):
+            assigner = self._assigner
+
+            def gfactory():
+                return GenericWindowOperator(assigner,
+                                             aggregate_function,
+                                             window_function)
+            return self._keyed._add_keyed_op(name, gfactory,
+                                             chaining="head")
         return self._build(
             name,
             AggregatingStateDescriptor("window-contents", aggregate_function),
