@@ -1,6 +1,7 @@
 """Table API + SQL on the streaming runtime (ref:
 flink-libraries/flink-table — TableEnvironment.scala, the
-DataStreamGroupWindowAggregate lowering; SURVEY.md §2.5)."""
+DataStreamGroupWindowAggregate lowering; SURVEY.md §2.5), plus the
+batch twin (SQL planned onto DataSet, the DataSetRel role)."""
 
 from flink_tpu.table.api import (
     Session,
@@ -9,12 +10,17 @@ from flink_tpu.table.api import (
     Table,
     Tumble,
 )
+from flink_tpu.table.batch import BatchTable, BatchTableEnvironment
 from flink_tpu.table.expressions import col, lit
+from flink_tpu.table.functions import TableFunction
 from flink_tpu.table.sql_parser import SqlError
 
 __all__ = [
     "StreamTableEnvironment",
     "Table",
+    "BatchTable",
+    "BatchTableEnvironment",
+    "TableFunction",
     "Tumble",
     "Slide",
     "Session",

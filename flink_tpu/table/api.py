@@ -42,7 +42,16 @@ from flink_tpu.table.functions import (
     UDAF_DEVICE,
     make_builtin_agg,
 )
-from flink_tpu.table.sql_parser import Query, SqlError, WindowSpec, parse
+from flink_tpu.table.sql_parser import (
+    InsertStatement,
+    LateralCall,
+    Query,
+    SqlError,
+    UnionQuery,
+    WindowSpec,
+    parse,
+    parse_statement,
+)
 
 
 class Table:
@@ -108,6 +117,14 @@ class Table:
             name="select")
         t = Table(self.t_env, out, Schema(names))
         t._updating = getattr(self, "_updating", False)
+        # the time attribute survives a projection that keeps its
+        # column (possibly renamed) — same rule as the columnar branch
+        rt = getattr(self, "rowtime", None)
+        if rt is not None:
+            t.rowtime = next(
+                (n for n, e in zip(names, inner)
+                 if isinstance(e, Column)
+                 and e.name in (rt, rt.split(".")[-1])), None)
         return t
 
     def filter(self, predicate) -> "Table":
@@ -123,8 +140,12 @@ class Table:
     where = filter
 
     def union_all(self, other: "Table") -> "Table":
-        if other.schema.fields != self.schema.fields:
-            raise SqlError("UNION ALL requires identical schemas")
+        # positional schema match, names from the left input (the
+        # reference unions by field position/type, Table.unionAll)
+        if len(other.schema.fields) != len(self.schema.fields):
+            raise SqlError(
+                f"UNION ALL requires same arity: "
+                f"{self.schema.fields} vs {other.schema.fields}")
         return Table(self.t_env,
                      self._as_rows().stream.union(
                          other._as_rows().stream),
@@ -264,6 +285,11 @@ class StreamTableEnvironment:
         self.env = env
         self.tables: Dict[str, Table] = {}
         self.udafs: Dict[str, Callable[[], Any]] = {}
+        #: name -> sink function (INSERT INTO targets; ref
+        #: TableEnvironment.registerTableSink)
+        self.sinks: Dict[str, Any] = {}
+        #: name -> TableFunction factory (UDTFs, LATERAL TABLE)
+        self.udtfs: Dict[str, Callable[[], Any]] = {}
 
     @staticmethod
     def create(env) -> "StreamTableEnvironment":
@@ -302,6 +328,19 @@ class StreamTableEnvironment:
     def register_table(self, name: str, table: Table) -> None:
         self.tables[name] = table
 
+    def register_table_sink(self, name: str, sink) -> None:
+        """Register a sink function as an INSERT INTO target
+        (ref: TableEnvironment.registerTableSink,
+        TableEnvironment.scala:578)."""
+        self.sinks[name] = sink
+
+    def register_table_function(self, name: str,
+                                factory: Callable[[], Any]) -> None:
+        """Register a UDTF: `factory()` returns a fresh TableFunction
+        consumed via `, LATERAL TABLE(name(...)) AS t(col, ...)`
+        (ref: TableEnvironment.registerFunction for TableFunction)."""
+        self.udtfs[name.upper()] = factory
+
     def register_function(self, name: str, factory: Callable[[], Any]
                           ) -> None:
         """Register a UDAF: `factory()` returns a fresh
@@ -315,12 +354,57 @@ class StreamTableEnvironment:
     # ---- SQL ---------------------------------------------------------
     def sql_query(self, sql: str) -> Table:
         q = parse(sql, udaf_names=self.udafs.keys())
-        if q.table not in self.tables:
-            raise SqlError(f"unknown table {q.table!r}")
-        if q.join is not None:
-            t = _lower_join(self, q)
+        return self._lower_node(q)
+
+    def execute_sql(self, sql: str):
+        """Execute a SQL statement: SELECT returns the result Table;
+        INSERT INTO plans the query and wires it to the registered
+        sink (ref: TableEnvironment.sqlUpdate,
+        TableEnvironment.scala:614)."""
+        stmt = parse_statement(sql, udaf_names=self.udafs.keys())
+        if isinstance(stmt, InsertStatement):
+            sink = self.sinks.get(stmt.target)
+            if sink is None:
+                raise SqlError(
+                    f"unknown sink table {stmt.target!r} "
+                    "(register_table_sink first)")
+            self._lower_node(stmt.query).execute_insert(sink)
+            return None
+        return self._lower_node(stmt)
+
+    # the reference's sqlUpdate name, kept as an alias
+    sql_update = execute_sql
+
+    def _lower_node(self, q) -> Table:
+        if isinstance(q, UnionQuery):
+            t = self._lower_query(q.queries[0])
+            for sub in q.queries[1:]:
+                t = t.union_all(self._lower_query(sub))
+            return _lower_order_limit(t, q.order_by, q.limit)
+        return self._lower_query(q)
+
+    def _lower_query(self, q: Query) -> Table:
+        t = self._resolve_from(q)
+        out = self._lower_select_clauses(q, t)
+        return _lower_order_limit(out, q.order_by, q.limit)
+
+    def _resolve_from(self, q: Query) -> Table:
+        if isinstance(q.table, (Query, UnionQuery)):
+            t = self._lower_node(q.table)
         else:
-            t = self.tables[q.table]
+            if q.table not in self.tables:
+                raise SqlError(f"unknown table {q.table!r}")
+            if q.join is not None:
+                t = _lower_join(self, q)
+            else:
+                t = self.tables[q.table]
+        if q.join is not None and isinstance(q.table, (Query, UnionQuery)):
+            raise SqlError("JOIN over a subquery is not supported")
+        for lat in q.laterals:
+            t = _lower_lateral(self, t, lat)
+        return t
+
+    def _lower_select_clauses(self, q: Query, t: Table) -> Table:
         if q.where is not None:
             t = t.filter(q.where)
         has_overs = any(find_overs(e) for e in q.select)
@@ -1101,3 +1185,241 @@ def _lower_over_agg(table: Table, select: List[Expr]) -> Table:
                                 else (lambda row: 0))
     out = keyed.process(OverAgg(), name="sql_over_agg")
     return Table(t_env, out, Schema(out_names))
+
+
+# ---------------------------------------------------------------------
+# LATERAL TABLE (UDTF) + ORDER BY / LIMIT lowering
+# ---------------------------------------------------------------------
+
+def _lower_lateral(t_env: StreamTableEnvironment, table: Table,
+                   lat: LateralCall) -> Table:
+    """`FROM t, LATERAL TABLE(fn(args)) AS s(cols...)` — cross-apply
+    the registered TableFunction to every row; output rows are the
+    input row extended with the UDTF's columns (ref: the reference's
+    LogicalTableFunctionScan over TableFunction.scala:69-90)."""
+    factory = t_env.udtfs.get(lat.fn.upper())
+    if factory is None:
+        raise SqlError(f"unknown table function {lat.fn!r} "
+                       "(register_table_function first)")
+    table = table._as_rows()
+    schema = table.schema
+    arg_fns = [t_env._expr(a).compile(schema) for a in lat.args]
+    fn = factory()
+    col_names = lat.col_names or [lat.alias]
+
+    def apply(row, fn=fn, arg_fns=arg_fns, width=len(col_names)):
+        args = [f(row) for f in arg_fns]
+        for out in fn.eval(*args):
+            if width == 1 and not isinstance(out, tuple):
+                yield (*row, out)
+            else:
+                out_t = tuple(out) if not isinstance(out, tuple) else out
+                if len(out_t) != width:
+                    raise SqlError(
+                        f"table function {lat.fn} yielded {len(out_t)} "
+                        f"columns, alias declares {width}")
+                yield (*row, *out_t)
+
+    out = table.stream.flat_map(apply, name=f"lateral_{lat.fn}")
+    t = Table(t_env, out,
+              Schema(list(schema.fields) + list(col_names)))
+    t.rowtime = getattr(table, "rowtime", None)
+    return t
+
+
+def _lower_order_limit(table: Table, order_by, limit) -> Table:
+    """ORDER BY / LIMIT on a streaming result.
+
+    - no ORDER BY, no LIMIT: pass through;
+    - LIMIT n alone: emit the first n rows (append-only);
+    - ORDER BY rowtime [secondary keys] [LIMIT n]: event-time sort —
+      rows buffer until the watermark passes them, then emit in
+      (time, keys) order (the reference's streaming-sort rule: the
+      primary sort key must be the time attribute ascending);
+    - ORDER BY anything else + LIMIT n: continuous Top-N — an
+      updating result maintained over the whole stream, consumed via
+      to_retract_stream (ref: the reference's streaming ORDER BY
+      restriction + the Blink Top-N pattern);
+    - ORDER BY anything else without LIMIT: rejected (unbounded
+      full-history sort on an unbounded stream)."""
+    if not order_by and limit is None:
+        return table
+    table = table._as_rows()
+    t_env = table.t_env
+    schema = table.schema
+    if not order_by:
+        # LIMIT alone: first-n (parallelism 1 so the count is global;
+        # the emitted count is operator state so a restore does not
+        # re-open the quota)
+        from flink_tpu.streaming.operators import StreamOperator
+
+        class FirstN(StreamOperator):
+            def __init__(self):
+                super().__init__()
+                self._n = 0
+
+            def process_element(self, record):
+                if self._n < limit:
+                    self._n += 1
+                    self.output.collect(record)
+
+            def snapshot_state(self, checkpoint_id=None):
+                snap = super().snapshot_state(checkpoint_id)
+                snap["limit_emitted"] = self._n
+                return snap
+
+            def restore_state(self, snapshots):
+                super().restore_state(snapshots)
+                for s in snapshots:
+                    self._n += s.get("limit_emitted", 0)
+
+        out = table.stream._add_op("sql_limit", FirstN, parallelism=1)
+        t = Table(t_env, out, schema)
+        t.rowtime = getattr(table, "rowtime", None)
+        return t
+
+    rowtime = getattr(table, "rowtime", None)
+    first_expr, first_desc = order_by[0]
+    time_leading = (rowtime is not None and not first_desc
+                    and isinstance(first_expr, Column)
+                    and first_expr.name in (rowtime,
+                                            rowtime.split(".")[-1]))
+    if time_leading:
+        key_fns = [t_env._expr(e).compile(schema) for e, _ in order_by]
+        descs = [d for _, d in order_by]
+        return _lower_event_time_sort(table, key_fns, descs, limit)
+    if limit is None:
+        raise SqlError(
+            "streaming ORDER BY must lead with the rowtime attribute "
+            "ascending unless a LIMIT makes it a Top-N")
+    key_fns = [t_env._expr(e).compile(schema) for e, _ in order_by]
+    descs = [d for _, d in order_by]
+    return _lower_top_n(table, key_fns, descs, limit)
+
+
+def _lower_event_time_sort(table: Table, key_fns, descs, limit) -> Table:
+    """Buffer rows until the watermark passes their timestamp, then
+    emit in sort order (ref: the reference's streaming sort on a time
+    attribute, RowTimeSortOperator)."""
+    from flink_tpu.streaming.operators import StreamOperator
+
+    class EventTimeSort(StreamOperator):
+        def __init__(self):
+            super().__init__()
+            self._rows = []      # (ts, row)
+            self._emitted = 0
+
+        def process_element(self, record):
+            self._rows.append((record.timestamp, record.value))
+
+        def process_watermark(self, watermark):
+            wm = watermark.timestamp
+            ready = [(t, r) for t, r in self._rows if t <= wm]
+            self._rows = [(t, r) for t, r in self._rows if t > wm]
+            if ready:
+                def sort_key(item):
+                    t, r = item
+                    return tuple(
+                        (_NegWrap(k) if d else k)
+                        for k, d in zip(
+                            (f(r) for f in key_fns), descs))
+                ready.sort(key=sort_key)
+                for t, r in ready:
+                    if limit is not None and self._emitted >= limit:
+                        break
+                    self._emitted += 1
+                    from flink_tpu.streaming.elements import StreamRecord
+                    self.output.collect(StreamRecord(r, timestamp=t))
+            self.output.emit_watermark(watermark)
+
+        def snapshot_state(self, checkpoint_id=None):
+            snap = super().snapshot_state(checkpoint_id)
+            snap["sort_rows"] = list(self._rows)
+            snap["sort_emitted"] = self._emitted
+            return snap
+
+        def restore_state(self, snapshots):
+            super().restore_state(snapshots)
+            for s in snapshots:
+                self._rows.extend(s.get("sort_rows", ()))
+                self._emitted += s.get("sort_emitted", 0)
+
+    out = table.stream._add_op("sql_sort", EventTimeSort,
+                               parallelism=1)
+    t = Table(table.t_env, out, table.schema)
+    t.rowtime = getattr(table, "rowtime", None)
+    return t
+
+
+def _lower_top_n(table: Table, key_fns, descs, limit) -> Table:
+    """Continuous Top-N with retractions: the best `limit` rows by the
+    sort key, updated as rows arrive; emits (is_add, row) through
+    to_retract_stream (the Blink Top-N pattern over the repo's
+    retract protocol)."""
+    import bisect
+
+    from flink_tpu.streaming.elements import StreamRecord
+    from flink_tpu.streaming.operators import StreamOperator
+
+    def sort_key(row):
+        return tuple((_NegWrap(k) if d else k)
+                     for k, d in zip((f(row) for f in key_fns), descs))
+
+    class TopN(StreamOperator):
+        """State (the current best-n) snapshots with checkpoints so a
+        restore neither re-adds rows nor loses pending retractions."""
+
+        def __init__(self):
+            super().__init__()
+            self._heap = []   # (key, row), best first
+
+        def process_element(self, record):
+            row = record.value
+            heap = self._heap
+            key = sort_key(row)
+            pos = bisect.bisect_right([e[0] for e in heap], key)
+            if len(heap) < limit:
+                heap.insert(pos, (key, row))
+                self.output.collect(StreamRecord((True, row),
+                                                 record.timestamp))
+            elif pos < limit:
+                evicted = heap.pop()
+                heap.insert(pos, (key, row))
+                self.output.collect(StreamRecord((False, evicted[1]),
+                                                 record.timestamp))
+                self.output.collect(StreamRecord((True, row),
+                                                 record.timestamp))
+
+        def snapshot_state(self, checkpoint_id=None):
+            snap = super().snapshot_state(checkpoint_id)
+            snap["top_n_rows"] = [r for _, r in self._heap]
+            return snap
+
+        def restore_state(self, snapshots):
+            super().restore_state(snapshots)
+            for s in snapshots:
+                for r in s.get("top_n_rows", ()):
+                    self._heap.append((sort_key(r), r))
+            self._heap.sort(key=lambda e: e[0])
+            del self._heap[limit:]
+
+    out = table.stream._add_op("sql_top_n", TopN, parallelism=1)
+    t = Table(table.t_env, out, table.schema)
+    t._retract_stream = out
+    t._updating = True
+    return t
+
+
+class _NegWrap:
+    """Descending-order wrapper for non-numeric sort keys."""
+
+    __slots__ = ("v",)
+
+    def __init__(self, v):
+        self.v = v
+
+    def __lt__(self, other):
+        return other.v < self.v
+
+    def __eq__(self, other):
+        return self.v == other.v

@@ -1,0 +1,428 @@
+"""Batch Table API: SQL planned onto DataSet.
+
+The reference plans SQL onto DataSet through `DataSetRel` nodes
+(flink-table/.../plan/nodes/dataset/ — DataSetCalc, DataSetAggregate,
+DataSetJoin, DataSetSort, DataSetUnion) driven by the same
+TableEnvironment.sqlQuery entry (TableEnvironment.scala:578).  Here the
+same parser and closure-compiled expressions that drive the streaming
+planner (table/api.py) lower onto the DataSet operators instead — one
+SQL front-end, two execution backends, as in the reference.
+
+Supported batch surface: projection/WHERE (DataSetCalc), GROUP BY with
+the builtin + registered aggregates and HAVING (DataSetAggregate),
+global aggregates, TUMBLE group windows (grouping by computed window
+start — batch windows are just a derived key), equi-JOIN with a
+post-filter for residual conjuncts (DataSetJoin), UNION ALL
+(DataSetUnion), subqueries in FROM, LATERAL TABLE UDTFs, total
+ORDER BY [LIMIT] (DataSetSort — a full sort is legitimate on bounded
+input), and INSERT INTO registered sinks (BatchTableSink path).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+from flink_tpu.table.expressions import (
+    AggCall,
+    BinaryOp,
+    Column,
+    Expr,
+    Schema,
+    find_aggs,
+    find_overs,
+    output_names,
+    strip_alias,
+    substitute,
+)
+from flink_tpu.table.functions import make_builtin_agg
+from flink_tpu.table.sql_parser import (
+    InsertStatement,
+    LateralCall,
+    Query,
+    SqlError,
+    UnionQuery,
+    parse,
+    parse_statement,
+)
+
+__all__ = ["BatchTable", "BatchTableEnvironment"]
+
+
+class BatchTable:
+    """A relational view over a DataSet (rows are tuples)."""
+
+    def __init__(self, t_env: "BatchTableEnvironment", dataset,
+                 schema: Schema):
+        self.t_env = t_env
+        self.dataset = dataset
+        self.schema = schema
+
+    # ---- Table API subset -------------------------------------------
+    def select(self, *exprs) -> "BatchTable":
+        exprs = [self.t_env._expr(e) for e in exprs]
+        if any(find_aggs(e) for e in exprs):
+            raise SqlError("aggregates need group_by() or SQL")
+        names = output_names(exprs)
+        fns = [strip_alias(e).compile(self.schema) for e in exprs]
+        ds = self.dataset.map(
+            lambda row, fns=fns: tuple(f(row) for f in fns))
+        return BatchTable(self.t_env, ds, Schema(names))
+
+    def filter(self, predicate) -> "BatchTable":
+        fn = self.t_env._expr(predicate).compile(self.schema)
+        return BatchTable(
+            self.t_env,
+            self.dataset.filter(lambda row: bool(fn(row))),
+            self.schema)
+
+    where = filter
+
+    def union_all(self, other: "BatchTable") -> "BatchTable":
+        # positional schema match, names from the left input
+        if len(other.schema.fields) != len(self.schema.fields):
+            raise SqlError(
+                f"UNION ALL requires same arity: "
+                f"{self.schema.fields} vs {other.schema.fields}")
+        return BatchTable(self.t_env,
+                          self.dataset.union(other.dataset),
+                          self.schema)
+
+    def to_data_set(self):
+        return self.dataset
+
+    def execute_insert(self, sink) -> None:
+        if callable(sink) and not hasattr(sink, "invoke"):
+            self.dataset.output(sink)
+        else:
+            # streaming-style SinkFunction: invoke per row
+            self.dataset.output(
+                lambda values, s=sink: [s.invoke(v) for v in values])
+
+
+class BatchTableEnvironment:
+    """(ref: BatchTableEnvironment.scala — the DataSet twin of
+    StreamTableEnvironment; one SQL surface, planned onto DataSet)."""
+
+    def __init__(self, env):
+        self.env = env
+        self.tables: Dict[str, BatchTable] = {}
+        self.udafs: Dict[str, Callable[[], Any]] = {}
+        self.udtfs: Dict[str, Callable[[], Any]] = {}
+        self.sinks: Dict[str, Any] = {}
+
+    @staticmethod
+    def create(env) -> "BatchTableEnvironment":
+        return BatchTableEnvironment(env)
+
+    # ---- registration -----------------------------------------------
+    def from_data_set(self, dataset, fields: Sequence[str]) -> BatchTable:
+        return BatchTable(self, dataset, Schema(fields))
+
+    def register_table(self, name: str, table: BatchTable) -> None:
+        self.tables[name] = table
+
+    def register_table_sink(self, name: str, sink) -> None:
+        self.sinks[name] = sink
+
+    def register_function(self, name: str,
+                          factory: Callable[[], Any]) -> None:
+        self.udafs[name.upper()] = factory
+
+    def register_table_function(self, name: str,
+                                factory: Callable[[], Any]) -> None:
+        self.udtfs[name.upper()] = factory
+
+    def scan(self, name: str) -> BatchTable:
+        return self.tables[name]
+
+    def _expr(self, e) -> Expr:
+        if isinstance(e, Expr):
+            return e
+        if isinstance(e, str):
+            from flink_tpu.table.sql_parser import (
+                _parse_select_item,
+                _Tokens,
+            )
+            return _parse_select_item(_Tokens(e), set(self.udafs))
+        raise TypeError(f"not an expression: {e!r}")
+
+    # ---- SQL ---------------------------------------------------------
+    def sql_query(self, sql: str) -> BatchTable:
+        q = parse(sql, udaf_names=self.udafs.keys())
+        return self._lower_node(q)
+
+    def execute_sql(self, sql: str):
+        stmt = parse_statement(sql, udaf_names=self.udafs.keys())
+        if isinstance(stmt, InsertStatement):
+            sink = self.sinks.get(stmt.target)
+            if sink is None:
+                raise SqlError(
+                    f"unknown sink table {stmt.target!r} "
+                    "(register_table_sink first)")
+            self._lower_node(stmt.query).execute_insert(sink)
+            return None
+        return self._lower_node(stmt)
+
+    sql_update = execute_sql
+
+    # ---- lowering ----------------------------------------------------
+    def _lower_node(self, q) -> BatchTable:
+        if isinstance(q, UnionQuery):
+            t = self._lower_query(q.queries[0])
+            for sub in q.queries[1:]:
+                t = t.union_all(self._lower_query(sub))
+            return _lower_batch_order_limit(t, q.order_by, q.limit)
+        return self._lower_query(q)
+
+    def _lower_query(self, q: Query) -> BatchTable:
+        if any(find_overs(e) for e in q.select):
+            raise SqlError("OVER aggregates are streaming-only")
+        t = self._resolve_from(q)
+        if q.where is not None:
+            t = t.filter(q.where)
+        has_aggs = any(find_aggs(e) for e in q.select)
+        if q.window is not None or q.group_by or has_aggs:
+            if q.window is not None and q.window.kind != "tumble":
+                raise SqlError(
+                    "batch group windows support TUMBLE (HOP/SESSION "
+                    "need the streaming planner)")
+            if not has_aggs:
+                raise SqlError("GROUP BY without aggregates")
+            t = _lower_batch_group_agg(self, t, q)
+        else:
+            t = t.select(*q.select)
+        return _lower_batch_order_limit(t, q.order_by, q.limit)
+
+    def _resolve_from(self, q: Query) -> BatchTable:
+        if isinstance(q.table, (Query, UnionQuery)):
+            if q.join is not None:
+                raise SqlError("JOIN over a subquery is not supported")
+            t = self._lower_node(q.table)
+        else:
+            if q.table not in self.tables:
+                raise SqlError(f"unknown table {q.table!r}")
+            t = self.tables[q.table]
+            if q.join is not None:
+                t = _lower_batch_join(self, t, q)
+        for lat in q.laterals:
+            t = _lower_batch_lateral(self, t, lat)
+        return t
+
+
+def _lower_batch_lateral(t_env, table: BatchTable,
+                         lat: LateralCall) -> BatchTable:
+    factory = t_env.udtfs.get(lat.fn.upper())
+    if factory is None:
+        raise SqlError(f"unknown table function {lat.fn!r}")
+    arg_fns = [t_env._expr(a).compile(table.schema) for a in lat.args]
+    fn = factory()
+    col_names = lat.col_names or [lat.alias]
+    width = len(col_names)
+
+    def apply(row):
+        for out in fn.eval(*[f(row) for f in arg_fns]):
+            if width == 1 and not isinstance(out, tuple):
+                yield (*row, out)
+            else:
+                out_t = tuple(out) if not isinstance(out, tuple) else out
+                if len(out_t) != width:
+                    raise SqlError(
+                        f"table function {lat.fn} yielded {len(out_t)} "
+                        f"columns, alias declares {width}")
+                yield (*row, *out_t)
+
+    return BatchTable(
+        t_env, table.dataset.flat_map(apply),
+        Schema(list(table.schema.fields) + list(col_names)))
+
+
+def _split_equi_conjuncts(on: Expr, left: Schema, l_alias, right_fields,
+                          r_alias):
+    """Equi-key pairs + residual predicate from a join condition."""
+    conjuncts: List[Expr] = []
+
+    def walk(e):
+        if isinstance(e, BinaryOp) and e.op == "AND":
+            walk(e.left)
+            walk(e.right)
+        else:
+            conjuncts.append(e)
+    walk(on)
+
+    def side_of(col: Column):
+        name = col.name
+        if "." in name:
+            alias, base = name.split(".", 1)
+            return ("L" if alias == l_alias else
+                    "R" if alias == r_alias else None), base
+        if name in left.index:
+            return "L", name
+        if name in right_fields:
+            return "R", name
+        return None, name
+
+    pairs, residual = [], []
+    for c in conjuncts:
+        if isinstance(c, BinaryOp) and c.op == "=" \
+                and isinstance(c.left, Column) \
+                and isinstance(c.right, Column):
+            sl, nl = side_of(c.left)
+            sr, nr = side_of(c.right)
+            if sl == "L" and sr == "R":
+                pairs.append((nl, nr))
+                continue
+            if sl == "R" and sr == "L":
+                pairs.append((nr, nl))
+                continue
+        residual.append(c)
+    return pairs, residual
+
+
+def _lower_batch_join(t_env, left: BatchTable, q: Query) -> BatchTable:
+    jt = q.join.table
+    if jt not in t_env.tables:
+        raise SqlError(f"unknown table {jt!r}")
+    right = t_env.tables[jt]
+    pairs, residual = _split_equi_conjuncts(
+        q.join.on, left.schema, q.table_alias or q.table,
+        set(right.schema.index), q.join.alias)
+    if not pairs:
+        raise SqlError("batch JOIN needs at least one equi-key "
+                       "conjunct (a.x = b.y)")
+    li = [left.schema.pos(n) for n, _ in pairs]
+    ri = [right.schema.pos(n) for _, n in pairs]
+    joined = (left.dataset.join(right.dataset)
+              .where(lambda r, li=tuple(li):
+                     tuple(r[i] for i in li))
+              .equal_to(lambda r, ri=tuple(ri):
+                        tuple(r[i] for i in ri))
+              .apply(lambda a, b: (*a, *b)))
+    # joined schema qualifies every field with its table alias and
+    # keeps unqualified names only when unambiguous (mirrors the
+    # streaming _lower_join — a shared name silently resolving to one
+    # side would return wrong data without an error)
+    la = q.table_alias or q.table
+    ra = q.join.alias
+    lf, rf = left.schema.fields, right.schema.fields
+    schema = Schema([f"{la}.{f}" for f in lf]
+                    + [f"{ra}.{f}" for f in rf])
+    for i, f in enumerate(lf):
+        if f not in rf:
+            schema.index.setdefault(f, i)
+    for i, f in enumerate(rf):
+        if f not in lf:
+            schema.index.setdefault(f, len(lf) + i)
+    out = BatchTable(t_env, joined, schema)
+    for r in residual:
+        out = out.filter(r)
+    return out
+
+
+def _lower_batch_group_agg(t_env, table: BatchTable,
+                           q: Query) -> BatchTable:
+    schema = table.schema
+    key_exprs = [strip_alias(t_env._expr(k)) for k in q.group_by]
+    key_fns = [k.compile(schema) for k in key_exprs]
+    key_names = {k.name: i for i, k in enumerate(key_exprs)
+                 if isinstance(k, Column)}
+    window = q.window
+    if window is not None:
+        ts_pos = schema.pos(window.time_col)
+        size = window.size_ms
+
+    agg_sites: List[AggCall] = []
+    site_index: Dict[str, int] = {}
+    for e in q.select:
+        for a in find_aggs(e):
+            if repr(a) not in site_index:
+                site_index[repr(a)] = len(agg_sites)
+                agg_sites.append(a)
+    parts = []
+    for a in agg_sites:
+        input_fn = (a.args[0].compile(schema) if a.args
+                    else (lambda row: 1))
+        agg = (t_env.udafs[a.name]() if a.name in t_env.udafs
+               else make_builtin_agg(a))
+        parts.append((agg, input_fn))
+
+    n_keys = len(key_exprs)
+    post_fields = ([f"__k{i}" for i in range(n_keys)]
+                   + [f"__a{i}" for i in range(len(agg_sites))]
+                   + (["__ws", "__we"] if window is not None else []))
+    post_schema = Schema(post_fields)
+
+    def remap(e):
+        from flink_tpu.table.expressions import WindowProp
+        if isinstance(e, AggCall):
+            return Column(f"__a{site_index[repr(e)]}")
+        if isinstance(e, WindowProp):
+            return Column("__ws" if e.kind == "start" else "__we")
+        if isinstance(e, Column):
+            if e.name in key_names:
+                return Column(f"__k{key_names[e.name]}")
+            raise SqlError(
+                f"column {e.name!r} must appear in GROUP BY or inside "
+                "an aggregate")
+        return None
+
+    out_fns = [substitute(strip_alias(t_env._expr(e)), remap)
+               .compile(post_schema) for e in q.select]
+    out_names = output_names([t_env._expr(e) for e in q.select])
+    having_fn = (substitute(strip_alias(t_env._expr(q.having)), remap)
+                 .compile(post_schema) if q.having is not None else None)
+
+    def group_key(row):
+        ks = tuple(f(row) for f in key_fns)
+        if window is not None:
+            t = row[ts_pos]
+            ks = ks + (t - t % size,)
+        return ks if ks else 0
+
+    def fold(rows, out):
+        rows = list(rows)
+        accs = [agg.create_accumulator() for agg, _ in parts]
+        for r in rows:
+            for i, (agg, input_fn) in enumerate(parts):
+                accs[i] = agg.add(input_fn(r), accs[i])
+        key_vals = tuple(f(rows[0]) for f in key_fns)
+        post = key_vals + tuple(
+            agg.get_result(a) for (agg, _), a in zip(parts, accs))
+        if window is not None:
+            t = rows[0][ts_pos]
+            ws = t - t % size
+            post = post + (ws, ws + size)
+        if having_fn is not None and not bool(having_fn(post)):
+            return
+        out.append(tuple(f(post) for f in out_fns))
+
+    def per_group(rows):
+        out: List[tuple] = []
+        fold(rows, out)
+        return out
+
+    ds = table.dataset.group_by(group_key).reduce_group(per_group)
+    return BatchTable(t_env, ds, Schema(out_names))
+
+
+def _lower_batch_order_limit(table: BatchTable, order_by,
+                             limit) -> BatchTable:
+    if not order_by and limit is None:
+        return table
+    t_env = table.t_env
+    schema = table.schema
+
+    if order_by:
+        key_fns = [t_env._expr(e).compile(schema) for e, _ in order_by]
+        descs = [d for _, d in order_by]
+
+        def total_sort(rows):
+            rows = list(rows)
+            # stable multi-key sort: apply keys right-to-left
+            for f, d in list(zip(key_fns, descs))[::-1]:
+                rows.sort(key=f, reverse=d)
+            return rows[:limit] if limit is not None else rows
+
+        # DataSetSort: a bounded input sorts totally on one node
+        ds = table.dataset.group_by(lambda r: 0).reduce_group(total_sort)
+        return BatchTable(t_env, ds, schema)
+    return BatchTable(t_env, table.dataset.first(limit), schema)

@@ -154,6 +154,18 @@ class DistinctCountAgg(DistinctAgg):
         return len(acc)
 
 
+class TableFunction:
+    """User-defined table function (UDTF) contract: ``eval(*args)``
+    yields zero or more output rows per input row (scalars for a
+    single output column, tuples for several) — consumed via
+    ``, LATERAL TABLE(fn(...)) AS t(col, ...)`` in SQL
+    (ref: flink-table/.../functions/TableFunction.scala:69-90; the
+    collect() protocol becomes a plain Python generator)."""
+
+    def eval(self, *args):
+        raise NotImplementedError
+
+
 def make_builtin_agg(call: AggCall):
     name = call.name
     if name == "COUNT":

@@ -23,7 +23,7 @@ from __future__ import annotations
 
 import re
 from dataclasses import dataclass, field
-from typing import List, Optional
+from typing import Any, List, Optional
 
 from flink_tpu.table.expressions import (
     AggCall,
@@ -55,7 +55,9 @@ _AGG_FNS = {"COUNT", "SUM", "MIN", "MAX", "AVG", "APPROX_COUNT_DISTINCT"}
 _KEYWORDS = {"SELECT", "FROM", "WHERE", "GROUP", "BY", "HAVING", "AS",
              "AND", "OR", "NOT", "DISTINCT", "INTERVAL", "NULL", "TRUE",
              "FALSE", "JOIN", "ON", "OVER", "PARTITION", "ORDER", "ROWS",
-             "RANGE", "BETWEEN", "PRECEDING", "CURRENT", "ROW"}
+             "RANGE", "BETWEEN", "PRECEDING", "CURRENT", "ROW",
+             "INSERT", "INTO", "UNION", "ALL", "LATERAL", "TABLE",
+             "ASC", "DESC", "LIMIT"}
 
 
 @dataclass
@@ -78,15 +80,49 @@ class JoinClause:
 
 
 @dataclass
+class LateralCall:
+    """`, LATERAL TABLE(fn(args)) AS alias(col, ...)` — a UDTF
+    cross-apply in the FROM clause (ref: the reference's
+    LogicalTableFunctionScan / UserDefinedTableFunction path,
+    flink-table/.../functions/TableFunction.scala)."""
+    fn: str
+    args: List[Expr]
+    alias: str
+    col_names: List[str]
+
+
+@dataclass
 class Query:
     select: List[Expr]
-    table: str
+    #: source table name, or a nested Query/UnionQuery (subquery in
+    #: FROM — ref TableEnvironment.scala's sqlQuery over views)
+    table: Any
     where: Optional[Expr] = None
     group_by: List[Expr] = field(default_factory=list)
     window: Optional[WindowSpec] = None
     having: Optional[Expr] = None
     table_alias: Optional[str] = None
     join: Optional[JoinClause] = None
+    laterals: List[LateralCall] = field(default_factory=list)
+    order_by: List[tuple] = field(default_factory=list)  # (Expr, desc)
+    limit: Optional[int] = None
+
+
+@dataclass
+class UnionQuery:
+    """`q1 UNION ALL q2 [UNION ALL ...]` (ref Table.unionAll /
+    TableEnvironment UNION planning)."""
+    queries: List[Query]
+    order_by: List[tuple] = field(default_factory=list)
+    limit: Optional[int] = None
+
+
+@dataclass
+class InsertStatement:
+    """`INSERT INTO sink <query>` — the SQL write path
+    (ref: TableEnvironment.sqlUpdate, TableEnvironment.scala:614)."""
+    target: str
+    query: Any  # Query | UnionQuery
 
 
 class SqlError(ValueError):
@@ -138,20 +174,120 @@ class _Tokens:
         return self.i >= len(self.toks)
 
 
-def parse(sql: str, udaf_names=()) -> Query:
+def parse(sql: str, udaf_names=()):
+    """Parse one SELECT statement (possibly a UNION ALL chain with a
+    trailing ORDER BY / LIMIT).  Returns Query or UnionQuery."""
     tk = _Tokens(sql)
     udafs = {n.upper() for n in udaf_names}
+    q = _parse_union(tk, udafs)
+    if not tk.done:
+        raise SqlError(f"unexpected trailing tokens: {tk.peek()}")
+    return q
+
+
+def parse_statement(sql: str, udaf_names=()):
+    """Parse a top-level statement: SELECT ... (Query | UnionQuery)
+    or INSERT INTO sink SELECT ... (InsertStatement)."""
+    tk = _Tokens(sql)
+    udafs = {n.upper() for n in udaf_names}
+    if tk.accept("kw", "INSERT"):
+        tk.expect("kw", "INTO")
+        target = tk.expect("name")
+        q = _parse_union(tk, udafs)
+        if not tk.done:
+            raise SqlError(f"unexpected trailing tokens: {tk.peek()}")
+        return InsertStatement(target=target, query=q)
+    q = _parse_union(tk, udafs)
+    if not tk.done:
+        raise SqlError(f"unexpected trailing tokens: {tk.peek()}")
+    return q
+
+
+def _parse_union(tk: _Tokens, udafs):
+    queries = [_parse_query(tk, udafs)]
+    while tk.accept("kw", "UNION"):
+        if not tk.accept("kw", "ALL"):
+            raise SqlError(
+                "streaming UNION requires ALL (distinct UNION would "
+                "need a retracting dedup; use UNION ALL)")
+        queries.append(_parse_query(tk, udafs))
+    order_by, limit = _parse_order_limit(tk, udafs)
+    if len(queries) == 1:
+        q = queries[0]
+        q.order_by, q.limit = order_by, limit
+        return q
+    return UnionQuery(queries=queries, order_by=order_by, limit=limit)
+
+
+def _parse_order_limit(tk: _Tokens, udafs):
+    order_by: List[tuple] = []
+    limit = None
+    if tk.accept("kw", "ORDER"):
+        tk.expect("kw", "BY")
+        while True:
+            e = _parse_expr(tk, udafs)
+            desc = False
+            if tk.accept("kw", "DESC"):
+                desc = True
+            else:
+                tk.accept("kw", "ASC")
+            order_by.append((e, desc))
+            if not tk.accept("op", ","):
+                break
+    if tk.accept("kw", "LIMIT"):
+        limit = int(tk.expect("number"))
+    return order_by, limit
+
+
+def _parse_from_item(tk: _Tokens, udafs):
+    """table-name | ( subquery ) — with optional alias."""
+    if tk.accept("op", "("):
+        sub = _parse_union(tk, udafs)
+        tk.expect("op", ")")
+        table = sub
+    else:
+        table = tk.expect("name")
+    alias = None
+    if tk.accept("kw", "AS"):
+        alias = tk.expect("name")
+    elif tk.peek()[0] == "name":
+        alias = tk.next()[1]
+    return table, alias
+
+
+def _parse_query(tk: _Tokens, udafs) -> Query:
     tk.expect("kw", "SELECT")
     select = [_parse_select_item(tk, udafs)]
     while tk.accept("op", ","):
         select.append(_parse_select_item(tk, udafs))
     tk.expect("kw", "FROM")
-    table = tk.expect("name")
-    table_alias = None
-    if tk.accept("kw", "AS"):
-        table_alias = tk.expect("name")
-    elif tk.peek()[0] == "name":
-        table_alias = tk.next()[1]
+    table, table_alias = _parse_from_item(tk, udafs)
+    laterals: List[LateralCall] = []
+    while tk.peek() == ("op", ",") and tk.peek(1) == ("kw", "LATERAL"):
+        tk.next()
+        tk.expect("kw", "LATERAL")
+        tk.expect("kw", "TABLE")
+        tk.expect("op", "(")
+        fn = tk.expect("name")
+        tk.expect("op", "(")
+        args: List[Expr] = []
+        if tk.peek() != ("op", ")"):
+            args.append(_parse_expr(tk, udafs))
+            while tk.accept("op", ","):
+                args.append(_parse_expr(tk, udafs))
+        tk.expect("op", ")")
+        tk.expect("op", ")")
+        alias = fn
+        col_names: List[str] = []
+        if tk.accept("kw", "AS"):
+            alias = tk.expect("name")
+            if tk.accept("op", "("):
+                col_names.append(tk.expect("name"))
+                while tk.accept("op", ","):
+                    col_names.append(tk.expect("name"))
+                tk.expect("op", ")")
+        laterals.append(LateralCall(fn=fn, args=args, alias=alias,
+                                    col_names=col_names))
     join = None
     if tk.accept("kw", "JOIN"):
         jt = tk.expect("name")
@@ -184,11 +320,9 @@ def parse(sql: str, udaf_names=()) -> Query:
     having = None
     if tk.accept("kw", "HAVING"):
         having = _parse_expr(tk, udafs)
-    if not tk.done:
-        raise SqlError(f"unexpected trailing tokens: {tk.peek()}")
     return Query(select=select, table=table, where=where,
                  group_by=group_by, window=window, having=having,
-                 table_alias=table_alias, join=join)
+                 table_alias=table_alias, join=join, laterals=laterals)
 
 
 def _parse_window(tk: _Tokens) -> WindowSpec:

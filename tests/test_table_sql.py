@@ -505,3 +505,291 @@ def test_retract_protocol_not_lost_by_filter():
     out = t_env.sql_query("SELECT k, SUM(v) AS s FROM ev GROUP BY k")
     with pytest.raises(SqlError, match="retract protocol lost"):
         out.filter(col("s") > 0).to_retract_stream()
+
+
+# ---------------------------------------------------------------------
+# round 5: SQL write path + set ops + subqueries + UDTF + ORDER/LIMIT
+# ---------------------------------------------------------------------
+
+def test_parse_statement_shapes():
+    from flink_tpu.table.sql_parser import (
+        InsertStatement,
+        UnionQuery,
+        parse_statement,
+    )
+    st = parse_statement("INSERT INTO out SELECT a FROM t")
+    assert isinstance(st, InsertStatement) and st.target == "out"
+    st = parse_statement("SELECT a FROM t UNION ALL SELECT a FROM s")
+    assert isinstance(st, UnionQuery) and len(st.queries) == 2
+    q = parse("SELECT a FROM (SELECT a, b FROM t WHERE b > 1) AS sub")
+    assert not isinstance(q.table, str)
+    q = parse("SELECT a FROM t ORDER BY a DESC LIMIT 5")
+    assert q.order_by == [(q.order_by[0][0], True)] and q.limit == 5
+    with pytest.raises(SqlError):
+        parse("SELECT a FROM t UNION SELECT a FROM s")  # needs ALL
+
+
+def test_sql_union_all():
+    events = [(1, 10, 0), (2, 20, 10)]
+    env, t_env = _table_env(events)
+    out = t_env.sql_query(
+        "SELECT k, u FROM ev WHERE k = 1 "
+        "UNION ALL SELECT k, u FROM ev WHERE k = 2 "
+        "UNION ALL SELECT k, u FROM ev")
+    sink = CollectSink()
+    out.to_append_stream().add_sink(sink)
+    env.execute("sql-union")
+    assert sorted(sink.values) == [(1, 10), (1, 10), (2, 20), (2, 20)]
+
+
+def test_sql_subquery_in_from():
+    events = _sorted_events()
+    env, t_env = _table_env(events)
+    out = t_env.sql_query(
+        "SELECT k, COUNT(*) AS c "
+        "FROM (SELECT k, u, ts FROM ev WHERE u > 25) AS filtered "
+        "GROUP BY TUMBLE(ts, INTERVAL '1' SECOND), k")
+    sink = CollectSink()
+    out.to_append_stream().add_sink(sink)
+    env.execute("sql-subquery")
+    expect = collections.Counter()
+    for k, u, t in events:
+        if u > 25:
+            expect[(k, t - t % 1000)] += 1
+    got = collections.Counter()
+    for k, c in sink.values:
+        got[k] += c
+    want = collections.Counter()
+    for (k, w), c in expect.items():
+        want[k] += c
+    assert got == want
+
+
+def test_sql_insert_into_registered_sink():
+    """INSERT INTO end-to-end over the columnar tier (the verdict's
+    e2e requirement: the write path rides the same physical plans)."""
+    rng = np.random.default_rng(5)
+    n = 4000
+    cols = {
+        "k": rng.integers(0, 16, n).astype(np.int64),
+        "u": rng.integers(0, 64, n).astype(np.int64),
+        "ts": np.sort(rng.integers(0, 3000, n).astype(np.int64)),
+    }
+    env = StreamExecutionEnvironment()
+    t_env = StreamTableEnvironment.create(env)
+    t_env.register_table("ev", t_env.from_columns(cols, rowtime="ts"))
+    sink = CollectSink()
+    t_env.register_table_sink("out", sink)
+    ret = t_env.execute_sql(
+        "INSERT INTO out "
+        "SELECT k, COUNT(*) AS c FROM ev "
+        "GROUP BY TUMBLE(ts, INTERVAL '1' SECOND), k")
+    assert ret is None
+    env.execute("sql-insert")
+    expect = collections.Counter()
+    for k, t in zip(cols["k"].tolist(), cols["ts"].tolist()):
+        expect[(k, t - t % 1000)] += 1
+    total = collections.Counter()
+    for k, c in sink.values:
+        total[k] += c
+    want = collections.Counter()
+    for (k, w), c in expect.items():
+        want[k] += c
+    assert total == want
+    with pytest.raises(SqlError):
+        t_env.execute_sql("INSERT INTO nowhere SELECT k FROM ev")
+
+
+def test_sql_udtf_lateral_table():
+    from flink_tpu.table.functions import TableFunction
+
+    class Split(TableFunction):
+        def eval(self, line):
+            for w in line.split():
+                yield w
+
+    env = StreamExecutionEnvironment()
+    stream = env.from_collection([(1, "a b"), (2, "c")])
+    t_env = StreamTableEnvironment.create(env)
+    t_env.register_table("lines",
+                         t_env.from_data_stream(stream, ["id", "line"]))
+    t_env.register_table_function("split", Split)
+    out = t_env.sql_query(
+        "SELECT id, word FROM lines, "
+        "LATERAL TABLE(split(line)) AS s(word)")
+    sink = CollectSink()
+    out.to_append_stream().add_sink(sink)
+    env.execute("sql-udtf")
+    assert sorted(sink.values) == [(1, "a"), (1, "b"), (2, "c")]
+
+
+def test_sql_udtf_multi_column():
+    from flink_tpu.table.functions import TableFunction
+
+    class Pairs(TableFunction):
+        def eval(self, n):
+            for i in range(n):
+                yield (i, i * 10)
+
+    env = StreamExecutionEnvironment()
+    stream = env.from_collection([(2,)])
+    t_env = StreamTableEnvironment.create(env)
+    t_env.register_table("t", t_env.from_data_stream(stream, ["n"]))
+    t_env.register_table_function("pairs", Pairs)
+    out = t_env.sql_query(
+        "SELECT i, v FROM t, LATERAL TABLE(pairs(n)) AS p(i, v)")
+    sink = CollectSink()
+    out.to_append_stream().add_sink(sink)
+    env.execute("sql-udtf2")
+    assert sorted(sink.values) == [(0, 0), (1, 10)]
+
+
+def test_sql_order_by_rowtime_sorts():
+    events = [(3, 30, 200), (1, 10, 0), (2, 20, 100)]
+    env, t_env = _table_env(events)
+    out = t_env.sql_query("SELECT k, u, ts FROM ev ORDER BY ts")
+    sink = CollectSink()
+    out.to_append_stream().add_sink(sink)
+    env.execute("sql-order-ts")
+    assert [k for k, u, ts in sink.values] == [1, 2, 3]
+
+
+def test_sql_order_by_rowtime_with_limit():
+    events = [(3, 30, 200), (1, 10, 0), (2, 20, 100), (4, 40, 300)]
+    env, t_env = _table_env(events)
+    out = t_env.sql_query("SELECT k, ts FROM ev ORDER BY ts LIMIT 2")
+    sink = CollectSink()
+    out.to_append_stream().add_sink(sink)
+    env.execute("sql-order-limit")
+    assert [k for k, ts in sink.values] == [1, 2]
+
+
+def test_sql_top_n_retract():
+    events = [(1, 50, 0), (2, 90, 10), (3, 10, 20), (4, 99, 30)]
+    env, t_env = _table_env(events)
+    out = t_env.sql_query("SELECT k, u FROM ev ORDER BY u DESC LIMIT 2")
+    sink = CollectSink()
+    out.to_retract_stream().add_sink(sink)
+    env.execute("sql-top-n")
+    state = set()
+    for is_add, row in sink.values:
+        if is_add:
+            state.add(row)
+        else:
+            state.discard(row)
+    assert state == {(2, 90), (4, 99)}
+
+
+def test_sql_order_by_non_time_without_limit_rejected():
+    events = [(1, 10, 0)]
+    env, t_env = _table_env(events)
+    with pytest.raises(SqlError):
+        t_env.sql_query("SELECT k, u FROM ev ORDER BY u")
+
+
+def test_sql_limit_alone():
+    events = [(1, 10, 0), (2, 20, 10), (3, 30, 20)]
+    env, t_env = _table_env(events)
+    out = t_env.sql_query("SELECT k FROM ev LIMIT 2")
+    sink = CollectSink()
+    out.to_append_stream().add_sink(sink)
+    env.execute("sql-limit")
+    assert len(sink.values) == 2
+
+
+# ---------------------------------------------------------------------
+# round 5: batch Table API (SQL planned onto DataSet)
+# ---------------------------------------------------------------------
+
+def _batch_env():
+    from flink_tpu.batch.dataset import ExecutionEnvironment
+    from flink_tpu.table.batch import BatchTableEnvironment
+    env = ExecutionEnvironment.get_execution_environment()
+    bt = BatchTableEnvironment.create(env)
+    rows = [(1, 10, 0), (1, 20, 500), (2, 5, 900), (2, 7, 1500),
+            (3, 100, 2100)]
+    bt.register_table("ev", bt.from_data_set(
+        env.from_collection(rows), ["k", "u", "ts"]))
+    return env, bt
+
+
+def test_batch_sql_projection_filter():
+    env, bt = _batch_env()
+    out = bt.sql_query("SELECT k * 10, u FROM ev WHERE u >= 10")
+    assert sorted(out.to_data_set().collect()) == \
+        [(10, 10), (10, 20), (30, 100)]
+
+
+def test_batch_sql_group_agg_having():
+    env, bt = _batch_env()
+    out = bt.sql_query(
+        "SELECT k, COUNT(*) AS c, SUM(u) AS s FROM ev "
+        "GROUP BY k HAVING COUNT(*) > 1")
+    assert sorted(out.to_data_set().collect()) == \
+        [(1, 2, 30), (2, 2, 12)]
+
+
+def test_batch_sql_tumble_window():
+    env, bt = _batch_env()
+    out = bt.sql_query(
+        "SELECT k, SUM(u) AS s, TUMBLE_START(ts) AS ws FROM ev "
+        "GROUP BY TUMBLE(ts, INTERVAL '1' SECOND), k")
+    assert sorted(out.to_data_set().collect()) == \
+        [(1, 30, 0), (2, 5, 0), (2, 7, 1000), (3, 100, 2000)]
+
+
+def test_batch_sql_join_union_order_limit():
+    env, bt = _batch_env()
+    dims = [(1, "a"), (2, "b"), (3, "c")]
+    bt.register_table("dim", bt.from_data_set(
+        env.from_collection(dims), ["dk", "name"]))
+    out = bt.sql_query(
+        "SELECT k, name, u FROM ev JOIN dim ON k = dk "
+        "WHERE u > 6 ORDER BY u DESC LIMIT 3")
+    assert out.to_data_set().collect() == \
+        [(3, "c", 100), (1, "a", 20), (1, "a", 10)]
+    out = bt.sql_query(
+        "SELECT k FROM ev WHERE k = 1 "
+        "UNION ALL SELECT k FROM ev WHERE k = 3")
+    assert sorted(out.to_data_set().collect()) == [(1,), (1,), (3,)]
+
+
+def test_batch_sql_subquery_udtf_insert():
+    from flink_tpu.table.functions import TableFunction
+
+    class Dup(TableFunction):
+        def eval(self, n):
+            yield n
+            yield n
+
+    env, bt = _batch_env()
+    bt.register_table_function("dup", Dup)
+    collected = []
+    bt.register_table_sink("out", collected.extend)
+    bt.execute_sql(
+        "INSERT INTO out "
+        "SELECT total FROM "
+        "(SELECT k, SUM(u) AS total FROM ev GROUP BY k) AS sums, "
+        "LATERAL TABLE(dup(k)) AS d(dk) "
+        "WHERE dk = 1")
+    env.execute("batch-insert")
+    assert sorted(collected) == [(30,), (30,)]
+
+
+def test_batch_sql_join_qualified_columns():
+    from flink_tpu.batch.dataset import ExecutionEnvironment
+    from flink_tpu.table.batch import BatchTableEnvironment
+    env = ExecutionEnvironment.get_execution_environment()
+    bt = BatchTableEnvironment.create(env)
+    bt.register_table("a", bt.from_data_set(
+        env.from_collection([(1, 10), (2, 20)]), ["k", "v"]))
+    bt.register_table("b", bt.from_data_set(
+        env.from_collection([(1, 100), (2, 200)]), ["k", "v"]))
+    # unqualified shared name is ambiguous -> error, not wrong data
+    with pytest.raises((SqlError, KeyError)):
+        bt.sql_query("SELECT v FROM a JOIN b ON a.k = b.k") \
+          .to_data_set().collect()
+    out = bt.sql_query(
+        "SELECT a.v AS av, b.v AS bv FROM a JOIN b ON a.k = b.k "
+        "ORDER BY av")
+    assert out.to_data_set().collect() == [(10, 100), (20, 200)]
