@@ -372,6 +372,60 @@ def bench_session_cm(n_events=1 << 21, n_keys=100_000):
 # (ref: WindowOperator.java:291-421 per-record contract).
 # ---------------------------------------------------------------------
 
+# ---------------------------------------------------------------------
+# cep — STRICT next-chain pattern matching (cep/vectorized.py): the
+# "three escalating events within T" alert shape over 1M keys, user
+# conditions as Python lambdas lifted to column masks, state + NFA
+# advance in the fused C++ kernel.  Baseline: the identical per-record
+# strict-chain NFA compiled (ft_cep_strict_baseline — probe + shift,
+# conditions inlined; favorable to the baseline, see BENCH_NOTES
+# "Round 5").
+# ---------------------------------------------------------------------
+
+def bench_cep(n_events=1 << 22, n_keys=1_000_000, within=5_000_000):
+    from flink_tpu.cep.pattern import Pattern
+    from flink_tpu.cep.vectorized import VectorizedStrictNFA
+
+    rng = np.random.default_rng(23)
+    keys = rng.integers(0, n_keys, n_events).astype(np.uint64)
+    ts = np.arange(n_events, dtype=np.int64)
+    vals = rng.random(n_events) * 200
+    kh = nat.splitmix64(keys)
+    base_rate, base_matches = max(
+        (nat.cep_strict_baseline(kh, vals, ts, 4.0, 100.0, 180.0,
+                                 within, capacity=2 * n_keys)
+         for _ in range(3)), key=lambda x: x[0])
+
+    def make_pat():
+        return (Pattern.begin("a").where(lambda e: e < 4.0)
+                .next("b").where(lambda e: e >= 100.0)
+                .next("c").where(lambda e: e >= 180.0)
+                .within(within))
+
+    # steady state: key table warm (the baseline's table is pre-sized
+    # the same way), sustained batches
+    eng = VectorizedStrictNFA(make_pat())
+    eng.advance_batch(keys, ts - (1 << 40), cols=[vals],
+                      vspec="scalar")
+    assert eng.mode == "lifted", eng.mode
+    eng.matches.clear()
+    best = 0.0
+    matches = 0
+    chunk = 1 << 21
+    for rep in range(3):
+        n0 = len(eng.matches)
+        t0 = time.perf_counter()
+        for i in range(0, n_events, chunk):
+            sl = slice(i, i + chunk)
+            eng.advance_batch(keys[sl],
+                              ts[sl] + (rep + 1) * (1 << 41),
+                              cols=[vals[sl]], vspec="scalar")
+        best = max(best, n_events / (time.perf_counter() - t0))
+        matches = len(eng.matches) - n0
+    assert matches == base_matches, (matches, base_matches)
+    return best, base_rate
+
+
 from flink_tpu.core.functions import AggregateFunction
 
 
@@ -593,6 +647,7 @@ def main():
         ("sliding_quantile", bench_sliding_quantile),
         ("session_cm", bench_session_cm),
         ("generic_agg", bench_generic_agg),
+        ("cep", bench_cep),
         ("sql", bench_sql),
         ("sql_join", bench_sql_join),
     ]

@@ -28,6 +28,13 @@ class PatternStream:
         #: side-output tag for timed-out partial matches
         self.timeout_tag: Optional[OutputTag] = None
         self._timeout_fn: Optional[Callable] = None
+        self._vectorized_enabled = True
+
+    def disable_vectorized(self) -> "PatternStream":
+        """Force the per-record scalar NFA even for vectorizable
+        patterns (debugging / semantics comparison)."""
+        self._vectorized_enabled = False
+        return self
 
     def with_timeout_side_output(self, tag: OutputTag,
                                  timeout_fn: Optional[Callable] = None
@@ -50,6 +57,22 @@ class PatternStream:
     def _build(self, emit_fn, name: str):
         stream = self.stream
         keyed = hasattr(stream, "key_selector") and stream.key_selector
+        # STRICT next-chains with unary conditions ride the batched
+        # vectorized NFA (cep/vectorized.py); everything else (loops,
+        # negation, skip-till, timeout side outputs) runs the scalar
+        # per-record operator
+        from flink_tpu.cep.vectorized import pattern_vectorizable
+        if (self._vectorized_enabled and self.timeout_tag is None
+                and pattern_vectorizable(self.pattern)
+                and stream.env.time_characteristic == "event"):
+            pattern = self.pattern
+            if not keyed:
+                stream = stream.key_by(lambda e: 0)
+
+            def vfactory():
+                return _VectorizedCepOperator(pattern, emit_fn)
+            return stream._add_keyed_op(name, vfactory,
+                                        chaining="head")
         if not keyed:
             stream = stream.key_by(lambda e: 0)
         op = _CepProcessFunction(self.pattern, emit_fn, self.timeout_tag,
@@ -150,3 +173,109 @@ class _CepProcessFunction(ProcessFunction):
 
     def _store_nfa(self, ctx, nfa: NFA) -> None:
         ctx.get_state(_NFA_STATE).update(nfa.snapshot())
+
+
+from flink_tpu.streaming.operators import StreamOperator as _StreamOp
+
+
+class _VectorizedCepOperator(_StreamOp):
+    """Batched twin of _CepProcessFunction for vectorizable patterns:
+    buffers events, sorts the watermark-ready prefix by time, and
+    advances the VectorizedStrictNFA over the whole batch (see
+    cep/vectorized.py).  Keys resolve vectorized at flush — the
+    operator IS the keyed state, like DeviceWindowOperator."""
+
+    def __init__(self, pattern: Pattern, emit_fn):
+        super().__init__()
+        self.pattern = pattern
+        self.emit_fn = emit_fn
+        self.engine = None
+        self._keys: List[Any] = []
+        self._ts: List[int] = []
+        self._values: List[Any] = []
+
+    def open(self):
+        from flink_tpu.cep.vectorized import VectorizedStrictNFA
+        from flink_tpu.streaming.operators import TimestampedCollector
+        if self.engine is None:
+            self.engine = VectorizedStrictNFA(self.pattern)
+        self.collector = TimestampedCollector(self.output)
+
+    def set_key_context(self, record):
+        pass
+
+    def process_element(self, record):
+        if record.timestamp is None:
+            raise ValueError(
+                "vectorized CEP requires event-time records")
+        self._keys.append(self.key_selector.get_key(record.value)
+                          if self.key_selector is not None
+                          else record.value)
+        self._ts.append(record.timestamp)
+        self._values.append(record.value)
+
+    def process_watermark(self, watermark):
+        import numpy as np
+        wm = watermark.timestamp
+        if self._ts:
+            ts = np.asarray(self._ts, np.int64)
+            ready = ts <= wm
+            if ready.any():
+                order = np.argsort(ts[ready], kind="stable")
+                idx = np.flatnonzero(ready)[order]
+                try:
+                    keys = np.asarray(self._keys)
+                    if keys.dtype.kind not in "iufUS" \
+                            or keys.ndim != 1:
+                        raise ValueError
+                except Exception:  # noqa: BLE001 — object keys
+                    keys = np.empty(len(self._keys), object)
+                    keys[:] = self._keys
+                vals = self._values
+                before = len(self.engine.matches)
+                self.engine.advance_batch(
+                    keys[idx], ts[idx],
+                    [vals[i] for i in idx.tolist()])
+                keep = np.flatnonzero(~ready).tolist()
+                self._keys = [self._keys[i] for i in keep]
+                self._ts = [self._ts[i] for i in keep]
+                self._values = [vals[i] for i in keep]
+                for key, events, m_ts in \
+                        self.engine.matches[before:]:
+                    self.collector.set_absolute_timestamp(m_ts)
+                    for r in self.emit_fn(events):
+                        self.collector.collect(r)
+                del self.engine.matches[:]
+        self.current_watermark = wm
+        self.output.emit_watermark(watermark)
+
+    # ---- checkpoint -------------------------------------------------
+    def snapshot_state(self, checkpoint_id=None) -> dict:
+        snap = _StreamOp.snapshot_state(self, checkpoint_id)
+        if self.engine is None:
+            from flink_tpu.cep.vectorized import VectorizedStrictNFA
+            self.engine = VectorizedStrictNFA(self.pattern)
+        snap["cep_engine"] = self.engine.snapshot()
+        snap["cep_buffer"] = (list(self._keys), list(self._ts),
+                              list(self._values))
+        return snap
+
+    def restore_state(self, snapshots) -> None:
+        from flink_tpu.cep.vectorized import VectorizedStrictNFA
+        _StreamOp.restore_state(self, snapshots)
+        engine_snaps = [s["cep_engine"] for s in snapshots
+                        if s.get("cep_engine") is not None]
+        if len(engine_snaps) > 1:
+            raise ValueError(
+                "vectorized CEP state cannot re-split across a "
+                "parallelism change; restore at the checkpointed "
+                "parallelism or disable_vectorized()")
+        if engine_snaps:
+            self.engine = VectorizedStrictNFA(self.pattern)
+            self.engine.restore(engine_snaps[0])
+        for s in snapshots:
+            buf = s.get("cep_buffer")
+            if buf:
+                self._keys.extend(buf[0])
+                self._ts.extend(buf[1])
+                self._values.extend(buf[2])

@@ -1,0 +1,594 @@
+"""Vectorized CEP: batched NFA advance for STRICT next-chains.
+
+The reference runs its NFA per record inside a keyed operator
+(flink-cep/.../nfa/NFA.java:202-221 process, SharedBuffer match
+storage).  For the most common pattern shape — a STRICT chain of
+single-event stages (``begin.next.next...``, the "n consecutive
+events satisfying p1..pk within T" fraud/alert patterns) — per-key NFA
+state collapses to ONE run per stage: every event either advances a
+waiting run or kills it (strict contiguity), so the per-key state is a
+length-k boolean vector plus the matched-event references, and the
+whole transition is a masked shift:
+
+    new_active[s] = old_active[s-1] AND cond[s-1](event)
+    match         = old_active[k-1] AND cond[k-1](event)
+
+This module executes that shift over record BATCHES: conditions are
+evaluated once per batch as numpy column masks (the same lift-probe
+contract as streaming/generic_agg.py — a condition written with
+comparisons/arithmetic runs elementwise over all rows; conditions that
+fail the probe fall back to per-row evaluation of the masks, keeping
+the batched state machine), rows group by key through the fused C++
+kernel, and the per-key event sequence applies in diagonal rounds, so
+Python-level work per batch is O(max per-key multiplicity × stages),
+not O(records).
+
+Patterns outside the shape (loops, optional, negation, skip-till
+contiguity, binary conditions) run the scalar NFA unchanged — the gate
+is `pattern_vectorizable`.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from flink_tpu.cep.pattern import STRICT, Pattern
+from flink_tpu.streaming.generic_agg import columnify, _value_struct
+
+__all__ = ["pattern_vectorizable", "VectorizedStrictNFA"]
+
+
+def pattern_vectorizable(pattern: Pattern) -> bool:
+    """True when the pattern is a STRICT chain of single-event,
+    non-negated, unary-condition stages (the shape whose NFA state is
+    one run per stage)."""
+    from flink_tpu.cep.pattern import _is_binary
+    for i, st in enumerate(pattern.stages):
+        if st.negated or st.optional or st.greedy:
+            return False
+        if st.min_times != 1 or st.max_times != 1:
+            return False
+        if i > 0 and st.contiguity != STRICT:
+            return False
+        for group in st.conditions:
+            for cond in group:
+                if _is_binary(cond):
+                    return False
+    return True
+
+
+class _EventLog:
+    """Append-only store of event rows referenced by partial runs;
+    compacts by keeping only still-referenced rows.  Rows arrive as
+    Python objects or as column chunks (the columnar ingest keeps
+    per-event Python out of the hot path; tuples materialize only at
+    match emission)."""
+
+    def __init__(self):
+        self.rows: List[Any] = []          # object rows, or None
+        self.chunks: List[tuple] = []      # (start_gid, cols, vspec)
+        self.base = 0                      # global id of rows[0]
+        self.columnar = False
+
+    def append_batch(self, rows) -> int:
+        start = self.base + len(self.rows)
+        self.rows.extend(rows)
+        return start
+
+    def append_cols(self, cols, vspec, n: int) -> int:
+        self.columnar = True
+        start = (self.chunks[-1][0] + len(self.chunks[-1][1][0])
+                 if self.chunks else self.base)
+        self.chunks.append((start, cols, vspec))
+        return start
+
+    def get(self, gid: int):
+        if not self.columnar:
+            return self.rows[gid - self.base]
+        import bisect
+        i = bisect.bisect_right(
+            [c[0] for c in self.chunks], gid) - 1
+        start, cols, vspec = self.chunks[i]
+        j = gid - start
+        if vspec == "scalar":
+            return cols[0][j]
+        kind, _ = vspec
+        mk = tuple if kind == "tuple" else list
+        return mk(c[j] for c in cols)
+
+    def compact(self, referenced: np.ndarray) -> None:
+        """Drop rows below the smallest referenced global id (simple
+        watermark compaction: references only grow forward)."""
+        if self.columnar:
+            if not self.chunks:
+                return
+            lo = (int(referenced.min()) if len(referenced)
+                  else self.chunks[-1][0] + len(self.chunks[-1][1][0]))
+            self.chunks = [c for c in self.chunks
+                           if c[0] + len(c[1][0]) > lo]
+            return
+        if not len(referenced):
+            self.base += len(self.rows)
+            self.rows = []
+            return
+        lo = int(referenced.min())
+        drop = lo - self.base
+        if drop > 0:
+            del self.rows[:drop]
+            self.base = lo
+
+
+class VectorizedStrictNFA:
+    """Keyed, batched executor for a vectorizable pattern.
+
+    State arrays are slot-indexed (key → slot through a dict; dense
+    integer keys could ride the native index, but the state arrays
+    dominate).  For stage s in 1..k-1:
+      active[s][slot]   — a run waits to match stage s
+      start[s][slot]    — its start timestamp (within() expiry)
+      refs[s][j][slot]  — global event id matched for stage j < s
+    """
+
+    def __init__(self, pattern: Pattern, capacity: int = 1 << 12):
+        if not pattern_vectorizable(pattern):
+            raise ValueError("pattern is not vectorizable "
+                             "(see pattern_vectorizable)")
+        pattern.validate()
+        self.pattern = pattern
+        self.k = len(pattern.stages)
+        self.within = pattern.within_ms
+        self._index: Dict[Any, int] = {}
+        self._nat_index = None
+        self._nat_state = None
+        self._slot_keys: List[Any] = []
+        n0 = capacity
+        k = self.k
+        self.active = [np.zeros(n0, bool) for _ in range(k)]
+        self.start = [np.zeros(n0, np.int64) for _ in range(k)]
+        self.refs = [[np.zeros(n0, np.int64) for _ in range(s)]
+                     for s in range(k)]
+        self.log = _EventLog()
+        #: condition evaluation mode, probed on the first batch:
+        #: "lifted" (column masks) | "scalar" (per-row loop)
+        self.mode: Optional[str] = None
+        self.matches: List[Tuple[Any, Dict[str, List[Any]]]] = []
+        self.num_timeouts = 0
+
+    # ---- slots ------------------------------------------------------
+    def _slots_of(self, keys: np.ndarray) -> np.ndarray:
+        """key → dense slot; 64-bit integer keys ride the C++
+        open-addressing index in one vectorized probe (splitmix64 is a
+        bijection, so the hash IS the key), others a Python dict."""
+        if keys.dtype in (np.dtype(np.uint64), np.dtype(np.int64)):
+            import flink_tpu.native as nat
+            if nat.available():
+                if self._nat_index is None:
+                    self._nat_index = nat.NativeSlotIndex()
+                h = nat.splitmix64(keys.view(np.uint64))
+                slot_keys = self._slot_keys
+
+                def alloc(n_new, base=len(slot_keys)):
+                    return np.arange(base, base + n_new)
+
+                slots, _, first_idx = \
+                    self._nat_index.lookup_or_insert(h, alloc)
+                if len(first_idx):
+                    slot_keys.extend(keys[first_idx].tolist())
+                    while len(slot_keys) > len(self.active[0]):
+                        self._grow()
+                return slots
+        if self._nat_index is not None:
+            raise TypeError(
+                "key type changed mid-stream (integer keys locked the "
+                "native slot index); CEP keys must keep one type")
+        index = self._index
+        slot_keys = self._slot_keys
+        out = np.empty(len(keys), np.int64)
+        for i, key in enumerate(keys.tolist()):
+            s = index.get(key)
+            if s is None:
+                s = index[key] = len(slot_keys)
+                slot_keys.append(key)
+                if s >= len(self.active[0]):
+                    self._grow()
+            out[i] = s
+        return out
+
+    def _grow(self):
+        n2 = 2 * len(self.active[0])
+
+        def g(a, fill=False):
+            b = np.zeros(n2, a.dtype)
+            b[:len(a)] = a
+            return b
+        self.active = [g(a) for a in self.active]
+        self.start = [g(a) for a in self.start]
+        self.refs = [[g(a) for a in stage] for stage in self.refs]
+
+    # ---- condition masks --------------------------------------------
+    def _stage_masks(self, cols, vspec, rows, n: int) -> List[np.ndarray]:
+        """Per-stage boolean masks over the batch (mode must be
+        probed; ``rows`` must cover all n rows in scalar mode)."""
+        stages = self.pattern.stages
+        if self.mode == "lifted":
+            vs = _value_struct(cols, vspec)
+            return [self._eval_stage_lifted(st, vs, n) for st in stages]
+        masks = []
+        for st in stages:
+            m = np.empty(n, bool)
+            for i in range(n):
+                m[i] = st.accepts(rows[i], {})
+            masks.append(m)
+        return masks
+
+    @staticmethod
+    def _eval_stage_lifted(stage, vs, n: int) -> np.ndarray:
+        out = np.ones(n, bool)
+        for group in stage.conditions:
+            g = np.zeros(n, bool)
+            for cond in group:
+                r = np.asarray(cond(vs))
+                if r.shape != (n,):
+                    r = np.broadcast_to(np.asarray(r, bool), (n,))
+                g |= r.astype(bool)
+            out &= g
+        return out
+
+    def _probe(self, cols, vspec, rows, n: int) -> None:
+        """Lift the conditions if column evaluation matches the scalar
+        truth on a sample (same contract as LiftedAggregate.probe)."""
+        if vspec is None or cols is None:
+            self.mode = "scalar"
+            return
+        m = min(64, n)
+        sample_cols = [c[:m] for c in cols]
+        try:
+            vs = _value_struct(sample_cols, vspec)
+            for st in self.pattern.stages:
+                lifted = self._eval_stage_lifted(st, vs, m)
+                want = np.asarray([st.accepts(rows[i], {})
+                                   for i in range(m)], bool)
+                if not np.array_equal(lifted, want):
+                    raise ValueError("condition mask disagrees")
+        except Exception:
+            self.mode = "scalar"
+            return
+        self.mode = "lifted"
+
+    @staticmethod
+    def log_sample_row(cols, vspec, i: int):
+        if vspec == "scalar":
+            return cols[0][i]
+        kind, _ = vspec
+        mk = tuple if kind == "tuple" else list
+        return mk(c[i] for c in cols)
+
+    # ---- batched advance --------------------------------------------
+    def advance_batch(self, keys: np.ndarray, ts: np.ndarray,
+                      rows: Optional[List[Any]] = None,
+                      cols=None, vspec=None) -> None:
+        """Feed a batch (per-key event order = batch order).  Matches
+        accumulate on self.matches as (key, {stage: [event]}, ts).
+        Events come either as Python ``rows`` or pre-columnified
+        ``cols``+``vspec`` (the columnar ingest — per-event Python
+        stays off the hot path)."""
+        n = len(keys)
+        if n == 0:
+            return
+        keys = np.asarray(keys)
+        ts = np.asarray(ts, np.int64)
+        if cols is None:
+            cols, vspec = columnify(rows)
+            base_gid = self.log.append_batch(rows)
+        else:
+            base_gid = self.log.append_cols(cols, vspec, n)
+        if self.mode is None:
+            sample = (rows[:64] if rows is not None else
+                      [self.log_sample_row(cols, vspec, i)
+                       for i in range(min(64, n))])
+            self._probe(cols, vspec, sample, len(sample))
+        if self.mode == "scalar" and rows is None:
+            rows = [self.log_sample_row(cols, vspec, i)
+                    for i in range(n)]
+        masks = self._stage_masks(cols, vspec, rows, n)
+
+        # fused native path: pack the stage masks into per-row bits
+        # and let the C++ kernel group + walk + match in one pass
+        # (ft_cep_advance; state lives native across batches)
+        import flink_tpu.native as nat
+        int_keys = keys.dtype in (np.dtype(np.uint64),
+                                  np.dtype(np.int64))
+        if self._nat_state is not None and not int_keys:
+            raise TypeError(
+                "key type changed mid-stream (integer keys locked the "
+                "native CEP state); CEP keys must keep one type")
+        if int_keys and nat.available() and self._numpy_state_empty():
+            if self._nat_state is None:
+                self._nat_state = nat.NativeCepState(
+                    self.k, -1 if self.within is None else self.within)
+            bits = masks[0].astype(np.uint32)
+            for s in range(1, self.k):
+                bits |= masks[s].astype(np.uint32) << np.uint32(s)
+            refs, pos = self._nat_state.advance(
+                keys.view(np.uint64), bits, ts, base_gid)
+            if len(pos):
+                pk = keys[pos]
+                pt = ts[pos]
+                names = [st.name for st in self.pattern.stages]
+                log = self.log
+                for i in range(len(pos)):
+                    events = {}
+                    for j, name in enumerate(names):
+                        events.setdefault(name, []).append(
+                            log.get(int(refs[i, j])))
+                    self.matches.append((int(pk[i]) if pk.dtype.kind
+                                         in "iu" else pk[i], events,
+                                         int(pt[i])))
+            self._maybe_compact_native()
+            return
+
+        slots = self._slots_of(keys)
+
+        # group by key keeping arrival order
+        from flink_tpu.streaming.generic_agg import (
+            _segments,
+            _stable_argsort,
+        )
+        if keys.dtype in (np.dtype(np.uint64), np.dtype(np.int64)) \
+                and nat.available():
+            u = (keys.view(np.uint64) ^ np.uint64(1 << 63)
+                 if keys.dtype == np.dtype(np.int64) else keys)
+            order, seg_starts, seg_lens, _ = nat.fold_prep(u)
+        else:
+            order = _stable_argsort(
+                keys if keys.dtype.kind in "iufUS"
+                else np.asarray([hash(key) for key in keys.tolist()]))
+            skeys = keys[order]
+            seg_starts, seg_lens = _segments(skeys)
+
+        # STRICT chains are LOCAL: a full in-batch match at sorted
+        # position p is simply AND_s masks[s] at p-(k-1)+s within one
+        # segment, with the within() bound against the stage-a event —
+        # pure shifted-mask algebra, no per-event state walk.  Only
+        # the first/last (k-1) rows of each segment touch the carried
+        # per-key state.
+        k = self.k
+        within = self.within
+        ms = [m[order] for m in masks]          # sorted-space masks
+        ts_s = ts[order]
+        gid_s = base_gid + order
+        # fold_prep emits segments length-descending; the offset
+        # computation needs them in POSITIONAL order
+        pos_perm = np.argsort(seg_starts)
+        starts_p = seg_starts[pos_perm]
+        lens_p = seg_lens[pos_perm]
+        offset = np.arange(n) - np.repeat(starts_p, lens_p)
+
+        match = ms[k - 1].copy()
+        for j in range(1, k):
+            match[j:] &= ms[k - 1 - j][:-j]
+        if k > 1:
+            match &= offset >= k - 1
+            if within is not None:
+                ta = np.empty(n, np.int64)
+                ta[k - 1:] = ts_s[:n - (k - 1)]
+                ta[:k - 1] = 0
+                for j in range(1, k):
+                    # step j's event time minus the run start (rows
+                    # arrive watermark-ordered, so per-key ts is
+                    # non-decreasing within the batch)
+                    step_t = np.empty(n, np.int64)
+                    d = (k - 1) - j
+                    step_t[d:] = ts_s[:n - d] if d else ts_s
+                    step_t[:d] = 0
+                    match[k - 1:] &= (step_t[k - 1:]
+                                      - ta[k - 1:]) < within
+        hits = np.flatnonzero(match)
+        if len(hits):
+            self._emit(slots[order[hits]], gid_s[hits],
+                       [gid_s[hits - (k - 1) + j]
+                        for j in range(k - 1)], ts_s[hits])
+
+        # boundary matches: a carried run at stage s0 = k-1-d completes
+        # at the segment's row d after matching rows 0..d
+        if k > 1:
+            firsts = seg_starts
+            fslots = slots[order[firsts]]
+            for d in range(0, k - 1):
+                s0 = k - 1 - d
+                segs = np.flatnonzero(seg_lens > d)
+                if not len(segs):
+                    break
+                p0 = firsts[segs]
+                sl = fslots[segs]
+                ok = self.active[s0][sl].copy()
+                if within is not None:
+                    st0 = self.start[s0][sl]
+                for j in range(d + 1):
+                    ok &= ms[s0 + j][p0 + j]
+                    if within is not None:
+                        ok &= (ts_s[p0 + j] - st0) < within
+                if ok.any():
+                    w = np.flatnonzero(ok)
+                    refs_cols = [self.refs[s0][j][sl[w]]
+                                 for j in range(s0)]
+                    refs_cols += [gid_s[p0[w] + j] for j in range(d)]
+                    self._emit(sl[w], gid_s[p0[w] + d], refs_cols,
+                               ts_s[p0[w] + d])
+
+        # output state per segment: the run waiting at stage s_out
+        # after the batch either starts fully in-batch (L >= s_out) or
+        # is a carried run extended through ALL L rows (L < s_out)
+        if k > 1:
+            lasts = seg_starts + seg_lens - 1
+            lslots = slots[order[lasts]]
+            new_active = [None] * k
+            new_start = [None] * k
+            new_refs = [[None] * s for s in range(k)]
+            for s_out in range(1, k):
+                n_seg = len(seg_starts)
+                act = np.zeros(n_seg, bool)
+                stt = np.zeros(n_seg, np.int64)
+                rfs = [np.zeros(n_seg, np.int64) for _ in range(s_out)]
+                # in-batch: started at row L - s_out
+                ib = np.flatnonzero(seg_lens >= s_out)
+                if len(ib):
+                    pstart = lasts[ib] - (s_out - 1)
+                    okb = np.ones(len(ib), bool)
+                    for j in range(s_out):
+                        okb &= ms[j][pstart + j]
+                        if within is not None:
+                            okb &= (ts_s[pstart + j]
+                                    - ts_s[pstart]) < within
+                    act[ib] = okb
+                    stt[ib] = ts_s[pstart]
+                    for j in range(s_out):
+                        rfs[j][ib] = gid_s[pstart + j]
+                # carried-extended: L < s_out rows all matched
+                for lcase in range(1, s_out):
+                    cs = np.flatnonzero(seg_lens == lcase)
+                    if not len(cs):
+                        continue
+                    s0 = s_out - lcase
+                    p0 = seg_starts[cs]
+                    sl = slots[order[p0]]
+                    okc = self.active[s0][sl].copy()
+                    st0 = self.start[s0][sl]
+                    for j in range(lcase):
+                        okc &= ms[s0 + j][p0 + j]
+                        if within is not None:
+                            okc &= (ts_s[p0 + j] - st0) < within
+                    act[cs] = okc
+                    stt[cs] = st0
+                    for j in range(s0):
+                        rfs[j][cs] = self.refs[s0][j][sl]
+                    for j in range(lcase):
+                        rfs[s0 + j][cs] = gid_s[p0 + j]
+                new_active[s_out] = act
+                new_start[s_out] = stt
+                new_refs[s_out] = rfs
+            # write back per segment (one write per key in the batch)
+            lslots_all = slots[order[seg_starts]]
+            for s_out in range(1, k):
+                self.active[s_out][lslots_all] = new_active[s_out]
+                self.start[s_out][lslots_all] = new_start[s_out]
+                for j in range(s_out):
+                    self.refs[s_out][j][lslots_all] = \
+                        new_refs[s_out][j]
+        self._maybe_compact()
+
+    def _emit(self, slots, gids, ref_cols, ts):
+        names = [st.name for st in self.pattern.stages]
+        log = self.log
+        slot_keys = self._slot_keys
+        for i in range(len(slots)):
+            events = {}
+            for j, name in enumerate(names[:-1]):
+                events.setdefault(name, []).append(
+                    log.get(int(ref_cols[j][i])))
+            events.setdefault(names[-1], []).append(
+                log.get(int(gids[i])))
+            self.matches.append((slot_keys[int(slots[i])], events,
+                                 int(ts[i])))
+
+    def _numpy_state_empty(self) -> bool:
+        """The native and numpy state paths are exclusive; the numpy
+        arrays must be untouched before the native path engages (key
+        dtype is stable on keyed streams, so in practice one path is
+        chosen on the first batch)."""
+        return not self._slot_keys
+
+    def _maybe_compact_native(self):
+        if self._log_span() < (1 << 20):
+            return
+        lo = self._nat_state.min_ref()   # one sequential C++ scan
+        self.log.compact(np.asarray([lo], np.int64)
+                         if lo < (1 << 62) else np.zeros(0, np.int64))
+
+    def _log_span(self) -> int:
+        if self.log.columnar:
+            if not self.log.chunks:
+                return 0
+            return (self.log.chunks[-1][0]
+                    + len(self.log.chunks[-1][1][0])
+                    - self.log.chunks[0][0])
+        return len(self.log.rows)
+
+    def _maybe_compact(self):
+        if self._log_span() < (1 << 16):
+            return
+        refs = [self.refs[s][j][:len(self._slot_keys)]
+                [self.active[s][:len(self._slot_keys)]]
+                for s in range(1, self.k)
+                for j in range(s)]
+        referenced = (np.concatenate(refs) if refs
+                      else np.zeros(0, np.int64))
+        self.log.compact(referenced)
+
+    # ---- checkpoint --------------------------------------------------
+    def snapshot(self) -> dict:
+        n = len(self._slot_keys)
+        nat_state = None
+        if self._nat_state is not None:
+            keys, active, cold = self._nat_state.export()
+            nat_state = {"keys": keys, "active": active,
+                         "cold": cold, "within": self.within}
+        return {
+            "nat_state": nat_state,
+            "keys": list(self._slot_keys),
+            "active": [a[:n].copy() for a in self.active],
+            "start": [s[:n].copy() for s in self.start],
+            "refs": [[r[:n].copy() for r in st] for st in self.refs],
+            "log_rows": list(self.log.rows),
+            "log_base": self.log.base,
+            "log_chunks": list(self.log.chunks),
+            "log_columnar": self.log.columnar,
+            "mode": self.mode,
+            "num_timeouts": self.num_timeouts,
+        }
+
+    def restore(self, snap: dict) -> None:
+        keys = snap["keys"]
+        self._slot_keys = list(keys)
+        self._index = {k2: i for i, k2 in enumerate(keys)}
+        self._nat_index = None
+        if keys and isinstance(keys[0], int):
+            import flink_tpu.native as nat
+            if nat.available():
+                arr = np.asarray(keys, np.int64).view(np.uint64)
+                self._nat_index = nat.NativeSlotIndex()
+                self._nat_index.set_bulk(
+                    nat.splitmix64(arr),
+                    np.arange(len(keys), dtype=np.int64))
+        n = max(len(keys), 1 << 12)
+        k = self.k
+
+        def fit(a):
+            b = np.zeros(n, a.dtype)
+            b[:len(a)] = a
+            return b
+        self.active = [fit(a) for a in snap["active"]]
+        self.start = [fit(s) for s in snap["start"]]
+        self.refs = [[fit(r) for r in st] for st in snap["refs"]]
+        self.log = _EventLog()
+        self.log.rows = list(snap["log_rows"])
+        self.log.base = snap["log_base"]
+        self.log.chunks = list(snap.get("log_chunks", ()))
+        self.log.columnar = snap.get("log_columnar", False)
+        self.mode = snap["mode"]
+        self.num_timeouts = snap["num_timeouts"]
+        self._nat_state = None
+        ns = snap.get("nat_state")
+        if ns is not None:
+            import flink_tpu.native as nat
+            if not nat.available():
+                raise RuntimeError(
+                    "checkpoint was taken on the native CEP state "
+                    "path; restoring requires the native runtime")
+            self._nat_state = nat.NativeCepState(
+                self.k, -1 if self.within is None else self.within,
+                capacity=max(2 * len(ns["keys"]), 1 << 12))
+            self._nat_state.import_(ns["keys"], ns["active"],
+                                    ns["cold"])

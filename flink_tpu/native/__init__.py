@@ -46,6 +46,7 @@ def _ensure_loaded() -> Optional[ctypes.CDLL]:
         i32p = np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")
         f64p = np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS")
         f32p = np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS")
+        u32p = np.ctypeslib.ndpointer(np.uint32, flags="C_CONTIGUOUS")
         c = ctypes
         lib.ft_splitmix64.argtypes = [u64p, u64p, c.c_int64]
         lib.ft_key_groups.argtypes = [u64p, i32p, c.c_int64, c.c_int32,
@@ -60,6 +61,29 @@ def _ensure_loaded() -> Optional[ctypes.CDLL]:
             u64p, f32p, c.c_int64, c.c_int64]
         lib.ft_heap_tumbling_lse_baseline.restype = c.c_double
         lib.ft_argsort_u64.argtypes = [u64p, c.c_int64, i64p]
+        lib.ft_cep_new.argtypes = [c.c_int64, c.c_int64, c.c_int64]
+        lib.ft_cep_new.restype = c.c_void_p
+        lib.ft_cep_free.argtypes = [c.c_void_p]
+        lib.ft_cep_advance.argtypes = [
+            c.c_void_p, u64p, u32p, i64p, c.c_int64, c.c_int64,
+            i64p, i64p, c.c_int64]
+        lib.ft_cep_advance.restype = c.c_int64
+        lib.ft_cep_advance_seq.argtypes = [
+            c.c_void_p, u64p, u32p, i64p, c.c_int64, c.c_int64,
+            i64p, i64p, c.c_int64]
+        lib.ft_cep_advance_seq.restype = c.c_int64
+        lib.ft_cep_size.argtypes = [c.c_void_p]
+        lib.ft_cep_size.restype = c.c_int64
+        lib.ft_cep_min_ref.argtypes = [c.c_void_p]
+        lib.ft_cep_min_ref.restype = c.c_int64
+        lib.ft_cep_export.argtypes = [c.c_void_p, u64p, u32p, i64p]
+        lib.ft_cep_export.restype = c.c_int64
+        lib.ft_cep_import.argtypes = [c.c_void_p, u64p, u32p, i64p,
+                                      c.c_int64]
+        lib.ft_cep_strict_baseline.argtypes = [
+            u64p, f64p, i64p, c.c_int64, c.c_double, c.c_double,
+            c.c_double, c.c_int64, c.c_int64, c.POINTER(c.c_int64)]
+        lib.ft_cep_strict_baseline.restype = c.c_double
         lib.ft_fold_prep.argtypes = [u64p, c.c_int64, i64p, i64p, i64p,
                                      u64p]
         lib.ft_fold_prep.restype = c.c_int64
@@ -118,7 +142,6 @@ def _ensure_loaded() -> Optional[ctypes.CDLL]:
             u64p, u16p, c.c_int64, c.c_int, f64p, c.c_int,
             c.c_double, c.c_int64, c.c_double, u64p, f64p]
         lib.ft_qsketch_log_fire.restype = c.c_int64
-        u32p = np.ctypeslib.ndpointer(np.uint32, flags="C_CONTIGUOUS")
         lib.ft_qsketch_log_fire2.argtypes = [
             u64p, u16p, u32p, c.c_int64, c.c_int, f64p, c.c_int,
             c.c_double, c.c_int64, c.c_double, u64p, f64p]
@@ -567,6 +590,101 @@ def heap_tumbling_lse_baseline(kh: np.ndarray, values: np.ndarray,
         np.ascontiguousarray(kh, np.uint64),
         np.ascontiguousarray(values, np.float32), n, cap)
     return n / elapsed
+
+
+class NativeCepState:
+    """Persistent keyed strict-chain NFA state + fused batched advance
+    (the C++ hot path of cep/vectorized.py): group-by-key, walk each
+    key's run with carried state, emit match event ids.  Conditions
+    arrive pre-evaluated as packed per-row stage bitmasks."""
+
+    __slots__ = ("_h", "k", "_out")
+
+    def __init__(self, k: int, within: int = -1,
+                 capacity: int = 1 << 12):
+        if k > 16:
+            raise ValueError("at most 16 stages")
+        lib = _ensure_loaded()
+        cap = _pow2_at_least(capacity)
+        self.k = k
+        self._h = lib.ft_cep_new(k, within, cap)
+
+    def __del__(self):
+        if _lib is not None and getattr(self, "_h", None):
+            _lib.ft_cep_free(self._h)
+            self._h = None
+
+    def advance(self, kh: np.ndarray, mask_bits: np.ndarray,
+                ts: np.ndarray, base_gid: int):
+        """→ (match_refs [m, k] global event ids, match_rows [m]
+        batch positions).  Variant selection: batches with high
+        rows-per-key ratio amortize the grouped walk\'s sort; low-
+        multiplicity batches probe per event instead (the sort would
+        cost more than the state misses it saves)."""
+        n = len(kh)
+        # reuse the out buffers: a fresh 8B*k*n allocation per batch
+        # page-faults more than the advance itself costs
+        buf = getattr(self, "_out", None)
+        if buf is None or len(buf[1]) < n:
+            buf = (np.empty(n * self.k, np.int64),
+                   np.empty(n, np.int64))
+            self._out = buf
+        out_refs, out_pos = buf
+        known = max(_lib.ft_cep_size(self._h), 1)
+        fn = (_lib.ft_cep_advance if n >= 8 * known
+              else _lib.ft_cep_advance_seq)
+        m = fn(self._h, np.ascontiguousarray(kh, np.uint64),
+               np.ascontiguousarray(mask_bits, np.uint32),
+               np.ascontiguousarray(ts, np.int64), n, base_gid,
+               out_refs, out_pos, n)
+        if m < 0:  # cannot happen with max_matches=n (<=1 match/row)
+            raise RuntimeError("CEP match buffer overflow")
+        return out_refs[:m * self.k].reshape(m, self.k), out_pos[:m]
+
+    @property
+    def cold_w(self) -> int:
+        k = self.k
+        return (k - 1) + k * (k - 1) // 2
+
+    def export(self):
+        n = _lib.ft_cep_size(self._h)
+        w = self.cold_w
+        keys = np.empty(n, np.uint64)
+        active = np.empty(n, np.uint32)
+        cold = np.empty(n * w, np.int64)
+        m = _lib.ft_cep_export(self._h, keys, active, cold)
+        return keys[:m], active[:m], cold[:m * w].reshape(m, w)
+
+    def min_ref(self) -> int:
+        """Smallest event id an active run still references (log
+        compaction watermark); 2^63-1 when no runs are active."""
+        return _lib.ft_cep_min_ref(self._h)
+
+    def import_(self, keys, active, cold) -> None:
+        m = len(keys)
+        _lib.ft_cep_import(
+            self._h, np.ascontiguousarray(keys, np.uint64),
+            np.ascontiguousarray(active, np.uint32),
+            np.ascontiguousarray(
+                np.asarray(cold).reshape(-1), np.int64), m)
+
+
+def cep_strict_baseline(kh: np.ndarray, values: np.ndarray,
+                        ts: np.ndarray, t0: float, t1: float,
+                        t2: float, within: int = -1,
+                        capacity=None):
+    """Per-record strict-chain CEP over heap keyed state, compiled.
+    Returns (records/second, match_count)."""
+    lib = _ensure_loaded()
+    n = len(kh)
+    cap = _pow2_at_least(capacity or 2 * n)
+    out = ctypes.c_int64(0)
+    elapsed = lib.ft_cep_strict_baseline(
+        np.ascontiguousarray(kh, np.uint64),
+        np.ascontiguousarray(values, np.float64),
+        np.ascontiguousarray(ts, np.int64), n,
+        t0, t1, t2, within, cap, ctypes.byref(out))
+    return n / elapsed, out.value
 
 
 def argsort_u64(keys: np.ndarray) -> np.ndarray:

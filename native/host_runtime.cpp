@@ -1046,6 +1046,383 @@ double ft_heap_tumbling_lse_baseline(const uint64_t* kh,
   return now_s() - t0;
 }
 
+// CEP baseline (bench config cep): per-record strict-chain NFA over
+// heap keyed state — probe the key, evaluate the three stage
+// conditions, shift the per-key run vector, record matched-event
+// indices (the per-record work of the reference's keyed NFA operator,
+// flink-cep NFA.java:202-221, minus SharedBuffer versioning — i.e.
+// favorable to the baseline).  k = 3 stages: v < t0, v >= t1,
+// v >= t2, optional within horizon.  Returns elapsed seconds;
+// *out_matches gets the match count (correctness cross-check).
+double ft_cep_strict_baseline(const uint64_t* kh, const double* values,
+                              const int64_t* ts, int64_t n,
+                              double t0v, double t1v, double t2v,
+                              int64_t within, int64_t capacity_pow2,
+                              int64_t* out_matches) {
+  ProbeTable table(capacity_pow2);
+  struct St {
+    uint8_t active1, active2;   // run waiting at stage 1 / stage 2
+    int64_t start1, start2;
+    int64_t ref1_a;             // stage-a event of the stage-1 run
+    int64_t ref2_a, ref2_b;     // events of the stage-2 run
+  };
+  std::vector<St> st(capacity_pow2, St{0, 0, 0, 0, 0, 0, 0});
+  volatile int64_t sink = 0;
+  int64_t matches = 0;
+  double t0 = now_s();
+  for (int64_t i = 0; i < n; ++i) {
+    int64_t s = table.get_or_insert(kh[i]);
+    St& a = st[s];
+    double v = values[i];
+    int64_t t = ts[i];
+    if (within >= 0) {
+      if (a.active1 && t - a.start1 >= within) a.active1 = 0;
+      if (a.active2 && t - a.start2 >= within) a.active2 = 0;
+    }
+    bool m0 = v < t0v, m1 = v >= t1v, m2 = v >= t2v;
+    if (a.active2 && m2) {
+      ++matches;
+      sink += a.ref2_a + a.ref2_b + i;
+    }
+    // strict shift
+    if (a.active1 && m1) {
+      a.active2 = 1;
+      a.start2 = a.start1;
+      a.ref2_a = a.ref1_a;
+      a.ref2_b = i;
+    } else {
+      a.active2 = 0;
+    }
+    if (m0) {
+      a.active1 = 1;
+      a.start1 = t;
+      a.ref1_a = i;
+    } else {
+      a.active1 = 0;
+    }
+  }
+  (void)sink;
+  *out_matches = matches;
+  return now_s() - t0;
+}
+
+// ---- vectorized CEP advance (cep/vectorized.py hot path) ------------------
+// Persistent keyed state + one fused advance: group the batch by key
+// (counting scatter co-locating mask/ts/row), then walk each key's
+// run SEQUENTIALLY with the carried state — per-key state is touched
+// once per key per batch instead of once per record, which is where
+// the per-record baseline's cache misses go.  Conditions arrive as a
+// packed bitmask per row (bit s = stage s condition holds), computed
+// vectorized in numpy from the user's Python conditions.
+struct FtCepState {
+  int k;
+  int64_t within;             // -1 = none
+  int64_t cap;                // slots capacity (pow2 probe table)
+  std::vector<uint64_t> hash; // probe table: splitmix64(key) -> slot
+  std::vector<int64_t> slot_of;
+  int64_t next_slot;
+  // split hot/cold layout: the active bitmask alone decides whether
+  // the cold row (starts + refs) is touched at all — most keys in a
+  // sparse-condition stream stay 0 -> 0 and never load it
+  std::vector<uint32_t> active;
+  std::vector<int64_t> cold;  // per slot: (k-1) starts + k(k-1)/2 refs
+  int cold_w;                 // cold row width
+  FtCepState(int k_, int64_t within_, int64_t cap_)
+      : k(k_), within(within_), cap(cap_), hash(cap_, 0),
+        slot_of(cap_, -1), next_slot(0), active(), cold(),
+        cold_w((k_ - 1) + k_ * (k_ - 1) / 2) {}
+  void rehash() {
+    int64_t cap2 = cap * 2;
+    std::vector<uint64_t> h2(cap2, 0);
+    std::vector<int64_t> s2(cap2, -1);
+    for (int64_t p = 0; p < cap; ++p) {
+      if (hash[p] == 0) continue;
+      uint64_t q = hash[p] & (cap2 - 1);
+      while (h2[q] != 0) q = (q + 1) & (cap2 - 1);
+      h2[q] = hash[p];
+      s2[q] = slot_of[p];
+    }
+    hash.swap(h2);
+    slot_of.swap(s2);
+    cap = cap2;
+  }
+  int64_t get_or_insert(uint64_t h) {
+    if (next_slot * 2 >= cap) rehash();   // load factor < 0.5 always
+    uint64_t p = h & (cap - 1);
+    while (hash[p] != h && hash[p] != 0) p = (p + 1) & (cap - 1);
+    if (hash[p] == 0) {
+      hash[p] = h;
+      slot_of[p] = next_slot++;
+      if (next_slot > static_cast<int64_t>(active.size())) {
+        active.resize(next_slot * 2, 0);
+        cold.resize(static_cast<size_t>(next_slot) * 2 * cold_w, 0);
+      }
+    }
+    return slot_of[p];
+  }
+  // cold row accessors: start of stage s (1..k-1) at [s-1];
+  // refs of stage s at (k-1) + s(s-1)/2 .. + s
+  int64_t* cold_row(int64_t slot) { return &cold[slot * cold_w]; }
+};
+
+static inline uint64_t ft_splitmix1(uint64_t x) {
+  x += 0x9E3779B97F4A7C15ull;
+  x = (x ^ (x >> 30)) * 0xBF58476D1CE4E5B9ull;
+  x = (x ^ (x >> 27)) * 0x94D049BB133111EBull;
+  return x ^ (x >> 31);
+}
+
+void* ft_cep_new(int64_t k, int64_t within, int64_t capacity_pow2) {
+  return new FtCepState(static_cast<int>(k), within, capacity_pow2);
+}
+
+void ft_cep_free(void* h) { delete static_cast<FtCepState*>(h); }
+
+// Advance over one batch.  keys are the RAW key bit patterns — the
+// sort runs on them (adaptive radix: small domains sort in one
+// counting pass) while the state probe hashes them inline.
+// Match output: k global event ids per match (row-major) + the match
+// row's original batch position.  Returns the match count.
+int64_t ft_cep_advance(void* handle, const uint64_t* kh,
+                       const uint32_t* mask_bits, const int64_t* ts,
+                       int64_t n, int64_t base_gid,
+                       int64_t* out_refs, int64_t* out_pos,
+                       int64_t max_matches) {
+  FtCepState& st = *static_cast<FtCepState*>(handle);
+  const int k = st.k;
+  const int km1 = k - 1;
+  const int64_t within = st.within;
+  if (n == 0) return 0;
+  struct KIdx {
+    uint64_t key;
+    int64_t idx;
+  };
+  static thread_local std::unique_ptr<KIdx[]> tl_buf, tl_scratch;
+  static thread_local int64_t tl_cap = 0;
+  if (n > tl_cap) {
+    int64_t c = 1;
+    while (c < n) c <<= 1;
+    tl_buf.reset(new KIdx[c]);
+    tl_scratch.reset(new KIdx[c]);
+    tl_cap = c;
+  }
+  KIdx* buf = tl_buf.get();
+  for (int64_t i = 0; i < n; ++i) buf[i] = KIdx{kh[i], i};
+  KIdx* sorted = radix_sort_by_key(buf, tl_scratch.get(), n);
+
+  auto ref_at = [&](int64_t* row, int s, int j) -> int64_t& {
+    return row[km1 + s * (s - 1) / 2 + j];
+  };
+  int64_t n_matches = 0;
+  int64_t i = 0;
+  int64_t start_loc[16];
+  int64_t refs_loc[16 * 16];
+  while (i < n) {
+    uint64_t key = sorted[i].key;
+    int64_t slot = st.get_or_insert(ft_splitmix1(key));
+    uint32_t a_loc = st.active[slot];
+    const bool was_active = a_loc != 0;
+    if (was_active) {
+      int64_t* row = st.cold_row(slot);
+      for (int s = 1; s < k; ++s) {
+        start_loc[s] = row[s - 1];
+        for (int j = 0; j < s; ++j)
+          refs_loc[s * k + j] = ref_at(row, s, j);
+      }
+    }
+    for (; i < n && sorted[i].key == key; ++i) {
+      int64_t rowi = sorted[i].idx;
+      uint32_t m = mask_bits[rowi];
+      if (a_loc == 0 && (m & 1) == 0) continue;  // nothing can move
+      int64_t t = ts[rowi];
+      int64_t gid = base_gid + rowi;
+      if (within >= 0 && a_loc) {
+        for (int s = 1; s < k; ++s)
+          if (((a_loc >> s) & 1) && t - start_loc[s] >= within)
+            a_loc &= ~(1u << s);
+      }
+      if (k >= 2 && ((a_loc >> km1) & 1) && ((m >> km1) & 1)) {
+        if (n_matches >= max_matches) return -1;
+        int64_t* o = out_refs + n_matches * k;
+        for (int j = 0; j < km1; ++j)
+          o[j] = refs_loc[km1 * k + j];
+        o[km1] = gid;
+        out_pos[n_matches++] = rowi;
+      } else if (k == 1 && (m & 1)) {
+        if (n_matches >= max_matches) return -1;
+        out_refs[n_matches * k] = gid;
+        out_pos[n_matches++] = rowi;
+      }
+      uint32_t new_a = 0;
+      for (int s = km1; s >= 2; --s) {
+        if (((a_loc >> (s - 1)) & 1) && ((m >> (s - 1)) & 1)) {
+          new_a |= (1u << s);
+          start_loc[s] = start_loc[s - 1];
+          for (int j = 0; j < s - 1; ++j)
+            refs_loc[s * k + j] = refs_loc[(s - 1) * k + j];
+          refs_loc[s * k + (s - 1)] = gid;
+        }
+      }
+      if (k >= 2 && (m & 1)) {
+        new_a |= 2u;
+        start_loc[1] = t;
+        refs_loc[1 * k + 0] = gid;
+      }
+      a_loc = new_a;
+    }
+    // write back; a 0 -> 0 key never touches the cold row
+    if (a_loc || was_active) {
+      st.active[slot] = a_loc;
+      if (a_loc) {
+        int64_t* row = st.cold_row(slot);
+        for (int s = 1; s < k; ++s) {
+          if (!((a_loc >> s) & 1)) continue;
+          row[s - 1] = start_loc[s];
+          for (int j = 0; j < s; ++j)
+            ref_at(row, s, j) = refs_loc[s * k + j];
+        }
+      }
+    }
+  }
+  return n_matches;
+}
+
+// Smallest event id still referenced by an active run (log compaction
+// watermark), or INT64_MAX when no runs are active.  One sequential
+// scan over live slots — cheap enough to run per compaction check.
+// Sequential variant: rows process in arrival order with one probe
+// per event (no sort).  Wins at LOW per-key multiplicity, where the
+// grouped walk cannot amortize its sort; the Python caller picks the
+// variant from the batch's rows-per-key ratio.
+int64_t ft_cep_advance_seq(void* handle, const uint64_t* kh,
+                           const uint32_t* mask_bits, const int64_t* ts,
+                           int64_t n, int64_t base_gid,
+                           int64_t* out_refs, int64_t* out_pos,
+                           int64_t max_matches) {
+  FtCepState& st = *static_cast<FtCepState*>(handle);
+  const int k = st.k;
+  const int km1 = k - 1;
+  const int64_t within = st.within;
+  int64_t n_matches = 0;
+  for (int64_t i = 0; i < n; ++i) {
+    uint32_t m = mask_bits[i];
+    int64_t slot = st.get_or_insert(ft_splitmix1(kh[i]));
+    uint32_t a = st.active[slot];
+    if (a == 0 && (m & 1) == 0) continue;
+    int64_t t = ts[i];
+    int64_t gid = base_gid + i;
+    int64_t* row = st.cold_row(slot);
+    if (within >= 0 && a) {
+      for (int s = 1; s < k; ++s)
+        if (((a >> s) & 1) && t - row[s - 1] >= within)
+          a &= ~(1u << s);
+    }
+    if (k >= 2 && ((a >> km1) & 1) && ((m >> km1) & 1)) {
+      if (n_matches >= max_matches) return -1;
+      int64_t* o = out_refs + n_matches * k;
+      for (int j = 0; j < km1; ++j)
+        o[j] = row[km1 + km1 * (km1 - 1) / 2 + j];
+      o[km1] = gid;
+      out_pos[n_matches++] = i;
+    } else if (k == 1 && (m & 1)) {
+      if (n_matches >= max_matches) return -1;
+      out_refs[n_matches * k] = gid;
+      out_pos[n_matches++] = i;
+    }
+    uint32_t new_a = 0;
+    for (int s = km1; s >= 2; --s) {
+      if (((a >> (s - 1)) & 1) && ((m >> (s - 1)) & 1)) {
+        new_a |= (1u << s);
+        row[s - 1] = row[s - 2];
+        for (int j = 0; j < s - 1; ++j)
+          row[km1 + s * (s - 1) / 2 + j] =
+              row[km1 + (s - 1) * (s - 2) / 2 + j];
+        row[km1 + s * (s - 1) / 2 + (s - 1)] = gid;
+      }
+    }
+    if (k >= 2 && (m & 1)) {
+      new_a |= 2u;
+      row[0] = t;
+      row[km1] = gid;
+    }
+    st.active[slot] = new_a;
+  }
+  return n_matches;
+}
+
+int64_t ft_cep_min_ref(void* handle) {
+  FtCepState& st = *static_cast<FtCepState*>(handle);
+  const int k = st.k;
+  const int km1 = k - 1;
+  int64_t lo = INT64_MAX;
+  for (int64_t slot = 0; slot < st.next_slot; ++slot) {
+    uint32_t a = st.active[slot];
+    if (!a) continue;
+    const int64_t* row = &st.cold[slot * st.cold_w];
+    for (int s = 1; s < k; ++s) {
+      if (!((a >> s) & 1)) continue;
+      for (int j = 0; j < s; ++j) {
+        int64_t r = row[km1 + s * (s - 1) / 2 + j];
+        if (r < lo) lo = r;
+      }
+    }
+  }
+  return lo;
+}
+
+// export / import the keyed state for checkpoints: per live slot the
+// probe hash (keys are recoverable only through it; splitmix64 is a
+// bijection so restore re-probes with the same hashes), active bits,
+// and the cold row (starts + packed refs)
+int64_t ft_cep_export(void* handle, uint64_t* keys_out,
+                      uint32_t* active_out, int64_t* cold_out) {
+  FtCepState& st = *static_cast<FtCepState*>(handle);
+  int64_t m = 0;
+  for (int64_t p = 0; p < st.cap; ++p) {
+    if (st.hash[p] == 0) continue;
+    int64_t slot = st.slot_of[p];
+    keys_out[m] = st.hash[p];
+    active_out[m] = st.active[slot];
+    for (int w = 0; w < st.cold_w; ++w)
+      cold_out[m * st.cold_w + w] = st.cold[slot * st.cold_w + w];
+    ++m;
+  }
+  return m;
+}
+
+int64_t ft_cep_size(void* handle) {
+  return static_cast<FtCepState*>(handle)->next_slot;
+}
+
+void ft_cep_import(void* handle, const uint64_t* keys,
+                   const uint32_t* active, const int64_t* cold,
+                   int64_t m) {
+  FtCepState& st = *static_cast<FtCepState*>(handle);
+  for (int64_t i = 0; i < m; ++i) {
+    // keys here are PROBE HASHES (from export) — insert directly
+    if (st.next_slot * 2 >= st.cap) st.rehash();
+    uint64_t h = keys[i];
+    uint64_t p = h & (st.cap - 1);
+    while (st.hash[p] != h && st.hash[p] != 0)
+      p = (p + 1) & (st.cap - 1);
+    int64_t slot;
+    if (st.hash[p] == 0) {
+      st.hash[p] = h;
+      slot = st.slot_of[p] = st.next_slot++;
+      if (st.next_slot > static_cast<int64_t>(st.active.size())) {
+        st.active.resize(st.next_slot * 2, 0);
+        st.cold.resize(static_cast<size_t>(st.next_slot) * 2
+                       * st.cold_w, 0);
+      }
+    } else {
+      slot = st.slot_of[p];
+    }
+    st.active[slot] = active[i];
+    for (int w = 0; w < st.cold_w; ++w)
+      st.cold[slot * st.cold_w + w] = cold[i * st.cold_w + w];
+  }
+}
+
 // Fused fire-path grouping for the generic-aggregate log tier
 // (flink_tpu/streaming/generic_agg.py): stable radix argsort by key,
 // segment (run) detection, and a LENGTH-DESCENDING segment layout in

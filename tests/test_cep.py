@@ -1,6 +1,7 @@
 """CEP: pattern builder + NFA semantics + stream integration
 (ref: flink-cep NFAITCase/CEPITCase shapes — SURVEY.md §2.5, §2.9)."""
 
+import numpy as np
 import pytest
 
 from flink_tpu.cep import CEP, NFA, Pattern
@@ -255,3 +256,158 @@ def test_cep_timeout_side_output():
     assert main_sink.values == []
     assert len(to_sink.values) == 1
     assert to_sink.values[0] == {"a": [("k", "A")]}
+
+
+# ---------------------------------------------------------------------
+# round 5: vectorized strict-chain NFA (cep/vectorized.py)
+# ---------------------------------------------------------------------
+
+def _strict_pattern(within=None):
+    p = (Pattern.begin("a").where(lambda e: e[1] < 10)
+         .next("b").where(lambda e: 10 <= e[1])
+         .next("c").where(lambda e: e[1] >= 100))
+    return p.within(within) if within else p
+
+
+def _rand_events(n=8000, keys=37, seed=5):
+    rng = np.random.default_rng(seed)
+    return [((int(k), int(v)), t) for t, (k, v) in enumerate(
+        zip(rng.integers(0, keys, n), rng.integers(0, 200, n)))]
+
+
+def _run_cep(events, pattern, vectorized):
+    env = StreamExecutionEnvironment()
+    stream = env.from_collection(events, timestamped=True)
+    stream = stream.key_by(lambda e: e[0])
+    sink = CollectSink()
+    ps = CEP.pattern(stream, pattern)
+    if not vectorized:
+        ps.disable_vectorized()
+    ps.select(lambda m: tuple(tuple(e) for k in ("a", "b", "c")
+                              for e in m[k])).add_sink(sink)
+    env.execute("cep-vec-job")
+    return sorted(sink.values)
+
+
+@pytest.mark.parametrize("within", [None, 40])
+def test_vectorized_equals_scalar(within):
+    events = _rand_events()
+    got = _run_cep(events, _strict_pattern(within), True)
+    want = _run_cep(events, _strict_pattern(within), False)
+    assert got == want and len(got) > 0
+
+
+def test_vectorizable_gate():
+    from flink_tpu.cep.vectorized import pattern_vectorizable
+    assert pattern_vectorizable(_strict_pattern())
+    p = (Pattern.begin("a").where(lambda e: e[1] == 1)
+         .followed_by("b").where(lambda e: e[1] == 2))
+    assert not pattern_vectorizable(p)       # skip-till contiguity
+    p = Pattern.begin("a").where(lambda e: e[1] == 1).times(2)
+    assert not pattern_vectorizable(p)       # loop
+    p = (Pattern.begin("a").where(lambda e: e[1] == 1)
+         .not_next("n").where(lambda e: e[1] == 9))
+    assert not pattern_vectorizable(p)       # negation
+    p = (Pattern.begin("a")
+         .where(lambda e, partial: e[1] == 1))
+    assert not pattern_vectorizable(p)       # binary condition
+
+
+def test_vectorized_scalar_condition_fallback():
+    """Conditions that don't lift (data-dependent Python) keep the
+    batched state machine with per-row masks — same results."""
+    from flink_tpu.cep.vectorized import VectorizedStrictNFA
+
+    def weird(e):
+        # str() defeats numpy lifting
+        return len(str(e[1])) == 1
+
+    p = (Pattern.begin("a").where(weird)
+         .next("b").where(lambda e: e[1] >= 100))
+    eng = VectorizedStrictNFA(p)
+    events = _rand_events(n=2000, keys=11, seed=9)
+    keys = np.asarray([e[0][0] for e in events], np.int64)
+    ts = np.asarray([t for _, t in events], np.int64)
+    rows = [e for e, _ in events]
+    eng.advance_batch(keys, ts, rows)
+    assert eng.mode == "scalar"
+    from flink_tpu.cep.nfa import NFA
+    nfas = {}
+    want = []
+    for (k, v), t in events:
+        nfa = nfas.setdefault(k, NFA(
+            Pattern.begin("a").where(weird)
+            .next("b").where(lambda e: e[1] >= 100)))
+        ms, _ = nfa.advance((k, v), t)
+        want.extend((k, tuple(m["a"][0]), tuple(m["b"][0]))
+                    for m in ms)
+    got = [(k, tuple(m["a"][0]), tuple(m["b"][0]))
+           for k, m, _ in eng.matches]
+    assert sorted(got) == sorted(want) and len(got) > 0
+
+
+def test_vectorized_snapshot_restore_mid_run():
+    from flink_tpu.cep.vectorized import VectorizedStrictNFA
+    events = _rand_events(n=3000, keys=13, seed=3)
+    keys = np.asarray([e[0][0] for e in events], np.int64)
+    ts = np.asarray([t for _, t in events], np.int64)
+    rows = [e for e, _ in events]
+    eng = VectorizedStrictNFA(_strict_pattern(within=60))
+    eng.advance_batch(keys[:1500], ts[:1500], rows[:1500])
+    head = list(eng.matches)
+    snap = eng.snapshot()
+    eng2 = VectorizedStrictNFA(_strict_pattern(within=60))
+    eng2.restore(snap)
+    for e in (eng, eng2):
+        e.advance_batch(keys[1500:], ts[1500:], rows[1500:])
+    tail1 = eng.matches[len(head):]
+    tail2 = eng2.matches
+    norm = lambda ms: sorted(
+        (k, tuple(tuple(x) for s in ("a", "b", "c") for x in m[s]))
+        for k, m, _ in ms)
+    assert norm(tail1) == norm(tail2) and len(tail2) > 0
+
+
+def test_vectorized_numpy_path_differential(monkeypatch):
+    """Force the pure-numpy segment-algebra path (no native lib) and
+    check it against the scalar NFA — the boundary-match and
+    carried-run extension code has no other coverage."""
+    import flink_tpu.native as nat
+    monkeypatch.setattr(nat, "available", lambda: False)
+    from flink_tpu.cep.vectorized import VectorizedStrictNFA
+
+    for within in (None, 40):
+        events = _rand_events(n=6000, keys=23, seed=21)
+        keys = np.asarray([e[0][0] for e in events], np.int64)
+        ts = np.asarray([t for _, t in events], np.int64)
+        rows = [e for e, _ in events]
+        eng = VectorizedStrictNFA(_strict_pattern(within))
+        for i in range(0, len(rows), 700):
+            eng.advance_batch(keys[i:i+700], ts[i:i+700],
+                              rows[i:i+700])
+        assert eng._nat_state is None  # numpy path exercised
+        got = sorted(
+            (k, tuple(tuple(x) for s in ("a", "b", "c")
+                      for x in m[s])) for k, m, _ in eng.matches)
+        from flink_tpu.cep.nfa import NFA
+        nfas = {}
+        want = []
+        for (k, v), t in events:
+            nfa = nfas.setdefault(k, NFA(_strict_pattern(within)))
+            ms, _ = nfa.advance((k, v), t)
+            want.extend(
+                (k, tuple(tuple(x) for s in ("a", "b", "c")
+                          for x in m[s])) for m in ms)
+        assert got == sorted(want) and len(got) > 0
+
+
+def test_vectorized_key_type_change_raises():
+    from flink_tpu.cep.vectorized import VectorizedStrictNFA
+    eng = VectorizedStrictNFA(_strict_pattern())
+    eng.advance_batch(np.array([1, 2], np.int64),
+                      np.array([0, 1], np.int64),
+                      [(1, 5), (2, 6)])
+    with pytest.raises(TypeError):
+        eng.advance_batch(np.array(["a", "b"]),
+                          np.array([2, 3], np.int64),
+                          [("a", 5), ("b", 6)])
