@@ -362,10 +362,25 @@ class GroupedDataSet:
                     acc = fn(acc, x)
                 out.append(acc)
             return out
-        return self.ds._derive("group_reduce", run, detail="hash-group",
-                               dist_keys=(grouped.ks,))
+        def per_group(g, fn=fn):
+            acc = g[0]
+            for x in g[1:]:
+                acc = fn(acc, x)
+            return [acc]
 
-    def reduce_group(self, fn) -> DataSet:
+        node = self.ds._derive("group_reduce", run, detail="hash-group",
+                               dist_keys=(grouped.ks,))
+        node.group_parts = (grouped.ks, per_group, grouped.sort_key,
+                            grouped.ascending)
+        return node
+
+    def reduce_group(self, fn, key_preserving: bool = False
+                     ) -> DataSet:
+        """``key_preserving=True`` declares that every output row
+        yields the SAME value under this grouping's key selector as
+        the group it came from (the reference's withForwardedFields)
+        — the optimizer then propagates the hash-partitioning
+        property and may skip a downstream re-exchange."""
         grouped = self
 
         def run(ins):
@@ -373,9 +388,13 @@ class GroupedDataSet:
             for g in grouped._groups(ins[0]).values():
                 out.extend(fn(g) or [])
             return out
-        return self.ds._derive("group_reduce_group", run,
+        node = self.ds._derive("group_reduce_group", run,
                                dist_keys=(grouped.ks,),
                                detail="hash-group")
+        node.group_parts = (grouped.ks, lambda g: list(fn(g) or []),
+                            grouped.sort_key, grouped.ascending)
+        node.key_preserving = key_preserving
+        return node
 
     def aggregate(self, agg: str, field) -> DataSet:
         return self._agg([(agg, field)])
@@ -484,8 +503,10 @@ class JoinOperator(_KeyedTwoInput):
         node = DataSet(self.left.env, "join", (self.left, self.right),
                        run, detail=f"hash-join outer={self.outer}")
         # equi-join: a hash key-partitioned exchange on both inputs
-        # gives every subtask complete key groups
+        # gives every subtask complete key groups (the optimizer may
+        # substitute a broadcast of the small side instead)
         node.dist_keys = (ks1, ks2)
+        node.join_outer = self.outer
         return node
 
     # joining without apply yields pairs

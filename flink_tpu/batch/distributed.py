@@ -139,6 +139,21 @@ def run_distributed(root) -> List[Any]:
     if getattr(benv, "max_parallelism", None):
         senv.set_max_parallelism(benv.max_parallelism)
 
+    # the optimizer's physical plan drives the edge wiring: its ship
+    # strategies (hash / broadcast / forward / rebalance / gather) map
+    # onto the streaming partitioners below
+    from flink_tpu.batch.optimizer import optimize
+    plan: Dict[int, Any] = {}
+
+    def index_plan(pn):
+        if id(pn.ds) in plan:
+            return
+        plan[id(pn.ds)] = pn
+        for i in pn.inputs:
+            index_plan(i)
+
+    index_plan(optimize(root))
+
     streams: Dict[int, Any] = {}
 
     def tag(stream, index: int):
@@ -171,29 +186,64 @@ def run_distributed(root) -> List[Any]:
             return s
         ins = [build(up) for up in node.inputs]
         keys = getattr(node, "dist_keys", None)
-        fn = node.fn
+        pn = plan.get(id(node))
+        ship = list(pn.ship) if pn is not None and pn.ship else None
+        fn = (pn.exec_fn if pn is not None and pn.exec_fn is not None
+              else node.fn)
         n_in = len(ins)
 
         def factory(fn=fn, n_in=n_in):
             return BatchNodeOperator(fn, n_in)
 
         tagged = [tag(s, i) for i, s in enumerate(ins)]
-        unioned = tagged[0] if n_in == 1 else tagged[0].union(*tagged[1:])
-        if keys is not None:
-            mp = senv.max_parallelism
-            key_sels = list(keys)
-
-            def route(tv, n, key_sels=key_sels, mp=mp):
-                ks = key_sels[tv[0]]
-                return assign_key_to_parallel_operator(
-                    ks.get_key(tv[1]), mp, n)
-
-            edge = unioned.partition_custom(route)
+        unioned = (tagged[0] if n_in == 1
+                   else tagged[0].union(*tagged[1:]))
+        if ship is not None and "broadcast" in ship:
+            # broadcast-hash join: the small side replicates to every
+            # subtask, the big side spreads round-robin — no keyed
+            # exchange (ref ShipStrategyType.BROADCAST).  The union
+            # node merges the tagged inputs, so the multicast decision
+            # rides its OUTPUT edge, per record, by tag.
+            from flink_tpu.streaming.datastream import DataStream
+            from flink_tpu.streaming.partitioners import (
+                TaggedBroadcastPartitioner,
+            )
+            bc_tags = [i for i, how in enumerate(ship)
+                       if how == "broadcast"]
+            edge = DataStream(unioned.env, unioned.node,
+                              TaggedBroadcastPartitioner(bc_tags))
             out = edge._add_op(f"batch_{node.op}", factory,
                                parallelism=par)
+        elif keys is not None:
+            if ship is not None and all(h == "forward" for h in ship):
+                # interesting-properties reuse: the input is already
+                # hash-partitioned on these keys by an upstream
+                # exchange with the same routing — no re-exchange
+                out = unioned._add_op(f"batch_{node.op}", factory,
+                                      parallelism=par)
+            else:
+                mp = senv.max_parallelism
+                key_sels = list(keys)
+
+                def route(tv, n, key_sels=key_sels, mp=mp):
+                    ks = key_sels[tv[0]]
+                    return assign_key_to_parallel_operator(
+                        ks.get_key(tv[1]), mp, n)
+
+                edge = unioned.partition_custom(route)
+                out = edge._add_op(f"batch_{node.op}", factory,
+                                   parallelism=par)
         elif mode == "any":
-            out = unioned.rebalance()._add_op(
-                f"batch_{node.op}", factory, parallelism=par)
+            if ship is not None and all(h == "forward" for h in ship):
+                # keep the input's placement (and with it any key
+                # partitioning the optimizer is propagating); the
+                # default edge partitioner still rebalances when the
+                # parallelism differs
+                out = unioned._add_op(f"batch_{node.op}", factory,
+                                      parallelism=par)
+            else:
+                out = unioned.rebalance()._add_op(
+                    f"batch_{node.op}", factory, parallelism=par)
         else:
             out = unioned._add_op(f"batch_{node.op}", factory,
                                   parallelism=1)

@@ -1,28 +1,60 @@
-"""Batch plan optimizer (ref: flink-optimizer Optimizer.java:64,396 —
-`compile`: cost-based shipping/local strategy choice over the operator
-DAG, then translation; dag/, operators/, plantranslate/).
+"""Cost-based batch plan optimizer (ref: flink-optimizer
+Optimizer.java:64,396 — `compile`: cost-based ship/local strategy
+choice over the operator DAG with interesting-properties propagation;
+dag/, operators/, plantranslate/).
 
-Scaled to this runtime: the logical DataSet DAG is annotated with size
-estimates, strategy decisions are recorded per node (hash vs
-sort-merge grouping, broadcast vs partitioned-hash joins, dead
-partition-op elimination, common-subplan reuse via memoized
-evaluation), and `explain()` renders the chosen physical plan the way
-`ExecutionEnvironment.getExecutionPlan` does."""
+What it decides, from size/cardinality estimates propagated bottom-up:
+
+- **ship strategy** per input edge (the reference's ShipStrategyType):
+  FORWARD (no exchange — including when an interesting property says
+  the input is ALREADY hash-partitioned on the needed keys), HASH
+  (key-partitioned exchange), BROADCAST (replicate the small build
+  side of a join below the threshold), REBALANCE (round-robin
+  data-parallel spread), GATHER (to one subtask);
+- **local strategy** per node (the reference's DriverStrategy):
+  hash-group vs sort-group for grouped reduces (sort-group substitutes
+  an ExternalSorter-backed runner when the estimated input exceeds the
+  in-memory budget), broadcast-hash vs partitioned-hash joins;
+- dead physical-op elimination (partition/rebalance in local memory)
+  and common-subplan reuse.
+
+`explain()` renders the physical plan with estimates and both
+strategy kinds the way `ExecutionEnvironment.getExecutionPlan` does;
+`batch/distributed.py` wires the chosen ship strategies into the
+streaming JobGraph (hash → key-partitioned exchange, broadcast →
+BroadcastPartitioner, forward → no exchange), so flipping an estimate
+flips the physical topology, not just a label.
+"""
 
 from __future__ import annotations
 
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
-#: broadcast-join threshold (elements on the build side)
+#: broadcast-join threshold (elements on the build side; ref
+#: optimizer cost model's broadcast cutoff)
 BROADCAST_THRESHOLD = 10_000
+
+#: grouped inputs estimated beyond this use the sort-group local
+#: strategy (ExternalSorter-backed, bounded memory) instead of the
+#: in-memory hash table
+SORT_GROUP_THRESHOLD = 1 << 20
 
 
 class PlanNode:
     def __init__(self, ds, inputs: List["PlanNode"]):
         self.ds = ds
         self.inputs = inputs
+        #: local strategy (DriverStrategy role)
         self.strategy = ds.detail or ds.op
+        #: per-input ship strategy (ShipStrategyType role)
+        self.ship: List[str] = []
         self.estimate: Optional[int] = ds.size_estimate
+        #: interesting property: the key-selector tuple this node's
+        #: output is hash-partitioned by (None = unknown/none)
+        self.partitioning: Optional[Tuple] = None
+        #: substituted execution closure (sort-group runner); None =
+        #: run the DataSet's own fn
+        self.exec_fn = None
 
     def execute(self) -> List[Any]:
         memo: Dict[int, List[Any]] = {}
@@ -32,7 +64,8 @@ class PlanNode:
             if key in memo:                 # common-subplan reuse
                 return memo[key]
             ins = [run(i) for i in node.inputs]
-            out = node.ds.fn(ins)
+            fn = node.exec_fn or node.ds.fn
+            out = fn(ins)
             memo[key] = out
             return out
 
@@ -40,14 +73,18 @@ class PlanNode:
 
     def explain(self, indent: int = 0) -> str:
         est = f" est={self.estimate}" if self.estimate is not None else ""
-        line = f"{'  ' * indent}{self.ds.op} [{self.strategy}]{est}"
+        ship = (" ship=[" + ", ".join(self.ship) + "]"
+                if self.ship else "")
+        line = (f"{'  ' * indent}{self.ds.op} "
+                f"[{self.strategy}]{ship}{est}")
         return "\n".join([line] + [i.explain(indent + 1)
                                    for i in self.inputs])
 
 
 def optimize(ds) -> PlanNode:
-    """Build the physical plan: propagate size estimates bottom-up,
-    settle join/grouping strategies, drop physical no-ops."""
+    """Build the physical plan: propagate size estimates and
+    partitioning properties bottom-up, settle ship + local
+    strategies, drop physical no-ops."""
     memo: Dict[int, PlanNode] = {}
 
     def build(d) -> PlanNode:
@@ -76,8 +113,14 @@ def _estimate(node: PlanNode) -> None:
     elif op == "union":
         node.estimate = (sum(x for x in ins if x is not None)
                          if any(x is not None for x in ins) else None)
-    elif op in ("filter", "distinct", "group_reduce", "group_aggregate"):
+    elif op in ("filter", "distinct", "group_reduce",
+                "group_reduce_group", "group_aggregate"):
         node.estimate = None if ins[0] is None else max(1, ins[0] // 2)
+    elif op == "join":
+        # equi-join estimate: bounded by the probe side (each probe
+        # row matches ~1 build key on average absent key stats)
+        known = [x for x in ins[:2] if x is not None]
+        node.estimate = max(known) if known else None
     elif op == "cross":
         node.estimate = (ins[0] * ins[1]
                          if None not in ins[:2] else None)
@@ -85,18 +128,130 @@ def _estimate(node: PlanNode) -> None:
         node.estimate = 1
 
 
+def _same_partitioning(have: Optional[Tuple], want: Tuple) -> bool:
+    """Key-selector identity comparison (the reference compares field
+    sets; selectors here are function objects, so identity is the
+    sound approximation — a false negative only costs an exchange)."""
+    return (have is not None and len(have) == len(want)
+            and all(a is b for a, b in zip(have, want)))
+
+
 def _choose_strategy(node: PlanNode) -> None:
     op = node.ds.op
+    keys = getattr(node.ds, "dist_keys", None)
+    mode = getattr(node.ds, "dist_mode", None)
+    n_in = len(node.inputs)
+
     if op == "join":
         sizes = [i.estimate for i in node.inputs]
-        small = [s for s in sizes if s is not None and s <= BROADCAST_THRESHOLD]
-        if small:
+        outer = getattr(node.ds, "join_outer", None)
+        small = None
+        if outer is None and None not in sizes[:2]:
+            # broadcast only pays when one side is small AND clearly
+            # smaller than the other (replicating ~half the data
+            # would beat nothing).  Outer joins are excluded: a
+            # broadcast build side would emit its unmatched rows once
+            # per subtask.
+            if (sizes[0] <= BROADCAST_THRESHOLD
+                    and sizes[1] >= 4 * sizes[0]):
+                small = 0
+            elif (sizes[1] <= BROADCAST_THRESHOLD
+                  and sizes[0] >= 4 * sizes[1]):
+                small = 1
+        if small is not None:
             node.strategy = "broadcast-hash-join"
+            node.ship = ["broadcast" if i == small else "forward"
+                         for i in range(2)]
         else:
             node.strategy = "partitioned-hash-join"
-        # very skewed + huge builds would pick sort-merge in the
-        # reference; the in-memory hash table stays superior here
-    elif op in ("group_reduce", "group_reduce_group", "group_aggregate"):
-        node.strategy = "hash-group"
-    elif op == "co_group":
+            node.ship = []
+            for i, inp in enumerate(node.inputs):
+                want = (keys[i],) if keys else ()
+                if keys and _same_partitioning(inp.partitioning, want):
+                    node.ship.append("forward")   # property reuse
+                else:
+                    node.ship.append("hash")
+        # the join's apply() rewrites rows arbitrarily, so no output
+        # partitioning survives (the reference reclaims it only via
+        # ForwardedFields annotations, which apply() doesn't carry)
+        node.partitioning = None
+        return
+
+    if op in ("group_reduce", "group_reduce_group", "group_aggregate",
+              "distinct") and keys:
+        est = node.inputs[0].estimate if node.inputs else None
+        if est is not None and est > SORT_GROUP_THRESHOLD \
+                and getattr(node.ds, "group_parts", None) is not None:
+            node.strategy = "sort-group"
+            node.exec_fn = _sort_group_runner(node.ds)
+        else:
+            node.strategy = "hash-group"
+        want = tuple(keys)
+        if _same_partitioning(node.inputs[0].partitioning, want):
+            node.ship = ["forward"]               # property reuse
+        else:
+            node.ship = ["hash"]
+        # the per-group UDF's output rows need not carry the group
+        # key, so the output partitioning claim requires the explicit
+        # key_preserving annotation (ref: SemanticProperties /
+        # withForwardedFields) — without it, claiming would skip a
+        # REQUIRED exchange downstream and silently split groups
+        node.partitioning = (want if getattr(node.ds, "key_preserving",
+                                             False) else None)
+        return
+
+    if op == "co_group":
         node.strategy = "hash-cogroup"
+        node.ship = ["hash"] * n_in
+        node.partitioning = None   # output rows are UDF products
+        return
+
+    if mode == "any":
+        node.ship = ["rebalance" if not i.inputs else "forward"
+                     for i in node.inputs]
+        # partitioning survives ops that cannot change a row's key
+        # (filter / local sort); map-like ops destroy it
+        if op in ("filter", "sort_partition") and node.inputs:
+            node.partitioning = node.inputs[0].partitioning
+        return
+
+    # everything else gathers to one subtask
+    node.ship = ["gather"] * n_in
+
+
+def _sort_group_runner(ds):
+    """Sort-group local strategy: ExternalSorter-backed grouped
+    execution with bounded memory — rows sort by a stable key hash,
+    hash runs walk contiguously, a tiny per-run dict absorbs 64-bit
+    hash collisions (ref: the SORT_GROUP DriverStrategy +
+    GroupReduceDriver over sorted input)."""
+    ks, per_group, sort_key, ascending = ds.group_parts
+    from flink_tpu.core.keygroups import stable_hash64
+
+    def run(ins):
+        from flink_tpu.batch.sorter import ExternalSorter
+        sorter = ExternalSorter(
+            key=lambda x: stable_hash64(ks.get_key(x)))
+        sorter.add_all(ins[0])
+        out: List[Any] = []
+
+        def flush(groups):
+            for rows in groups.values():
+                if sort_key is not None:
+                    rows = sorted(rows, key=sort_key.get_key,
+                                  reverse=not ascending)
+                out.extend(per_group(rows) or [])
+
+        cur_hash = None
+        groups: Dict[Any, List[Any]] = {}
+        for x in sorter.sorted_iter():
+            h = stable_hash64(ks.get_key(x))
+            if h != cur_hash:
+                flush(groups)
+                groups = {}
+                cur_hash = h
+            groups.setdefault(ks.get_key(x), []).append(x)
+        flush(groups)
+        return out
+
+    return run

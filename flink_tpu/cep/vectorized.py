@@ -73,11 +73,19 @@ class _EventLog:
         self.columnar = False
 
     def append_batch(self, rows) -> int:
+        if self.columnar:
+            raise ValueError(
+                "event log locked to columnar ingest; one engine "
+                "cannot mix rows- and cols-based advance_batch")
         start = self.base + len(self.rows)
         self.rows.extend(rows)
         return start
 
     def append_cols(self, cols, vspec, n: int) -> int:
+        if self.rows:
+            raise ValueError(
+                "event log locked to row ingest; one engine cannot "
+                "mix rows- and cols-based advance_batch")
         self.columnar = True
         start = (self.chunks[-1][0] + len(self.chunks[-1][1][0])
                  if self.chunks else self.base)
@@ -155,6 +163,8 @@ class VectorizedStrictNFA:
         self.mode: Optional[str] = None
         self.matches: List[Tuple[Any, Dict[str, List[Any]]]] = []
         self.num_timeouts = 0
+        #: max event time seen (drives dormant-run expiry sweeps)
+        self.watermark = -(2 ** 63)
 
     # ---- slots ------------------------------------------------------
     def _slots_of(self, keys: np.ndarray) -> np.ndarray:
@@ -279,6 +289,7 @@ class VectorizedStrictNFA:
             return
         keys = np.asarray(keys)
         ts = np.asarray(ts, np.int64)
+        self.watermark = max(self.watermark, int(ts[-1]))
         if cols is None:
             cols, vspec = columnify(rows)
             base_gid = self.log.append_batch(rows)
@@ -342,10 +353,15 @@ class VectorizedStrictNFA:
                  if keys.dtype == np.dtype(np.int64) else keys)
             order, seg_starts, seg_lens, _ = nat.fold_prep(u)
         else:
-            order = _stable_argsort(
-                keys if keys.dtype.kind in "iufUS"
-                else np.asarray([hash(key) for key in keys.tolist()]))
-            skeys = keys[order]
+            if keys.dtype.kind in "iufUS":
+                sort_col = keys
+            else:
+                # dense per-key slot ids, NOT raw hash(): two distinct
+                # keys with equal hashes would interleave and split a
+                # key's rows across segments
+                sort_col = slots
+            order = _stable_argsort(sort_col)
+            skeys = sort_col[order]
             seg_starts, seg_lens = _segments(skeys)
 
         # STRICT chains are LOCAL: a full in-batch match at sorted
@@ -503,6 +519,12 @@ class VectorizedStrictNFA:
     def _maybe_compact_native(self):
         if self._log_span() < (1 << 20):
             return
+        if self.within is not None:
+            # sweep runs whose within() horizon has passed — dormant
+            # keys would otherwise pin the compaction watermark and
+            # the event log would grow without bound
+            import flink_tpu.native as nat2
+            nat2.cep_expire(self._nat_state, self.watermark)
         lo = self._nat_state.min_ref()   # one sequential C++ scan
         self.log.compact(np.asarray([lo], np.int64)
                          if lo < (1 << 62) else np.zeros(0, np.int64))
@@ -519,6 +541,14 @@ class VectorizedStrictNFA:
     def _maybe_compact(self):
         if self._log_span() < (1 << 16):
             return
+        if self.within is not None:
+            # expire dormant runs so they stop pinning the watermark
+            n = len(self._slot_keys)
+            for s in range(1, self.k):
+                expired = (self.active[s][:n]
+                           & (self.watermark - self.start[s][:n]
+                              >= self.within))
+                self.active[s][:n] &= ~expired
         refs = [self.refs[s][j][:len(self._slot_keys)]
                 [self.active[s][:len(self._slot_keys)]]
                 for s in range(1, self.k)

@@ -76,6 +76,7 @@ def _ensure_loaded() -> Optional[ctypes.CDLL]:
         lib.ft_cep_size.restype = c.c_int64
         lib.ft_cep_min_ref.argtypes = [c.c_void_p]
         lib.ft_cep_min_ref.restype = c.c_int64
+        lib.ft_cep_expire.argtypes = [c.c_void_p, c.c_int64]
         lib.ft_cep_export.argtypes = [c.c_void_p, u64p, u32p, i64p]
         lib.ft_cep_export.restype = c.c_int64
         lib.ft_cep_import.argtypes = [c.c_void_p, u64p, u32p, i64p,
@@ -667,6 +668,12 @@ class NativeCepState:
             np.ascontiguousarray(active, np.uint32),
             np.ascontiguousarray(
                 np.asarray(cold).reshape(-1), np.int64), m)
+
+
+def cep_expire(state: "NativeCepState", watermark: int) -> None:
+    """Expire runs past the within() horizon (dormant-key sweep
+    before log compaction)."""
+    _lib.ft_cep_expire(state._h, watermark)
 
 
 def cep_strict_baseline(kh: np.ndarray, values: np.ndarray,

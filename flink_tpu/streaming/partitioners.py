@@ -129,6 +129,29 @@ class KeyGroupStreamPartitioner(StreamPartitioner):
         return "HASH"
 
 
+class TaggedBroadcastPartitioner(StreamPartitioner):
+    """Per-record multicast for tagged (input_index, value) carriers:
+    inputs in `broadcast_tags` replicate to EVERY channel (a join's
+    broadcast build side), the rest spread round-robin (the probe
+    side) — the batch optimizer's BROADCAST ship strategy riding one
+    union edge (ref: ShipStrategyType.BROADCAST)."""
+
+    is_broadcast = True  # channel capacity accounting: may multicast
+
+    def __init__(self, broadcast_tags):
+        self.broadcast_tags = frozenset(broadcast_tags)
+        self._rr = 0
+
+    def select_channels(self, value, num_channels):
+        if value[0] in self.broadcast_tags:
+            return list(range(num_channels))
+        self._rr = (self._rr + 1) % num_channels
+        return [self._rr]
+
+    def __repr__(self):
+        return f"TAGGED_BROADCAST{sorted(self.broadcast_tags)}"
+
+
 class CustomPartitionerWrapper(StreamPartitioner):
     """(ref: CustomPartitionerWrapper.java) — partitioner(key,
     num_channels) -> channel."""

@@ -1169,7 +1169,10 @@ static inline uint64_t ft_splitmix1(uint64_t x) {
   x += 0x9E3779B97F4A7C15ull;
   x = (x ^ (x >> 30)) * 0xBF58476D1CE4E5B9ull;
   x = (x ^ (x >> 27)) * 0x94D049BB133111EBull;
-  return x ^ (x >> 31);
+  x ^= x >> 31;
+  // 0 is the probe table's empty sentinel: the one key hashing to 0
+  // would re-insert a ghost slot per event and vanish from exports
+  return x ? x : 1;
 }
 
 void* ft_cep_new(int64_t k, int64_t within, int64_t capacity_pow2) {
@@ -1348,6 +1351,23 @@ int64_t ft_cep_advance_seq(void* handle, const uint64_t* kh,
     st.active[slot] = new_a;
   }
   return n_matches;
+}
+
+// Expire runs whose within() horizon has passed the watermark —
+// dormant keys otherwise pin the event-log compaction watermark.
+void ft_cep_expire(void* handle, int64_t watermark) {
+  FtCepState& st = *static_cast<FtCepState*>(handle);
+  const int k = st.k;
+  if (st.within < 0) return;
+  for (int64_t slot = 0; slot < st.next_slot; ++slot) {
+    uint32_t a = st.active[slot];
+    if (!a) continue;
+    const int64_t* row = &st.cold[slot * st.cold_w];
+    for (int s = 1; s < k; ++s)
+      if (((a >> s) & 1) && watermark - row[s - 1] >= st.within)
+        a &= ~(1u << s);
+    st.active[slot] = a;
+  }
 }
 
 int64_t ft_cep_min_ref(void* handle) {
