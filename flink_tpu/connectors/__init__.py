@@ -22,6 +22,11 @@ from flink_tpu.connectors.sharded_stream import (
     FileShardedStream,
     ShardedStreamSource,
 )
+from flink_tpu.connectors.upsert_sink import (
+    DocumentStore,
+    FileDocumentStore,
+    UpsertSink,
+)
 
 __all__ = [
     "FilePartitionedLog",
@@ -35,4 +40,7 @@ __all__ = [
     "JdbcSink",
     "FileShardedStream",
     "ShardedStreamSource",
+    "DocumentStore",
+    "FileDocumentStore",
+    "UpsertSink",
 ]

@@ -1145,8 +1145,19 @@ class TaskExecutor(RpcEndpoint):
                 pass           # the JM fallback path still works
             _jm.tell.acknowledge_checkpoint(_att, task_key, cid, snapshot)
 
+        def decline(cid, _jm=jm, _att=attempt):
+            _jm.tell.decline_checkpoint(_att, cid)
+
+        cp_cfg = getattr(job_graph, "checkpoint_config", None) or {}
         for st in att.subtasks:
             st.ack_fn = ack
+            st.decline_fn = decline
+            if "alignment_spill_threshold" in cp_cfg:
+                st.alignment_spill_threshold = \
+                    cp_cfg["alignment_spill_threshold"]
+            if "alignment_abort_limit" in cp_cfg:
+                st.alignment_abort_limit = \
+                    cp_cfg["alignment_abort_limit"]
         self._attempts[job_id] = att
 
     def _wire(self, att: _JobAttempt, job_graph: JobGraph, tdd: dict,

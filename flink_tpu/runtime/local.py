@@ -222,10 +222,17 @@ class _RouterOutput(Output):
 
 class _InputChannel:
     """One logical channel into a subtask: a bounded FIFO of
-    StreamElements (ref: InputChannel + its queued buffers)."""
+    StreamElements (ref: InputChannel + its queued buffers).
+
+    While alignment-blocked, elements past the spill threshold go to
+    disk instead of growing the in-memory queue (ref:
+    BufferSpiller.java:67 — the reference spills post-barrier buffers
+    so a long alignment never stalls upstream producers or exhausts
+    memory)."""
 
     __slots__ = ("subtask", "input_index", "channel_id", "queue",
-                 "capacity", "blocked", "eos", "is_feedback")
+                 "capacity", "blocked", "eos", "is_feedback",
+                 "_spill_file", "spilled_count", "_spill_disabled")
 
     def __init__(self, subtask: "SubtaskInstance", input_index: int,
                  channel_id: int, capacity: int = DEFAULT_CHANNEL_CAPACITY):
@@ -240,9 +247,64 @@ class _InputChannel:
         self.eos = False
         #: iteration back edge: exempt from EOS and barrier alignment
         self.is_feedback = False
+        self._spill_file = None
+        self.spilled_count = 0
+        self._spill_disabled = False
 
     def push(self, element) -> None:
+        if self.blocked:
+            st = self.subtask
+            st.note_alignment_element()
+            # the cap check may have ABORTED the alignment (releasing
+            # and unspilling this channel) — re-check before spilling,
+            # else the element strands in a fresh spill file
+            if self.blocked and not self._spill_disabled:
+                threshold = st.alignment_spill_threshold
+                if threshold is not None \
+                        and len(self.queue) >= threshold:
+                    if self._try_spill(element):
+                        return
+                    # unpicklable element: restore order (spilled
+                    # rows are older) and stop spilling this channel
+                    self.unspill()
+                    self._spill_disabled = True
         self.queue.append(element)
+
+    def _try_spill(self, element) -> bool:
+        import pickle as _pickle
+        import tempfile as _tempfile
+        try:
+            payload = _pickle.dumps(element,
+                                    protocol=_pickle.HIGHEST_PROTOCOL)
+        except Exception:  # noqa: BLE001 — unpicklable user value:
+            return False   # keep it in memory (spill is best-effort)
+        if self._spill_file is None:
+            self._spill_file = _tempfile.TemporaryFile(
+                prefix="flink_tpu_align_spill_")
+        f = self._spill_file
+        f.write(len(payload).to_bytes(8, "little"))
+        f.write(payload)
+        self.spilled_count += 1
+        self.subtask.alignment_spilled_total += 1
+        return True
+
+    def unspill(self) -> None:
+        """Move spilled elements back behind the in-memory queue (they
+        are strictly newer than every queued element)."""
+        if self._spill_file is None:
+            return
+        import pickle as _pickle
+        f = self._spill_file
+        f.seek(0)
+        while True:
+            header = f.read(8)
+            if len(header) < 8:
+                break
+            n = int.from_bytes(header, "little")
+            self.queue.append(_pickle.loads(f.read(n)))
+        f.close()
+        self._spill_file = None
+        self.spilled_count = 0
 
 
 class SubtaskInstance:
@@ -277,6 +339,22 @@ class SubtaskInstance:
         self._align_id: Optional[int] = None
         self._align_barrier: Optional[CheckpointBarrier] = None
         self._align_received: Set[int] = set()  # channel ids
+        #: elements buffered on blocked channels past this spill to
+        #: disk (ref BufferSpiller.java:67); None disables spilling
+        self.alignment_spill_threshold: Optional[int] = channel_capacity
+        #: total elements buffered during ONE alignment beyond this
+        #: ABORT the checkpoint instead of buffering on (the
+        #: reference's alignment cap, TaskManagerOptions.java:342);
+        #: None = unbounded
+        self.alignment_abort_limit: Optional[int] = None
+        self._align_buffered = 0
+        #: lifetime count of alignment-spilled elements (metric)
+        self.alignment_spilled_total = 0
+        #: checkpoints aborted by the alignment cap (metric)
+        self.alignment_aborts = 0
+        #: set by the executor: callable(checkpoint_id) declining at
+        #: the coordinator
+        self.decline_fn = None
         # at-least-once barrier counting (ref: BarrierTracker)
         self._tracker_counts: Dict[int, Tuple[CheckpointBarrier, Set[int]]] = {}
 
@@ -526,6 +604,8 @@ class SubtaskInstance:
                 self._complete_checkpoint(barrier)
             return
         # exactly-once alignment (ref: BarrierBuffer.processBarrier :222)
+        if barrier.checkpoint_id == getattr(self, "_aborted_cid", None):
+            return  # stragglers of an alignment-cap abort: ignore
         if self._align_id is None:
             self._align_id = barrier.checkpoint_id
             self._align_barrier = barrier
@@ -548,12 +628,36 @@ class SubtaskInstance:
             self._release_alignment()
             self._complete_checkpoint(barrier)
 
+    def note_alignment_element(self) -> None:
+        """One more element buffered behind the alignment; past the
+        configured cap the checkpoint ABORTS (release + decline)
+        rather than buffering without bound (ref: the alignment-size
+        abort of TaskManagerOptions.java:342)."""
+        self._align_buffered += 1
+        cap = self.alignment_abort_limit
+        if cap is not None and self._align_id is not None \
+                and self._align_buffered > cap:
+            cid = self._align_id
+            barrier = self._align_barrier
+            self.alignment_aborts += 1
+            self._aborted_cid = cid   # drop this cid's stragglers
+            self._release_alignment()
+            # forward the barrier WITHOUT snapshotting here (the
+            # CancelCheckpointMarker role): downstream paths still see
+            # cid on every channel, so no stale-barrier inversion; the
+            # decline below makes the coordinator drop their acks
+            self.router.broadcast_barrier(barrier)
+            if self.decline_fn is not None:
+                self.decline_fn(cid)
+
     def _release_alignment(self):
         for c in self.input_channels:
             c.blocked = False
+            c.unspill()
         self._align_id = None
         self._align_barrier = None
         self._align_received = set()
+        self._align_buffered = 0
 
     def _complete_checkpoint(self, barrier: CheckpointBarrier):
         """All channels aligned: snapshot, forward barrier, ack (ref:
@@ -955,8 +1059,19 @@ class LocalExecutor:
         def ack(task_key, cid, snapshot):
             ack_queue.append((task_key, cid, snapshot))
 
+        def decline(cid):
+            ack_queue.append((None, cid, None))   # decline marker
+
+        cp_cfg = job_graph.checkpoint_config or {}
         for st in all_tasks:
             st.ack_fn = ack
+            st.decline_fn = decline
+            if "alignment_spill_threshold" in cp_cfg:
+                st.alignment_spill_threshold = \
+                    cp_cfg["alignment_spill_threshold"]
+            if "alignment_abort_limit" in cp_cfg:
+                st.alignment_abort_limit = \
+                    cp_cfg["alignment_abort_limit"]
 
         client.executor_state = {
             "subtasks": subtasks, "coordinator": coordinator,
@@ -1066,7 +1181,10 @@ class LocalExecutor:
             if coordinator is not None:
                 while ack_queue:
                     task_key, cid, snapshot = ack_queue.popleft()
-                    coordinator.acknowledge(task_key, cid, snapshot)
+                    if task_key is None:   # alignment-cap decline
+                        coordinator.decline(cid)
+                    else:
+                        coordinator.acknowledge(task_key, cid, snapshot)
                 # a source that finished with an unhandled trigger can
                 # never ack — decline that checkpoint (threaded-source
                 # race; cooperative sources handle triggers in-step)

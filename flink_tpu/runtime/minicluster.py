@@ -320,8 +320,19 @@ class MiniCluster:
         def ack(task_key, cid, snapshot):
             ack_queue.append((task_key, cid, snapshot))
 
+        def decline(cid):
+            ack_queue.append((None, cid, None))   # decline marker
+
+        cp_cfg = job_graph.checkpoint_config or {}
         for st in all_tasks:
             st.ack_fn = ack
+            st.decline_fn = decline
+            if "alignment_spill_threshold" in cp_cfg:
+                st.alignment_spill_threshold = \
+                    cp_cfg["alignment_spill_threshold"]
+            if "alignment_abort_limit" in cp_cfg:
+                st.alignment_abort_limit = \
+                    cp_cfg["alignment_abort_limit"]
 
         client.executor_state = {
             "subtasks": subtasks, "coordinator": coordinator,
@@ -382,7 +393,10 @@ class MiniCluster:
                     coordinator.maybe_trigger()
                 while ack_queue:
                     task_key, cid, snapshot = ack_queue.popleft()
-                    coordinator.acknowledge(task_key, cid, snapshot)
+                    if task_key is None:   # alignment-cap decline
+                        coordinator.decline(cid)
+                    else:
+                        coordinator.acknowledge(task_key, cid, snapshot)
                 for s in sources:
                     if s.finished and s.pending_trigger is not None:
                         cid = s.pending_trigger[0]

@@ -153,6 +153,24 @@ class StreamExecutionEnvironment:
         self.checkpoint_async_persist = async_persist
         return self
 
+    _UNSET = object()
+
+    def set_alignment_limits(self, spill_threshold=_UNSET,
+                             abort_limit=_UNSET
+                             ) -> "StreamExecutionEnvironment":
+        """Exactly-once alignment buffering policy: elements queued
+        on alignment-blocked channels past ``spill_threshold`` spill
+        to disk (ref BufferSpiller.java:67; default: the channel
+        capacity); an alignment that buffers more than ``abort_limit``
+        elements in total ABORTS its checkpoint instead of buffering
+        on (ref the alignment cap of TaskManagerOptions.java:342;
+        default: unbounded)."""
+        if spill_threshold is not self._UNSET:
+            self.alignment_spill_threshold = spill_threshold
+        if abort_limit is not self._UNSET:
+            self.alignment_abort_limit = abort_limit
+        return self
+
     def set_checkpoint_storage(self, storage: str, directory: Optional[str] = None,
                                retain: int = 1) -> "StreamExecutionEnvironment":
         """`memory` | `filesystem` (with directory) — the checkpoint-
@@ -270,6 +288,12 @@ class StreamExecutionEnvironment:
                                          False),
                 **self.checkpoint_storage,
             }
+            if hasattr(self, "alignment_spill_threshold"):
+                jg.checkpoint_config["alignment_spill_threshold"] = \
+                    self.alignment_spill_threshold
+            if hasattr(self, "alignment_abort_limit"):
+                jg.checkpoint_config["alignment_abort_limit"] = \
+                    self.alignment_abort_limit
         jg.savepoint_restore_path = getattr(
             self, "savepoint_restore_path", None)
         jg.allow_non_restored_state = getattr(

@@ -278,3 +278,171 @@ def test_parallel_rich_function_gets_own_subtask_context():
         .add_sink(CollectSink()))
     env.execute("parallel-context")
     assert sorted(seen_indices) == [0, 1]
+
+
+# ---------------------------------------------------------------------
+# round 5: idempotent upsert sink (ES role) + columnar file format
+# (ORC/Avro-file role) — VERDICT r4 missing #7
+# ---------------------------------------------------------------------
+
+def test_upsert_sink_exactly_once_through_crash(tmp_path):
+    """Checkpointed job with a mid-stream crash AND injected transient
+    store failures: the store ends exactly at the final per-key state
+    (idempotent doc ids absorb both the replay and the retries)."""
+    import numpy as np
+    from flink_tpu.connectors.upsert_sink import (
+        FileDocumentStore,
+        UpsertSink,
+    )
+    from flink_tpu.streaming.datastream import StreamExecutionEnvironment
+    from flink_tpu.streaming.sources import SourceFunction
+
+    rng = np.random.default_rng(3)
+    n = 4000
+    rows = [(int(k), int(v)) for k, v in zip(
+        rng.integers(0, 37, n), rng.integers(0, 1000, n))]
+    store_dir = str(tmp_path / "docs")
+    store = FileDocumentStore(store_dir, fail_times=3, fail_after=5)
+
+    class CrashOnce(SourceFunction):
+        crashed = False
+
+        def __init__(self):
+            self.offset = 0
+
+        def run(self, ctx):
+            while self.emit_step(ctx, 64):
+                pass
+
+        def emit_step(self, ctx, max_records):
+            end = min(self.offset + max_records, n)
+            for i in range(self.offset, end):
+                ctx.collect(rows[i])
+            self.offset = end
+            if self.offset >= n // 2 and not type(self).crashed:
+                type(self).crashed = True
+                raise RuntimeError("injected crash")
+            return self.offset < n
+
+        def snapshot_function_state(self, checkpoint_id=None):
+            return {"offset": self.offset}
+
+        def restore_function_state(self, state):
+            self.offset = state["offset"]
+
+    env = StreamExecutionEnvironment()
+    env.enable_checkpointing(10)
+    env.set_restart_strategy("fixed_delay", restart_attempts=3,
+                             delay_ms=5)
+    sink = UpsertSink(lambda: store,
+                      key_fn=lambda r: f"k{r[0]}",
+                      doc_fn=lambda r: {"key": r[0], "value": r[1]},
+                      buffer_size=100)
+    env.add_source(CrashOnce()).add_sink(sink)
+    result = env.execute("upsert-crash")
+    assert result.restarts >= 1
+
+    want = {}
+    for k, v in rows:
+        want[f"k{k}"] = {"key": k, "value": v}
+    assert store.read_all() == want
+    assert sink.num_retries >= 1   # the injected failures were retried
+
+
+def test_upsert_sink_retract_deletes(tmp_path):
+    from flink_tpu.connectors.upsert_sink import (
+        FileDocumentStore,
+        UpsertSink,
+    )
+    store = FileDocumentStore(str(tmp_path / "d"))
+    sink = UpsertSink(lambda: store, key_fn=lambda r: r[0],
+                      doc_fn=lambda r: {"v": r[1]}, buffer_size=10)
+    sink.open()
+    sink.invoke((True, ("a", 1)))
+    sink.invoke((True, ("b", 2)))
+    sink.invoke((False, ("a", 1)))      # retract before flush: dedup
+    sink.snapshot_function_state(1)     # checkpoint-aligned flush
+    assert store.read_all() == {"b": {"v": 2}}
+    sink.invoke((False, ("b", 2)))      # delete a stored doc
+    sink.close()
+    assert store.read_all() == {}
+
+
+def test_columnar_file_roundtrip_and_schema_evolution(tmp_path):
+    import numpy as np
+    from flink_tpu.core.colformat import (
+        read_columnar_file,
+        write_columnar_file,
+    )
+    from flink_tpu.core.records import RecordSchema
+
+    v1 = RecordSchema([("id", "long"), ("name", "string"),
+                       ("score", "long")])
+    path = str(tmp_path / "data.ftcf")
+    cols = {
+        "id": np.arange(5, dtype=np.int64),
+        "name": np.asarray(["a", "bb", "ccc", "d", ""]),
+        "score": np.asarray([10, 20, 30, 40, 50], np.int64),
+    }
+    write_columnar_file(path, v1, cols)
+
+    # same-schema roundtrip
+    back = read_columnar_file(path)
+    assert (back["id"] == cols["id"]).all()
+    assert back["name"].tolist() == cols["name"].tolist()
+
+    # evolved reader: score promoted long->double, `rank` added with a
+    # default, `name` dropped
+    v2 = RecordSchema([("id", "long"), ("score", "double"),
+                       ("rank", "long", 7)])
+    got = read_columnar_file(path, v2)
+    assert set(got) == {"id", "score", "rank"}
+    assert got["score"].dtype == np.dtype("<f8")
+    assert got["score"].tolist() == [10.0, 20.0, 30.0, 40.0, 50.0]
+    assert got["rank"].tolist() == [7] * 5
+
+    # incompatible evolution rejected with the reason
+    bad = RecordSchema([("name", "double")])
+    with pytest.raises(ValueError, match="changed type"):
+        read_columnar_file(path, bad)
+
+
+def test_columnar_file_dataset_and_table_integration(tmp_path):
+    """ORC-role end to end: DataSet writes the file, the columnar
+    Table tier reads it back through from_columns."""
+    import numpy as np
+    from flink_tpu.batch import ExecutionEnvironment
+    from flink_tpu.core.colformat import (
+        ColumnarFileInputFormat,
+        ColumnarFileOutputFormat,
+        read_columnar_file,
+    )
+    from flink_tpu.core.records import RecordSchema
+
+    schema = RecordSchema([("k", "long"), ("ts", "long"),
+                           ("u", "long")])
+    path = str(tmp_path / "events.ftcf")
+    env = ExecutionEnvironment.get_execution_environment()
+    rows = [(i % 5, i, i * 3) for i in range(100)]
+    env.from_collection(rows).output(
+        lambda values: ColumnarFileOutputFormat(path, schema)
+        .write(values))
+    env.execute("write-colfile")
+    assert ColumnarFileInputFormat(path).read()[0] == \
+        {"k": 0, "ts": 0, "u": 0}
+
+    # straight into the columnar SQL tier
+    from flink_tpu.streaming.datastream import StreamExecutionEnvironment
+    from flink_tpu.streaming.sources import CollectSink
+    from flink_tpu.table import StreamTableEnvironment
+    cols = read_columnar_file(path)
+    senv = StreamExecutionEnvironment()
+    t_env = StreamTableEnvironment.create(senv)
+    t_env.register_table("ev", t_env.from_columns(cols, rowtime="ts"))
+    out = t_env.sql_query(
+        "SELECT k, COUNT(*) AS c FROM ev "
+        "GROUP BY TUMBLE(ts, INTERVAL '1' SECOND), k")
+    sink = CollectSink()
+    out.to_append_stream().add_sink(sink)
+    senv.execute("colfile-sql")
+    assert sum(c for k, c in sink.values) == 100
