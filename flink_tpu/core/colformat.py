@@ -16,7 +16,7 @@ Layout:
   magic "FTCF1\\n" | schema-JSON length + bytes | n_rows |
   per column: name len+bytes, dtype-descr len+bytes, payload
   (fixed-width columns: raw little-endian array bytes; string
-  columns: i32 offsets array + utf-8 blob)
+  columns: i64 offsets array + utf-8 blob)
 """
 
 from __future__ import annotations
@@ -74,7 +74,7 @@ def write_columnar_file(path: str, schema: RecordSchema,
             _write_block(f, fld.name.encode("utf-8"))
             if fld.type == "string":
                 blobs = [s.encode("utf-8") for s in col.tolist()]
-                offsets = np.zeros(n_rows + 1, "<i4")
+                offsets = np.zeros(n_rows + 1, "<i8")
                 np.cumsum([len(b) for b in blobs],
                           out=offsets[1:]) if n_rows else None
                 _write_block(f, b"string")
@@ -82,7 +82,7 @@ def write_columnar_file(path: str, schema: RecordSchema,
                 _write_block(f, b"".join(blobs))
             elif fld.type == "bytes":
                 blobs = list(col.tolist())
-                offsets = np.zeros(n_rows + 1, "<i4")
+                offsets = np.zeros(n_rows + 1, "<i8")
                 np.cumsum([len(b) for b in blobs],
                           out=offsets[1:]) if n_rows else None
                 _write_block(f, b"bytes")
@@ -113,7 +113,12 @@ def read_columnar_file(path: str,
             name = _read_block(f).decode("utf-8")
             kind = _read_block(f).decode("ascii")
             if kind in ("string", "bytes"):
-                offsets = np.frombuffer(_read_block(f), "<i4")
+                raw_off = _read_block(f)
+                # i4 offsets are the v1 layout; i8 since (2 GiB+
+                # string columns wrapped in i4)
+                offsets = np.frombuffer(
+                    raw_off, "<i8" if len(raw_off) == 8 * (n_rows + 1)
+                    else "<i4")
                 blob = _read_block(f)
                 vals = [blob[offsets[i]:offsets[i + 1]]
                         for i in range(n_rows)]

@@ -16,9 +16,12 @@ from flink_tpu.graph.iterations import (
     ScatterGatherIteration,
 )
 from flink_tpu.graph.library import (
+    AdamicAdar,
+    ClusteringCoefficient,
     CommunityDetection,
     ConnectedComponents,
     HITS,
+    JaccardIndex,
     LabelPropagation,
     PageRank,
     SingleSourceShortestPaths,
@@ -31,4 +34,5 @@ __all__ = [
     "PregelIteration",
     "PageRank", "ConnectedComponents", "SingleSourceShortestPaths",
     "TriangleCount", "LabelPropagation", "CommunityDetection", "HITS",
+    "JaccardIndex", "AdamicAdar", "ClusteringCoefficient",
 ]

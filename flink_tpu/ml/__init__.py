@@ -17,6 +17,20 @@ from flink_tpu.ml.preprocessing import (
 from flink_tpu.ml.regression import MultipleLinearRegression
 from flink_tpu.ml.classification import KNN, SVM
 from flink_tpu.ml.recommendation import ALS
+from flink_tpu.ml.validation import (
+    GridSearchCV,
+    KFold,
+    accuracy_score,
+    confusion_matrix,
+    cross_val_score,
+    f1_score,
+    mean_absolute_error,
+    mean_squared_error,
+    precision_score,
+    r2_score,
+    recall_score,
+    train_test_split,
+)
 from flink_tpu.ml.metrics import (
     chebyshev_distance,
     cosine_distance,
@@ -34,4 +48,8 @@ __all__ = [
     "euclidean_distance", "squared_euclidean_distance",
     "cosine_distance", "chebyshev_distance", "manhattan_distance",
     "minkowski_distance", "tanimoto_distance",
+    "KFold", "GridSearchCV", "cross_val_score", "train_test_split",
+    "accuracy_score", "precision_score", "recall_score", "f1_score",
+    "confusion_matrix", "mean_squared_error", "mean_absolute_error",
+    "r2_score",
 ]

@@ -604,8 +604,9 @@ class SubtaskInstance:
                 self._complete_checkpoint(barrier)
             return
         # exactly-once alignment (ref: BarrierBuffer.processBarrier :222)
-        if barrier.checkpoint_id == getattr(self, "_aborted_cid", None):
-            return  # stragglers of an alignment-cap abort: ignore
+        if barrier.checkpoint_id <= getattr(self, "_aborted_cid", -1):
+            return  # stragglers of alignment-cap aborts: ignore every
+            # barrier at or below the newest aborted id (ids ascend)
         if self._align_id is None:
             self._align_id = barrier.checkpoint_id
             self._align_barrier = barrier
@@ -640,7 +641,8 @@ class SubtaskInstance:
             cid = self._align_id
             barrier = self._align_barrier
             self.alignment_aborts += 1
-            self._aborted_cid = cid   # drop this cid's stragglers
+            self._aborted_cid = max(
+                getattr(self, "_aborted_cid", -1), cid)
             self._release_alignment()
             # forward the barrier WITHOUT snapshotting here (the
             # CancelCheckpointMarker role): downstream paths still see

@@ -193,3 +193,113 @@ def test_pregel_iteration_custom():
     out = g.run(it)
     got = {v.id: int(v.value) for v in out.get_vertices()}
     assert got == {0: 7, 1: 7, 2: 9, 3: 9}
+
+
+# ---------------------------------------------------------------------
+# round 5: similarity / clustering / community inventory (VERDICT r4
+# weak #7 — ref flink-gelly library/similarity, library/clustering,
+# library/CommunityDetection.java)
+# ---------------------------------------------------------------------
+
+def _brute_neighbors(edges, n):
+    nbrs = {i: set() for i in range(n)}
+    for s, t in edges:
+        if s != t:
+            nbrs[s].add(t)
+            nbrs[t].add(s)
+    return nbrs
+
+
+def _random_graph(n=40, m=160, seed=4):
+    import numpy as np
+    rng = np.random.default_rng(seed)
+    edges = {(int(a), int(b)) for a, b in zip(
+        rng.integers(0, n, m), rng.integers(0, n, m)) if a != b}
+    g = Graph.from_collection(
+        vertices=[(i, 0) for i in range(n)],
+        edges=[(s, t, 1.0) for s, t in sorted(edges)])
+    return g, sorted(edges), n
+
+
+def test_jaccard_index_differential():
+    from flink_tpu.graph import JaccardIndex
+    g, edges, n = _random_graph()
+    got = JaccardIndex().run(g)
+    nbrs = _brute_neighbors(edges, n)
+    want = {}
+    for u in range(n):
+        for v in range(u + 1, n):
+            shared = len(nbrs[u] & nbrs[v])
+            if shared:
+                want[(u, v)] = shared / len(nbrs[u] | nbrs[v])
+    assert set(got) == set(want)
+    for k in want:
+        assert abs(got[k] - want[k]) < 1e-12, k
+
+
+def test_adamic_adar_differential():
+    import math
+    from flink_tpu.graph import AdamicAdar
+    g, edges, n = _random_graph(seed=5)
+    got = AdamicAdar().run(g)
+    nbrs = _brute_neighbors(edges, n)
+    want = {}
+    for u in range(n):
+        for v in range(u + 1, n):
+            shared = nbrs[u] & nbrs[v]
+            if shared:
+                want[(u, v)] = sum(1.0 / math.log(len(nbrs[w]))
+                                   for w in shared)
+    assert set(got) == set(want)
+    for k in want:
+        assert abs(got[k] - want[k]) < 1e-9, k
+
+
+def test_clustering_coefficient_differential():
+    from flink_tpu.graph import ClusteringCoefficient
+    g, edges, n = _random_graph(seed=6)
+    local, avg, global_cc = ClusteringCoefficient().run(g)
+    nbrs = _brute_neighbors(edges, n)
+    tri_total = 0
+    for v in range(n):
+        d = len(nbrs[v])
+        links = sum(1 for a in nbrs[v] for b in nbrs[v]
+                    if a < b and b in nbrs[a])
+        tri_total += links
+        want = links / (d * (d - 1) / 2) if d >= 2 else 0.0
+        assert abs(local[v] - want) < 1e-12, v
+    assert abs(avg - sum(local.values()) / n) < 1e-12
+    wedges = sum(len(nbrs[v]) * (len(nbrs[v]) - 1) / 2
+                 for v in range(n))
+    assert abs(global_cc - (tri_total / wedges if wedges else 0)) \
+        < 1e-12
+
+
+def test_clustering_coefficient_triangle():
+    from flink_tpu.graph import ClusteringCoefficient
+    g = Graph.from_collection(
+        vertices=[(i, 0) for i in range(4)],
+        edges=[(0, 1, 1.0), (1, 2, 1.0), (2, 0, 1.0), (2, 3, 1.0)])
+    local, avg, global_cc = ClusteringCoefficient().run(g)
+    assert local[0] == 1.0 and local[1] == 1.0
+    assert abs(local[2] - 1 / 3) < 1e-12 and local[3] == 0.0
+
+
+def test_community_detection_two_cliques():
+    """Two 5-cliques joined by one bridge edge: the attenuated-score
+    rule keeps them as two communities (plain LabelPropagation floods
+    one label across the bridge on this shape)."""
+    from flink_tpu.graph import CommunityDetection
+    cliques = []
+    for base in (0, 5):
+        for i in range(5):
+            for j in range(i + 1, 5):
+                cliques.append((base + i, base + j, 1.0))
+    cliques.append((4, 5, 0.1))   # weak bridge
+    g = Graph.from_collection(
+        vertices=[(i, 0) for i in range(10)], edges=cliques)
+    labels = CommunityDetection(max_iterations=30, delta=0.3).run(g)
+    left = {labels[i] for i in range(5)}
+    right = {labels[i] for i in range(5, 10)}
+    assert len(left) == 1 and len(right) == 1
+    assert left != right
