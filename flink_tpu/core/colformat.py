@@ -77,7 +77,7 @@ def write_columnar_file(path: str, schema: RecordSchema,
                 offsets = np.zeros(n_rows + 1, "<i8")
                 np.cumsum([len(b) for b in blobs],
                           out=offsets[1:]) if n_rows else None
-                _write_block(f, b"string")
+                _write_block(f, b"string8")
                 _write_block(f, offsets.tobytes())
                 _write_block(f, b"".join(blobs))
             elif fld.type == "bytes":
@@ -85,7 +85,7 @@ def write_columnar_file(path: str, schema: RecordSchema,
                 offsets = np.zeros(n_rows + 1, "<i8")
                 np.cumsum([len(b) for b in blobs],
                           out=offsets[1:]) if n_rows else None
-                _write_block(f, b"bytes")
+                _write_block(f, b"bytes8")
                 _write_block(f, offsets.tobytes())
                 _write_block(f, b"".join(blobs))
             else:
@@ -112,17 +112,18 @@ def read_columnar_file(path: str,
         for _ in writer.fields:
             name = _read_block(f).decode("utf-8")
             kind = _read_block(f).decode("ascii")
-            if kind in ("string", "bytes"):
+            if kind in ("string", "bytes", "string8", "bytes8"):
+                # "string8"/"bytes8" carry i8 offsets (2 GiB+ columns
+                # wrapped the original i4); the DISTINCT kind tag makes
+                # an old reader fail loudly on a new file instead of
+                # mis-slicing interleaved 32-bit words
                 raw_off = _read_block(f)
-                # i4 offsets are the v1 layout; i8 since (2 GiB+
-                # string columns wrapped in i4)
                 offsets = np.frombuffer(
-                    raw_off, "<i8" if len(raw_off) == 8 * (n_rows + 1)
-                    else "<i4")
+                    raw_off, "<i8" if kind.endswith("8") else "<i4")
                 blob = _read_block(f)
                 vals = [blob[offsets[i]:offsets[i + 1]]
                         for i in range(n_rows)]
-                if kind == "string":
+                if kind.startswith("string"):
                     raw[name] = np.asarray(
                         [v.decode("utf-8") for v in vals])
                 else:

@@ -106,38 +106,13 @@ class SingleSourceShortestPaths:
 class TriangleCount:
     """(ref: library/TriangleEnumerator.java / gelly TriangleCount)
     Counts undirected triangles via the adjacency-intersection method
-    on a dense bitset: for each edge (u, v), |N(u) ∩ N(v)| — computed
-    as packed-uint32 AND + popcount, a pure VPU workload."""
+    on a dense bitset — the shared per-edge kernel of
+    ClusteringCoefficient (each triangle is counted once per edge, so
+    the global count is sum/3)."""
 
     def run(self, graph) -> int:
-        n = graph.number_of_vertices()
-        if n == 0:
-            return 0
-        und = graph.get_undirected()
-        # dedupe + drop self loops; canonical (min, max) pairs
-        a = np.minimum(und.edge_src, und.edge_dst)
-        b = np.maximum(und.edge_src, und.edge_dst)
-        keep = a != b
-        pairs = np.unique(np.stack([a[keep], b[keep]], 1), axis=0)
-        words = (n + 31) // 32
-        adj = np.zeros((n, words), np.uint32)
-        u, v = pairs[:, 0], pairs[:, 1]
-        for s, t in ((u, v), (v, u)):
-            np.bitwise_or.at(adj, (s, t // 32),
-                             np.uint32(1) << (t % 32).astype(np.uint32))
-
-        from flink_tpu.ops.hashing import popcount32
-
-        @jax.jit
-        def count(adj, u, v):
-            inter = jnp.bitwise_and(adj[u], adj[v])
-            return jnp.sum(popcount32(inter))
-
-        total = int(count(jnp.asarray(adj), jnp.asarray(pairs[:, 0]),
-                          jnp.asarray(pairs[:, 1])))
-        # each triangle counted once per edge (3 edges) as a common
-        # neighbor
-        return total // 3
+        common = _edge_common_neighbors(_NeighborPairs(graph))
+        return int(common.sum()) // 3 if common is not None else 0
 
 
 class LabelPropagation:
@@ -385,12 +360,36 @@ class AdamicAdar:
                                    sums.tolist())}
 
 
+def _edge_common_neighbors(np_: "_NeighborPairs"):
+    """|N(u) ∩ N(v)| per canonical undirected edge, via the packed
+    uint32 bitset + popcount kernel (pure VPU work) — shared by
+    TriangleCount and ClusteringCoefficient."""
+    n = np_.n
+    if n == 0 or not len(np_.pairs):
+        return None
+    words = (n + 31) // 32
+    adj = np.zeros((n, words), np.uint32)
+    u, v = np_.pairs[:, 0], np_.pairs[:, 1]
+    for s, t in ((u, v), (v, u)):
+        np.bitwise_or.at(adj, (s, t // 32),
+                         np.uint32(1) << (t % 32).astype(np.uint32))
+
+    from flink_tpu.ops.hashing import popcount32
+
+    @jax.jit
+    def per_edge(adj, u, v):
+        inter = jnp.bitwise_and(adj[u], adj[v])
+        return jnp.sum(popcount32(inter), axis=1)
+
+    return np.asarray(per_edge(jnp.asarray(adj), jnp.asarray(u),
+                               jnp.asarray(v)))
+
+
 class ClusteringCoefficient:
     """(ref: flink-gelly library/clustering/
     LocalClusteringCoefficient + GlobalClusteringCoefficient +
     AverageClusteringCoefficient) — per-vertex triangle density over
-    the packed-bitset adjacency (the TriangleCount kernel, kept as
-    per-edge counts instead of a global sum)."""
+    the shared per-edge common-neighbor kernel."""
 
     def run(self, graph):
         """→ (local: Dict[vertex, float], average: float,
@@ -398,24 +397,10 @@ class ClusteringCoefficient:
         np_ = _NeighborPairs(graph)
         n = np_.n
         ids = graph.vertex_ids
-        if n == 0 or not len(np_.pairs):
+        common = _edge_common_neighbors(np_)
+        if common is None:
             return ({vid: 0.0 for vid in ids}, 0.0, 0.0)
-        words = (n + 31) // 32
-        adj = np.zeros((n, words), np.uint32)
         u, v = np_.pairs[:, 0], np_.pairs[:, 1]
-        for s, t in ((u, v), (v, u)):
-            np.bitwise_or.at(adj, (s, t // 32),
-                             np.uint32(1) << (t % 32).astype(np.uint32))
-
-        from flink_tpu.ops.hashing import popcount32
-
-        @jax.jit
-        def per_edge(adj, u, v):
-            inter = jnp.bitwise_and(adj[u], adj[v])
-            return jnp.sum(popcount32(inter), axis=1)
-
-        common = np.asarray(per_edge(jnp.asarray(adj), jnp.asarray(u),
-                                     jnp.asarray(v)))
         # each triangle {a,b,c} reaches vertex a through its two
         # incident edges -> tri[a] accumulates 2x the triangle count
         tri2 = np.zeros(n, np.int64)

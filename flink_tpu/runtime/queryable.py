@@ -96,6 +96,12 @@ class QueryableStateClient:
         table = getattr(state, "_table", None)
         if table is not None:
             value = table.get(key, namespace)
+            # aggregating state tables hold ACCUMULATORS; the query
+            # contract returns what state.get() would — the finalized
+            # result (HeapAggregatingState.java get() semantics)
+            agg = getattr(desc, "aggregate_function", None)
+            if value is not None and agg is not None:
+                value = agg.get_result(value)
         else:
             # device-backed state (TPU backend): the gather read path
             # — slot resolved by pure host reads, single-slot jitted

@@ -227,6 +227,12 @@ class ColumnarWindowOperator(StreamOperator):
             from flink_tpu.parallel.mesh_log import (
                 mesh_log_engine_for_assigner,
             )
+            from flink_tpu.streaming.device_window_operator import (
+                resolve_mesh,
+            )
+            # factory resolution stays INSIDE the integer-key branch:
+            # non-mesh-eligible jobs must not pay a device/client init
+            self.mesh = resolve_mesh(self.mesh)
             eng = mesh_log_engine_for_assigner(
                 self.assigner, self.agg, self.mesh, axis=self.mesh_axis,
                 max_parallelism=self.max_parallelism)
@@ -387,6 +393,10 @@ class ColumnarWindowOperator(StreamOperator):
             from flink_tpu.parallel.mesh_log import (
                 mesh_log_engine_for_assigner,
             )
+            from flink_tpu.streaming.device_window_operator import (
+                resolve_mesh,
+            )
+            self.mesh = resolve_mesh(self.mesh)
             if self.mesh is None:
                 raise RuntimeError(
                     "checkpoint was taken on the mesh log tier; "

@@ -185,7 +185,15 @@ class StreamExecutionEnvironment:
         """Shard device window aggregation over `mesh[axis]` — the
         keyBy exchange runs as lax.all_to_all over ICI inside the
         jitted step (flink_tpu.parallel.mesh_windows), the TPU-native
-        replacement for the reference's Netty key-group shuffle."""
+        replacement for the reference's Netty key-group shuffle.
+
+        `mesh` may be a CALLABLE returning a Mesh: the pod topology,
+        where each TaskExecutor process builds a mesh over its OWN
+        device subset at operator open (a Mesh holds live device
+        handles and cannot ship inside the pickled job graph).  With a
+        factory the operator runs at the env parallelism — the keyed
+        exchange shards keys across processes over the DCN data plane,
+        and each subtask's mesh shards its key range over ICI."""
         self.mesh = mesh
         self.mesh_axis = axis
         return self
@@ -828,7 +836,10 @@ class WindowedStream:
                 return DeviceWindowOperator(assigner, aggregate_function,
                                             window_function,
                                             mesh=mesh, mesh_axis=mesh_axis)
-            if mesh is not None:
+            from flink_tpu.streaming.device_window_operator import (
+                is_mesh_factory,
+            )
+            if mesh is not None and not is_mesh_factory(mesh):
                 # the mesh IS the parallelism: one host subtask drives
                 # the SPMD program over all devices; upstream edges
                 # still hash-route (to the single subtask) so the
@@ -836,6 +847,9 @@ class WindowedStream:
                 return self._keyed._add_op(
                     name, factory, parallelism=1,
                     key_selector=self._keyed.key_selector, chaining="head")
+            # a mesh FACTORY runs per subtask (pod topology: the keyed
+            # exchange spans processes, each subtask's own mesh spans
+            # its local devices)
             return self._keyed._add_keyed_op(name, factory, chaining="head")
         # arbitrary Python aggregates with the same eligible window
         # shapes ride the generic vectorized log tier (sort + diagonal

@@ -130,6 +130,20 @@ def engine_for_assigner(assigner, agg: DeviceAggregateFunction,
     return None
 
 
+def is_mesh_factory(mesh) -> bool:
+    """True for a callable that BUILDS a mesh (the pod-topology
+    per-process factory) as opposed to a Mesh instance — jax's Mesh is
+    itself callable (a context decorator), so `callable` alone cannot
+    discriminate; factories have no device grid `.shape`."""
+    return callable(mesh) and not hasattr(mesh, "shape")
+
+
+def resolve_mesh(mesh):
+    """Mesh | mesh-factory | None → Mesh | None (factories resolve in
+    the CURRENT process; device handles cannot ride a pickled graph)."""
+    return mesh() if is_mesh_factory(mesh) else mesh
+
+
 def is_device_eligible(assigner, aggregate_function, trigger, evictor,
                        allowed_lateness, late_tag, window_function) -> bool:
     """The graph-builder gate for the device fast path."""
@@ -234,6 +248,7 @@ class DeviceWindowOperator(StreamOperator):
         when eligible, else the sharded scatter engines."""
         if self.engine is not None:
             return
+        self.mesh = resolve_mesh(self.mesh)
         if self.mesh is not None:
             if np.issubdtype(keys_arr.dtype, np.integer):
                 from flink_tpu.parallel.mesh_log import (
@@ -479,6 +494,7 @@ class DeviceWindowOperator(StreamOperator):
                         from flink_tpu.parallel.mesh_log import (
                             mesh_log_engine_for_assigner,
                         )
+                        self.mesh = resolve_mesh(self.mesh)
                         if self.mesh is None:
                             raise RuntimeError(
                                 "checkpoint was taken on the mesh log "

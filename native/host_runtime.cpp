@@ -2401,10 +2401,31 @@ int64_t ft_intern_sum(void* interner, void* wsums, const uint8_t* rows,
   FtInterner& it = *static_cast<FtInterner*>(interner);
   FtWordSums& ws = *static_cast<FtWordSums*>(wsums);
   const double* w = has_weights ? weights : nullptr;
-  if (elem_size == 4)
-    return intern_sum_t(it, ws, reinterpret_cast<const uint32_t*>(rows),
-                        width, w, n, first_idx);
-  return intern_sum_t(it, ws, rows, width, w, n, first_idx);
+  // (r5) CHUNK the phase pipeline: the phase intermediates (hash /
+  // candidate / id per row) for a whole megabatch round-trip through
+  // DRAM; per ~8k rows they stay L2-resident, which keeps the
+  // phase-split ILP advantage intact when the shared box is
+  // bandwidth-starved (the r4 1.0-1.2x swing came exactly from this)
+  const int64_t CHUNK = 8192;
+  int64_t total_new = 0;
+  for (int64_t off = 0; off < n; off += CHUNK) {
+    int64_t m = n - off < CHUNK ? n - off : CHUNK;
+    const uint8_t* r = rows + off * width * elem_size;
+    const double* wc = w ? w + off : nullptr;
+    int64_t n_new;
+    if (elem_size == 4)
+      n_new = intern_sum_t(it, ws,
+                           reinterpret_cast<const uint32_t*>(r),
+                           width, wc, m, first_idx + total_new);
+    else
+      n_new = intern_sum_t(it, ws, r, width, wc, m,
+                           first_idx + total_new);
+    // first_idx entries are chunk-relative -> rebase to the batch
+    for (int64_t k = 0; k < n_new; ++k)
+      first_idx[total_new + k] += off;
+    total_new += n_new;
+  }
+  return total_new;
 }
 
 }  // extern "C"
