@@ -118,8 +118,15 @@ def read_columnar_file(path: str,
                 # an old reader fail loudly on a new file instead of
                 # mis-slicing interleaved 32-bit words
                 raw_off = _read_block(f)
-                offsets = np.frombuffer(
-                    raw_off, "<i8" if kind.endswith("8") else "<i4")
+                # "string8"/"bytes8" are explicitly i8; the LEGACY
+                # tags existed with both widths (an i8 interim wrote
+                # them untagged), so they sniff by block length
+                if kind.endswith("8") \
+                        or len(raw_off) == 8 * (n_rows + 1):
+                    odt = "<i8"
+                else:
+                    odt = "<i4"
+                offsets = np.frombuffer(raw_off, odt)
                 blob = _read_block(f)
                 vals = [blob[offsets[i]:offsets[i + 1]]
                         for i in range(n_rows)]

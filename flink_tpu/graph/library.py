@@ -279,14 +279,33 @@ class _NeighborPairs:
         keep = a != b
         self.pairs = (np.unique(np.stack([a[keep], b[keep]], 1), axis=0)
                       if keep.any() else np.zeros((0, 2), np.int32))
-        # CSR over both directions
+        self.deg = np.bincount(
+            np.concatenate([self.pairs[:, 0], self.pairs[:, 1]]),
+            minlength=self.n)
+        # CSR built lazily: only the 2-hop pair expansion needs it
+        # (TriangleCount / ClusteringCoefficient read just n + pairs)
+        self._adj_flat = None
+        self._indptr = None
+
+    def _build_csr(self):
+        if self._adj_flat is not None:
+            return
         s = np.concatenate([self.pairs[:, 0], self.pairs[:, 1]])
         t = np.concatenate([self.pairs[:, 1], self.pairs[:, 0]])
         order = np.argsort(s, kind="stable")
-        self.adj_flat = t[order]
-        self.deg = np.bincount(s, minlength=self.n)
-        self.indptr = np.zeros(self.n + 1, np.int64)
-        np.cumsum(self.deg, out=self.indptr[1:])
+        self._adj_flat = t[order]
+        self._indptr = np.zeros(self.n + 1, np.int64)
+        np.cumsum(self.deg, out=self._indptr[1:])
+
+    @property
+    def adj_flat(self):
+        self._build_csr()
+        return self._adj_flat
+
+    @property
+    def indptr(self):
+        self._build_csr()
+        return self._indptr
 
     def two_hop_pairs(self):
         """→ (pair_u, pair_v, via) — one row per (neighbor pair,

@@ -552,12 +552,19 @@ def _try_columnar_windowed_agg(table: Table, keys: List[Expr],
         ColumnarWindowOperator,
     )
 
-    # with a mesh set (and task parallelism 1), the keyBy exchange
-    # rides the mesh axis (lax.all_to_all + per-shard log engines,
-    # parallel/mesh_log.py) instead of the TCP split exchange — the
-    # mesh IS the scale axis
+    # with a mesh INSTANCE set (and task parallelism 1), the keyBy
+    # exchange rides the mesh axis (lax.all_to_all + per-shard log
+    # engines, parallel/mesh_log.py) instead of the TCP split
+    # exchange — the mesh IS the scale axis.  A mesh FACTORY (the pod
+    # topology) keeps the env parallelism: the split exchange shards
+    # keys across subtasks/processes and each subtask's own mesh
+    # shards its range (same contract as the DataStream path).
+    from flink_tpu.streaming.device_window_operator import (
+        is_mesh_factory,
+    )
     env = table.stream.env
-    mesh = env.mesh if env.parallelism == 1 else None
+    mesh = (env.mesh if env.parallelism == 1
+            or is_mesh_factory(env.mesh) else None)
     mesh_axis = env.mesh_axis
 
     def factory(assigner=assigner, agg=agg, key_col=key_col,

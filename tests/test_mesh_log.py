@@ -351,3 +351,48 @@ def test_datastream_session_job_on_mesh():
     got = run(_mesh())
     want = run(None)
     assert got == want and len(got) > 0
+
+
+def test_sql_mesh_factory_at_parallelism_2():
+    """Pod-topology SQL: a mesh FACTORY with parallelism 2 keeps the
+    mesh tier per subtask (each builds its own 4-device mesh) and
+    results equal the meshless run."""
+    import jax
+    from jax.sharding import Mesh
+
+    def factory():
+        devices = jax.devices()
+        return Mesh(np.array(devices[:4]), ("kg",))
+
+    rng = np.random.default_rng(19)
+    n = 6000
+    cols = {
+        "k": rng.integers(0, 24, n).astype(np.int64),
+        "u": rng.integers(0, 64, n).astype(np.int64),
+        "ts": np.sort(rng.integers(0, 4000, n).astype(np.int64)),
+    }
+
+    def run(mesh):
+        from flink_tpu.streaming.datastream import (
+            StreamExecutionEnvironment,
+        )
+        from flink_tpu.streaming.sources import CollectSink
+        from flink_tpu.table import StreamTableEnvironment
+        env = StreamExecutionEnvironment()
+        if mesh is not None:
+            env.set_mesh(mesh)
+            env.set_parallelism(2)
+        t_env = StreamTableEnvironment.create(env)
+        t_env.register_table("ev", t_env.from_columns(
+            dict(cols), rowtime="ts"))
+        out = t_env.sql_query(
+            "SELECT k, APPROX_COUNT_DISTINCT(u) AS d FROM ev "
+            "GROUP BY TUMBLE(ts, INTERVAL '1' SECOND), k")
+        sink = CollectSink()
+        out.to_append_stream().add_sink(sink)
+        env.execute("sql-mesh-factory")
+        return sorted(sink.values)
+
+    got = run(factory)
+    want = run(None)
+    assert got == want and len(got) > 0
