@@ -57,14 +57,24 @@ class PatternStream:
     def _build(self, emit_fn, name: str):
         stream = self.stream
         keyed = hasattr(stream, "key_selector") and stream.key_selector
-        # STRICT next-chains with unary conditions ride the batched
-        # vectorized NFA (cep/vectorized.py); everything else (loops,
-        # negation, skip-till, timeout side outputs) runs the scalar
-        # per-record operator
-        from flink_tpu.cep.vectorized import pattern_vectorizable
-        if (self._vectorized_enabled and self.timeout_tag is None
-                and pattern_vectorizable(self.pattern)
-                and stream.env.time_characteristic == "event"):
+        # STRICT / skip-till-next chains with unary conditions ride
+        # the batched vectorized NFA (cep/vectorized.py); everything
+        # else (loops, negation, skip-till-ANY, timeout side outputs)
+        # runs the scalar per-record operator.  Skip chains have no
+        # numpy fallback — their per-stage run lists live in the
+        # native run-list kernel — so they additionally require the
+        # native runtime.
+        from flink_tpu.cep.vectorized import (
+            pattern_strict_chain,
+            pattern_vectorizable,
+        )
+        vec_ok = (self._vectorized_enabled and self.timeout_tag is None
+                  and pattern_vectorizable(self.pattern)
+                  and stream.env.time_characteristic == "event")
+        if vec_ok and not pattern_strict_chain(self.pattern):
+            import flink_tpu.native as nat
+            vec_ok = nat.available()
+        if vec_ok:
             pattern = self.pattern
             if not keyed:
                 stream = stream.key_by(lambda e: 0)

@@ -17,6 +17,8 @@ from __future__ import annotations
 import inspect
 from typing import Callable, List, Optional
 
+import numpy as np
+
 STRICT = "strict"               # next
 SKIP_TILL_NEXT = "skip_next"    # followedBy
 SKIP_TILL_ANY = "skip_any"      # followedByAny
@@ -176,3 +178,321 @@ class Pattern:
 
     def __repr__(self):
         return f"Pattern({self.stages}, within={self.within_ms})"
+
+
+# ---- predicate bytecode -------------------------------------------------
+# Conditions written as comparisons / arithmetic / boolean algebra over
+# the event's numeric fields lower to a tiny postfix stack program that
+# the native runtime evaluates columnwise (ft_cep_eval_masks /
+# ft_cep_advance_prog) — keeping every NFA transition inside one tight
+# native loop the way the reference does (NFA.java:202-221) instead of
+# calling back into Python per condition.  Conditions that do not lower
+# keep the existing lift-probe / scalar fallback unchanged.
+#
+# Program encoding: int64 [n, 2] rows of (opcode, arg).  arg is a
+# column index for OP_COL, a consts-table index for OP_CONST, unused
+# otherwise.  Comparisons and boolean ops produce 0.0/1.0 doubles;
+# truthiness everywhere is "nonzero" (NaN counts as true, matching
+# Python's bool(nan) and C's nan != 0.0).
+
+OP_COL, OP_CONST = 0, 1
+OP_ADD, OP_SUB, OP_MUL, OP_DIV, OP_NEG, OP_ABS = 2, 3, 4, 5, 6, 7
+OP_LT, OP_LE, OP_GT, OP_GE, OP_EQ, OP_NE = 10, 11, 12, 13, 14, 15
+OP_AND, OP_OR, OP_NOT = 20, 21, 22
+
+_NUM_SCALARS = (bool, int, float, np.integer, np.floating, np.bool_)
+
+
+class TraceFail(Exception):
+    """The condition's shape cannot be predicate bytecode."""
+
+
+def _as_expr(v):
+    if isinstance(v, CepExpr):
+        return v
+    if isinstance(v, _NUM_SCALARS):
+        return CepExpr([(OP_CONST, float(v))])
+    return None
+
+
+class CepExpr:
+    """Symbolic value flowing through a condition during tracing;
+    operators append postfix code.  Control flow on a symbolic value
+    (``bool``, ``if``, ``and``/``or``, hashing into a set) raises
+    TraceFail, so the condition keeps its Python evaluation path.
+    Equality against a non-numeric operand must RAISE rather than
+    return NotImplemented — Python's identity-comparison fallback
+    would otherwise silently lower ``e == "VIP"`` to constant False.
+    """
+
+    __slots__ = ("code",)
+    __array_ufunc__ = None      # numpy scalars defer to our reflected ops
+
+    def __init__(self, code):
+        self.code = code
+
+    def _bin(self, other, op, swap=False):
+        o = _as_expr(other)
+        if o is None:
+            return NotImplemented
+        a, b = (o, self) if swap else (self, o)
+        return CepExpr(a.code + b.code + [(op, 0.0)])
+
+    # arithmetic
+    def __add__(self, o):
+        return self._bin(o, OP_ADD)
+
+    def __radd__(self, o):
+        return self._bin(o, OP_ADD, swap=True)
+
+    def __sub__(self, o):
+        return self._bin(o, OP_SUB)
+
+    def __rsub__(self, o):
+        return self._bin(o, OP_SUB, swap=True)
+
+    def __mul__(self, o):
+        return self._bin(o, OP_MUL)
+
+    def __rmul__(self, o):
+        return self._bin(o, OP_MUL, swap=True)
+
+    def __truediv__(self, o):
+        return self._bin(o, OP_DIV)
+
+    def __rtruediv__(self, o):
+        return self._bin(o, OP_DIV, swap=True)
+
+    def __neg__(self):
+        return CepExpr(self.code + [(OP_NEG, 0.0)])
+
+    def __pos__(self):
+        return self
+
+    def __abs__(self):
+        return CepExpr(self.code + [(OP_ABS, 0.0)])
+
+    # comparisons — ordering returns NotImplemented on foreign
+    # operands (Python then raises TypeError and the trace falls
+    # back); equality must raise instead (see class docstring)
+    def __lt__(self, o):
+        return self._bin(o, OP_LT)
+
+    def __le__(self, o):
+        return self._bin(o, OP_LE)
+
+    def __gt__(self, o):
+        return self._bin(o, OP_GT)
+
+    def __ge__(self, o):
+        return self._bin(o, OP_GE)
+
+    def __eq__(self, o):
+        r = self._bin(o, OP_EQ)
+        if r is NotImplemented:
+            raise TraceFail("equality against a non-numeric operand")
+        return r
+
+    def __ne__(self, o):
+        r = self._bin(o, OP_NE)
+        if r is NotImplemented:
+            raise TraceFail("inequality against a non-numeric operand")
+        return r
+
+    # boolean algebra (the &/|/~ idiom lifted conditions already use)
+    def __and__(self, o):
+        return self._bin(o, OP_AND)
+
+    def __rand__(self, o):
+        return self._bin(o, OP_AND, swap=True)
+
+    def __or__(self, o):
+        return self._bin(o, OP_OR)
+
+    def __ror__(self, o):
+        return self._bin(o, OP_OR, swap=True)
+
+    def __invert__(self):
+        return CepExpr(self.code + [(OP_NOT, 0.0)])
+
+    def __bool__(self):
+        raise TraceFail("data-dependent control flow in condition")
+
+    # stringification would feed "<CepExpr object at …>" into string
+    # comparisons and silently compile them to a constant — refuse
+    def __str__(self):
+        raise TraceFail("symbolic value stringified")
+
+    def __repr__(self):
+        raise TraceFail("symbolic value stringified")
+
+    def __format__(self, spec):
+        raise TraceFail("symbolic value stringified")
+
+    def __hash__(self):
+        # a hash lookup (``e in {…}``) would silently miss and yield
+        # constant False — refuse instead
+        raise TraceFail("symbolic value used as a hash key")
+
+
+class _SymEvent:
+    """Symbolic tuple/list event: ``e[i]`` loads numeric column i."""
+
+    __slots__ = ("_numeric",)
+
+    def __init__(self, numeric):
+        self._numeric = numeric    # per-column: dtype lowers to f64
+
+    def __len__(self):
+        return len(self._numeric)
+
+    def __getitem__(self, i):
+        if isinstance(i, bool) or not isinstance(i, (int, np.integer)):
+            raise TraceFail("non-integer event field index")
+        n = len(self._numeric)
+        if i < 0:
+            i += n
+        if not 0 <= i < n:
+            raise IndexError(i)
+        if not self._numeric[i]:
+            raise TraceFail(f"event column {i} is not numeric")
+        return CepExpr([(OP_COL, float(i))])
+
+    def __iter__(self):
+        return (self[i] for i in range(len(self)))
+
+
+def trace_condition(cond, sym):
+    """Run ``cond`` over a symbolic event; returns postfix code (list
+    of (op, arg) pairs) or None when the condition does not lower."""
+    if _is_binary(cond):
+        return None
+    try:
+        r = cond(sym)
+    except Exception:
+        return None
+    if isinstance(r, CepExpr):
+        return r.code
+    if isinstance(r, _NUM_SCALARS):      # constant condition
+        return [(OP_CONST, float(r))]
+    return None
+
+
+def _stage_code(stage, sym):
+    """One stage's predicate: AND over groups of OR'd conditions.
+    Leaves exactly one value on the stack; None if any condition in
+    the stage fails to lower."""
+    if not stage.conditions:
+        return [(OP_CONST, 1.0)]
+    code = []
+    for gi, group in enumerate(stage.conditions):
+        for ci, cond in enumerate(group):
+            c = trace_condition(cond, sym)
+            if c is None:
+                return None
+            code += c
+            if ci:
+                code.append((OP_OR, 0.0))
+        if gi:
+            code.append((OP_AND, 0.0))
+    return code
+
+
+def compile_stage_programs(pattern, vspec, cols):
+    """Lower every stage's conditions to one concatenated predicate
+    program.  Returns (prog int64 [n,2], stage_off int64 [k+1],
+    consts float64 [m]) — stage s occupies prog[stage_off[s]:
+    stage_off[s+1]] — or None when any stage fails to lower (the
+    engine then keeps the lift/scalar modes)."""
+    if vspec == "scalar":
+        if cols[0].dtype.kind not in "iufb":
+            return None
+        sym = CepExpr([(OP_COL, 0.0)])
+    elif isinstance(vspec, tuple):
+        _, ncols = vspec
+        sym = _SymEvent([cols[i].dtype.kind in "iufb"
+                         for i in range(ncols)])
+    else:
+        return None
+    chunks = []
+    offs = [0]
+    for st in pattern.stages:
+        code = _stage_code(st, sym)
+        if code is None:
+            return None
+        chunks.append(code)
+        offs.append(offs[-1] + len(code))
+    prog = np.zeros((offs[-1], 2), np.int64)
+    consts: List[float] = []
+    cidx = {}
+    pos = 0
+    for code in chunks:
+        for op, arg in code:
+            prog[pos, 0] = op
+            if op == OP_COL:
+                prog[pos, 1] = int(arg)
+            elif op == OP_CONST:
+                key = np.float64(arg).tobytes()   # NaN-safe interning
+                j = cidx.get(key)
+                if j is None:
+                    j = cidx[key] = len(consts)
+                    consts.append(float(arg))
+                prog[pos, 1] = j
+            pos += 1
+    return (prog, np.asarray(offs, np.int64),
+            np.asarray(consts, np.float64))
+
+
+def eval_stage_program(prog, stage_off, consts, stage, cols):
+    """Reference evaluator for one stage's program over float64
+    columns; returns a bool mask.  Mirrors the native stack machine
+    exactly (comparisons produce 0/1 doubles, truthiness is nonzero)
+    — used to verify the compiled program against Stage.accepts on
+    the probe sample."""
+    code = prog[int(stage_off[stage]):int(stage_off[stage + 1])]
+    n = len(cols[0]) if cols else 0
+    stack = []
+    with np.errstate(all="ignore"):
+        for op, arg in code:
+            op = int(op)
+            if op == OP_COL:
+                stack.append(cols[int(arg)])
+            elif op == OP_CONST:
+                stack.append(np.full(n, consts[int(arg)]))
+            elif op == OP_NEG:
+                stack.append(-stack.pop())
+            elif op == OP_ABS:
+                stack.append(np.abs(stack.pop()))
+            elif op == OP_NOT:
+                stack.append((stack.pop() == 0.0).astype(np.float64))
+            else:
+                b = stack.pop()
+                a = stack.pop()
+                if op == OP_ADD:
+                    r = a + b
+                elif op == OP_SUB:
+                    r = a - b
+                elif op == OP_MUL:
+                    r = a * b
+                elif op == OP_DIV:
+                    r = a / b
+                elif op == OP_LT:
+                    r = (a < b).astype(np.float64)
+                elif op == OP_LE:
+                    r = (a <= b).astype(np.float64)
+                elif op == OP_GT:
+                    r = (a > b).astype(np.float64)
+                elif op == OP_GE:
+                    r = (a >= b).astype(np.float64)
+                elif op == OP_EQ:
+                    r = (a == b).astype(np.float64)
+                elif op == OP_NE:
+                    r = (a != b).astype(np.float64)
+                elif op == OP_AND:
+                    r = ((a != 0.0) & (b != 0.0)).astype(np.float64)
+                elif op == OP_OR:
+                    r = ((a != 0.0) | (b != 0.0)).astype(np.float64)
+                else:
+                    raise ValueError(f"bad opcode {op}")
+                stack.append(r)
+    return stack[-1] != 0.0

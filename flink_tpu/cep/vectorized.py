@@ -1,4 +1,5 @@
-"""Vectorized CEP: batched NFA advance for STRICT next-chains.
+"""Vectorized CEP: batched NFA advance for STRICT and SKIP_TILL_NEXT
+single-event chains.
 
 The reference runs its NFA per record inside a keyed operator
 (flink-cep/.../nfa/NFA.java:202-221 process, SharedBuffer match
@@ -23,9 +24,23 @@ kernel, and the per-key event sequence applies in diagonal rounds, so
 Python-level work per batch is O(max per-key multiplicity × stages),
 not O(records).
 
-Patterns outside the shape (loops, optional, negation, skip-till
-contiguity, binary conditions) run the scalar NFA unchanged — the gate
-is `pattern_vectorizable`.
+Relaxed contiguity (``followedBy`` / SKIP_TILL_NEXT) breaks the
+one-run-per-stage collapse — a stage can hold many waiting runs — but
+advancement stays all-or-nothing per event, so per-key state is one
+run LIST per stage and the whole transition is a list splice.  That
+shape runs in the native run-list kernel (ft_cepr_*); there is no
+numpy fallback for it, so skip chains additionally gate on the native
+runtime being present.
+
+Conditions that lower to predicate bytecode
+(cep/pattern.py compile_stage_programs) evaluate INSIDE the native
+kernel (mode "compiled") — the per-batch Python condition callbacks
+and mask packing disappear.  Everything else keeps the lift-probe
+("lifted") and per-row ("scalar") modes.
+
+Patterns outside the shape (loops, optional, negation, skip-till-ANY,
+binary conditions) run the scalar NFA unchanged — the gate is
+`pattern_vectorizable`.
 """
 
 from __future__ import annotations
@@ -34,29 +49,46 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
-from flink_tpu.cep.pattern import STRICT, Pattern
+from flink_tpu.cep.pattern import (
+    SKIP_TILL_NEXT,
+    STRICT,
+    Pattern,
+    compile_stage_programs,
+    eval_stage_program,
+)
 from flink_tpu.streaming.generic_agg import columnify, _value_struct
 
-__all__ = ["pattern_vectorizable", "VectorizedStrictNFA"]
+__all__ = ["pattern_vectorizable", "pattern_strict_chain",
+           "VectorizedStrictNFA"]
 
 
 def pattern_vectorizable(pattern: Pattern) -> bool:
-    """True when the pattern is a STRICT chain of single-event,
-    non-negated, unary-condition stages (the shape whose NFA state is
-    one run per stage)."""
+    """True when the pattern is a chain of single-event, non-negated,
+    unary-condition stages under STRICT or SKIP_TILL_NEXT contiguity.
+    Strict chains collapse to one run per stage (masked shift);
+    skip-till-next chains keep per-stage run lists in the native
+    run-list kernel.  Loops, optional, negation, skip-till-ANY and
+    binary conditions run the scalar NFA."""
     from flink_tpu.cep.pattern import _is_binary
     for i, st in enumerate(pattern.stages):
         if st.negated or st.optional or st.greedy:
             return False
         if st.min_times != 1 or st.max_times != 1:
             return False
-        if i > 0 and st.contiguity != STRICT:
+        if i > 0 and st.contiguity not in (STRICT, SKIP_TILL_NEXT):
             return False
         for group in st.conditions:
             for cond in group:
                 if _is_binary(cond):
                     return False
     return True
+
+
+def pattern_strict_chain(pattern: Pattern) -> bool:
+    """True when every post-begin stage is STRICT — the shape with a
+    pure-numpy fallback.  Skip-till-next chains require the native
+    run-list kernel (callers gate on native availability)."""
+    return all(st.contiguity == STRICT for st in pattern.stages[1:])
 
 
 class _EventLog:
@@ -147,6 +179,29 @@ class VectorizedStrictNFA:
         self.pattern = pattern
         self.k = len(pattern.stages)
         self.within = pattern.within_ms
+        #: any post-begin stage with relaxed contiguity → per-stage
+        #: run lists in the native run-list kernel (no numpy fallback)
+        self.skip_chain = not pattern_strict_chain(pattern)
+        #: bit s set = stage s relates STRICTly to its predecessor
+        #: (a waiting run at s dies on a non-matching event)
+        self.strict_bits = sum(
+            1 << s for s in range(1, self.k)
+            if pattern.stages[s].contiguity == STRICT)
+        if self.skip_chain:
+            import flink_tpu.native as nat
+            if not nat.available():
+                raise RuntimeError(
+                    "skip-till-next (followedBy) chains run on the "
+                    "native run-list kernel; native runtime "
+                    "unavailable: %s" % (nat.load_error(),))
+        self._nat_runs = None
+        #: compiled predicate program (prog, stage_off, consts) when
+        #: mode == "compiled"; None until probed (or after restore,
+        #: which recompiles lazily from the first batch)
+        self._prog = None
+        #: "int" | "obj" once the first batch fixes the kernel-key
+        #: scheme for the run-list tier
+        self._key_mode: Optional[str] = None
         self._index: Dict[Any, int] = {}
         self._nat_index = None
         self._nat_state = None
@@ -163,6 +218,11 @@ class VectorizedStrictNFA:
         self.mode: Optional[str] = None
         self.matches: List[Tuple[Any, Dict[str, List[Any]]]] = []
         self.num_timeouts = 0
+        #: next log end-gid at which native compaction runs (the
+        #: expire + min_ref table scans are paced by APPENDED volume,
+        #: not attempted per batch — a pinned watermark would
+        #: otherwise rescan the whole table every batch for nothing)
+        self._next_compact = 1 << 20
         #: max event time seen (drives dormant-run expiry sweeps)
         self.watermark = -(2 ** 63)
 
@@ -248,11 +308,37 @@ class VectorizedStrictNFA:
 
     def _probe(self, cols, vspec, rows, n: int) -> None:
         """Lift the conditions if column evaluation matches the scalar
-        truth on a sample (same contract as LiftedAggregate.probe)."""
+        truth on a sample (same contract as LiftedAggregate.probe).
+        Conditions that also lower to predicate bytecode verify the
+        same way — compiled program vs Stage.accepts on the sample —
+        and lock mode "compiled": masks are then evaluated inside the
+        native kernel and never cross back into Python."""
         if vspec is None or cols is None:
             self.mode = "scalar"
             return
         m = min(64, n)
+        import flink_tpu.native as nat
+        if nat.available():
+            compiled = compile_stage_programs(self.pattern, vspec, cols)
+            if compiled is not None:
+                prog, off, consts = compiled
+                try:
+                    f64 = [np.ascontiguousarray(c[:m], np.float64)
+                           for c in cols]
+                    for s, st in enumerate(self.pattern.stages):
+                        got = eval_stage_program(prog, off, consts,
+                                                 s, f64)
+                        want = np.asarray([st.accepts(rows[i], {})
+                                           for i in range(m)], bool)
+                        if not np.array_equal(got, want):
+                            raise ValueError(
+                                "compiled mask disagrees")
+                except Exception:
+                    pass
+                else:
+                    self.mode = "compiled"
+                    self._prog = compiled
+                    return
         sample_cols = [c[:m] for c in cols]
         try:
             vs = _value_struct(sample_cols, vspec)
@@ -300,21 +386,73 @@ class VectorizedStrictNFA:
                       [self.log_sample_row(cols, vspec, i)
                        for i in range(min(64, n))])
             self._probe(cols, vspec, sample, len(sample))
+        import flink_tpu.native as nat
+        int_keys = keys.dtype in (np.dtype(np.uint64),
+                                  np.dtype(np.int64))
+        if (self._nat_state is not None and not int_keys
+                and self._key_mode != "obj"):
+            raise TypeError(
+                "key type changed mid-stream (integer keys locked the "
+                "native CEP state); CEP keys must keep one type")
+
+        if self.mode == "compiled":
+            if self._prog is None:
+                # restored checkpoint: recompile against this stream
+                self._prog = compile_stage_programs(
+                    self.pattern, vspec, cols)
+                if self._prog is None:
+                    raise RuntimeError(
+                        "compiled CEP checkpoint restored against a "
+                        "stream whose conditions no longer lower to "
+                        "predicate bytecode")
+            prog, off, consts = self._prog
+            kh = self._kernel_keys(keys)
+            ncols = len(cols)
+            if ncols == 1 and cols[0].dtype.kind in "iufb":
+                flat = np.ascontiguousarray(cols[0], np.float64)
+            else:
+                # column-major pack; non-numeric columns zero-fill
+                # (the tracer refuses to reference them, so no
+                # compiled program ever reads those lanes)
+                flat = np.empty(ncols * n, np.float64)
+                for i, c2 in enumerate(cols):
+                    seg = flat[i * n:(i + 1) * n]
+                    seg[:] = c2 if c2.dtype.kind in "iufb" else 0.0
+            if self.skip_chain:
+                refs, pos = self._ensure_runs().advance_prog(
+                    kh, ts, base_gid, prog, off, consts, flat, ncols)
+            else:
+                if self._nat_state is None:
+                    self._nat_state = nat.NativeCepState(
+                        self.k,
+                        -1 if self.within is None else self.within)
+                refs, pos = self._nat_state.advance_prog(
+                    kh, ts, base_gid, prog, off, consts, flat, ncols)
+            self._emit_native(keys, ts, refs, pos)
+            self._maybe_compact_native()
+            return
+
         if self.mode == "scalar" and rows is None:
             rows = [self.log_sample_row(cols, vspec, i)
                     for i in range(n)]
         masks = self._stage_masks(cols, vspec, rows, n)
 
+        if self.skip_chain:
+            # lifted/scalar masks feed the run-list kernel as packed
+            # per-row stage bits (the numpy shifted-mask algebra below
+            # is strict-only)
+            bits = masks[0].astype(np.uint32)
+            for s in range(1, self.k):
+                bits |= masks[s].astype(np.uint32) << np.uint32(s)
+            refs, pos = self._ensure_runs().advance(
+                self._kernel_keys(keys), bits, ts, base_gid)
+            self._emit_native(keys, ts, refs, pos)
+            self._maybe_compact_native()
+            return
+
         # fused native path: pack the stage masks into per-row bits
         # and let the C++ kernel group + walk + match in one pass
         # (ft_cep_advance; state lives native across batches)
-        import flink_tpu.native as nat
-        int_keys = keys.dtype in (np.dtype(np.uint64),
-                                  np.dtype(np.int64))
-        if self._nat_state is not None and not int_keys:
-            raise TypeError(
-                "key type changed mid-stream (integer keys locked the "
-                "native CEP state); CEP keys must keep one type")
         if int_keys and nat.available() and self._numpy_state_empty():
             if self._nat_state is None:
                 self._nat_state = nat.NativeCepState(
@@ -323,20 +461,8 @@ class VectorizedStrictNFA:
             for s in range(1, self.k):
                 bits |= masks[s].astype(np.uint32) << np.uint32(s)
             refs, pos = self._nat_state.advance(
-                keys.view(np.uint64), bits, ts, base_gid)
-            if len(pos):
-                pk = keys[pos]
-                pt = ts[pos]
-                names = [st.name for st in self.pattern.stages]
-                log = self.log
-                for i in range(len(pos)):
-                    events = {}
-                    for j, name in enumerate(names):
-                        events.setdefault(name, []).append(
-                            log.get(int(refs[i, j])))
-                    self.matches.append((int(pk[i]) if pk.dtype.kind
-                                         in "iu" else pk[i], events,
-                                         int(pt[i])))
+                self._kernel_keys(keys), bits, ts, base_gid)
+            self._emit_native(keys, ts, refs, pos)
             self._maybe_compact_native()
             return
 
@@ -509,6 +635,56 @@ class VectorizedStrictNFA:
             self.matches.append((slot_keys[int(slots[i])], events,
                                  int(ts[i])))
 
+    def _kernel_keys(self, keys: np.ndarray) -> np.ndarray:
+        """Per-row uint64 kernel keys: 64-bit integer keys pass
+        through (splitmix64 in-kernel is a bijection on them); other
+        key types go through the dense slot mapping — the slot id IS
+        the kernel key, so arbitrary hashable keys ride the native
+        tiers (match keys recover positionally as ``keys[pos]`` from
+        the batch).  The scheme locks on the first batch: raw integer
+        keys and slot ids share one hash space, so mixing them could
+        silently merge two keys' state."""
+        int_keys = keys.dtype in (np.dtype(np.uint64),
+                                  np.dtype(np.int64))
+        mode = "int" if int_keys else "obj"
+        if self._key_mode is None:
+            self._key_mode = mode
+        elif self._key_mode != mode:
+            raise TypeError(
+                "key type changed mid-stream (the first batch locked "
+                "the native kernel-key scheme); CEP keys must keep "
+                "one type")
+        if int_keys:
+            return keys.view(np.uint64)
+        return self._slots_of(keys).astype(np.uint64)
+
+    def _ensure_runs(self):
+        if self._nat_runs is None:
+            import flink_tpu.native as nat
+            self._nat_runs = nat.NativeCepRuns(
+                self.k, -1 if self.within is None else self.within,
+                self.strict_bits)
+        return self._nat_runs
+
+    def _emit_native(self, keys, ts, refs, pos):
+        """Materialize matches from a native-tier result: ``pos`` is
+        the batch row of each match's last event, ``refs`` the k
+        global event ids."""
+        if not len(pos):
+            return
+        pk = keys[pos]
+        pt = ts[pos]
+        names = [st.name for st in self.pattern.stages]
+        log = self.log
+        int_k = pk.dtype.kind in "iu"
+        for i in range(len(pos)):
+            events = {}
+            for j, name in enumerate(names):
+                events.setdefault(name, []).append(
+                    log.get(int(refs[i, j])))
+            self.matches.append((int(pk[i]) if int_k else pk[i],
+                                 events, int(pt[i])))
+
     def _numpy_state_empty(self) -> bool:
         """The native and numpy state paths are exclusive; the numpy
         arrays must be untouched before the native path engages (key
@@ -517,17 +693,34 @@ class VectorizedStrictNFA:
         return not self._slot_keys
 
     def _maybe_compact_native(self):
-        if self._log_span() < (1 << 20):
+        end = self._log_end()
+        if end < self._next_compact or self._log_span() < (1 << 20):
+            return
+        self._next_compact = end + (1 << 22)
+        state = (self._nat_runs if self._nat_runs is not None
+                 else self._nat_state)
+        if state is None:
             return
         if self.within is not None:
             # sweep runs whose within() horizon has passed — dormant
             # keys would otherwise pin the compaction watermark and
             # the event log would grow without bound
-            import flink_tpu.native as nat2
-            nat2.cep_expire(self._nat_state, self.watermark)
-        lo = self._nat_state.min_ref()   # one sequential C++ scan
+            if self._nat_runs is not None:
+                self._nat_runs.expire(self.watermark)
+            else:
+                import flink_tpu.native as nat2
+                nat2.cep_expire(self._nat_state, self.watermark)
+        lo = state.min_ref()   # one sequential C++ scan
         self.log.compact(np.asarray([lo], np.int64)
                          if lo < (1 << 62) else np.zeros(0, np.int64))
+
+    def _log_end(self) -> int:
+        if self.log.columnar:
+            if not self.log.chunks:
+                return self.log.base
+            return (self.log.chunks[-1][0]
+                    + len(self.log.chunks[-1][1][0]))
+        return self.log.base + len(self.log.rows)
 
     def _log_span(self) -> int:
         if self.log.columnar:
@@ -565,8 +758,19 @@ class VectorizedStrictNFA:
             keys, active, cold = self._nat_state.export()
             nat_state = {"keys": keys, "active": active,
                          "cold": cold, "within": self.within}
+        nat_runs = None
+        if self._nat_runs is not None:
+            # flat int64 blob (ft_cepr_export: per live key, the run
+            # lists oldest-first); the mode/"compiled" flag travels
+            # separately — the program itself recompiles lazily from
+            # the first post-restore batch
+            nat_runs = {"blob": self._nat_runs.export(),
+                        "within": self.within,
+                        "strict_bits": self.strict_bits}
         return {
             "nat_state": nat_state,
+            "nat_runs": nat_runs,
+            "key_mode": self._key_mode,
             "keys": list(self._slot_keys),
             "active": [a[:n].copy() for a in self.active],
             "start": [s[:n].copy() for s in self.start],
@@ -609,6 +813,10 @@ class VectorizedStrictNFA:
         self.log.columnar = snap.get("log_columnar", False)
         self.mode = snap["mode"]
         self.num_timeouts = snap["num_timeouts"]
+        self._key_mode = snap.get("key_mode")
+        # compiled programs never checkpoint — they recompile (and
+        # re-verify) against the first post-restore batch
+        self._prog = None
         self._nat_state = None
         ns = snap.get("nat_state")
         if ns is not None:
@@ -622,3 +830,15 @@ class VectorizedStrictNFA:
                 capacity=max(2 * len(ns["keys"]), 1 << 12))
             self._nat_state.import_(ns["keys"], ns["active"],
                                     ns["cold"])
+        self._nat_runs = None
+        nr = snap.get("nat_runs")
+        if nr is not None:
+            import flink_tpu.native as nat
+            if not nat.available():
+                raise RuntimeError(
+                    "checkpoint holds native CEP run-list state; "
+                    "restoring requires the native runtime")
+            self._nat_runs = nat.NativeCepRuns(
+                self.k, -1 if self.within is None else self.within,
+                self.strict_bits)
+            self._nat_runs.import_(nr["blob"])

@@ -99,9 +99,13 @@ class UpsertSink(RichSinkFunction):
     """Checkpoint-aligned idempotent upsert sink.
 
     ``key_fn(value) -> doc_id`` and ``doc_fn(value) -> dict`` extract
-    the mutation from each record.  Records may also be retract pairs
-    ``(is_add, row)`` (a Table's to_retract_stream): a retract maps to
-    a DELETE of the row's id.
+    the mutation from each record.  With ``retract_stream=True``
+    records are ``(is_add, row)`` pairs (a Table's
+    to_retract_stream): a retract maps to a DELETE of the row's id.
+    The flag is wired automatically when the sink is attached to a
+    ``to_retract_stream()`` result — plain streams are NEVER sniffed
+    for pair-shaped values, so a record that happens to be a
+    ``(bool, x)`` tuple is upserted as-is.
 
     Buffered mutations flush when ``buffer_size`` is reached, at every
     checkpoint (flushOnCheckpoint), and at close; flushes retry
@@ -113,7 +117,8 @@ class UpsertSink(RichSinkFunction):
                  doc_fn: Callable[[Any], dict],
                  buffer_size: int = 1000,
                  max_retries: int = 5,
-                 backoff_ms: int = 10):
+                 backoff_ms: int = 10,
+                 retract_stream: bool = False):
         super().__init__()
         self.store_factory = store_factory
         self.key_fn = key_fn
@@ -121,11 +126,17 @@ class UpsertSink(RichSinkFunction):
         self.buffer_size = buffer_size
         self.max_retries = max_retries
         self.backoff_ms = backoff_ms
+        self.retract_stream = retract_stream
         self._store: Optional[DocumentStore] = None
         #: doc_id -> doc | None (last wins; None = delete)
         self._buffer: Dict[str, Optional[dict]] = {}
         self.num_flushes = 0
         self.num_retries = 0
+
+    def enable_retract_decoding(self) -> None:
+        """Called by the retract-stream sink wiring
+        (DataStream.add_sink on a to_retract_stream result)."""
+        self.retract_stream = True
 
     # ---- lifecycle --------------------------------------------------
     def open(self, configuration=None):
@@ -138,8 +149,12 @@ class UpsertSink(RichSinkFunction):
 
     # ---- writes -----------------------------------------------------
     def invoke(self, value, context=None):
-        if isinstance(value, tuple) and len(value) == 2 \
-                and isinstance(value[0], bool):
+        if self.retract_stream:
+            if not (isinstance(value, tuple) and len(value) == 2
+                    and isinstance(value[0], bool)):
+                raise TypeError(
+                    "retract_stream=True expects (is_add, row) pairs; "
+                    f"got {value!r}")
             is_add, row = value
         else:
             is_add, row = True, value

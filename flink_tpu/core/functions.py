@@ -159,7 +159,36 @@ class AggregateFunction(Function, Generic[IN, ACC, OUT], abc.ABC):
     whose add/merge are expressible as jnp ops can additionally
     implement :class:`flink_tpu.ops.device_agg.DeviceAggregateFunction`
     to run micro-batched on TPU.
+
+    **The lift probe.**  Plain Python implementations still run
+    batched on the generic vectorized tier when the window shape is
+    eligible: the runtime *probes* the aggregate on a <=64-record
+    sample of the first batch — it replays ``add``/``merge``/
+    ``get_result`` with numpy arrays substituted for the scalar
+    accumulator fields and compares against a per-record scalar
+    reference.  Only on an exact match does the operator lock the
+    lifted mode; any exception or numeric mismatch in the probe pins
+    the per-record scalar path instead.  The contract this relies on:
+
+    - the accumulator is a number or a fixed-arity tuple/list of
+      numbers whose shape never changes across ``add``;
+    - ``add``/``merge``/``get_result`` are built from operations that
+      numpy broadcasts elementwise (arithmetic, comparisons,
+      ``min``/``max`` via ufuncs).  Python-level control flow on
+      accumulator VALUES (``if acc > ...:``) fails the probe and
+      demotes to scalar — that demotion is safe, not an error.
+
+    A probe can also pass while lifting is still unwanted: the sample
+    may not exercise a value-dependent branch, or array dtype
+    promotion may mask an overflow the scalar path would raise on.
+    Set the class/instance attribute ``force_scalar = True`` to skip
+    the probe and pin the scalar fold; operator construction
+    (``GenericWindowOperator(force_scalar=True)``) offers the same
+    opt-out per operator.
     """
+
+    #: opt-out of the generic tier's lift probe (see class docstring)
+    force_scalar: bool = False
 
     @abc.abstractmethod
     def create_accumulator(self) -> ACC:

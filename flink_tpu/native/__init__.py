@@ -85,6 +85,41 @@ def _ensure_loaded() -> Optional[ctypes.CDLL]:
             u64p, f64p, i64p, c.c_int64, c.c_double, c.c_double,
             c.c_double, c.c_int64, c.c_int64, c.POINTER(c.c_int64)]
         lib.ft_cep_strict_baseline.restype = c.c_double
+        lib.ft_cep_eval_masks.argtypes = [
+            i64p, i64p, c.c_int64, f64p, f64p, c.c_int64, c.c_int64,
+            u32p]
+        lib.ft_cep_advance_prog.argtypes = [
+            c.c_void_p, u64p, i64p, c.c_int64, c.c_int64,
+            i64p, i64p, f64p, f64p, c.c_int64, c.c_int64,
+            i64p, i64p, c.c_int64]
+        lib.ft_cep_advance_prog.restype = c.c_int64
+        lib.ft_cepr_new.argtypes = [c.c_int64, c.c_int64, c.c_int64,
+                                    c.c_int64]
+        lib.ft_cepr_new.restype = c.c_void_p
+        lib.ft_cepr_free.argtypes = [c.c_void_p]
+        lib.ft_cepr_advance.argtypes = [
+            c.c_void_p, u64p, u32p, i64p, c.c_int64, c.c_int64]
+        lib.ft_cepr_advance.restype = c.c_int64
+        lib.ft_cepr_advance_prog.argtypes = [
+            c.c_void_p, u64p, i64p, c.c_int64, c.c_int64,
+            i64p, i64p, f64p, f64p, c.c_int64]
+        lib.ft_cepr_advance_prog.restype = c.c_int64
+        lib.ft_cepr_matches.argtypes = [c.c_void_p, i64p, i64p]
+        lib.ft_cepr_matches.restype = c.c_int64
+        lib.ft_cepr_size.argtypes = [c.c_void_p]
+        lib.ft_cepr_size.restype = c.c_int64
+        lib.ft_cepr_expire.argtypes = [c.c_void_p, c.c_int64]
+        lib.ft_cepr_min_ref.argtypes = [c.c_void_p]
+        lib.ft_cepr_min_ref.restype = c.c_int64
+        lib.ft_cepr_export_size.argtypes = [c.c_void_p]
+        lib.ft_cepr_export_size.restype = c.c_int64
+        lib.ft_cepr_export.argtypes = [c.c_void_p, i64p]
+        lib.ft_cepr_export.restype = c.c_int64
+        lib.ft_cepr_import.argtypes = [c.c_void_p, i64p, c.c_int64]
+        lib.ft_cep_followed_baseline.argtypes = [
+            u64p, f64p, i64p, c.c_int64, c.c_double, c.c_double,
+            c.c_int64, c.c_int64, c.POINTER(c.c_int64)]
+        lib.ft_cep_followed_baseline.restype = c.c_double
         lib.ft_fold_prep.argtypes = [u64p, c.c_int64, i64p, i64p, i64p,
                                      u64p]
         lib.ft_fold_prep.restype = c.c_int64
@@ -642,6 +677,36 @@ class NativeCepState:
             raise RuntimeError("CEP match buffer overflow")
         return out_refs[:m * self.k].reshape(m, self.k), out_pos[:m]
 
+    def advance_prog(self, kh: np.ndarray, ts: np.ndarray,
+                     base_gid: int, prog: np.ndarray,
+                     stage_off: np.ndarray, consts: np.ndarray,
+                     cols_flat: np.ndarray, ncols: int):
+        """Fused advance with NATIVE condition evaluation: the
+        predicate programs (cep/pattern.py compile_stage_programs)
+        run columnwise in C++ and the mask bits never cross back
+        into Python.  cols_flat is column-major float64
+        [ncols * n]."""
+        n = len(kh)
+        buf = getattr(self, "_out", None)
+        if buf is None or len(buf[1]) < n:
+            buf = (np.empty(n * self.k, np.int64),
+                   np.empty(n, np.int64))
+            self._out = buf
+        out_refs, out_pos = buf
+        known = max(_lib.ft_cep_size(self._h), 1)
+        use_seq = 0 if n >= 8 * known else 1
+        m = _lib.ft_cep_advance_prog(
+            self._h, np.ascontiguousarray(kh, np.uint64),
+            np.ascontiguousarray(ts, np.int64), n, base_gid,
+            np.ascontiguousarray(prog, np.int64),
+            np.ascontiguousarray(stage_off, np.int64),
+            np.ascontiguousarray(consts, np.float64),
+            np.ascontiguousarray(cols_flat, np.float64), ncols,
+            use_seq, out_refs, out_pos, n)
+        if m < 0:  # cannot happen with max_matches=n (<=1 match/row)
+            raise RuntimeError("CEP match buffer overflow")
+        return out_refs[:m * self.k].reshape(m, self.k), out_pos[:m]
+
     @property
     def cold_w(self) -> int:
         k = self.k
@@ -674,6 +739,112 @@ def cep_expire(state: "NativeCepState", watermark: int) -> None:
     """Expire runs past the within() horizon (dormant-key sweep
     before log compaction)."""
     _lib.ft_cep_expire(state._h, watermark)
+
+
+class NativeCepRuns:
+    """Persistent keyed run-list NFA state for relaxed-contiguity
+    (skip-till-next / followedBy) chains — the FULL run-list
+    semantics of the scalar NFA, kept native.  A stage holds a
+    linked list of waiting runs; advancement is all-or-nothing per
+    event, so transitions splice whole lists and within()-expired
+    runs form a lazily-truncated suffix.  Matches buffer internally
+    (one event can complete many runs); fetch via the advance
+    return."""
+
+    __slots__ = ("_h", "k")
+
+    def __init__(self, k: int, within: int = -1, strict_bits: int = 0,
+                 capacity: int = 1 << 12):
+        if k > 16:
+            raise ValueError("at most 16 stages")
+        lib = _ensure_loaded()
+        self.k = k
+        self._h = lib.ft_cepr_new(k, within, strict_bits,
+                                  _pow2_at_least(capacity))
+
+    def __del__(self):
+        if _lib is not None and getattr(self, "_h", None):
+            _lib.ft_cepr_free(self._h)
+            self._h = None
+
+    def _fetch(self, m: int):
+        if m == 0:
+            return (np.empty((0, self.k), np.int64),
+                    np.empty(0, np.int64))
+        refs = np.empty(m * self.k, np.int64)
+        pos = np.empty(m, np.int64)
+        got = _lib.ft_cepr_matches(self._h, refs, pos)
+        return refs[:got * self.k].reshape(got, self.k), pos[:got]
+
+    def advance(self, kh: np.ndarray, mask_bits: np.ndarray,
+                ts: np.ndarray, base_gid: int):
+        """→ (match_refs [m, k] global event ids, match_rows [m]
+        batch positions)."""
+        m = _lib.ft_cepr_advance(
+            self._h, np.ascontiguousarray(kh, np.uint64),
+            np.ascontiguousarray(mask_bits, np.uint32),
+            np.ascontiguousarray(ts, np.int64), len(kh), base_gid)
+        return self._fetch(m)
+
+    def advance_prog(self, kh: np.ndarray, ts: np.ndarray,
+                     base_gid: int, prog: np.ndarray,
+                     stage_off: np.ndarray, consts: np.ndarray,
+                     cols_flat: np.ndarray, ncols: int):
+        """Fused advance with native predicate evaluation (see
+        NativeCepState.advance_prog)."""
+        m = _lib.ft_cepr_advance_prog(
+            self._h, np.ascontiguousarray(kh, np.uint64),
+            np.ascontiguousarray(ts, np.int64), len(kh), base_gid,
+            np.ascontiguousarray(prog, np.int64),
+            np.ascontiguousarray(stage_off, np.int64),
+            np.ascontiguousarray(consts, np.float64),
+            np.ascontiguousarray(cols_flat, np.float64), ncols)
+        return self._fetch(m)
+
+    def size(self) -> int:
+        """Live-run count across all keys and stages."""
+        return _lib.ft_cepr_size(self._h)
+
+    def expire(self, watermark: int) -> None:
+        """Truncate runs past the within() horizon (dormant-key
+        sweep before log compaction)."""
+        _lib.ft_cepr_expire(self._h, watermark)
+
+    def min_ref(self) -> int:
+        """Smallest event id a live run still references; 2^63-1
+        when none."""
+        return _lib.ft_cepr_min_ref(self._h)
+
+    def export(self) -> np.ndarray:
+        """Flat int64 checkpoint stream (lists serialized oldest-
+        first so import's push-front rebuilds newest-first order)."""
+        size = _lib.ft_cepr_export_size(self._h)
+        buf = np.empty(max(size, 1), np.int64)
+        w = _lib.ft_cepr_export(self._h, buf)
+        return buf[:w].copy()
+
+    def import_(self, buf: np.ndarray) -> None:
+        buf = np.ascontiguousarray(buf, np.int64)
+        _lib.ft_cepr_import(self._h, buf, len(buf))
+
+
+def cep_followed_baseline(kh: np.ndarray, values: np.ndarray,
+                          ts: np.ndarray, t0: float, t1: float,
+                          within: int = -1, capacity=None):
+    """Per-record skip-till-next (A followedBy B) run-list CEP over
+    heap keyed state, compiled — the honest baseline for the
+    cep_followed_by bench config.  Returns (records/second,
+    match_count)."""
+    lib = _ensure_loaded()
+    n = len(kh)
+    cap = _pow2_at_least(capacity or 2 * n)
+    out = ctypes.c_int64(0)
+    elapsed = lib.ft_cep_followed_baseline(
+        np.ascontiguousarray(kh, np.uint64),
+        np.ascontiguousarray(values, np.float64),
+        np.ascontiguousarray(ts, np.int64), n,
+        t0, t1, within, cap, ctypes.byref(out))
+    return n / elapsed, out.value
 
 
 def cep_strict_baseline(kh: np.ndarray, values: np.ndarray,

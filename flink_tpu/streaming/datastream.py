@@ -577,6 +577,12 @@ class DataStream:
 
     # ---- sinks ------------------------------------------------------
     def add_sink(self, sink_function, name: str = "sink") -> "DataStreamSink":
+        # Table.to_retract_stream marks its result; retract-aware
+        # sinks opt into pair decoding here instead of sniffing
+        # (bool, x)-shaped values on every stream
+        if getattr(self, "carries_retract_pairs", False) and \
+                hasattr(sink_function, "enable_retract_decoding"):
+            sink_function.enable_retract_decoding()
         node = self._add_op(name, _op_factory(StreamSink, lambda: sink_function))
         return DataStreamSink(node)
 

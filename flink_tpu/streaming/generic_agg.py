@@ -137,13 +137,19 @@ class LiftedAggregate:
     ("fields"); the user's functions are called with the SAME Python
     structure they declared (scalar / tuple / list), just holding
     arrays instead of scalars.
+
+    An aggregate that would pass the probe but must not be lifted
+    (see ``AggregateFunction.force_scalar``) pins ``mode`` to
+    "scalar" here, before any probe runs.
     """
 
     def __init__(self, agg):
         self.agg = agg
         self.acc0 = agg.create_accumulator()
         self.acc_spec = self._spec_of(self.acc0)
-        self.mode: Optional[str] = None   # "lifted" | "scalar"
+        #: "lifted" | "scalar" | None (undecided — probe on first use)
+        self.mode: Optional[str] = (
+            "scalar" if getattr(agg, "force_scalar", False) else None)
         self.field_dtypes: Optional[List[np.dtype]] = None
         #: whether get_result lifts too (it can fail independently of
         #: add — e.g. a result built via data-dependent branching)
@@ -1372,13 +1378,18 @@ class GenericWindowOperator(StreamOperator):
 
     def __init__(self, assigner, aggregate_function,
                  window_function=None, flush_batch: int = 8192,
-                 compact_threshold: int = 1 << 21):
+                 compact_threshold: int = 1 << 21,
+                 force_scalar: bool = False):
         super().__init__()
         self.assigner = assigner
         self.agg = aggregate_function
         self.window_function = window_function
         self.flush_batch = flush_batch
         self.compact_threshold = compact_threshold
+        #: pin the engine's per-record scalar fold even when the
+        #: lift probe would accept the aggregate (see
+        #: AggregateFunction.force_scalar for when that matters)
+        self.force_scalar = force_scalar
         self.engine = None
         self._keys: List[Any] = []
         self._ts: List[int] = []
@@ -1416,6 +1427,8 @@ class GenericWindowOperator(StreamOperator):
         if self.engine is None:
             self.engine = generic_engine_for_assigner(
                 self.assigner, self.agg, self.compact_threshold)
+            if self.force_scalar:
+                self.engine.lift.mode = "scalar"
 
     def _flush_buffer(self):
         if not self._keys:
@@ -1506,6 +1519,9 @@ class GenericWindowOperator(StreamOperator):
             self.engine.restore_many(engine_snaps, keep_fn)
         else:
             self.engine.restore(engine_snaps[0])
+        if self.force_scalar:
+            # the pin outranks a checkpoint taken without it
+            self.engine.lift.mode = "scalar"
 
 
 def generic_engine_for_assigner(assigner, aggregate,

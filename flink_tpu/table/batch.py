@@ -384,7 +384,7 @@ def _lower_batch_group_agg(t_env, table: BatchTable,
         for r in rows:
             for i, (agg, input_fn) in enumerate(parts):
                 accs[i] = agg.add(input_fn(r), accs[i])
-        key_vals = tuple(f(rows[0]) for f in key_fns)
+        key_vals = tuple(f(rows[0]) for f in key_fns) if rows else ()
         post = key_vals + tuple(
             agg.get_result(a) for (agg, _), a in zip(parts, accs))
         if window is not None:
@@ -400,7 +400,14 @@ def _lower_batch_group_agg(t_env, table: BatchTable,
         fold(rows, out)
         return out
 
-    ds = table.dataset.group_by(group_key).reduce_group(per_group)
+    if not key_fns and window is None:
+        # global aggregate: SQL emits exactly one row even over empty
+        # input (COUNT = 0, SUM/MIN/MAX/AVG = NULL — the fresh
+        # accumulators), so fold the whole dataset rather than
+        # grouping, which would produce zero groups
+        ds = table.dataset.reduce_group(per_group)
+    else:
+        ds = table.dataset.group_by(group_key).reduce_group(per_group)
     return BatchTable(t_env, ds, Schema(out_names))
 
 

@@ -1118,51 +1118,56 @@ struct FtCepState {
   int k;
   int64_t within;             // -1 = none
   int64_t cap;                // slots capacity (pow2 probe table)
-  std::vector<uint64_t> hash; // probe table: splitmix64(key) -> slot
-  std::vector<int64_t> slot_of;
+  // probe entry: hash + dense slot + active bitmask in 16 bytes —
+  // the hot loop is one random probe per event, and keeping the
+  // active bits ON the probe line means the common 0 -> 0 key costs
+  // a single cache-line visit; the cold row (starts + refs) is only
+  // touched when the bitmask says a run is waiting
+  struct Ent {
+    uint64_t h;               // splitmix64(key); 0 = empty
+    int32_t slot;             // dense cold-row index
+    uint32_t act;             // active-run bitmask
+  };
+  std::vector<Ent> tab;
   int64_t next_slot;
-  // split hot/cold layout: the active bitmask alone decides whether
-  // the cold row (starts + refs) is touched at all — most keys in a
-  // sparse-condition stream stay 0 -> 0 and never load it
-  std::vector<uint32_t> active;
   std::vector<int64_t> cold;  // per slot: (k-1) starts + k(k-1)/2 refs
   int cold_w;                 // cold row width
   FtCepState(int k_, int64_t within_, int64_t cap_)
-      : k(k_), within(within_), cap(cap_), hash(cap_, 0),
-        slot_of(cap_, -1), next_slot(0), active(), cold(),
-        cold_w((k_ - 1) + k_ * (k_ - 1) / 2) {}
+      : k(k_), within(within_), cap(cap_), tab(cap_, Ent{0, 0, 0}),
+        next_slot(0), cold(), cold_w((k_ - 1) + k_ * (k_ - 1) / 2) {}
   void rehash() {
     int64_t cap2 = cap * 2;
-    std::vector<uint64_t> h2(cap2, 0);
-    std::vector<int64_t> s2(cap2, -1);
+    std::vector<Ent> t2(cap2, Ent{0, 0, 0});
     for (int64_t p = 0; p < cap; ++p) {
-      if (hash[p] == 0) continue;
-      uint64_t q = hash[p] & (cap2 - 1);
-      while (h2[q] != 0) q = (q + 1) & (cap2 - 1);
-      h2[q] = hash[p];
-      s2[q] = slot_of[p];
+      if (tab[p].h == 0) continue;
+      uint64_t q = tab[p].h & (cap2 - 1);
+      while (t2[q].h != 0) q = (q + 1) & (cap2 - 1);
+      t2[q] = tab[p];
     }
-    hash.swap(h2);
-    slot_of.swap(s2);
+    tab.swap(t2);
     cap = cap2;
   }
-  int64_t get_or_insert(uint64_t h) {
+  // reserve so the next n_new inserts cannot rehash (lets batch
+  // loops cache probe POSITIONS across a chunk)
+  void reserve_inserts(int64_t n_new) {
+    while ((next_slot + n_new) * 2 >= cap) rehash();
+  }
+  int64_t probe_pos(uint64_t h) {
     if (next_slot * 2 >= cap) rehash();   // load factor < 0.5 always
     uint64_t p = h & (cap - 1);
-    while (hash[p] != h && hash[p] != 0) p = (p + 1) & (cap - 1);
-    if (hash[p] == 0) {
-      hash[p] = h;
-      slot_of[p] = next_slot++;
-      if (next_slot > static_cast<int64_t>(active.size())) {
-        active.resize(next_slot * 2, 0);
+    while (tab[p].h != h && tab[p].h != 0) p = (p + 1) & (cap - 1);
+    if (tab[p].h == 0) {
+      tab[p].h = h;
+      tab[p].slot = static_cast<int32_t>(next_slot++);
+      tab[p].act = 0;
+      if (next_slot * cold_w > static_cast<int64_t>(cold.size()))
         cold.resize(static_cast<size_t>(next_slot) * 2 * cold_w, 0);
-      }
     }
-    return slot_of[p];
+    return static_cast<int64_t>(p);
   }
   // cold row accessors: start of stage s (1..k-1) at [s-1];
   // refs of stage s at (k-1) + s(s-1)/2 .. + s
-  int64_t* cold_row(int64_t slot) { return &cold[slot * cold_w]; }
+  int64_t* cold_row(int64_t slot) { return cold.data() + slot * cold_w; }
 };
 
 static inline uint64_t ft_splitmix1(uint64_t x) {
@@ -1222,8 +1227,9 @@ int64_t ft_cep_advance(void* handle, const uint64_t* kh,
   int64_t refs_loc[16 * 16];
   while (i < n) {
     uint64_t key = sorted[i].key;
-    int64_t slot = st.get_or_insert(ft_splitmix1(key));
-    uint32_t a_loc = st.active[slot];
+    int64_t p = st.probe_pos(ft_splitmix1(key));
+    int64_t slot = st.tab[p].slot;
+    uint32_t a_loc = st.tab[p].act;
     const bool was_active = a_loc != 0;
     if (was_active) {
       int64_t* row = st.cold_row(slot);
@@ -1275,7 +1281,7 @@ int64_t ft_cep_advance(void* handle, const uint64_t* kh,
     }
     // write back; a 0 -> 0 key never touches the cold row
     if (a_loc || was_active) {
-      st.active[slot] = a_loc;
+      st.tab[p].act = a_loc;
       if (a_loc) {
         int64_t* row = st.cold_row(slot);
         for (int s = 1; s < k; ++s) {
@@ -1297,24 +1303,55 @@ int64_t ft_cep_advance(void* handle, const uint64_t* kh,
 // per event (no sort).  Wins at LOW per-key multiplicity, where the
 // grouped walk cannot amortize its sort; the Python caller picks the
 // variant from the batch's rows-per-key ratio.
-int64_t ft_cep_advance_seq(void* handle, const uint64_t* kh,
-                           const uint32_t* mask_bits, const int64_t* ts,
-                           int64_t n, int64_t base_gid,
-                           int64_t* out_refs, int64_t* out_pos,
-                           int64_t max_matches) {
-  FtCepState& st = *static_cast<FtCepState*>(handle);
+// One <=1024-row chunk of the sequential walk.  Two phases with
+// software prefetch: the record-at-a-time baseline eats a
+// dependent-miss chain per event (probe line -> state row); the batch
+// hands us every key upfront, so phase 1 resolves probe positions
+// with the table line prefetched PD events ahead, and phase 2 walks
+// the NFA with the cold row prefetched the same way.  On a table far
+// beyond L3 this is the entire gap between the tiers.  `bits` is
+// chunk-local (bits[j] belongs to batch row pos0 + j).  Returns the
+// updated match count, or -1 on output overflow.
+static constexpr int64_t FT_CEP_CHUNK = 1024;
+
+static int64_t ft_cep_seq_chunk(FtCepState& st, const uint64_t* kh,
+                                const uint32_t* bits, const int64_t* ts,
+                                int64_t c, int64_t gid0, int64_t pos0,
+                                int64_t* out_refs, int64_t* out_pos,
+                                int64_t max_matches,
+                                int64_t n_matches) {
   const int k = st.k;
   const int km1 = k - 1;
   const int64_t within = st.within;
-  int64_t n_matches = 0;
-  for (int64_t i = 0; i < n; ++i) {
-    uint32_t m = mask_bits[i];
-    int64_t slot = st.get_or_insert(ft_splitmix1(kh[i]));
-    uint32_t a = st.active[slot];
+  constexpr int64_t PD = 32;
+  uint64_t hv[FT_CEP_CHUNK];
+  int64_t posv[FT_CEP_CHUNK];
+  // probe POSITIONS are cached across the chunk, so no rehash may
+  // happen mid-chunk — reserve headroom for c fresh keys up front
+  st.reserve_inserts(c);
+  for (int64_t j = 0; j < c; ++j) hv[j] = ft_splitmix1(kh[j]);
+  for (int64_t j = 0; j < c; ++j) {
+    if (j + PD < c) {
+      // load factor stays < 0.5, so the home slot is the common hit;
+      // hash + slot + active share the one prefetched line (written
+      // back through e.act, hence write intent)
+      __builtin_prefetch(&st.tab[hv[j + PD] & (st.cap - 1)], 1);
+    }
+    posv[j] = st.probe_pos(hv[j]);
+  }
+  for (int64_t j = 0; j < c; ++j) {
+    if (j + PD < c) {
+      // the entry is hot from phase 1; only its cold row can miss
+      __builtin_prefetch(
+          st.cold.data() + st.tab[posv[j + PD]].slot * st.cold_w);
+    }
+    uint32_t m = bits[j];
+    FtCepState::Ent& e = st.tab[posv[j]];
+    uint32_t a = e.act;
     if (a == 0 && (m & 1) == 0) continue;
-    int64_t t = ts[i];
-    int64_t gid = base_gid + i;
-    int64_t* row = st.cold_row(slot);
+    int64_t t = ts[j];
+    int64_t gid = gid0 + j;
+    int64_t* row = st.cold_row(e.slot);
     if (within >= 0 && a) {
       for (int s = 1; s < k; ++s)
         if (((a >> s) & 1) && t - row[s - 1] >= within)
@@ -1323,23 +1360,23 @@ int64_t ft_cep_advance_seq(void* handle, const uint64_t* kh,
     if (k >= 2 && ((a >> km1) & 1) && ((m >> km1) & 1)) {
       if (n_matches >= max_matches) return -1;
       int64_t* o = out_refs + n_matches * k;
-      for (int j = 0; j < km1; ++j)
-        o[j] = row[km1 + km1 * (km1 - 1) / 2 + j];
+      for (int w = 0; w < km1; ++w)
+        o[w] = row[km1 + km1 * (km1 - 1) / 2 + w];
       o[km1] = gid;
-      out_pos[n_matches++] = i;
+      out_pos[n_matches++] = pos0 + j;
     } else if (k == 1 && (m & 1)) {
       if (n_matches >= max_matches) return -1;
       out_refs[n_matches * k] = gid;
-      out_pos[n_matches++] = i;
+      out_pos[n_matches++] = pos0 + j;
     }
     uint32_t new_a = 0;
     for (int s = km1; s >= 2; --s) {
       if (((a >> (s - 1)) & 1) && ((m >> (s - 1)) & 1)) {
         new_a |= (1u << s);
         row[s - 1] = row[s - 2];
-        for (int j = 0; j < s - 1; ++j)
-          row[km1 + s * (s - 1) / 2 + j] =
-              row[km1 + (s - 1) * (s - 2) / 2 + j];
+        for (int w = 0; w < s - 1; ++w)
+          row[km1 + s * (s - 1) / 2 + w] =
+              row[km1 + (s - 1) * (s - 2) / 2 + w];
         row[km1 + s * (s - 1) / 2 + (s - 1)] = gid;
       }
     }
@@ -1348,7 +1385,24 @@ int64_t ft_cep_advance_seq(void* handle, const uint64_t* kh,
       row[0] = t;
       row[km1] = gid;
     }
-    st.active[slot] = new_a;
+    e.act = new_a;
+  }
+  return n_matches;
+}
+
+int64_t ft_cep_advance_seq(void* handle, const uint64_t* kh,
+                           const uint32_t* mask_bits, const int64_t* ts,
+                           int64_t n, int64_t base_gid,
+                           int64_t* out_refs, int64_t* out_pos,
+                           int64_t max_matches) {
+  FtCepState& st = *static_cast<FtCepState*>(handle);
+  int64_t n_matches = 0;
+  for (int64_t i0 = 0; i0 < n; i0 += FT_CEP_CHUNK) {
+    const int64_t c = std::min(FT_CEP_CHUNK, n - i0);
+    n_matches = ft_cep_seq_chunk(st, kh + i0, mask_bits + i0, ts + i0,
+                                 c, base_gid + i0, i0, out_refs,
+                                 out_pos, max_matches, n_matches);
+    if (n_matches < 0) return -1;
   }
   return n_matches;
 }
@@ -1359,14 +1413,14 @@ void ft_cep_expire(void* handle, int64_t watermark) {
   FtCepState& st = *static_cast<FtCepState*>(handle);
   const int k = st.k;
   if (st.within < 0) return;
-  for (int64_t slot = 0; slot < st.next_slot; ++slot) {
-    uint32_t a = st.active[slot];
+  for (int64_t p = 0; p < st.cap; ++p) {
+    uint32_t a = st.tab[p].act;
     if (!a) continue;
-    const int64_t* row = &st.cold[slot * st.cold_w];
+    const int64_t* row = st.cold.data() + st.tab[p].slot * st.cold_w;
     for (int s = 1; s < k; ++s)
       if (((a >> s) & 1) && watermark - row[s - 1] >= st.within)
         a &= ~(1u << s);
-    st.active[slot] = a;
+    st.tab[p].act = a;
   }
 }
 
@@ -1375,10 +1429,10 @@ int64_t ft_cep_min_ref(void* handle) {
   const int k = st.k;
   const int km1 = k - 1;
   int64_t lo = INT64_MAX;
-  for (int64_t slot = 0; slot < st.next_slot; ++slot) {
-    uint32_t a = st.active[slot];
+  for (int64_t p = 0; p < st.cap; ++p) {
+    uint32_t a = st.tab[p].act;
     if (!a) continue;
-    const int64_t* row = &st.cold[slot * st.cold_w];
+    const int64_t* row = st.cold.data() + st.tab[p].slot * st.cold_w;
     for (int s = 1; s < k; ++s) {
       if (!((a >> s) & 1)) continue;
       for (int j = 0; j < s; ++j) {
@@ -1399,10 +1453,10 @@ int64_t ft_cep_export(void* handle, uint64_t* keys_out,
   FtCepState& st = *static_cast<FtCepState*>(handle);
   int64_t m = 0;
   for (int64_t p = 0; p < st.cap; ++p) {
-    if (st.hash[p] == 0) continue;
-    int64_t slot = st.slot_of[p];
-    keys_out[m] = st.hash[p];
-    active_out[m] = st.active[slot];
+    if (st.tab[p].h == 0) continue;
+    int64_t slot = st.tab[p].slot;
+    keys_out[m] = st.tab[p].h;
+    active_out[m] = st.tab[p].act;
     for (int w = 0; w < st.cold_w; ++w)
       cold_out[m * st.cold_w + w] = st.cold[slot * st.cold_w + w];
     ++m;
@@ -1420,27 +1474,782 @@ void ft_cep_import(void* handle, const uint64_t* keys,
   FtCepState& st = *static_cast<FtCepState*>(handle);
   for (int64_t i = 0; i < m; ++i) {
     // keys here are PROBE HASHES (from export) — insert directly
-    if (st.next_slot * 2 >= st.cap) st.rehash();
-    uint64_t h = keys[i];
-    uint64_t p = h & (st.cap - 1);
-    while (st.hash[p] != h && st.hash[p] != 0)
-      p = (p + 1) & (st.cap - 1);
-    int64_t slot;
-    if (st.hash[p] == 0) {
-      st.hash[p] = h;
-      slot = st.slot_of[p] = st.next_slot++;
-      if (st.next_slot > static_cast<int64_t>(st.active.size())) {
-        st.active.resize(st.next_slot * 2, 0);
-        st.cold.resize(static_cast<size_t>(st.next_slot) * 2
-                       * st.cold_w, 0);
-      }
-    } else {
-      slot = st.slot_of[p];
-    }
-    st.active[slot] = active[i];
+    int64_t p = st.probe_pos(keys[i]);
+    st.tab[p].act = active[i];
+    int64_t slot = st.tab[p].slot;
     for (int w = 0; w < st.cold_w; ++w)
       st.cold[slot * st.cold_w + w] = cold[i * st.cold_w + w];
   }
+}
+
+// ---- CEP predicate bytecode (cep/pattern.py compile_stage_programs) -------
+// Stage conditions arrive as a postfix stack program over float64
+// event columns; evaluation is a chunked columnwise stack machine
+// (each op streams over a cache-sized span of rows), so the per-event
+// Python condition callback — the ~15 ns/ev the roofline charged to
+// mask packing — disappears entirely.  Opcode values mirror
+// flink_tpu/cep/pattern.py; comparisons and boolean ops produce
+// 0.0/1.0, truthiness is nonzero (NaN counts as true, like Python).
+enum {
+  FT_OP_COL = 0, FT_OP_CONST = 1,
+  FT_OP_ADD = 2, FT_OP_SUB = 3, FT_OP_MUL = 4, FT_OP_DIV = 5,
+  FT_OP_NEG = 6, FT_OP_ABS = 7,
+  FT_OP_LT = 10, FT_OP_LE = 11, FT_OP_GT = 12, FT_OP_GE = 13,
+  FT_OP_EQ = 14, FT_OP_NE = 15,
+  FT_OP_AND = 20, FT_OP_OR = 21, FT_OP_NOT = 22,
+};
+
+static int ft_prog_max_depth(const int64_t* prog, int64_t lo,
+                             int64_t hi) {
+  int d = 0, mx = 0;
+  for (int64_t p = lo; p < hi; ++p) {
+    int op = static_cast<int>(prog[p * 2]);
+    if (op == FT_OP_COL || op == FT_OP_CONST) ++d;
+    else if (op != FT_OP_NEG && op != FT_OP_ABS && op != FT_OP_NOT) --d;
+    if (d > mx) mx = d;
+  }
+  return mx;
+}
+
+// Fast path for the dominant compiled shape — a single comparison
+// between one column and one constant (`COL, CONST, CMP` in either
+// operand order): one branch-free fused loop instead of three stack
+// passes, so the compiler can vectorize the compare straight into
+// the mask bits.  Returns false when the program isn't that shape.
+static bool ft_eval_stage_fast(const int64_t* prog, int64_t lo,
+                               int64_t hi, const double* consts,
+                               const double* const* cols, int64_t r0,
+                               int64_t cn, uint32_t* out_bits,
+                               uint32_t bit) {
+  if (hi - lo != 3) return false;
+  int op0 = static_cast<int>(prog[lo * 2]);
+  int op1 = static_cast<int>(prog[lo * 2 + 2]);
+  int cmp = static_cast<int>(prog[lo * 2 + 4]);
+  if (cmp < FT_OP_LT || cmp > FT_OP_NE) return false;
+  const double* c;
+  double v;
+  if (op0 == FT_OP_COL && op1 == FT_OP_CONST) {
+    c = cols[prog[lo * 2 + 1]] + r0;
+    v = consts[prog[lo * 2 + 3]];
+  } else if (op0 == FT_OP_CONST && op1 == FT_OP_COL) {
+    v = consts[prog[lo * 2 + 1]];
+    c = cols[prog[lo * 2 + 3]] + r0;
+    // v CMP x  ==  x FLIPPED(CMP) v
+    if (cmp == FT_OP_LT) cmp = FT_OP_GT;
+    else if (cmp == FT_OP_GT) cmp = FT_OP_LT;
+    else if (cmp == FT_OP_LE) cmp = FT_OP_GE;
+    else if (cmp == FT_OP_GE) cmp = FT_OP_LE;
+  } else {
+    return false;
+  }
+  uint32_t* ob = out_bits + r0;
+  switch (cmp) {
+    case FT_OP_LT:
+      for (int64_t j = 0; j < cn; ++j)
+        ob[j] |= bit & -static_cast<uint32_t>(c[j] < v);
+      break;
+    case FT_OP_LE:
+      for (int64_t j = 0; j < cn; ++j)
+        ob[j] |= bit & -static_cast<uint32_t>(c[j] <= v);
+      break;
+    case FT_OP_GT:
+      for (int64_t j = 0; j < cn; ++j)
+        ob[j] |= bit & -static_cast<uint32_t>(c[j] > v);
+      break;
+    case FT_OP_GE:
+      for (int64_t j = 0; j < cn; ++j)
+        ob[j] |= bit & -static_cast<uint32_t>(c[j] >= v);
+      break;
+    case FT_OP_EQ:
+      for (int64_t j = 0; j < cn; ++j)
+        ob[j] |= bit & -static_cast<uint32_t>(c[j] == v);
+      break;
+    case FT_OP_NE:
+      for (int64_t j = 0; j < cn; ++j)
+        ob[j] |= bit & -static_cast<uint32_t>(c[j] != v);
+      break;
+  }
+  return true;
+}
+
+static void ft_eval_stage_chunk(const int64_t* prog, int64_t lo,
+                                int64_t hi, const double* consts,
+                                const double* const* cols, int64_t r0,
+                                int64_t cn, double* stack,
+                                int64_t stride, uint32_t* out_bits,
+                                uint32_t bit) {
+  if (ft_eval_stage_fast(prog, lo, hi, consts, cols, r0, cn,
+                         out_bits, bit))
+    return;
+  int sp = 0;
+  for (int64_t p = lo; p < hi; ++p) {
+    int op = static_cast<int>(prog[p * 2]);
+    int64_t arg = prog[p * 2 + 1];
+    if (op == FT_OP_COL) {
+      const double* c = cols[arg] + r0;
+      double* t = stack + sp * stride;
+      for (int64_t j = 0; j < cn; ++j) t[j] = c[j];
+      ++sp;
+    } else if (op == FT_OP_CONST) {
+      double v = consts[arg];
+      double* t = stack + sp * stride;
+      for (int64_t j = 0; j < cn; ++j) t[j] = v;
+      ++sp;
+    } else if (op == FT_OP_NEG) {
+      double* a = stack + (sp - 1) * stride;
+      for (int64_t j = 0; j < cn; ++j) a[j] = -a[j];
+    } else if (op == FT_OP_ABS) {
+      double* a = stack + (sp - 1) * stride;
+      for (int64_t j = 0; j < cn; ++j) a[j] = a[j] < 0 ? -a[j] : a[j];
+    } else if (op == FT_OP_NOT) {
+      double* a = stack + (sp - 1) * stride;
+      for (int64_t j = 0; j < cn; ++j) a[j] = a[j] == 0.0 ? 1.0 : 0.0;
+    } else {
+      double* b = stack + (sp - 1) * stride;
+      double* a = stack + (sp - 2) * stride;
+      switch (op) {
+        case FT_OP_ADD:
+          for (int64_t j = 0; j < cn; ++j) a[j] += b[j];
+          break;
+        case FT_OP_SUB:
+          for (int64_t j = 0; j < cn; ++j) a[j] -= b[j];
+          break;
+        case FT_OP_MUL:
+          for (int64_t j = 0; j < cn; ++j) a[j] *= b[j];
+          break;
+        case FT_OP_DIV:
+          for (int64_t j = 0; j < cn; ++j) a[j] /= b[j];
+          break;
+        case FT_OP_LT:
+          for (int64_t j = 0; j < cn; ++j) a[j] = a[j] < b[j];
+          break;
+        case FT_OP_LE:
+          for (int64_t j = 0; j < cn; ++j) a[j] = a[j] <= b[j];
+          break;
+        case FT_OP_GT:
+          for (int64_t j = 0; j < cn; ++j) a[j] = a[j] > b[j];
+          break;
+        case FT_OP_GE:
+          for (int64_t j = 0; j < cn; ++j) a[j] = a[j] >= b[j];
+          break;
+        case FT_OP_EQ:
+          for (int64_t j = 0; j < cn; ++j) a[j] = a[j] == b[j];
+          break;
+        case FT_OP_NE:
+          for (int64_t j = 0; j < cn; ++j) a[j] = a[j] != b[j];
+          break;
+        case FT_OP_AND:
+          for (int64_t j = 0; j < cn; ++j)
+            a[j] = (a[j] != 0.0) & (b[j] != 0.0);
+          break;
+        case FT_OP_OR:
+          for (int64_t j = 0; j < cn; ++j)
+            a[j] = (a[j] != 0.0) | (b[j] != 0.0);
+          break;
+      }
+      --sp;
+    }
+  }
+  for (int64_t j = 0; j < cn; ++j)
+    if (stack[j] != 0.0) out_bits[r0 + j] |= bit;
+}
+
+// Evaluate all k stage programs over the batch into packed per-row
+// mask bits (bit s = stage s condition holds).  cols is column-major
+// [ncols][n] float64.
+void ft_cep_eval_masks(const int64_t* prog, const int64_t* stage_off,
+                       int64_t k, const double* consts,
+                       const double* cols, int64_t ncols, int64_t n,
+                       uint32_t* out_bits) {
+  const double* colp[64];
+  int64_t nc = ncols < 64 ? ncols : 64;
+  for (int64_t c = 0; c < nc; ++c) colp[c] = cols + c * n;
+  int maxd = 1;
+  for (int64_t s = 0; s < k; ++s) {
+    int d = ft_prog_max_depth(prog, stage_off[s], stage_off[s + 1]);
+    if (d > maxd) maxd = d;
+  }
+  const int64_t CHUNK = 2048;
+  static thread_local std::vector<double> tl_stack;
+  if (static_cast<int64_t>(tl_stack.size()) < maxd * CHUNK)
+    tl_stack.resize(maxd * CHUNK);
+  for (int64_t i = 0; i < n; ++i) out_bits[i] = 0;
+  for (int64_t r0 = 0; r0 < n; r0 += CHUNK) {
+    int64_t cn = n - r0 < CHUNK ? n - r0 : CHUNK;
+    for (int64_t s = 0; s < k; ++s)
+      ft_eval_stage_chunk(prog, stage_off[s], stage_off[s + 1],
+                          consts, colp, r0, cn, tl_stack.data(),
+                          CHUNK, out_bits, 1u << s);
+  }
+}
+
+// Fused advance: evaluate the predicate programs AND run the keyed
+// strict-chain transition in one call — the mask bits never cross
+// back into Python.  use_seq picks the sequential walk (same rule the
+// Python caller applies to ft_cep_advance vs _seq).
+int64_t ft_cep_advance_prog(void* handle, const uint64_t* kh,
+                            const int64_t* ts, int64_t n,
+                            int64_t base_gid, const int64_t* prog,
+                            const int64_t* stage_off,
+                            const double* consts, const double* cols,
+                            int64_t ncols, int64_t use_seq,
+                            int64_t* out_refs, int64_t* out_pos,
+                            int64_t max_matches) {
+  FtCepState& st = *static_cast<FtCepState*>(handle);
+  if (!use_seq) {
+    // the grouped walk wants every row's bits upfront (it reorders)
+    static thread_local std::vector<uint32_t> tl_bits;
+    if (static_cast<int64_t>(tl_bits.size()) < n) tl_bits.resize(n);
+    ft_cep_eval_masks(prog, stage_off, st.k, consts, cols, ncols, n,
+                      tl_bits.data());
+    return ft_cep_advance(handle, kh, tl_bits.data(), ts, n, base_gid,
+                          out_refs, out_pos, max_matches);
+  }
+  // sequential: evaluate the stage programs one chunk at a time and
+  // feed the chunk walk directly — the bits never leave L1
+  const int64_t k = st.k;
+  const double* colp[64];
+  const double* colc[64];
+  int64_t nc = ncols < 64 ? ncols : 64;
+  for (int64_t ci = 0; ci < nc; ++ci) colp[ci] = cols + ci * n;
+  int maxd = 1;
+  for (int64_t s = 0; s < k; ++s) {
+    int d = ft_prog_max_depth(prog, stage_off[s], stage_off[s + 1]);
+    if (d > maxd) maxd = d;
+  }
+  static thread_local std::vector<double> tl_stack;
+  if (static_cast<int64_t>(tl_stack.size()) < maxd * FT_CEP_CHUNK)
+    tl_stack.resize(maxd * FT_CEP_CHUNK);
+  uint32_t bits[FT_CEP_CHUNK];
+  int64_t n_matches = 0;
+  for (int64_t i0 = 0; i0 < n; i0 += FT_CEP_CHUNK) {
+    const int64_t c = std::min(FT_CEP_CHUNK, n - i0);
+    for (int64_t ci = 0; ci < nc; ++ci) colc[ci] = colp[ci] + i0;
+    for (int64_t j = 0; j < c; ++j) bits[j] = 0;
+    for (int64_t s = 0; s < k; ++s)
+      ft_eval_stage_chunk(prog, stage_off[s], stage_off[s + 1],
+                          consts, colc, 0, c, tl_stack.data(),
+                          FT_CEP_CHUNK, bits, 1u << s);
+    n_matches = ft_cep_seq_chunk(st, kh + i0, bits, ts + i0, c,
+                                 base_gid + i0, i0, out_refs, out_pos,
+                                 max_matches, n_matches);
+    if (n_matches < 0) return -1;
+  }
+  return n_matches;
+}
+
+// ---- vectorized CEP for skip-till-next (followedBy) chains ----------------
+// Relaxed contiguity breaks the one-run-per-stage collapse: a stage
+// can hold MANY waiting runs (each started by a different stage-0
+// event).  The saving grace is that advancement is all-or-nothing per
+// event — every run waiting at stage s sees the same condition — so
+// per-key state is one run LIST per stage and each transition splices
+// a whole list, never a subset.  Lists are kept newest-start-first:
+// because all runs at a stage advance together, arrival order into a
+// stage is spawn order, so starts are non-increasing front-to-back
+// and within()-expired runs always form a SUFFIX — expiry is a lazy
+// truncation during the walks the event already pays for.
+struct FtCepRuns {
+  int k;
+  int64_t within;             // -1 = none
+  uint32_t strict_bits;       // bit s: stage s contiguity is STRICT
+  int64_t cap;
+  // merged probe entry: key hash (0 = empty sentinel), dense slot id,
+  // and the STAGE-1 waiting-run head together in 16 bytes — the k==2
+  // (A followedBy B) hot path touches exactly one cache line per
+  // active event.
+  struct Ent {
+    uint64_t h;
+    int32_t slot;
+    int32_t hd1;
+  };
+  std::vector<Ent> tab;
+  int64_t next_slot;
+  // list heads for stages >= 2 only: stage s at heads[slot*(k-2)+s-2]
+  std::vector<int32_t> heads;
+  // one pool per waiting stage: a run at stage s carries start_ts +
+  // s matched refs = s+1 int64s
+  struct Pool {
+    int stride;
+    std::vector<int64_t> data;
+    std::vector<int32_t> nxt;
+    std::vector<int32_t> free_list;
+    int32_t alloc() {
+      if (!free_list.empty()) {
+        int32_t r = free_list.back();
+        free_list.pop_back();
+        return r;
+      }
+      int32_t r = static_cast<int32_t>(nxt.size());
+      nxt.push_back(-1);
+      data.resize(data.size() + stride);
+      return r;
+    }
+  };
+  std::vector<Pool> pools;    // pools[s-1] serves stage s
+  std::vector<int64_t> m_refs;  // k gids per match (internal buffer:
+  std::vector<int64_t> m_pos;   // one event can complete many runs)
+  FtCepRuns(int k_, int64_t within_, uint32_t strict_bits_,
+            int64_t cap_)
+      : k(k_), within(within_), strict_bits(strict_bits_), cap(cap_),
+        tab(cap_, Ent{0, 0, -1}), next_slot(0) {
+    for (int s = 1; s < k_; ++s) pools.push_back(Pool{s + 1, {}, {}, {}});
+  }
+  void rehash() {
+    int64_t cap2 = cap * 2;
+    std::vector<Ent> t2(cap2, Ent{0, 0, -1});
+    for (int64_t p = 0; p < cap; ++p) {
+      if (tab[p].h == 0) continue;
+      uint64_t q = tab[p].h & (cap2 - 1);
+      while (t2[q].h != 0) q = (q + 1) & (cap2 - 1);
+      t2[q] = tab[p];
+    }
+    tab.swap(t2);
+    cap = cap2;
+  }
+  // grow BEFORE caching probe positions for a chunk: no insert may
+  // rehash mid-chunk or the cached positions dangle
+  void reserve_inserts(int64_t n_new) {
+    while ((next_slot + n_new) * 2 >= cap) rehash();
+  }
+  int64_t probe_pos(uint64_t h) {
+    uint64_t p = h & (cap - 1);
+    while (tab[p].h != h && tab[p].h != 0) p = (p + 1) & (cap - 1);
+    if (tab[p].h == 0) {
+      tab[p].h = h;
+      tab[p].slot = static_cast<int32_t>(next_slot++);
+      if (k > 2 &&
+          static_cast<size_t>(next_slot) * (k - 2) > heads.size())
+        heads.resize(static_cast<size_t>(next_slot) * 2 * (k - 2), -1);
+    }
+    return static_cast<int64_t>(p);
+  }
+  int64_t find_pos(uint64_t h) const {  // -1 when absent (no insert)
+    uint64_t p = h & (cap - 1);
+    while (tab[p].h != h && tab[p].h != 0) p = (p + 1) & (cap - 1);
+    return tab[p].h == 0 ? -1 : static_cast<int64_t>(p);
+  }
+  // head of the stage-s waiting list for the entry at probe pos p
+  int32_t* head(int64_t p, int s) {
+    return s == 1 ? &tab[p].hd1
+                  : &heads[static_cast<size_t>(tab[p].slot) * (k - 2)
+                           + s - 2];
+  }
+  void free_list_from(int s, int32_t r) {
+    Pool& pl = pools[s - 1];
+    while (r >= 0) {
+      int32_t nx = pl.nxt[r];
+      pl.free_list.push_back(r);
+      r = nx;
+    }
+  }
+};
+
+void* ft_cepr_new(int64_t k, int64_t within, int64_t strict_bits,
+                  int64_t capacity_pow2) {
+  return new FtCepRuns(static_cast<int>(k), within,
+                       static_cast<uint32_t>(strict_bits),
+                       capacity_pow2);
+}
+
+void ft_cepr_free(void* h) { delete static_cast<FtCepRuns*>(h); }
+
+// One chunk of the run-list advance.  Stage walk runs DESCENDING so
+// a run spliced into stage s+1 cannot re-advance on the same event,
+// and the stage-0 spawn comes last so the fresh run cannot consume
+// its own event.  Structure mirrors ft_cep_seq_chunk:
+//   phase 0 skims the chunk down to its ACTIVE rows — with no STRICT
+//           stage an event matching nothing cannot touch state;
+//   phase 1 resolves probe positions with the table line prefetched
+//           PD active events ahead (reserve_inserts first: a rehash
+//           mid-chunk would dangle the cached positions);
+//   phase 2 walks the NFA on warm lines.
+static void ft_cepr_chunk(FtCepRuns& st, const uint64_t* kh,
+                          const uint32_t* bits, const int64_t* ts,
+                          int64_t c, int64_t gid0, int64_t pos0) {
+  const int k = st.k;
+  const int64_t within = st.within;
+  int32_t idx[FT_CEP_CHUNK];
+  uint64_t hv[FT_CEP_CHUNK];
+  int64_t posv[FT_CEP_CHUNK];
+  int64_t na = 0;
+  if (st.strict_bits == 0) {
+    for (int64_t j = 0; j < c; ++j)
+      if (bits[j]) idx[na++] = static_cast<int32_t>(j);
+  } else {
+    // a STRICT stage clears its list on ANY non-matching event, so
+    // every row with existing state is active — no skim
+    for (int64_t j = 0; j < c; ++j) idx[na++] = static_cast<int32_t>(j);
+  }
+  if (na == 0) return;
+  st.reserve_inserts(na);
+  constexpr int64_t PD = 16;
+  for (int64_t a = 0; a < na; ++a)
+    hv[a] = ft_splitmix1(kh[idx[a]]);
+  for (int64_t a = 0; a < na; ++a) {
+    if (a + PD < na)
+      __builtin_prefetch(&st.tab[hv[a + PD] & (st.cap - 1)], 1);
+    posv[a] = bits[idx[a]] ? st.probe_pos(hv[a]) : st.find_pos(hv[a]);
+  }
+  for (int64_t a = 0; a < na; ++a) {
+    const int64_t p = posv[a];
+    if (p < 0) continue;            // no-match row, key never seen
+    const int64_t j = idx[a];
+    const uint32_t m = bits[j];
+    const int64_t t = ts[j];
+    const int64_t gid = gid0 + j;
+    for (int s = k - 1; s >= 1; --s) {
+      int32_t* hp = st.head(p, s);
+      int32_t h = *hp;
+      if ((m >> s) & 1) {
+        if (h < 0) continue;
+        FtCepRuns::Pool& src = st.pools[s - 1];
+        if (s == k - 1) {
+          // every waiting run completes (and dies: skip-till-next
+          // keeps no branch alive after a match)
+          int32_t r = h;
+          while (r >= 0) {
+            const int64_t* d = &src.data[static_cast<size_t>(r)
+                                         * src.stride];
+            if (within >= 0 && t - d[0] >= within) {
+              st.free_list_from(s, r);        // expired suffix
+              break;
+            }
+            for (int j2 = 0; j2 < s; ++j2)
+              st.m_refs.push_back(d[1 + j2]);
+            st.m_refs.push_back(gid);
+            st.m_pos.push_back(pos0 + j);
+            int32_t nx = src.nxt[r];
+            src.free_list.push_back(r);
+            r = nx;
+          }
+          *hp = -1;
+        } else {
+          // splice the WHOLE list one stage up, appending this gid;
+          // block-prepend preserves internal order, keeping the
+          // destination list newest-start-first
+          FtCepRuns::Pool& dst = st.pools[s];
+          int32_t r = h, chain_head = -1, chain_tail = -1;
+          while (r >= 0) {
+            int64_t start = src.data[static_cast<size_t>(r)
+                                     * src.stride];
+            if (within >= 0 && t - start >= within) {
+              st.free_list_from(s, r);
+              break;
+            }
+            int32_t q = dst.alloc();
+            int64_t* e = &dst.data[static_cast<size_t>(q)
+                                   * dst.stride];
+            const int64_t* d = &src.data[static_cast<size_t>(r)
+                                         * src.stride];
+            for (int j2 = 0; j2 <= s; ++j2) e[j2] = d[j2];
+            e[s + 1] = gid;
+            if (chain_head < 0) chain_head = q;
+            else dst.nxt[chain_tail] = q;
+            chain_tail = q;
+            int32_t nx = src.nxt[r];
+            src.free_list.push_back(r);
+            r = nx;
+          }
+          *hp = -1;
+          if (chain_head >= 0) {
+            int32_t* hq = st.head(p, s + 1);
+            dst.nxt[chain_tail] = *hq;
+            *hq = chain_head;
+          }
+        }
+      } else if ((st.strict_bits >> s) & 1) {
+        if (h >= 0) {
+          st.free_list_from(s, h);
+          *hp = -1;
+        }
+      }
+    }
+    if (m & 1) {
+      if (k == 1) {
+        st.m_refs.push_back(gid);
+        st.m_pos.push_back(pos0 + j);
+      } else {
+        FtCepRuns::Pool& p1 = st.pools[0];
+        int32_t q = p1.alloc();
+        int64_t* e = &p1.data[static_cast<size_t>(q) * p1.stride];
+        e[0] = t;
+        e[1] = gid;
+        int32_t* h0 = st.head(p, 1);
+        p1.nxt[q] = *h0;
+        *h0 = q;
+      }
+    }
+  }
+}
+
+// Advance one batch (arrival order).  Matches accumulate internally
+// (fetch + clear via ft_cepr_matches); returns the total buffered
+// match count.
+int64_t ft_cepr_advance(void* handle, const uint64_t* kh,
+                        const uint32_t* mask_bits, const int64_t* ts,
+                        int64_t n, int64_t base_gid) {
+  FtCepRuns& st = *static_cast<FtCepRuns*>(handle);
+  for (int64_t i0 = 0; i0 < n; i0 += FT_CEP_CHUNK) {
+    const int64_t c = std::min(FT_CEP_CHUNK, n - i0);
+    ft_cepr_chunk(st, kh + i0, mask_bits + i0, ts + i0, c,
+                  base_gid + i0, i0);
+  }
+  return static_cast<int64_t>(st.m_pos.size());
+}
+
+// Fused variant: stage programs evaluated one chunk at a time into a
+// stack-local bits buffer that feeds the chunk walk directly — the
+// skip-tier analogue of ft_cep_advance_prog's sequential case.
+int64_t ft_cepr_advance_prog(void* handle, const uint64_t* kh,
+                             const int64_t* ts, int64_t n,
+                             int64_t base_gid, const int64_t* prog,
+                             const int64_t* stage_off,
+                             const double* consts, const double* cols,
+                             int64_t ncols) {
+  FtCepRuns& st = *static_cast<FtCepRuns*>(handle);
+  const int64_t k = st.k;
+  const double* colp[64];
+  const double* colc[64];
+  int64_t nc = ncols < 64 ? ncols : 64;
+  for (int64_t ci = 0; ci < nc; ++ci) colp[ci] = cols + ci * n;
+  int maxd = 1;
+  for (int64_t s = 0; s < k; ++s) {
+    int d = ft_prog_max_depth(prog, stage_off[s], stage_off[s + 1]);
+    if (d > maxd) maxd = d;
+  }
+  static thread_local std::vector<double> tl_stack;
+  if (static_cast<int64_t>(tl_stack.size()) < maxd * FT_CEP_CHUNK)
+    tl_stack.resize(maxd * FT_CEP_CHUNK);
+  uint32_t bits[FT_CEP_CHUNK];
+  for (int64_t i0 = 0; i0 < n; i0 += FT_CEP_CHUNK) {
+    const int64_t c = std::min(FT_CEP_CHUNK, n - i0);
+    for (int64_t ci = 0; ci < nc; ++ci) colc[ci] = colp[ci] + i0;
+    for (int64_t j = 0; j < c; ++j) bits[j] = 0;
+    for (int64_t s = 0; s < k; ++s)
+      ft_eval_stage_chunk(prog, stage_off[s], stage_off[s + 1],
+                          consts, colc, 0, c, tl_stack.data(),
+                          FT_CEP_CHUNK, bits, 1u << s);
+    ft_cepr_chunk(st, kh + i0, bits, ts + i0, c, base_gid + i0, i0);
+  }
+  return static_cast<int64_t>(st.m_pos.size());
+}
+
+// Copy-and-clear the buffered matches (k refs row-major + batch pos).
+int64_t ft_cepr_matches(void* handle, int64_t* out_refs,
+                        int64_t* out_pos) {
+  FtCepRuns& st = *static_cast<FtCepRuns*>(handle);
+  int64_t m = static_cast<int64_t>(st.m_pos.size());
+  if (m) {
+    std::memcpy(out_refs, st.m_refs.data(),
+                st.m_refs.size() * sizeof(int64_t));
+    std::memcpy(out_pos, st.m_pos.data(), m * sizeof(int64_t));
+    st.m_refs.clear();
+    st.m_pos.clear();
+  }
+  return m;
+}
+
+// Live-run count across all keys and stages (tests / sizing).
+int64_t ft_cepr_size(void* handle) {
+  FtCepRuns& st = *static_cast<FtCepRuns*>(handle);
+  int64_t total = 0;
+  for (int64_t p = 0; p < st.cap; ++p) {
+    if (st.tab[p].h == 0) continue;
+    for (int s = 1; s < st.k; ++s) {
+      int32_t r = *st.head(p, s);
+      while (r >= 0) {
+        ++total;
+        r = st.pools[s - 1].nxt[r];
+      }
+    }
+  }
+  return total;
+}
+
+// Expiry sweep: truncate each list at the first expired run (runs
+// behind it are older — the suffix invariant).
+void ft_cepr_expire(void* handle, int64_t watermark) {
+  FtCepRuns& st = *static_cast<FtCepRuns*>(handle);
+  if (st.within < 0) return;
+  for (int64_t p = 0; p < st.cap; ++p) {
+    if (st.tab[p].h == 0) continue;
+    for (int s = 1; s < st.k; ++s) {
+      int32_t* hp = st.head(p, s);
+      FtCepRuns::Pool& pl = st.pools[s - 1];
+      int32_t r = *hp, prev = -1;
+      while (r >= 0) {
+        int64_t start = pl.data[static_cast<size_t>(r) * pl.stride];
+        if (watermark - start >= st.within) {
+          st.free_list_from(s, r);
+          if (prev < 0) *hp = -1;
+          else pl.nxt[prev] = -1;
+          break;
+        }
+        prev = r;
+        r = pl.nxt[r];
+      }
+    }
+  }
+}
+
+// Smallest event id still referenced by a live run (a run's first
+// ref is its oldest), INT64_MAX when none — log compaction watermark.
+int64_t ft_cepr_min_ref(void* handle) {
+  FtCepRuns& st = *static_cast<FtCepRuns*>(handle);
+  int64_t lo = INT64_MAX;
+  for (int64_t p = 0; p < st.cap; ++p) {
+    if (st.tab[p].h == 0) continue;
+    for (int s = 1; s < st.k; ++s) {
+      int32_t r = *st.head(p, s);
+      FtCepRuns::Pool& pl = st.pools[s - 1];
+      while (r >= 0) {
+        int64_t ref0 = pl.data[static_cast<size_t>(r) * pl.stride + 1];
+        if (ref0 < lo) lo = ref0;
+        r = pl.nxt[r];
+      }
+    }
+  }
+  return lo;
+}
+
+// Checkpoint serialization, flat int64 stream per live probe entry:
+//   hash, then per stage s=1..k-1: count, then count runs of
+//   (s+1) int64s each, OLDEST-FIRST — import's push-front rebuilds
+//   the newest-first list order the suffix-expiry invariant needs.
+int64_t ft_cepr_export_size(void* handle) {
+  FtCepRuns& st = *static_cast<FtCepRuns*>(handle);
+  int64_t total = 0;
+  for (int64_t p = 0; p < st.cap; ++p) {
+    if (st.tab[p].h == 0) continue;
+    total += 1 + (st.k - 1);        // hash + per-stage counts
+    for (int s = 1; s < st.k; ++s) {
+      int32_t r = *st.head(p, s);
+      while (r >= 0) {
+        total += s + 1;
+        r = st.pools[s - 1].nxt[r];
+      }
+    }
+  }
+  return total;
+}
+
+int64_t ft_cepr_export(void* handle, int64_t* out) {
+  FtCepRuns& st = *static_cast<FtCepRuns*>(handle);
+  int64_t w = 0;
+  std::vector<int32_t> order;
+  for (int64_t p = 0; p < st.cap; ++p) {
+    if (st.tab[p].h == 0) continue;
+    out[w++] = static_cast<int64_t>(st.tab[p].h);
+    for (int s = 1; s < st.k; ++s) {
+      FtCepRuns::Pool& pl = st.pools[s - 1];
+      order.clear();
+      int32_t r = *st.head(p, s);
+      while (r >= 0) {
+        order.push_back(r);
+        r = pl.nxt[r];
+      }
+      out[w++] = static_cast<int64_t>(order.size());
+      for (auto it = order.rbegin(); it != order.rend(); ++it) {
+        const int64_t* d = &pl.data[static_cast<size_t>(*it)
+                                    * pl.stride];
+        for (int j = 0; j <= s; ++j) out[w++] = d[j];
+      }
+    }
+  }
+  return w;
+}
+
+void ft_cepr_import(void* handle, const int64_t* buf, int64_t len) {
+  FtCepRuns& st = *static_cast<FtCepRuns*>(handle);
+  int64_t r = 0;
+  while (r < len) {
+    uint64_t h = static_cast<uint64_t>(buf[r++]);
+    // hashes come from export — insert directly, like ft_cep_import
+    st.reserve_inserts(1);
+    int64_t p = st.probe_pos(h);
+    for (int s = 1; s < st.k; ++s) {
+      int64_t cnt = buf[r++];
+      FtCepRuns::Pool& pl = st.pools[s - 1];
+      int32_t* hp = st.head(p, s);
+      for (int64_t c = 0; c < cnt; ++c) {
+        int32_t q = pl.alloc();
+        int64_t* e = &pl.data[static_cast<size_t>(q) * pl.stride];
+        for (int j = 0; j <= s; ++j) e[j] = buf[r++];
+        pl.nxt[q] = *hp;
+        *hp = q;
+      }
+    }
+  }
+}
+
+// followedBy baseline (bench config cep_followed_by): the per-record
+// heap run-list work of the reference's keyed NFA under skip-till-
+// next — probe the key, complete every waiting run on a stage-b
+// event, spawn on a stage-a event, lazily truncate the expired
+// suffix.  Conditions inline (v < t0v starts, v >= t1v completes) so
+// the baseline pays zero interpretation overhead.  Returns elapsed
+// seconds; *out_matches the match count (correctness cross-check).
+double ft_cep_followed_baseline(const uint64_t* kh,
+                                const double* values,
+                                const int64_t* ts, int64_t n,
+                                double t0v, double t1v, int64_t within,
+                                int64_t capacity_pow2,
+                                int64_t* out_matches) {
+  ProbeTable table(capacity_pow2);
+  std::vector<int32_t> heads(capacity_pow2, -1);
+  std::vector<int64_t> start_of;
+  std::vector<int64_t> ref_of;
+  std::vector<int32_t> nxt;
+  std::vector<int32_t> free_list;
+  volatile int64_t sink = 0;
+  int64_t matches = 0;
+  double t0 = now_s();
+  for (int64_t i = 0; i < n; ++i) {
+    double v = values[i];
+    bool ma = v < t0v, mb = v >= t1v;
+    if (!ma && !mb) continue;
+    int64_t s = table.get_or_insert(kh[i]);
+    int64_t t = ts[i];
+    if (mb) {
+      int32_t r = heads[s];
+      while (r >= 0) {
+        if (within >= 0 && t - start_of[r] >= within) {
+          while (r >= 0) {                 // expired suffix
+            int32_t nx = nxt[r];
+            free_list.push_back(r);
+            r = nx;
+          }
+          break;
+        }
+        ++matches;
+        sink += ref_of[r] + i;
+        int32_t nx = nxt[r];
+        free_list.push_back(r);
+        r = nx;
+      }
+      heads[s] = -1;
+    }
+    if (ma) {
+      int32_t q;
+      if (!free_list.empty()) {
+        q = free_list.back();
+        free_list.pop_back();
+      } else {
+        q = static_cast<int32_t>(nxt.size());
+        nxt.push_back(-1);
+        start_of.push_back(0);
+        ref_of.push_back(0);
+      }
+      start_of[q] = t;
+      ref_of[q] = i;
+      nxt[q] = heads[s];
+      heads[s] = q;
+    }
+  }
+  (void)sink;
+  *out_matches = matches;
+  return now_s() - t0;
 }
 
 // Fused fire-path grouping for the generic-aggregate log tier

@@ -298,11 +298,19 @@ def test_vectorized_equals_scalar(within):
 
 
 def test_vectorizable_gate():
-    from flink_tpu.cep.vectorized import pattern_vectorizable
+    from flink_tpu.cep.vectorized import (
+        pattern_strict_chain,
+        pattern_vectorizable,
+    )
     assert pattern_vectorizable(_strict_pattern())
+    assert pattern_strict_chain(_strict_pattern())
     p = (Pattern.begin("a").where(lambda e: e[1] == 1)
          .followed_by("b").where(lambda e: e[1] == 2))
-    assert not pattern_vectorizable(p)       # skip-till contiguity
+    assert pattern_vectorizable(p)           # skip-till-next admitted
+    assert not pattern_strict_chain(p)       # ...on the run-list tier
+    p = (Pattern.begin("a").where(lambda e: e[1] == 1)
+         .followed_by_any("b").where(lambda e: e[1] == 2))
+    assert not pattern_vectorizable(p)       # skip-till-ANY
     p = Pattern.begin("a").where(lambda e: e[1] == 1).times(2)
     assert not pattern_vectorizable(p)       # loop
     p = (Pattern.begin("a").where(lambda e: e[1] == 1)
@@ -411,3 +419,155 @@ def test_vectorized_key_type_change_raises():
         eng.advance_batch(np.array(["a", "b"]),
                           np.array([2, 3], np.int64),
                           [("a", 5), ("b", 6)])
+
+
+# ---------------------------------------------------------------------
+# followedBy (skip-till-next) on the vectorized run-list tier
+# ---------------------------------------------------------------------
+
+def _fb_pattern(within=None):
+    p = (Pattern.begin("a").where(lambda e: e[1] < 10)
+         .followed_by("b").where(lambda e: e[1] >= 180)
+         .followed_by("c").where(lambda e: e[1] >= 100))
+    return p.within(within) if within else p
+
+
+def _batch_arrays(events):
+    keys = np.asarray([e[0][0] for e in events], np.int64)
+    ts = np.asarray([t for _, t in events], np.int64)
+    rows = [e for e, _ in events]
+    return keys, ts, rows
+
+
+def test_strict_chain_compiles_to_predicate_kernel():
+    """The plain-comparison strict chain must take the compiled
+    bytecode path (not merely the lifted numpy path)."""
+    from flink_tpu.cep.vectorized import VectorizedStrictNFA
+    eng = VectorizedStrictNFA(_strict_pattern(40))
+    keys, ts, rows = _batch_arrays(_rand_events(n=2000, keys=11, seed=3))
+    eng.advance_batch(keys, ts, rows)
+    assert eng.mode == "compiled"
+    assert len(eng.matches) > 0
+
+
+@pytest.mark.parametrize("within", [None, 60])
+@pytest.mark.parametrize("seed", [1, 2, 7])
+def test_followed_by_vectorized_equals_scalar(within, seed):
+    events = _rand_events(n=6000, keys=23, seed=seed)
+    got = _run_cep(events, _fb_pattern(within), True)
+    want = _run_cep(events, _fb_pattern(within), False)
+    assert got == want and len(got) > 0
+
+
+def test_followed_by_takes_compiled_runs_tier():
+    from flink_tpu.cep.vectorized import VectorizedStrictNFA
+    import flink_tpu.native as nat
+    if not nat.available():
+        pytest.skip("native runtime unavailable")
+    eng = VectorizedStrictNFA(_fb_pattern(60))
+    keys, ts, rows = _batch_arrays(_rand_events(n=4000, keys=13, seed=5))
+    eng.advance_batch(keys, ts, rows)
+    assert eng.mode == "compiled"
+    assert eng._nat_runs is not None
+    assert len(eng.matches) > 0
+
+
+def test_mixed_contiguity_vectorized_equals_scalar():
+    """next + followedBy in one chain: strict stages clear on miss,
+    skip stages carry — both inside the run-list kernel."""
+    p = (Pattern.begin("a").where(lambda e: e[1] < 10)
+         .followed_by("b").where(lambda e: e[1] >= 180)
+         .next("c").where(lambda e: e[1] >= 100)).within(80)
+    events = _rand_events(n=6000, keys=19, seed=11)
+    got = _run_cep(events, p, True)
+    want = _run_cep(events, p, False)
+    assert got == want and len(got) > 0
+
+
+def test_followed_by_scalar_mask_fallback():
+    """Non-liftable condition on a followedBy stage: masks are built
+    per-row in Python but the run-list kernel still advances them."""
+    from flink_tpu.cep.vectorized import VectorizedStrictNFA
+
+    def weird(e):
+        return len(str(int(e[1]))) >= 3   # str defeats lift & compile
+
+    def mk():
+        return (Pattern.begin("a").where(lambda e: e[1] < 10)
+                .followed_by("b").where(weird)).within(50)
+
+    events = _rand_events(n=4000, keys=13, seed=17)
+    eng = VectorizedStrictNFA(mk())
+    keys, ts, rows = _batch_arrays(events)
+    eng.advance_batch(keys, ts, rows)
+    assert eng.mode == "scalar"
+    got = sorted((k, tuple(m["a"][0]), tuple(m["b"][0]))
+                 for k, m, _ in eng.matches)
+    nfas, want = {}, []
+    for (k, v), t in events:
+        nfa = nfas.setdefault(k, NFA(mk()))
+        ms, _ = nfa.advance((k, v), t)
+        want.extend((k, tuple(m["a"][0]), tuple(m["b"][0])) for m in ms)
+    assert got == sorted(want) and len(got) > 0
+
+
+def test_followed_by_snapshot_restore_mid_run():
+    """Checkpoint/restore of the extended per-key run-list state
+    (ft_cep_export/ft_cep_import blob round-trip): a restored engine
+    must continue identically to the uninterrupted one."""
+    from flink_tpu.cep.vectorized import VectorizedStrictNFA
+    events = _rand_events(n=6000, keys=13, seed=23)
+    keys, ts, rows = _batch_arrays(events)
+    eng = VectorizedStrictNFA(_fb_pattern(60))
+    eng.advance_batch(keys[:3000], ts[:3000], rows[:3000])
+    head = len(eng.matches)
+    snap = eng.snapshot()
+    eng2 = VectorizedStrictNFA(_fb_pattern(60))
+    eng2.restore(snap)
+    for e in (eng, eng2):
+        e.advance_batch(keys[3000:], ts[3000:], rows[3000:])
+    norm = lambda ms: sorted(
+        (k, tuple(tuple(x) for s in ("a", "b", "c") for x in m[s]))
+        for k, m, _ in ms)
+    assert norm(eng.matches[head:]) == norm(eng2.matches)
+    assert len(eng2.matches) > 0
+
+
+def test_followed_by_object_keys():
+    """String keys hash through the object-key path into the same
+    run-list kernel."""
+    events = [((f"k{k}", v), t)
+              for ((k, v), t) in _rand_events(n=4000, keys=7, seed=29)]
+    got = _run_cep(events, _fb_pattern(60), True)
+    want = _run_cep(events, _fb_pattern(60), False)
+    assert got == want and len(got) > 0
+
+
+def test_native_runs_export_import_roundtrip():
+    """Drive the native run-list state directly: export mid-stream,
+    import into a fresh instance, and both must produce identical
+    match positions on the remaining events."""
+    import flink_tpu.native as nat
+    if not nat.available():
+        pytest.skip("native runtime unavailable")
+    rng = np.random.default_rng(41)
+    n, k = 20000, 4
+    kh = rng.integers(1, 40, n).astype(np.uint64)
+    ts = np.arange(n, dtype=np.int64)
+    vals = rng.integers(0, 200, n)
+    # stage masks: bit s set when event passes stage s condition
+    bits = ((vals < 10).astype(np.uint32)
+            | ((vals >= 150).astype(np.uint32) << 1)
+            | ((vals >= 100).astype(np.uint32) << 2)
+            | ((vals % 2 == 0).astype(np.uint32) << 3))
+    st1 = nat.NativeCepRuns(k, within=2000)
+    cut = n // 2
+    refs_h, _ = st1.advance(kh[:cut], bits[:cut], ts[:cut], 0)
+    blob = st1.export()
+    st2 = nat.NativeCepRuns(k, within=2000)
+    st2.import_(blob)
+    assert st1.size() == st2.size() > 0
+    r1, p1 = st1.advance(kh[cut:], bits[cut:], ts[cut:], cut)
+    r2, p2 = st2.advance(kh[cut:], bits[cut:], ts[cut:], cut)
+    assert np.array_equal(r1, r2) and np.array_equal(p1, p2)
+    assert len(r1) > 0 and len(refs_h) > 0

@@ -356,7 +356,8 @@ def test_upsert_sink_retract_deletes(tmp_path):
     )
     store = FileDocumentStore(str(tmp_path / "d"))
     sink = UpsertSink(lambda: store, key_fn=lambda r: r[0],
-                      doc_fn=lambda r: {"v": r[1]}, buffer_size=10)
+                      doc_fn=lambda r: {"v": r[1]}, buffer_size=10,
+                      retract_stream=True)
     sink.open()
     sink.invoke((True, ("a", 1)))
     sink.invoke((True, ("b", 2)))
@@ -366,6 +367,44 @@ def test_upsert_sink_retract_deletes(tmp_path):
     sink.invoke((False, ("b", 2)))      # delete a stored doc
     sink.close()
     assert store.read_all() == {}
+
+    # without the flag, pair-shaped values are NOT sniffed as
+    # retractions — they are plain rows for key_fn/doc_fn
+    plain_store = FileDocumentStore(str(tmp_path / "p"))
+    plain = UpsertSink(lambda: plain_store, key_fn=lambda r: r[0],
+                       doc_fn=lambda r: {"v": r[1]}, buffer_size=10)
+    plain.open()
+    plain.invoke((False, "x"))          # a record, not a retraction
+    plain.close()
+    assert plain_store.read_all() == {"False": {"v": "x"}}
+
+
+def test_upsert_sink_retract_wiring_via_table(tmp_path):
+    """to_retract_stream().add_sink(UpsertSink) enables pair decoding
+    automatically — the constructor flag never needs spelling out on
+    the Table path."""
+    from flink_tpu.connectors.upsert_sink import (
+        FileDocumentStore,
+        UpsertSink,
+    )
+    from flink_tpu.streaming.datastream import (
+        StreamExecutionEnvironment,
+    )
+    from flink_tpu.table.api import StreamTableEnvironment
+
+    env = StreamExecutionEnvironment()
+    t_env = StreamTableEnvironment.create(env)
+    st = env.from_collection([("x", 1), ("x", 2), ("y", 5)])
+    t_env.register_table("ev", t_env.from_data_stream(st, ["k", "v"]))
+    out = t_env.sql_query("SELECT k, SUM(v) AS s FROM ev GROUP BY k")
+    store = FileDocumentStore(str(tmp_path / "w"))
+    sink = UpsertSink(lambda: store, key_fn=lambda r: r[0],
+                      doc_fn=lambda r: {"s": r[1]}, buffer_size=100)
+    assert not sink.retract_stream
+    out.to_retract_stream().add_sink(sink)
+    env.execute("retract-upsert")
+    assert sink.retract_stream          # wired by add_sink
+    assert store.read_all() == {"x": {"s": 3}, "y": {"s": 5}}
 
 
 def test_columnar_file_roundtrip_and_schema_evolution(tmp_path):

@@ -117,6 +117,36 @@ def test_tumbling_differential(agg_cls, mode):
                                    rtol=1e-9)
 
 
+def test_force_scalar_opt_out():
+    """force_scalar pins the scalar fold on an aggregate the probe
+    would lift — results stay identical."""
+    keys, ts, vals = _stream()
+
+    class PinnedMeanMax(MeanMax):
+        force_scalar = True
+
+    agg = PinnedMeanMax()
+    eng = GenericLogTumblingWindows(agg, 1000, compact_threshold=2048)
+    for i in range(0, len(keys), 1500):
+        eng.process_batch(keys[i:i+1500], ts[i:i+1500], vals[i:i+1500])
+    eng.advance_watermark(10_000)
+    assert eng.mode == "scalar"
+    got = {(s, k): r for k, r, s, e in eng.emitted}
+    want = _scalar_reference(keys, ts, vals, agg, 1000)
+    assert set(got) == set(want)
+    for key in want:
+        np.testing.assert_allclose(np.asarray(got[key], float),
+                                   np.asarray(want[key], float),
+                                   rtol=1e-9)
+
+    # the per-operator knob pins it without touching the aggregate
+    from flink_tpu.streaming.generic_agg import GenericWindowOperator
+    op = GenericWindowOperator(TumblingEventTimeWindows.of(1000),
+                               MeanMax(), force_scalar=True)
+    op._ensure_engine()
+    assert op.engine.lift.mode == "scalar"
+
+
 def test_sliding_differential():
     keys, ts, vals = _stream()
     agg = MeanMax()
