@@ -707,10 +707,19 @@ def bench_sql_join(n_each=1 << 21, n_keys=100_000, bound_ms=500,
 
 
 def main():
+    # --trace: attach the tracer for the whole run and write the
+    # Chrome trace-event file next to the report, so perf PRs can ship
+    # kernel-level evidence for every headline number
+    argv = sys.argv[1:]
+    trace = "--trace" in argv
+    if trace:
+        argv = [a for a in argv if a != "--trace"]
+        from flink_tpu.runtime import tracing
+        tracing.get_tracer().enabled = True
     # single-config runs MERGE into the existing report instead of
     # clobbering the other configs' results
     results = {}
-    if len(sys.argv) > 1:
+    if argv:
         try:
             with open("bench_report.json") as f:
                 results = json.load(f)
@@ -734,7 +743,7 @@ def main():
     # diagnostics: runnable by name, excluded from the default suite
     # (they document measured LIMITS, not headline configs)
     extras = [("generic_agg_minimal", bench_generic_agg_minimal)]
-    only = sys.argv[1] if len(sys.argv) > 1 else None
+    only = argv[0] if argv else None
     if only is not None and only in {n for n, _ in extras}:
         suite = extras
     elif only is not None and only not in {n for n, _ in suite}:
@@ -766,6 +775,21 @@ def main():
         log(f"[bench] {name}: tpu {tpu_rate/1e6:.2f} M ev/s, "
             f"C++ baseline {base_rate/1e6:.2f} M ev/s, "
             f"ratio {tpu_rate/base_rate:.2f}x")
+
+    if trace:
+        from flink_tpu.runtime import tracing
+        tracer = tracing.get_tracer()
+        n = tracer.write_chrome_trace("bench_trace.json")
+        log(f"[bench] trace: {n} events -> bench_trace.json")
+        top_spans = sorted(tracer.stats().items(),
+                           key=lambda kv: -kv[1]["total_ms"])[:20]
+        for name, s in top_spans:
+            log(f"[bench]   span {name}: n={s['count']} "
+                f"total={s['total_ms']:.1f}ms self={s['self_ms']:.1f}ms")
+        for name, s in sorted(tracing.kernel_stats().items(),
+                              key=lambda kv: -kv[1]["total_ms"])[:20]:
+            log(f"[bench]   native.{name}: n={s['dispatches']} "
+                f"total={s['total_ms']:.1f}ms p99={s['p99_ms']:.3f}ms")
 
     with open("bench_report.json", "w") as f:
         json.dump(results, f, indent=2)

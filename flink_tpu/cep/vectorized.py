@@ -306,6 +306,20 @@ class VectorizedStrictNFA:
             out &= g
         return out
 
+    def _compile_programs_timed(self, vspec, cols):
+        """compile_stage_programs with the compile accounted as a
+        compile event (runtime.tracing) — the CEP analogue of a jit
+        recompile, so ``jit.cep.predicate_compile`` shows up next to
+        the JAX counters in registry dumps."""
+        import time as _time
+
+        from flink_tpu.runtime import tracing as _tracing
+        t0 = _time.perf_counter()
+        compiled = compile_stage_programs(self.pattern, vspec, cols)
+        _tracing.record_compile_event("cep.predicate_compile",
+                                      _time.perf_counter() - t0)
+        return compiled
+
     def _probe(self, cols, vspec, rows, n: int) -> None:
         """Lift the conditions if column evaluation matches the scalar
         truth on a sample (same contract as LiftedAggregate.probe).
@@ -319,7 +333,7 @@ class VectorizedStrictNFA:
         m = min(64, n)
         import flink_tpu.native as nat
         if nat.available():
-            compiled = compile_stage_programs(self.pattern, vspec, cols)
+            compiled = self._compile_programs_timed(vspec, cols)
             if compiled is not None:
                 prog, off, consts = compiled
                 try:
@@ -398,8 +412,7 @@ class VectorizedStrictNFA:
         if self.mode == "compiled":
             if self._prog is None:
                 # restored checkpoint: recompile against this stream
-                self._prog = compile_stage_programs(
-                    self.pattern, vspec, cols)
+                self._prog = self._compile_programs_timed(vspec, cols)
                 if self._prog is None:
                     raise RuntimeError(
                         "compiled CEP checkpoint restored against a "

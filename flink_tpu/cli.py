@@ -2,6 +2,10 @@
 bin/flink script).
 
     python -m flink_tpu run <script.py> [args...]   execute a job script
+    python -m flink_tpu profile <script.py> [args...] run with the tracer
+                                   [--trace-out F]   attached; write a
+                                                     Chrome trace-event
+                                                     file + span summary
     python -m flink_tpu list --master H:P            list cluster jobs
     python -m flink_tpu cancel --master H:P <job>    cancel a running job
                                    [-s DIR]          ... with a savepoint
@@ -60,6 +64,8 @@ def main(argv=None) -> int:
         sys.argv = rest
         runpy.run_path(rest[0], run_name="__main__")
         return 0
+    if verb == "profile":
+        return _profile(rest)
     if verb == "bench":
         import subprocess
         return subprocess.call([sys.executable, "bench.py"] + rest)
@@ -81,10 +87,51 @@ def main(argv=None) -> int:
     if verb == "stop":
         return _stop(rest)
     print(f"unknown command {verb!r}; "
-          f"try: run | list | cancel | savepoint | stop | info | bench "
-          f"| jobmanager | taskmanager",
+          f"try: run | profile | list | cancel | savepoint | stop | info "
+          f"| bench | jobmanager | taskmanager",
           file=sys.stderr)
     return 2
+
+
+def _profile(rest) -> int:
+    """Run a job script with the tracer attached; on exit write the
+    Chrome trace-event file (load in Perfetto / chrome://tracing) and
+    print the per-span and per-kernel summaries to stderr."""
+    out = "trace.json"
+    if "--trace-out" in rest:
+        i = rest.index("--trace-out")
+        if i + 1 >= len(rest):
+            print("--trace-out needs a path", file=sys.stderr)
+            return 2
+        out = rest[i + 1]
+        rest = rest[:i] + rest[i + 2:]
+    if not rest:
+        print("usage: flink_tpu profile <script.py> [args...] "
+              "[--trace-out trace.json]", file=sys.stderr)
+        return 2
+
+    from flink_tpu.runtime import tracing
+    tracer = tracing.get_tracer()
+    tracer.enabled = True
+    sys.argv = rest
+    try:
+        runpy.run_path(rest[0], run_name="__main__")
+    finally:
+        n = tracer.write_chrome_trace(out)
+        print(f"-- trace: {n} events -> {out}", file=sys.stderr)
+        stats = sorted(tracer.stats().items(),
+                       key=lambda kv: -kv[1]["total_ms"])
+        for name, s in stats[:20]:
+            print(f"{name:<40} n={s['count']:<8} "
+                  f"total={s['total_ms']:.1f}ms self={s['self_ms']:.1f}ms "
+                  f"p99={s['p99_ms']:.3f}ms", file=sys.stderr)
+        kernels = sorted(tracing.kernel_stats().items(),
+                         key=lambda kv: -kv[1]["total_ms"])
+        for name, s in kernels[:20]:
+            print(f"native.{name:<33} n={s['dispatches']:<8} "
+                  f"total={s['total_ms']:.1f}ms p99={s['p99_ms']:.3f}ms",
+                  file=sys.stderr)
+    return 0
 
 
 def _client(master, secret=None, tls_dir=None):

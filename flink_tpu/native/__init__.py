@@ -11,11 +11,17 @@ callers fall back to the numpy paths.
 from __future__ import annotations
 
 import ctypes
+import functools
 import os
 import subprocess
+import time
 from typing import Optional
 
 import numpy as np
+
+from flink_tpu.runtime import tracing as _tracing
+
+_perf_ns = time.perf_counter_ns
 
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
@@ -249,8 +255,28 @@ def load_error() -> Optional[str]:
     return _load_error
 
 
+def _kernel(name: str):
+    """Per-kernel dispatch counter + wall-time accounting around a
+    host_runtime entry point.  Feeds runtime.tracing's kernel store
+    (gauges under ``native.<name>``) and, when the tracer is enabled,
+    emits a ``native.<name>`` span into the Chrome trace.  The wrapper
+    is transparent to the no-compiler degradation path — errors pass
+    straight through."""
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            t0 = _perf_ns()
+            try:
+                return fn(*args, **kwargs)
+            finally:
+                _tracing.record_kernel(name, t0, _perf_ns())
+        return wrapper
+    return deco
+
+
 # ---- hot host-path kernels -------------------------------------------------
 
+@_kernel("splitmix64")
 def splitmix64(x: np.ndarray) -> np.ndarray:
     lib = _ensure_loaded()
     x = np.ascontiguousarray(x, np.uint64)
@@ -259,6 +285,7 @@ def splitmix64(x: np.ndarray) -> np.ndarray:
     return out
 
 
+@_kernel("key_groups")
 def key_groups(kh: np.ndarray, max_parallelism: int,
                n_shards: int) -> np.ndarray:
     lib = _ensure_loaded()
@@ -290,6 +317,7 @@ class NativeSlotIndex:
     def n(self) -> int:
         return _lib.ft_index_size(self._h)
 
+    @_kernel("index.lookup_or_insert")
     def lookup_or_insert(self, batch_hashes: np.ndarray, alloc):
         h = np.ascontiguousarray(batch_hashes, np.uint64)
         n = len(h)
@@ -317,6 +345,7 @@ class NativeSlotIndex:
 
 # ---- log-structured window engine kernels ---------------------------------
 
+@_kernel("hll_log_compact")
 def hll_log_compact(keys: np.ndarray, regs: np.ndarray, ranks: np.ndarray,
                     precision: int):
     """Sort a window's HLL cell log by key and dedup (reg)->max(rank).
@@ -338,6 +367,7 @@ def hll_log_compact(keys: np.ndarray, regs: np.ndarray, ranks: np.ndarray,
     return ok[:c], orr[:c], ork[:c], ends[:n_keys]
 
 
+@_kernel("hll_log_fire")
 def hll_log_fire(keys: np.ndarray, regs: np.ndarray, ranks: np.ndarray,
                  precision: int):
     """Host-tier HLL fire over a window's cell log: per distinct key,
@@ -353,6 +383,7 @@ def hll_log_fire(keys: np.ndarray, regs: np.ndarray, ranks: np.ndarray,
     return ok[:n_keys], est[:n_keys]
 
 
+@_kernel("sum_log_fire")
 def sum_log_fire(keys: np.ndarray, values: np.ndarray):
     """Per distinct key, the sum of its logged values (key-sorted)."""
     lib = _ensure_loaded()
@@ -387,6 +418,7 @@ class NativeSumTable:
     def n(self) -> int:
         return _lib.ft_sumtab_size(self._h)
 
+    @_kernel("sum_table.ingest")
     def ingest(self, keys: np.ndarray, values: np.ndarray,
                max_distinct: int) -> int:
         """Accumulate; returns records consumed (< len(keys) when the
@@ -404,6 +436,7 @@ class NativeSumTable:
         return keys[:k], sums[:k]
 
 
+@_kernel("hll_make_cells")
 def hll_make_cells(value_hashes: np.ndarray, precision: int):
     """(register u16, rank u8) cells from u64 value hashes — one C++
     pass (the ingest twin of HyperLogLogAggregate.compress_value_hash
@@ -422,6 +455,7 @@ def hll_make_cells(value_hashes: np.ndarray, precision: int):
     return regs, ranks
 
 
+@_kernel("qsketch_log_fire")
 def qsketch_log_fire(keys: np.ndarray, buckets: np.ndarray,
                      n_buckets: int, quantiles, log_gamma: float,
                      offset: int, mid_corr: float, counts=None):
@@ -455,6 +489,7 @@ def qsketch_log_fire(keys: np.ndarray, buckets: np.ndarray,
     return ok[:n_keys], out[:n_keys * len(q)].reshape(n_keys, len(q))
 
 
+@_kernel("qsketch_log_compact")
 def qsketch_log_compact(keys: np.ndarray, buckets: np.ndarray,
                         counts, n_buckets: int):
     """Collapse (key, bucket) duplicates into count cells — bounds a
@@ -476,6 +511,7 @@ def qsketch_log_compact(keys: np.ndarray, buckets: np.ndarray,
     return ok[:n_out].copy(), ob[:n_out].copy(), oc[:n_out].copy()
 
 
+@_kernel("session_log_fire")
 def session_log_fire(keys: np.ndarray, ts: np.ndarray, weights: np.ndarray,
                      vhs: np.ndarray, gap_ms: int, watermark: int,
                      depth: int, width: int, retained=None):
@@ -559,6 +595,7 @@ def heap_tumbling_meanmax_baseline(kh: np.ndarray, values: np.ndarray,
     return n / elapsed
 
 
+@_kernel("fold_prep")
 def fold_prep(keys: np.ndarray):
     """Fused fire-path grouping for the generic-aggregate tier: stable
     radix argsort + segment detection + length-descending segment
@@ -577,6 +614,7 @@ def fold_prep(keys: np.ndarray):
             ukeys[:n_seg])
 
 
+@_kernel("group_cols")
 def group_cols(keys: np.ndarray, cols=(), want_order: bool = True):
     """Small-domain (keys < 2^22) grouping with payload columns
     co-scattered in the same counting-sort pass: returns (order,
@@ -650,6 +688,7 @@ class NativeCepState:
             _lib.ft_cep_free(self._h)
             self._h = None
 
+    @_kernel("cep.advance")
     def advance(self, kh: np.ndarray, mask_bits: np.ndarray,
                 ts: np.ndarray, base_gid: int):
         """→ (match_refs [m, k] global event ids, match_rows [m]
@@ -677,6 +716,7 @@ class NativeCepState:
             raise RuntimeError("CEP match buffer overflow")
         return out_refs[:m * self.k].reshape(m, self.k), out_pos[:m]
 
+    @_kernel("cep.advance_prog")
     def advance_prog(self, kh: np.ndarray, ts: np.ndarray,
                      base_gid: int, prog: np.ndarray,
                      stage_off: np.ndarray, consts: np.ndarray,
@@ -776,6 +816,7 @@ class NativeCepRuns:
         got = _lib.ft_cepr_matches(self._h, refs, pos)
         return refs[:got * self.k].reshape(got, self.k), pos[:got]
 
+    @_kernel("cep_runs.advance")
     def advance(self, kh: np.ndarray, mask_bits: np.ndarray,
                 ts: np.ndarray, base_gid: int):
         """→ (match_refs [m, k] global event ids, match_rows [m]
@@ -786,6 +827,7 @@ class NativeCepRuns:
             np.ascontiguousarray(ts, np.int64), len(kh), base_gid)
         return self._fetch(m)
 
+    @_kernel("cep_runs.advance_prog")
     def advance_prog(self, kh: np.ndarray, ts: np.ndarray,
                      base_gid: int, prog: np.ndarray,
                      stage_off: np.ndarray, consts: np.ndarray,
@@ -865,6 +907,7 @@ def cep_strict_baseline(kh: np.ndarray, values: np.ndarray,
     return n / elapsed, out.value
 
 
+@_kernel("argsort_u64")
 def argsort_u64(keys: np.ndarray) -> np.ndarray:
     """Stable argsort of a u64 column via the C++ adaptive radix sort
     (~5x numpy's stable comparison argsort at 8M 64-bit keys)."""
@@ -972,6 +1015,7 @@ class NativeStringInterner:
     def n(self) -> int:
         return _lib.ft_intern_size(self._h)
 
+    @_kernel("interner.intern")
     def intern(self, arr: np.ndarray):
         """→ (ids uint64 [n], first_idx int64 [n_new]): dense ids per
         row; first_idx = batch row of each newly-seen string, in id
@@ -1005,6 +1049,7 @@ class NativeWordSums:
             _lib.ft_wordsums_free(self._h)
             self._h = None
 
+    @_kernel("word_sums.add")
     def add(self, interner: "NativeStringInterner", words: np.ndarray,
             weights=None):
         """→ first_idx of newly-interned words (append words[first_idx]
@@ -1026,6 +1071,7 @@ class NativeWordSums:
     def touched(self) -> int:
         return _lib.ft_wordsums_count(self._h)
 
+    @_kernel("word_sums.fire")
     def fire(self):
         """→ (ids int64, sums float64) of touched ids; resets."""
         k = self.touched
@@ -1062,6 +1108,7 @@ class NativeIntervalJoin:
             _lib.ft_ivjoin_free(self._h)
             self._h = None
 
+    @_kernel("interval_join.push")
     def push(self, side: int, key_hashes: np.ndarray, ts: np.ndarray):
         """→ (left_rows, right_rows) int64 global row ids of the new
         pairs."""

@@ -64,3 +64,22 @@ def sample_client(client, num_samples: int = 20,
     if not subtasks:
         return {}
     return sample_backpressure(subtasks, num_samples, delay_s)
+
+
+def register_backpressure_gauges(vertex_group, subtasks: List) -> None:
+    """Publish the vertex's backpressure classification as gauges
+    (``backpressure.ratio`` numeric + ``backpressure.level`` string).
+    Read-time sampling is a single pass over the capacity predicate —
+    cheap enough for every metrics dump; callers wanting the smoothed
+    N-sample window keep using :func:`sample_backpressure`."""
+    group = vertex_group.add_group("backpressure")
+
+    def ratio() -> float:
+        if not subtasks:
+            return 0.0
+        blocked = sum(1 for st in subtasks
+                      if not st.router.has_capacity())
+        return blocked / len(subtasks)
+
+    group.gauge("ratio", ratio)
+    group.gauge("level", lambda: classify(ratio()))

@@ -58,6 +58,10 @@ from flink_tpu.runtime.metrics import (
     TaskIOMetricGroup,
     register_checkpoint_gauges,
 )
+from flink_tpu.runtime.tracing import (
+    get_tracer,
+    register_runtime_profile_gauges,
+)
 from flink_tpu.state.loader import load_state_backend
 from flink_tpu.state.operator_state import OperatorStateBackend
 from flink_tpu.streaming.elements import (
@@ -381,6 +385,10 @@ class SubtaskInstance:
         self.latency_stats = latency_stats
         self.io_metrics = (TaskIOMetricGroup(metrics_group)
                            if metrics_group is not None else None)
+        # precomputed span names (the per-element tracing fast path
+        # must not format strings)
+        self._span_process = f"op.{vertex.name}.process"
+        self._span_checkpoint = "checkpoint.barrier"
 
         # build the chain, tail first so outputs exist when wiring heads
         chain = vertex.chain
@@ -417,7 +425,8 @@ class SubtaskInstance:
                 max_parallelism=max_parallelism,
             )
             if metrics_group is not None:
-                op.metrics = metrics_group.add_group(node.uid)
+                op.register_standard_metrics(
+                    metrics_group.add_group(node.uid))
             ops_by_node[node.id] = op
         # operators in chain order (head first)
         self.operators = [ops_by_node[n.id] for n in chain]
@@ -531,10 +540,13 @@ class SubtaskInstance:
         self.pending_trigger = None
         cid, ts, options = trig
         barrier = CheckpointBarrier(cid, ts, options)
-        snapshot = self.snapshot(cid)
-        self.router.broadcast_barrier(barrier)
-        if self.ack_fn is not None:
-            self.ack_fn(self.task_key, cid, snapshot)
+        with get_tracer().span(self._span_checkpoint, checkpoint_id=cid,
+                               task=self.vertex.name,
+                               subtask=self.subtask_index):
+            snapshot = self.snapshot(cid)
+            self.router.broadcast_barrier(barrier)
+            if self.ack_fn is not None:
+                self.ack_fn(self.task_key, cid, snapshot)
 
     def try_inject_threaded_trigger(self):
         """Executor-side injection for blocking sources: take the
@@ -573,7 +585,12 @@ class SubtaskInstance:
 
     def _dispatch(self, ch: _InputChannel, element):
         if element.__class__ is StreamRecord or element.is_record:
-            self.process_record(ch.input_index, element)
+            tracer = get_tracer()
+            if tracer.enabled:
+                with tracer.span(self._span_process):
+                    self.process_record(ch.input_index, element)
+            else:
+                self.process_record(ch.input_index, element)
         elif element.is_watermark:
             self.process_channel_watermark(ch.input_index, ch.channel_id,
                                            element)
@@ -666,10 +683,15 @@ class SubtaskInstance:
         StreamTask.triggerCheckpointOnBarrier :586 →
         performCheckpoint :618 — barrier forwarded first, then
         snapshot, both atomically on this loop)."""
-        snapshot = self.snapshot(barrier.checkpoint_id)
-        self.router.broadcast_barrier(barrier)
-        if self.ack_fn is not None:
-            self.ack_fn(self.task_key, barrier.checkpoint_id, snapshot)
+        with get_tracer().span(self._span_checkpoint,
+                               checkpoint_id=barrier.checkpoint_id,
+                               task=self.vertex.name,
+                               subtask=self.subtask_index):
+            snapshot = self.snapshot(barrier.checkpoint_id)
+            self.router.broadcast_barrier(barrier)
+            if self.ack_fn is not None:
+                self.ack_fn(self.task_key, barrier.checkpoint_id,
+                            snapshot)
 
     def _on_end_of_stream(self, ch: _InputChannel):
         ch.eos = True
@@ -1529,6 +1551,10 @@ def build_and_wire_subtasks(job_graph: JobGraph, state_backend: str,
     TaskManager its own so timers fire on the owning worker thread."""
     job_group = metrics.job_group(job_graph.job_name)
     latency_stats = LatencyStats(job_group)
+    # native-kernel / jit-compile / span-aggregate gauges land at the
+    # registry root (process-wide stores; both executors route here)
+    register_runtime_profile_gauges(metrics)
+    from flink_tpu.runtime.backpressure import register_backpressure_gauges
     subtasks: Dict[int, List[SubtaskInstance]] = {}
     for vid, vertex in job_graph.vertices.items():
         vertex_group = job_group.add_group(f"{vid}_{vertex.name}")
@@ -1540,6 +1566,7 @@ def build_and_wire_subtasks(job_graph: JobGraph, state_backend: str,
                             latency_stats=latency_stats)
             for i in range(vertex.parallelism)
         ]
+        register_backpressure_gauges(vertex_group, subtasks[vid])
     for edge in job_graph.edges:
         ups = subtasks[edge.source_vertex_id]
         downs = subtasks[edge.target_vertex_id]

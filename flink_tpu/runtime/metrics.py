@@ -152,10 +152,16 @@ class Meter:
         now = self._clock()
         cutoff = now - self._window_s
         i = bisect.bisect_left(self._events, (cutoff, -1))
+        if i >= len(self._events):
+            # mark_event prunes at mark time only, so at READ time
+            # every retained event can predate the window: nothing
+            # happened within it — the rate is zero, not the stale
+            # (count - base) extrapolation over dead events
+            return 0.0
         base = self._events[i - 1][1] if i else (
             self._events[0][1] - 1)  # approximate pre-window base
         span = min(self._window_s, now - self._events[0][0]) or 1e-9
-        return (self.count - base) / span
+        return max(0.0, (self.count - base) / span)
 
 
 # ---------------------------------------------------------------------------
@@ -290,6 +296,14 @@ class PrometheusTextReporter(MetricReporter):
     def _sanitize(key: str) -> str:
         return "".join(c if (c.isalnum() or c == "_") else "_" for c in key)
 
+    @staticmethod
+    def _emit(lines: List[str], name: str, value) -> None:
+        if value != value:  # NaN — invalid exposition value; flag it
+            lines.append(f"# flink_tpu: skipped NaN sample {name}")
+            return
+        lines.append(f"# TYPE {name} gauge")
+        lines.append(f"{name} {value}")
+
     def render(self) -> str:
         lines: List[str] = []
         for key, value in sorted(self._last.items()):
@@ -297,9 +311,9 @@ class PrometheusTextReporter(MetricReporter):
             if isinstance(value, dict):
                 for sub, v in value.items():
                     if isinstance(v, (int, float)) and not isinstance(v, bool):
-                        lines.append(f"{name}_{self._sanitize(sub)} {v}")
+                        self._emit(lines, f"{name}_{self._sanitize(sub)}", v)
             elif isinstance(value, (int, float)) and not isinstance(value, bool):
-                lines.append(f"{name} {value}")
+                self._emit(lines, name, value)
         return "\n".join(lines) + ("\n" if lines else "")
 
 
@@ -366,11 +380,19 @@ class LatencyStats:
     def __init__(self, group: MetricGroup, window: int = 1024):
         self.group = group.add_group("latency")
         self.window = window
+        # markers arrive per source-interval per channel: resolving
+        # two group levels + a histogram registration each time is
+        # pure allocation churn — the mapping is static per attempt
+        self._histograms: Dict[Tuple[str, int, str], Histogram] = {}
 
     def record(self, marker, operator_id: str, latency_ms: float) -> None:
-        h = self.group.add_group(
-            f"source_{marker.operator_id}_{marker.subtask_index}"
-        ).histogram(f"operator_{operator_id}", self.window)
+        key = (marker.operator_id, marker.subtask_index, operator_id)
+        h = self._histograms.get(key)
+        if h is None:
+            h = self.group.add_group(
+                f"source_{marker.operator_id}_{marker.subtask_index}"
+            ).histogram(f"operator_{operator_id}", self.window)
+            self._histograms[key] = h
         h.update(latency_ms)
 
 

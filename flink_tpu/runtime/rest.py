@@ -207,6 +207,19 @@ class WebMonitor:
             if job not in self.jobs:
                 raise KeyError(path)
             return self._job_detail(job), "application/json"
+        if path.startswith("/jobs/") and path.endswith("/traces"):
+            job = urllib.parse.unquote(
+                path[len("/jobs/"):-len("/traces")])
+            if job not in self.jobs:
+                raise KeyError(path)
+            from flink_tpu.runtime.tracing import get_tracer
+            tracer = get_tracer()
+            # the tracer is process-global: spans are not partitioned
+            # per job, so this surfaces the recent window + aggregates
+            # while the named job is tracked
+            return ({"enabled": tracer.enabled,
+                     "spans": tracer.recent(200),
+                     "stats": tracer.stats()}, "application/json")
         if path.startswith("/jobs/") and path.endswith("/metrics"):
             job = urllib.parse.unquote(
                 path[len("/jobs/"):-len("/metrics")])

@@ -1,0 +1,535 @@
+"""End-to-end tracing & kernel profiling (ref: the reference runtime's
+LatencyStats / CheckpointStatsTracker observability story, extended
+down to the device tiers).
+
+Three cooperating pieces live here because they share one registry
+surface:
+
+* **Span tracing** — :class:`Tracer` with a ``span(name, **attrs)``
+  context manager, a thread-local span stack (parent/child + self-time
+  attribution), a bounded buffer of finished spans, and Chrome
+  trace-event JSON export (loadable in Perfetto / ``chrome://tracing``).
+  When disabled, ``span()`` returns a shared no-op object — one
+  attribute check and a dict-free return, so instrumented hot paths pay
+  near zero.
+
+* **Kernel profiling** — ``record_kernel(name, t0_ns, t1_ns)`` called
+  by the wrappers in :mod:`flink_tpu.native` around every
+  ``host_runtime`` entry point: per-kernel dispatch counters +
+  wall-time reservoirs, surfaced as gauges and (when the tracer is
+  enabled) as ``native.<kernel>`` spans in the Chrome trace.
+
+* **JAX compile tracking** — :func:`traced_jit` wraps ``jax.jit`` and
+  detects recompiles via the jitted callable's ``_cache_size()``
+  (grows across a call ⇒ that call compiled; otherwise a cache hit).
+  Non-JAX compilation events (the CEP predicate bytecode compiler)
+  report through :func:`record_compile_event` into the same store.
+
+All three feed the existing :class:`MetricRegistry` through
+:func:`register_runtime_profile_gauges` — names that appear *after*
+registration (engines are tier-selected on first flush) back-fill into
+every registered registry, so ``registry.dump()`` always reflects the
+full picture.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+import weakref
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+__all__ = [
+    "Tracer",
+    "get_tracer",
+    "set_tracer",
+    "traced_jit",
+    "record_kernel",
+    "record_compile_event",
+    "kernel_stats",
+    "jit_stats",
+    "reset_kernel_stats",
+    "reset_jit_stats",
+    "register_runtime_profile_gauges",
+]
+
+_perf_ns = time.perf_counter_ns
+
+# one lock guards the aggregate stores (kernel + jit + span stats and
+# the registered-registry list); all updates are batch-level, not
+# per-record, so contention is negligible
+_LOCK = threading.Lock()
+
+
+def _percentile(sorted_vals: List[float], q: float) -> float:
+    if not sorted_vals:
+        return 0.0
+    idx = min(len(sorted_vals) - 1, int(round(q * (len(sorted_vals) - 1))))
+    return sorted_vals[idx]
+
+
+class _Reservoir:
+    """Bounded sliding reservoir of recent durations (milliseconds)."""
+
+    __slots__ = ("values",)
+
+    def __init__(self, size: int = 512):
+        self.values: deque = deque(maxlen=size)
+
+    def update(self, v: float) -> None:
+        self.values.append(v)
+
+    def quantile(self, q: float) -> float:
+        return _percentile(sorted(self.values), q)
+
+
+# ---------------------------------------------------------------------
+# span tracing
+# ---------------------------------------------------------------------
+
+class _NullSpan:
+    """Shared no-op context manager returned while tracing is off."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def set_attr(self, key: str, value: Any) -> None:
+        pass
+
+
+_NULL_SPAN = _NullSpan()
+
+
+class _Span:
+    __slots__ = ("tracer", "name", "attrs", "start_ns", "child_ns",
+                 "parent")
+
+    def __init__(self, tracer: "Tracer", name: str, attrs: Optional[dict]):
+        self.tracer = tracer
+        self.name = name
+        self.attrs = attrs
+        self.child_ns = 0
+        self.parent: Optional[_Span] = None
+
+    def set_attr(self, key: str, value: Any) -> None:
+        if self.attrs is None:
+            self.attrs = {}
+        self.attrs[key] = value
+
+    def __enter__(self):
+        stack = self.tracer._stack()
+        self.parent = stack[-1] if stack else None
+        stack.append(self)
+        self.start_ns = _perf_ns()
+        return self
+
+    def __exit__(self, *exc):
+        end_ns = _perf_ns()
+        stack = self.tracer._stack()
+        if stack and stack[-1] is self:
+            stack.pop()
+        dur_ns = end_ns - self.start_ns
+        if self.parent is not None:
+            self.parent.child_ns += dur_ns
+        self.tracer._finish(self, dur_ns)
+        return False
+
+
+class _SpanStat:
+    __slots__ = ("count", "total_ms", "self_ms", "reservoir")
+
+    def __init__(self):
+        self.count = 0
+        self.total_ms = 0.0
+        self.self_ms = 0.0
+        self.reservoir = _Reservoir()
+
+
+class Tracer:
+    """Span recorder with Chrome trace-event export and per-name
+    aggregate stats.  One tracer is process-global (``get_tracer()``);
+    instrumentation points check ``tracer.enabled`` and skip all work
+    when off."""
+
+    def __init__(self, max_events: int = 100_000):
+        self.enabled = False
+        self.max_events = max_events
+        self._events: deque = deque(maxlen=max_events)
+        self._stats: Dict[str, _SpanStat] = {}
+        self._lock = threading.Lock()
+        self._tls = threading.local()
+        self._pid = os.getpid()
+        # metric groups (weakrefs) that want per-span-name gauges
+        self._metric_groups: List[weakref.ref] = []
+
+    # ---- recording --------------------------------------------------
+    def span(self, name: str, **attrs):
+        """Context manager timing one unit of work.  Near-free when
+        the tracer is disabled (returns a shared no-op)."""
+        if not self.enabled:
+            return _NULL_SPAN
+        return _Span(self, name, attrs or None)
+
+    def _stack(self) -> list:
+        stack = getattr(self._tls, "stack", None)
+        if stack is None:
+            stack = []
+            self._tls.stack = stack
+        return stack
+
+    def _finish(self, span: _Span, dur_ns: int) -> None:
+        event = {
+            "name": span.name,
+            "ph": "X",
+            "ts": span.start_ns / 1000.0,
+            "dur": dur_ns / 1000.0,
+            "pid": self._pid,
+            "tid": threading.get_ident(),
+        }
+        if span.parent is not None:
+            event["parent"] = span.parent.name
+        if span.attrs:
+            event["args"] = span.attrs
+        total_ms = dur_ns / 1e6
+        self_ms = (dur_ns - span.child_ns) / 1e6
+        with self._lock:
+            self._events.append(event)
+            stat = self._stats.get(span.name)
+            if stat is None:
+                stat = self._stats[span.name] = _SpanStat()
+                self._register_span_gauges(span.name, stat)
+            stat.count += 1
+            stat.total_ms += total_ms
+            stat.self_ms += self_ms
+            stat.reservoir.update(total_ms)
+
+    def record_instant(self, name: str, **attrs) -> None:
+        """Record a zero-duration marker event (checkpoint triggers,
+        compile events...)."""
+        if not self.enabled:
+            return
+        event = {
+            "name": name,
+            "ph": "i",
+            "ts": _perf_ns() / 1000.0,
+            "pid": self._pid,
+            "tid": threading.get_ident(),
+            "s": "t",
+        }
+        if attrs:
+            event["args"] = attrs
+        with self._lock:
+            self._events.append(event)
+
+    # ---- export -----------------------------------------------------
+    def recent(self, limit: int = 200) -> List[dict]:
+        """Most recent finished spans, oldest first."""
+        with self._lock:
+            events = list(self._events)
+        return events[-limit:]
+
+    def chrome_trace(self) -> dict:
+        """The Chrome trace-event JSON object (``traceEvents`` uses
+        complete events: ``ph``/``ts``/``dur``/``pid``/``tid``/
+        ``name``; timestamps are microseconds)."""
+        with self._lock:
+            events = list(self._events)
+        return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+    def write_chrome_trace(self, path: str) -> int:
+        """Write the trace file; returns the number of events."""
+        trace = self.chrome_trace()
+        with open(path, "w") as f:
+            json.dump(trace, f)
+        return len(trace["traceEvents"])
+
+    def stats(self) -> Dict[str, dict]:
+        """Aggregated per-span-name stats."""
+        out = {}
+        with self._lock:
+            for name, st in self._stats.items():
+                vals = sorted(st.reservoir.values)
+                out[name] = {
+                    "count": st.count,
+                    "total_ms": st.total_ms,
+                    "self_ms": st.self_ms,
+                    "p50_ms": _percentile(vals, 0.50),
+                    "p99_ms": _percentile(vals, 0.99),
+                }
+        return out
+
+    def reset(self) -> None:
+        with self._lock:
+            self._events.clear()
+            self._stats.clear()
+
+    # ---- metric registry feed --------------------------------------
+    def install_metrics(self, group) -> None:
+        """Register per-span-name aggregate gauges under ``group``
+        (a ``MetricGroup``); names that appear later back-fill."""
+        with self._lock:
+            self._metric_groups.append(weakref.ref(group))
+            for name, stat in self._stats.items():
+                self._add_gauges(group, name, stat)
+
+    def _register_span_gauges(self, name: str, stat: _SpanStat) -> None:
+        # caller holds self._lock
+        alive = []
+        for ref in self._metric_groups:
+            group = ref()
+            if group is None:
+                continue
+            alive.append(ref)
+            self._add_gauges(group, name, stat)
+        self._metric_groups[:] = alive
+
+    @staticmethod
+    def _add_gauges(group, name: str, stat: _SpanStat) -> None:
+        g = group.add_group(name)
+        g.gauge("count", lambda s=stat: s.count)
+        g.gauge("totalMs", lambda s=stat: s.total_ms)
+        g.gauge("selfMs", lambda s=stat: s.self_ms)
+        g.gauge("p50Ms", lambda s=stat: s.reservoir.quantile(0.50))
+        g.gauge("p99Ms", lambda s=stat: s.reservoir.quantile(0.99))
+
+
+_tracer = Tracer()
+
+
+def get_tracer() -> Tracer:
+    return _tracer
+
+
+def set_tracer(tracer: Tracer) -> Tracer:
+    global _tracer
+    _tracer = tracer
+    return tracer
+
+
+# ---------------------------------------------------------------------
+# native kernel profiling (fed by flink_tpu.native wrappers)
+# ---------------------------------------------------------------------
+
+class _KernelStat:
+    __slots__ = ("dispatches", "total_ms", "reservoir")
+
+    def __init__(self):
+        self.dispatches = 0
+        self.total_ms = 0.0
+        self.reservoir = _Reservoir()
+
+
+_kernel_stats: Dict[str, _KernelStat] = {}
+
+
+def record_kernel(name: str, t0_ns: int, t1_ns: int) -> None:
+    """Account one native-kernel dispatch (called by the wrappers in
+    ``flink_tpu/native/__init__.py``)."""
+    ms = (t1_ns - t0_ns) / 1e6
+    with _LOCK:
+        stat = _kernel_stats.get(name)
+        if stat is None:
+            stat = _kernel_stats[name] = _KernelStat()
+            _backfill_kernel_gauges(name, stat)
+        stat.dispatches += 1
+        stat.total_ms += ms
+        stat.reservoir.update(ms)
+    tracer = _tracer
+    if tracer.enabled:
+        event = {
+            "name": "native." + name,
+            "ph": "X",
+            "ts": t0_ns / 1000.0,
+            "dur": (t1_ns - t0_ns) / 1000.0,
+            "pid": tracer._pid,
+            "tid": threading.get_ident(),
+        }
+        with tracer._lock:
+            tracer._events.append(event)
+
+
+def kernel_stats() -> Dict[str, dict]:
+    """Per-kernel dispatch counters + wall-time summaries."""
+    out = {}
+    with _LOCK:
+        for name, st in _kernel_stats.items():
+            vals = sorted(st.reservoir.values)
+            out[name] = {
+                "dispatches": st.dispatches,
+                "total_ms": st.total_ms,
+                "p50_ms": _percentile(vals, 0.50),
+                "p99_ms": _percentile(vals, 0.99),
+            }
+    return out
+
+
+def reset_kernel_stats() -> None:
+    with _LOCK:
+        _kernel_stats.clear()
+
+
+# ---------------------------------------------------------------------
+# JAX jit compile tracking
+# ---------------------------------------------------------------------
+
+class _JitStat:
+    __slots__ = ("recompiles", "compile_time_ms", "cache_hits")
+
+    def __init__(self):
+        self.recompiles = 0
+        self.compile_time_ms = 0.0
+        self.cache_hits = 0
+
+
+_jit_stats: Dict[str, _JitStat] = {}
+
+
+def _jit_entry(name: str) -> _JitStat:
+    with _LOCK:
+        stat = _jit_stats.get(name)
+        if stat is None:
+            stat = _jit_stats[name] = _JitStat()
+            _backfill_jit_gauges(name, stat)
+        return stat
+
+
+def traced_jit(fn, name: Optional[str] = None, **jit_kwargs):
+    """``jax.jit`` with compile-event accounting.  Each call compares
+    the jitted callable's ``_cache_size()`` before/after: growth means
+    the call traced+compiled (count it, with wall time — compilation
+    dominates the call so attributing the whole call is a fine
+    estimate); no growth is a cache hit.  Falls back to plain timing
+    when the private API is absent."""
+    import jax
+
+    jitted = jax.jit(fn, **jit_kwargs)
+    label = name or getattr(fn, "__name__", None) or "jit_fn"
+    stat = _jit_entry(label)
+    cache_size = getattr(jitted, "_cache_size", None)
+
+    def wrapper(*args, **kwargs):
+        if cache_size is None:
+            return jitted(*args, **kwargs)
+        before = cache_size()
+        t0 = _perf_ns()
+        out = jitted(*args, **kwargs)
+        if cache_size() > before:
+            ms = (_perf_ns() - t0) / 1e6
+            with _LOCK:
+                stat.recompiles += 1
+                stat.compile_time_ms += ms
+            tracer = _tracer
+            if tracer.enabled:
+                tracer.record_instant("jit.compile." + label,
+                                      compile_ms=round(ms, 3))
+        else:
+            stat.cache_hits += 1
+        return out
+
+    wrapper.__name__ = "traced_" + label.replace(".", "_")
+    wrapper._jitted = jitted  # escape hatch (.lower(), cache control)
+    wrapper._jit_label = label
+    return wrapper
+
+
+def record_compile_event(name: str, seconds: float) -> None:
+    """Account a non-JAX compilation (e.g. the CEP predicate bytecode
+    compiler) in the same store ``traced_jit`` feeds."""
+    stat = _jit_entry(name)
+    ms = seconds * 1000.0
+    with _LOCK:
+        stat.recompiles += 1
+        stat.compile_time_ms += ms
+    tracer = _tracer
+    if tracer.enabled:
+        tracer.record_instant("compile." + name, compile_ms=round(ms, 3))
+
+
+def jit_stats() -> Dict[str, dict]:
+    out = {}
+    with _LOCK:
+        for name, st in _jit_stats.items():
+            out[name] = {
+                "recompiles": st.recompiles,
+                "compile_time_ms": st.compile_time_ms,
+                "cache_hits": st.cache_hits,
+            }
+    return out
+
+
+def reset_jit_stats() -> None:
+    with _LOCK:
+        _jit_stats.clear()
+
+
+# ---------------------------------------------------------------------
+# registry wiring
+# ---------------------------------------------------------------------
+
+# (weakref-to-root-group, kind) pairs; kernel/jit names discovered
+# after registration back-fill into every live registered group
+_profile_groups: List[weakref.ref] = []
+_registered_registry_ids: "weakref.WeakSet" = weakref.WeakSet()
+
+
+def _backfill_kernel_gauges(name: str, stat: _KernelStat) -> None:
+    # caller holds _LOCK
+    for ref in list(_profile_groups):
+        root = ref()
+        if root is None:
+            _profile_groups.remove(ref)
+            continue
+        _add_kernel_gauges(root.add_group("native"), name, stat)
+
+
+def _backfill_jit_gauges(name: str, stat: _JitStat) -> None:
+    # caller holds _LOCK
+    for ref in list(_profile_groups):
+        root = ref()
+        if root is None:
+            _profile_groups.remove(ref)
+            continue
+        _add_jit_gauges(root.add_group("jit"), name, stat)
+
+
+def _add_kernel_gauges(group, name: str, stat: _KernelStat) -> None:
+    g = group.add_group(name)
+    g.gauge("dispatches", lambda s=stat: s.dispatches)
+    g.gauge("totalMs", lambda s=stat: s.total_ms)
+    g.gauge("p50Ms", lambda s=stat: s.reservoir.quantile(0.50))
+    g.gauge("p99Ms", lambda s=stat: s.reservoir.quantile(0.99))
+
+
+def _add_jit_gauges(group, name: str, stat: _JitStat) -> None:
+    g = group.add_group(name)
+    g.gauge("recompiles", lambda s=stat: s.recompiles)
+    g.gauge("compileTimeMs", lambda s=stat: s.compile_time_ms)
+    g.gauge("cacheHits", lambda s=stat: s.cache_hits)
+
+
+def register_runtime_profile_gauges(registry) -> None:
+    """Publish native-kernel dispatch stats, jit compile stats, and
+    span aggregates into ``registry`` (a :class:`MetricRegistry`).
+    Idempotent per registry; kernel/jit/span names that first appear
+    after registration (engines tier-select on first flush) back-fill
+    automatically."""
+    if registry in _registered_registry_ids:
+        return
+    _registered_registry_ids.add(registry)
+    root = registry.root
+    with _LOCK:
+        _profile_groups.append(weakref.ref(root))
+        native_group = root.add_group("native")
+        for name, stat in _kernel_stats.items():
+            _add_kernel_gauges(native_group, name, stat)
+        jit_group = root.add_group("jit")
+        for name, stat in _jit_stats.items():
+            _add_jit_gauges(jit_group, name, stat)
+    _tracer.install_metrics(root.add_group("tracing"))

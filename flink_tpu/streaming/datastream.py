@@ -321,6 +321,22 @@ class StreamExecutionEnvironment:
         after execute()/execute_async())."""
         return self._last_executor.metrics if self._last_executor else None
 
+    def enable_tracing(self, enabled: bool = True
+                       ) -> "StreamExecutionEnvironment":
+        """Turn the process-global tracer on (or off): spans for
+        operator processing, device flush/fire, native kernel
+        dispatches, and checkpoint barriers land in the Chrome
+        trace-event buffer (runtime.tracing).  Export after the job
+        with ``env.get_tracer().write_chrome_trace(path)``."""
+        from flink_tpu.runtime.tracing import get_tracer
+        get_tracer().enabled = enabled
+        return self
+
+    def get_tracer(self):
+        """The process-global :class:`~flink_tpu.runtime.tracing.Tracer`."""
+        from flink_tpu.runtime.tracing import get_tracer
+        return get_tracer()
+
     def _make_executor(self):
         kw = dict(
             state_backend=self.state_backend,

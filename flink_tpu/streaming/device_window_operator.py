@@ -26,6 +26,7 @@ from typing import Any, List, Optional
 import numpy as np
 
 from flink_tpu.ops.device_agg import DeviceAggregateFunction
+from flink_tpu.runtime.tracing import get_tracer
 from flink_tpu.streaming.elements import (MAX_TIMESTAMP,
     StreamRecord, Watermark)
 from flink_tpu.streaming.operators import StreamOperator, TimestampedCollector
@@ -203,9 +204,11 @@ class DeviceWindowOperator(StreamOperator):
         # metric parity with the scalar WindowOperator (ref:
         # WindowOperator.java:138 numLateRecordsDropped); reset = this
         # execution attempt
+        self._emit_batch_hist = None
         if self.metrics is not None:
             c = self.metrics.counter("numLateRecordsDropped")
             c.count = 0
+            self._emit_batch_hist = self.metrics.histogram("emitBatchSize")
 
     # ---- input ------------------------------------------------------
     def set_key_context(self, record):
@@ -289,6 +292,15 @@ class DeviceWindowOperator(StreamOperator):
     def _flush_buffer(self):
         if not self._keys:
             return
+        tracer = get_tracer()
+        if tracer.enabled:
+            with tracer.span("device_window.flush",
+                             batch=len(self._keys)):
+                self._flush_buffer_inner()
+        else:
+            self._flush_buffer_inner()
+
+    def _flush_buffer_inner(self):
         agg = self.agg
         extract = agg.extract_value
         # overridden either on the class or per-instance (a plain
@@ -357,8 +369,14 @@ class DeviceWindowOperator(StreamOperator):
         self._flush_buffer()
         if self.engine is not None:
             before = len(self.engine.emitted)
-            self.engine.advance_watermark(wm)
-            self._emit_from(before)
+            tracer = get_tracer()
+            if tracer.enabled:
+                with tracer.span("device_window.fire", watermark=wm):
+                    self.engine.advance_watermark(wm)
+                    self._emit_from(before)
+            else:
+                self.engine.advance_watermark(wm)
+                self._emit_from(before)
             self.num_late_records_dropped = self.engine.num_late_dropped
             if self.metrics is not None:
                 self.metrics.counter(
@@ -378,6 +396,8 @@ class DeviceWindowOperator(StreamOperator):
 
     def _emit_from(self, start_idx: int):
         emitted = self.engine.emitted
+        if self._emit_batch_hist is not None and len(emitted) > start_idx:
+            self._emit_batch_hist.update(len(emitted) - start_idx)
         fn = self.window_function
         id_to_key = self._id_to_key if self._interner is not None else None
         for key, result, w_start, w_end in emitted[start_idx:]:

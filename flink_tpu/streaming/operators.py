@@ -28,6 +28,7 @@ from flink_tpu.core.state import ReducingStateDescriptor, StateDescriptor
 from flink_tpu.state.backend import VOID_NAMESPACE, KeyedStateBackend
 from flink_tpu.state.operator_state import OperatorStateBackend
 from flink_tpu.streaming.elements import (
+    MAX_TIMESTAMP,
     MIN_TIMESTAMP,
     LatencyMarker,
     StreamRecord,
@@ -163,6 +164,25 @@ class StreamOperator(abc.ABC):
             self.timer_service = InternalTimerService(
                 f"{self.operator_id}-timers", keyed_backend,
                 processing_time_service, self)
+
+    def register_standard_metrics(self, group) -> None:
+        """Attach the operator's MetricGroup and publish the standard
+        pipeline-health gauges every operator gets for free:
+        ``currentWatermark`` and ``watermarkLag`` (event-time vs wall
+        clock, ms) — the per-operator lag the web monitor and
+        Prometheus endpoint surface (ref: the reference's
+        currentInputWatermark / task metric group)."""
+        self.metrics = group
+        group.gauge("currentWatermark", lambda: self.current_watermark)
+        group.gauge("watermarkLag", self._watermark_lag_ms)
+
+    def _watermark_lag_ms(self):
+        wm = self.current_watermark
+        if wm <= MIN_TIMESTAMP:
+            return None  # no watermark seen yet: lag undefined
+        if wm >= MAX_TIMESTAMP:
+            return 0.0  # final watermark: stream drained, no lag
+        return max(0.0, _time_mod.time() * 1000.0 - wm)
 
     def open(self) -> None:  # noqa: B027
         pass

@@ -34,6 +34,7 @@ import numpy as np
 from flink_tpu.core.keygroups import splitmix64_np, stable_hash64
 from flink_tpu.ops.device_agg import DeviceAggregateFunction
 from flink_tpu.ops.hashing import split_hash64_np
+from flink_tpu.runtime.tracing import traced_jit
 
 
 def hash_keys_np(keys) -> np.ndarray:
@@ -268,7 +269,8 @@ def make_masked_update(agg: DeviceAggregateFunction):
         mask = jnp.arange(slots.shape[0], dtype=jnp.int32) < n
         return agg.update(state, slots, values, hi, lo, mask)
 
-    return jax.jit(update_fn, donate_argnums=0)
+    return traced_jit(update_fn, name="window.masked_update",
+                      donate_argnums=0)
 
 
 class _ScratchMergeMixin:
@@ -383,8 +385,10 @@ class VectorizedTumblingWindows:
         self._p_lo: List[np.ndarray] = []
         self._p_count = 0
         self._jit_update = make_masked_update(self.agg)
-        self._jit_result = jax.jit(self.agg.result)
-        self._jit_clear = jax.jit(self.agg.clear_slots, donate_argnums=0)
+        self._jit_result = traced_jit(self.agg.result,
+                                      name="window.result")
+        self._jit_clear = traced_jit(self.agg.clear_slots,
+                                     name="window.clear", donate_argnums=0)
         # contiguous fire fast path: slots handed out by the arena are
         # dense, so a full tile of consecutive slots fires as ONE
         # dynamic_slice + dense reduction instead of a row gather
@@ -396,8 +400,9 @@ class VectorizedTumblingWindows:
                    for k, v in state.items()}
             return agg.result_dense(sub)
 
-        self._jit_result_contig = jax.jit(_result_contig,
-                                          static_argnums=(2,))
+        self._jit_result_contig = traced_jit(_result_contig,
+                                             name="window.result_contig",
+                                             static_argnums=(2,))
 
         specs = agg.state_specs()
 
@@ -410,9 +415,10 @@ class VectorizedTumblingWindows:
                     out[name], fill, start, 0)
             return out
 
-        self._jit_clear_contig = jax.jit(_clear_contig,
-                                         static_argnums=(2,),
-                                         donate_argnums=0)
+        self._jit_clear_contig = traced_jit(_clear_contig,
+                                            name="window.clear_contig",
+                                            static_argnums=(2,),
+                                            donate_argnums=0)
         # full-arena fire: when the fired window owns EVERY live slot
         # (the steady tumbling cadence — one window live at a time) and
         # covers enough of the arena, one fused full-array reduce beats
@@ -420,7 +426,8 @@ class VectorizedTumblingWindows:
         # a multi-GB array materializes unfused, ~4x the bandwidth cost
         # — measured, BENCH_NOTES.md), and the clear becomes one
         # donated full fill at write bandwidth
-        self._jit_result_all = jax.jit(agg.result_dense)
+        self._jit_result_all = traced_jit(agg.result_dense,
+                                          name="window.result_all")
         # fire/clear tile bounded by BYTES not slot count: a gather or
         # clear materializes [tile, *slot_shape] intermediates, so wide
         # per-slot state (Count-Min: depth*width ints) must shrink the
@@ -771,7 +778,8 @@ class VectorizedSlidingWindows(_ScratchMergeMixin, VectorizedTumblingWindows):
         self.n_panes = window_size_ms // slide_ms
         self.lateness_horizon = window_size_ms
         self._fired_horizon = -(2**63)  # last watermark fires ran at
-        self._jit_merge = jax.jit(self.agg.merge_slots, donate_argnums=0)
+        self._jit_merge = traced_jit(self.agg.merge_slots,
+                                     name="window.merge", donate_argnums=0)
 
     def advance_watermark(self, watermark: int) -> int:
         """Fire every sliding window with end-1 in

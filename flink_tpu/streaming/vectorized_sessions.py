@@ -41,6 +41,7 @@ import numpy as np
 
 from flink_tpu.ops.device_agg import DeviceAggregateFunction
 from flink_tpu.ops.hashing import split_hash64_np
+from flink_tpu.runtime.tracing import traced_jit
 from flink_tpu.streaming.vectorized import (
     _ScratchMergeMixin,
     _SlotArena,
@@ -85,9 +86,12 @@ class VectorizedSessionWindows(_ScratchMergeMixin):
         self._expiry_heap: List[Tuple[int, int]] = []
 
         self._jit_update = make_masked_update(self.agg)
-        self._jit_merge = jax.jit(self.agg.merge_slots, donate_argnums=0)
-        self._jit_result = jax.jit(self.agg.result)
-        self._jit_clear = jax.jit(self.agg.clear_slots, donate_argnums=0)
+        self._jit_merge = traced_jit(self.agg.merge_slots,
+                                     name="session.merge", donate_argnums=0)
+        self._jit_result = traced_jit(self.agg.result,
+                                      name="session.result")
+        self._jit_clear = traced_jit(self.agg.clear_slots,
+                                     name="session.clear", donate_argnums=0)
 
     # ---- device helpers (power-of-two padded) -----------------------
     def _clear_release(self, slots: List[int]) -> None:

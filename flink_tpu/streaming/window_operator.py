@@ -27,6 +27,7 @@ from flink_tpu.core.state import (
     StateDescriptor,
     ValueStateDescriptor,
 )
+from flink_tpu.runtime.tracing import get_tracer
 from flink_tpu.streaming.elements import MAX_TIMESTAMP, StreamRecord
 from flink_tpu.streaming.operators import (
     AbstractUdfStreamOperator,
@@ -306,12 +307,14 @@ class WindowOperator(AbstractUdfStreamOperator):
     # ---- lifecycle --------------------------------------------------
     def open(self):
         super().open()
+        self._emit_batch_hist = None
         if self.metrics is not None:
             # eager so monitoring sees the zero (ref: the counter is
             # constructed in WindowOperator.open, not on first drop);
             # reset = fresh execution attempt (restart replays must not
             # accumulate into the previous attempt's count)
             self.metrics.counter("numLateRecordsDropped").count = 0
+            self._emit_batch_hist = self.metrics.histogram("emitBatchSize")
         self.window_state = self.keyed_backend.get_or_create_keyed_state(
             self.state_descriptor)
         self.trigger_ctx = _WindowTriggerContext(self)
@@ -490,6 +493,18 @@ class WindowOperator(AbstractUdfStreamOperator):
     def _emit(self, window, contents) -> None:
         """(ref: emitWindowContents :544 — output timestamp =
         window.maxTimestamp)"""
+        if self._emit_batch_hist is not None:
+            self._emit_batch_hist.update(
+                len(contents) if hasattr(contents, "__len__") else 1)
+        tracer = get_tracer()
+        if tracer.enabled:
+            with tracer.span("window.fire"):
+                self.collector.set_absolute_timestamp(
+                    window.max_timestamp())
+                key = self.keyed_backend.current_key
+                self._internal_fn.process(key, window, self, contents,
+                                          self.collector)
+            return
         self.collector.set_absolute_timestamp(window.max_timestamp())
         key = self.keyed_backend.current_key
         self._internal_fn.process(key, window, self, contents, self.collector)

@@ -220,3 +220,88 @@ def test_latency_markers_flow_to_histograms():
     h = next(iter(lat.values()))
     assert h["count"] >= 1
     assert h["p99"] >= 0
+
+
+def test_meter_rate_zero_after_window_expires():
+    """Regression: get_rate() must clamp to 0.0 once all retained
+    events predate the window — not extrapolate over dead events or
+    go negative."""
+    t = [0.0]
+    m = Meter(clock=lambda: t[0], window_s=60.0)
+    m.mark_event(10)
+    t[0] = 30.0
+    assert m.get_rate() > 0.0
+    t[0] = 120.0  # the single retained event is now outside the window
+    assert m.get_rate() == 0.0
+    t[0] = 10_000.0
+    assert m.get_rate() == 0.0
+    assert m.get_count() == 10  # count is lifetime, unaffected
+    # rate is never negative at any probe point
+    t[0] = 10_001.0
+    m.mark_event(1)
+    for probe in (10_001.0, 10_030.0, 10_061.0, 10_500.0):
+        t[0] = probe
+        assert m.get_rate() >= 0.0
+
+
+def _parse_prometheus(text):
+    """Tiny exposition-format parser for the round-trip test: returns
+    ({name: value}, {name: type}, [flag comments])."""
+    samples, types, flags = {}, {}, []
+    for line in text.splitlines():
+        if not line.strip():
+            continue
+        if line.startswith("# TYPE "):
+            _, _, name, mtype = line.split(None, 3)
+            types[name] = mtype
+        elif line.startswith("#"):
+            flags.append(line)
+        else:
+            name, value = line.rsplit(None, 1)
+            samples[name] = float(value)
+    return samples, types, flags
+
+
+def test_prometheus_round_trip_types_and_nan():
+    reg = MetricRegistry()
+    g = reg.job_group("rt").add_group("op")
+    g.counter("records").inc(42)
+    g.gauge("lag", lambda: 7.5)
+    g.gauge("bad", lambda: float("nan"))
+    g.gauge("label", lambda: "a-string")  # non-numeric: excluded
+    rep = reg.add_reporter(PrometheusTextReporter())
+    reg.report()
+    samples, types, flags = _parse_prometheus(rep.render())
+    assert samples["flink_tpu_rt_op_records"] == 42.0
+    assert samples["flink_tpu_rt_op_lag"] == 7.5
+    # every sample is preceded by a # TYPE comment of type gauge
+    for name in samples:
+        assert types[name] == "gauge"
+    # NaN is skipped from samples but flagged as a comment
+    assert "flink_tpu_rt_op_bad" not in samples
+    assert any("skipped NaN sample flink_tpu_rt_op_bad" in f for f in flags)
+    # strings never leak into the exposition
+    assert "flink_tpu_rt_op_label" not in samples
+
+
+def test_latency_stats_caches_histograms():
+    from flink_tpu.runtime.metrics import LatencyStats
+
+    class _Marker:
+        operator_id = "src-1"
+        subtask_index = 0
+
+    reg = MetricRegistry()
+    stats = LatencyStats(reg.job_group("lat-cache"))
+    stats.record(_Marker(), "sink-1", 5.0)
+    h1 = stats._histograms[("src-1", 0, "sink-1")]
+    stats.record(_Marker(), "sink-1", 7.0)
+    assert stats._histograms[("src-1", 0, "sink-1")] is h1
+    assert len(stats._histograms) == 1
+    assert h1.get_statistics().count == 2
+    # a different (marker, operator) pair gets its own histogram
+    stats.record(_Marker(), "sink-2", 1.0)
+    assert len(stats._histograms) == 2
+    dump = reg.dump()
+    assert dump["lat-cache.latency.source_src-1_0.operator_sink-1"][
+        "count"] == 2
