@@ -706,6 +706,28 @@ def bench_sql_join(n_each=1 << 21, n_keys=100_000, bound_ms=500,
     return best, base_rate
 
 
+def chaos_smoke() -> int:
+    """One seeded chaos run per executor: injected storage failures,
+    lost checkpoint acks, and a task crash must leave the output
+    multiset identical to a fault-free run (exactly-once)."""
+    from flink_tpu.runtime.chaos import run_chaos_case
+
+    failures = 0
+    for executor in ("local", "minicluster"):
+        log(f"[chaos] {executor}: seeded fault schedule ...")
+        t0 = time.perf_counter()
+        r = run_chaos_case(executor, seed=7)
+        ok = r["chaos"] == r["baseline"]
+        failures += 0 if ok else 1
+        log(f"[chaos] {executor}: exactly_once={'OK' if ok else 'BROKEN'} "
+            f"restarts={r['restarts']} "
+            f"timeouts={r['counters'].get('checkpoint_timeouts', 0)} "
+            f"retries={r['counters'].get('retries_total', 0)} "
+            f"({time.perf_counter() - t0:.1f}s)")
+    print(json.dumps({"chaos_smoke": "pass" if failures == 0 else "fail"}))
+    return 1 if failures else 0
+
+
 def main():
     # --trace: attach the tracer for the whole run and write the
     # Chrome trace-event file next to the report, so perf PRs can ship
@@ -716,6 +738,11 @@ def main():
         argv = [a for a in argv if a != "--trace"]
         from flink_tpu.runtime import tracing
         tracing.get_tracer().enabled = True
+    # --chaos-smoke: one seeded chaos case per executor (the
+    # tests/test_chaos.py harness), exits non-zero if exactly-once
+    # breaks — a quick fault-tolerance gate without the full suite
+    if "--chaos-smoke" in argv:
+        sys.exit(chaos_smoke())
     # single-config runs MERGE into the existing report instead of
     # clobbering the other configs' results
     results = {}

@@ -173,10 +173,13 @@ def _list(rest) -> int:
         if not args.all and j.get("state") not in ("RUNNING", "CREATED",
                                                    "RESTARTING"):
             continue
-        print(f"{j['job_id']}  {j.get('state'):<10}  "
-              f"restarts={j.get('restarts', 0)}  "
-              f"checkpoints={j.get('checkpoints_completed', 0)}  "
-              f"{j.get('job_name', '')}")
+        line = (f"{j['job_id']}  {j.get('state'):<10}  "
+                f"restarts={j.get('restarts', 0)}  "
+                f"checkpoints={j.get('checkpoints_completed', 0)}  "
+                f"{j.get('job_name', '')}")
+        if j.get("last_failure"):
+            line += f"\n    last failure: {j['last_failure']}"
+        print(line)
         shown += 1
     if shown == 0:
         print("(no jobs)" if args.all else

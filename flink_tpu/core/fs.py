@@ -30,6 +30,8 @@ import threading
 import time as _time
 from typing import Dict, List, Tuple
 
+from flink_tpu.runtime import faults
+
 
 class FileSystem(abc.ABC):
     @abc.abstractmethod
@@ -74,6 +76,10 @@ class LocalFileSystem(FileSystem):
         return os.listdir(path)
 
     def replace(self, src, dst):
+        # the durable-commit point of every storage write path — where
+        # an injected "disk" failure is indistinguishable from a real
+        # one to the layers above
+        faults.fire("storage.persist")
         os.replace(src, dst)
 
     def remove(self, path):
@@ -157,6 +163,7 @@ class MemoryFileSystem(FileSystem):
                            for k in self._files if k.startswith(prefix)})
 
     def replace(self, src, dst):
+        faults.fire("storage.persist")  # same commit point as local
         with self._lock:
             if src not in self._files:
                 raise FileNotFoundError(src)

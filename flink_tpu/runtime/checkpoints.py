@@ -26,10 +26,44 @@ from __future__ import annotations
 import os
 import pickle
 import shutil
+import struct
 import threading
 import time as _time
+import zlib
 from collections import deque
 from typing import Any, Callable, Dict, List, Optional, Set, Tuple
+
+from flink_tpu.runtime import faults
+
+
+class CorruptCheckpointError(Exception):
+    """A checkpoint or chunk file failed its CRC32 verification (or is
+    torn/truncated).  Deliberately NOT an OSError: retrying a read of a
+    corrupt file cannot heal it, so the retry helper must not spin on
+    it — `latest()` falls back to an older retained checkpoint
+    instead."""
+
+
+#: checksummed-file envelope: magic + CRC32(payload) + payload.  Files
+#: without the magic are legacy (pre-checksum) and load unverified.
+_CRC_MAGIC = b"FTCK"
+
+
+def _crc_wrap(payload: bytes) -> bytes:
+    return _CRC_MAGIC + struct.pack("<I", zlib.crc32(payload)) + payload
+
+
+def _crc_unwrap(data: bytes, path: str) -> bytes:
+    if not data.startswith(_CRC_MAGIC):
+        return data  # legacy un-checksummed file
+    if len(data) < 8:
+        raise CorruptCheckpointError(f"torn checkpoint file {path}")
+    (expect,) = struct.unpack("<I", data[4:8])
+    payload = data[8:]
+    if zlib.crc32(payload) != expect:
+        raise CorruptCheckpointError(
+            f"CRC mismatch in checkpoint file {path}")
+    return payload
 
 
 class CheckpointStorage:
@@ -158,28 +192,67 @@ class FsCheckpointStorage(CheckpointStorage):
             exists=lambda h: self.fs.exists(f"{self._shared_dir}/{h}"))
         self._adopted: Set[int] = set()
         self._chunk_sizes: Dict[str, int] = {}
+        # sweep orphaned *.part files first: a crashed predecessor's
+        # torn write must never be adopted, and a lingering chunk .part
+        # would shadow the next write of the same hash
+        for d in (self.directory, self._shared_dir):
+            for name in self.fs.listdir(d):
+                if name.endswith(".part"):
+                    try:
+                        self.fs.remove(f"{d.rstrip('/')}/{name}")
+                    except OSError:
+                        pass
         # fresh-process recovery: adopt EVERY retained checkpoint's
         # chunk refs up front, so rotation decrefs (and eventually
         # deletes) chunks of pre-restart checkpoints instead of
         # orphaning them on disk
         for cid in self.checkpoint_ids():
             try:
-                with self.fs.open(self._path(cid), "rb") as f:
-                    entry = pickle.load(f)
+                entry = self._read_entry(self._path(cid))
                 self.registry.adopt_checkpoint(cid, entry["tasks"])
                 self._adopted.add(cid)
             except Exception:  # noqa: BLE001 — unreadable old file:
                 pass           # rotation will still remove its chk-N
 
+    #: bounded-backoff policy for storage I/O (transient faults heal;
+    #: CorruptCheckpointError is not an OSError and never retries)
+    RETRY_ATTEMPTS = 4
+    RETRY_BASE_MS = 5.0
+    RETRY_DEADLINE_MS = 5_000.0
+
+    def _retry(self, fn):
+        return faults.retry_with_backoff(
+            fn, attempts=self.RETRY_ATTEMPTS,
+            base_delay_ms=self.RETRY_BASE_MS,
+            deadline_ms=self.RETRY_DEADLINE_MS,
+            counter="storage_retries")
+
     def _path(self, checkpoint_id: int) -> str:
         return f"{self.directory.rstrip('/')}/chk-{checkpoint_id}"
 
+    def _write_file(self, tmp: str, final: str, payload: bytes) -> None:
+        """Checksummed write-then-rename, retried with backoff.  The
+        `storage.persist` fault point fires inside fs.replace (the
+        commit), so an injected failure leaves the .part behind —
+        exactly the torn-write shape the orphan sweep cleans up."""
+
+        def attempt():
+            with self.fs.open(tmp, "wb") as f:
+                f.write(_crc_wrap(payload))
+            self.fs.replace(tmp, final)
+
+        self._retry(attempt)
+
+    def _read_entry(self, path: str):
+        with self.fs.open(path, "rb") as f:
+            data = f.read()
+        return pickle.loads(_crc_unwrap(data, path))
+
     def _store_chunk(self, h: str, payload) -> None:
-        tmp = f"{self._shared_dir}/{h}.part"
-        with self.fs.open(tmp, "wb") as f:
-            pickle.dump(payload, f, protocol=pickle.HIGHEST_PROTOCOL)
-            self._chunk_sizes[h] = f.tell()
-        self.fs.replace(tmp, f"{self._shared_dir}/{h}")
+        data = pickle.dumps(payload, protocol=pickle.HIGHEST_PROTOCOL)
+        self._chunk_sizes[h] = len(data)
+        self._write_file(f"{self._shared_dir}/{h}.part",
+                         f"{self._shared_dir}/{h}", data)
 
     def _delete_chunk(self, h: str) -> None:
         try:
@@ -188,8 +261,11 @@ class FsCheckpointStorage(CheckpointStorage):
             pass
 
     def _fetch_chunk(self, h: str):
-        with self.fs.open(f"{self._shared_dir}/{h}", "rb") as f:
-            return pickle.load(f)
+        def attempt():
+            faults.fire("storage.fetch_chunk")
+            return self._read_entry(f"{self._shared_dir}/{h}")
+
+        return self._retry(attempt)
 
     _fetch_shared = _fetch_chunk
 
@@ -201,15 +277,14 @@ class FsCheckpointStorage(CheckpointStorage):
             "metadata": metadata,
             "tasks": tasks,
         }
-        tmp = self._path(checkpoint_id) + ".part"
-        with self.fs.open(tmp, "wb") as f:
-            pickle.dump(payload, f, protocol=pickle.HIGHEST_PROTOCOL)
-            size = f.tell()
+        data = pickle.dumps(payload, protocol=pickle.HIGHEST_PROTOCOL)
+        size = len(data)
         # count chunks NEWLY written by this checkpoint (incremental
         # bytes); deduped chunks cost nothing
         size += sum(self._chunk_sizes.get(h, 0)
                     for h in self.registry.last_new_hashes)
-        self.fs.replace(tmp, self._path(checkpoint_id))
+        self._write_file(self._path(checkpoint_id) + ".part",
+                         self._path(checkpoint_id), data)
         for cid in self.checkpoint_ids()[:-self.retain]:
             try:
                 self.fs.remove(self._path(cid))
@@ -219,16 +294,29 @@ class FsCheckpointStorage(CheckpointStorage):
         return size
 
     def latest(self):
-        ids = self.checkpoint_ids()
-        return self.load(ids[-1]) if ids else None
+        """Newest LOADABLE retained checkpoint: when the newest file is
+        corrupt or torn (CRC mismatch, truncated pickle, missing
+        chunk), fall back to the next-older retained one instead of
+        failing recovery (ref: the reference re-reads the completed-
+        checkpoint store and skips unreadable entries)."""
+        for cid in reversed(self.checkpoint_ids()):
+            try:
+                entry = self.load(cid)
+            except Exception:  # noqa: BLE001 — corrupt/torn newest:
+                # recovery prefers an older consistent snapshot over
+                # failing the job
+                faults.count("checkpoint_fallbacks")
+                continue
+            if entry is not None:
+                return entry
+        return None
 
     def load(self, checkpoint_id):
         from flink_tpu.state.shared_registry import ChunkRef, map_chunks
         path = self._path(checkpoint_id)
         if not self.fs.exists(path):
             return None
-        with self.fs.open(path, "rb") as f:
-            entry = pickle.load(f)
+        entry = self._read_entry(path)
         if checkpoint_id not in self.registry._by_checkpoint \
                 and checkpoint_id not in self._adopted:
             # recovery in a fresh process: re-register the retained
@@ -377,6 +465,22 @@ def load_savepoint(path: str) -> dict:
         return pickle.load(f)
 
 
+class CheckpointFailuresExceeded(RuntimeError):
+    """More consecutive checkpoint failures than
+    `tolerable_checkpoint_failures` allows — escalated to a task
+    failure (ref: CheckpointFailureManager.java
+    checkExceedTolerableFailures → FlinkRuntimeException)."""
+
+    def __init__(self, n_failures: int, tolerable: int,
+                 cause: Optional[BaseException]):
+        super().__init__(
+            f"{n_failures} consecutive checkpoint failures exceed "
+            f"tolerable_checkpoint_failures={tolerable}"
+            + (f"; last cause: {cause!r}" if cause is not None else ""))
+        self.n_failures = n_failures
+        self.cause = cause
+
+
 class CheckpointCoordinator:
     """Periodic barrier-checkpoint driver (ref:
     CheckpointCoordinator.java).  `trigger_sources` is a callback that
@@ -393,7 +497,9 @@ class CheckpointCoordinator:
                  max_concurrent: int = 1,
                  clock: Callable[[], float] = None,
                  metadata_extra: Optional[dict] = None,
-                 async_persist: bool = False):
+                 async_persist: bool = False,
+                 checkpoint_timeout_ms: Optional[int] = None,
+                 tolerable_checkpoint_failures: Optional[int] = None):
         #: merged into every completed checkpoint's metadata (e.g. the
         #: JobMaster's master_epoch + attempt — the provenance local
         #: recovery needs, since bare checkpoint ids are reused across
@@ -408,6 +514,22 @@ class CheckpointCoordinator:
         self.min_pause_ms = min_pause_ms
         self.max_concurrent = max_concurrent
         self._clock = clock or (lambda: _time.monotonic() * 1000.0)
+        # a pending checkpoint older than this is aborted so the
+        # coordinator can re-trigger — a lost ack must not stall
+        # checkpointing forever (ref: CheckpointCoordinator's
+        # checkpointTimeout / abortExpired)
+        self.checkpoint_timeout_ms = checkpoint_timeout_ms
+        # None = unlimited (legacy behavior: declines/aborts never
+        # escalate, a failed persist raises immediately).  An int N
+        # tolerates N CONSECUTIVE failed/aborted checkpoints; the
+        # N+1-th escalates to a task failure (ref:
+        # ExecutionCheckpointingOptions.TOLERABLE_FAILURE_NUMBER +
+        # CheckpointFailureManager.java)
+        self.tolerable_checkpoint_failures = tolerable_checkpoint_failures
+        self.consecutive_failures = 0
+        self.failed_count = 0       # lifetime failed/aborted/declined
+        self.aborted_count = 0      # aborted (timeout) + declined
+        self.timeout_aborts = 0     # aborted specifically by timeout
         self._id_counter = 0
         self.pending: Dict[int, PendingCheckpoint] = {}
         self.completed_count = 0
@@ -454,6 +576,10 @@ class CheckpointCoordinator:
         if self.stopped:
             return None
         now = self._clock()
+        # expire stale pendings FIRST: a timed-out checkpoint must
+        # release its max_concurrent slot on this very call, or a
+        # single lost ack pins the slot forever
+        self._abort_timed_out(now)
         if len(self.pending) >= self.max_concurrent:
             return None
         # user savepoint requests bypass the periodic gating (ref:
@@ -537,15 +663,55 @@ class CheckpointCoordinator:
             self._complete(pc)
 
     def decline(self, checkpoint_id: int) -> None:
-        """(ref: CheckpointDeclineReason / abortDeclined)"""
-        self.pending.pop(checkpoint_id, None)
+        """(ref: CheckpointDeclineReason / abortDeclined).  Releases
+        the max_concurrent slot and counts toward the tolerable-
+        failure budget (when one is configured)."""
+        pc = self.pending.pop(checkpoint_id, None)
         req = self._savepoint_cids.pop(checkpoint_id, None)
         if req is not None:
             req.fail(RuntimeError(
                 "savepoint declined: a source already finished"))
+        if pc is not None:
+            self.aborted_count += 1
+            self._register_failure(RuntimeError(
+                f"checkpoint {checkpoint_id} declined"))
 
     def abort_all_pending(self) -> None:
         self.pending.clear()
+
+    def _abort_timed_out(self, now: float) -> None:
+        """Abort pending checkpoints older than checkpoint_timeout_ms
+        (ref: PendingCheckpoint abort(CHECKPOINT_EXPIRED)).  A later
+        ack of an aborted id hits the pending-map miss in
+        `acknowledge` and is ignored."""
+        if self.checkpoint_timeout_ms is None:
+            return
+        for cid in [cid for cid, pc in self.pending.items()
+                    if now - pc.timestamp >= self.checkpoint_timeout_ms]:
+            pc = self.pending.pop(cid)
+            pc.discarded = True
+            self.aborted_count += 1
+            self.timeout_aborts += 1
+            faults.count("checkpoint_timeouts")
+            req = self._savepoint_cids.pop(cid, None)
+            err = TimeoutError(
+                f"checkpoint {cid} expired after "
+                f"{self.checkpoint_timeout_ms}ms "
+                f"({len(pc.acks)}/{len(pc.expected)} acks)")
+            if req is not None:
+                req.fail(err)
+            self._register_failure(err)
+
+    def _register_failure(self, err: BaseException) -> None:
+        """Consecutive-failure accounting; escalates past the
+        tolerable budget."""
+        self.failed_count += 1
+        self.consecutive_failures += 1
+        faults.count("checkpoint_failures")
+        tolerable = self.tolerable_checkpoint_failures
+        if tolerable is not None and self.consecutive_failures > tolerable:
+            raise CheckpointFailuresExceeded(
+                self.consecutive_failures, tolerable, err)
 
     def _complete(self, pc: PendingCheckpoint) -> None:
         """(ref: completePendingCheckpoint :802).  The sync part ends
@@ -630,16 +796,22 @@ class CheckpointCoordinator:
                 req: Optional[SavepointRequest]) -> None:
         now = self._clock()
         if err is not None:
-            # a failed persist fails the JOB (the reference's
-            # tolerable-failed-checkpoints default is 0): silent
-            # checkpoint stalls would let 2PC sinks commit against an
-            # ever-staler recovery point.  _finish always runs on the
-            # loop thread (sync path or drained), so the raise
-            # surfaces as a task/job failure
+            # a failed persist aborts this CHECKPOINT and charges the
+            # tolerable-failure budget; with no budget configured
+            # (tolerable=None, the legacy default) it fails the JOB
+            # outright: silent checkpoint stalls would let 2PC sinks
+            # commit against an ever-staler recovery point.  _finish
+            # always runs on the loop thread (sync path or drained),
+            # so a raise surfaces as a task/job failure
             self.stats.pop(pc.checkpoint_id, None)
             if req is not None:
                 req.fail(err)
-            raise err
+            if self.tolerable_checkpoint_failures is None:
+                raise err
+            self.aborted_count += 1
+            self._register_failure(err)  # raises past the budget
+            return
+        self.consecutive_failures = 0
         self.completed_count += 1
         self.latest_completed_id = pc.checkpoint_id
         self._last_completed_at = now

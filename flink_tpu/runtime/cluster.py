@@ -69,6 +69,7 @@ from flink_tpu.runtime.local import (
     initial_restore_point,
     merge_accumulators,
 )
+from flink_tpu.runtime import faults
 from flink_tpu.runtime.metrics import MetricRegistry
 from flink_tpu.runtime.netchannel import DataClient, DataServer
 from flink_tpu.runtime.rpc import (
@@ -442,6 +443,9 @@ class JobMaster(RpcEndpoint):
         self.restarts = 0
         self.checkpoints_completed = 0
         self.attempt = 0
+        #: per-attempt failure records, newest last (ref: the
+        #: JobExceptionsHandler payload behind /jobs/:jobid/exceptions)
+        self.exception_history: List[dict] = []
         self._ack_queue: deque = deque()
         self._failure_queue: deque = deque()
         self._driver: Optional[threading.Thread] = None
@@ -520,15 +524,33 @@ class JobMaster(RpcEndpoint):
                                         name=f"jm-driver-{self.job_id}")
         self._driver.start()
 
+    def _record_failure(self, error: BaseException) -> None:
+        entry = {
+            "attempt": self.restarts,
+            "timestamp": _time.time(),
+            "exception": f"{type(error).__name__}: {error}",
+        }
+        task_key = getattr(error, "task_key", None)
+        if task_key is not None:
+            entry["task_key"] = list(task_key)
+        cause = getattr(error, "cause", None)
+        if cause is not None:
+            entry["root_exception"] = f"{type(cause).__name__}: {cause}"
+        self.exception_history.append(entry)
+        del self.exception_history[:-32]  # bounded history
+
     def status_snapshot(self, light: bool = False) -> dict:
         live = self._live_coordinator
         snap = {"state": self.state, "restarts": self.restarts,
                 "checkpoints_completed": self.checkpoints_completed
                 + (live.completed_count if live is not None else 0),
                 "job_name": self.job_graph.job_name}
+        if self.exception_history:
+            snap["last_failure"] = self.exception_history[-1]["exception"]
         if not light:
             snap["error_blob"] = self.error_blob
             snap["result"] = self.result
+            snap["exceptions"] = list(self.exception_history)
         return snap
 
     # -- driver -------------------------------------------------------
@@ -572,8 +594,10 @@ class JobMaster(RpcEndpoint):
                     }
                     return
                 except SuppressRestartsException as e:
+                    self._record_failure(e.cause)
                     raise e.cause
-                except Exception:  # noqa: BLE001
+                except Exception as e:  # noqa: BLE001
+                    self._record_failure(e)
                     restart.notify_failure(_time.monotonic() * 1000.0)
                     if self.cancel_requested or not restart.can_restart():
                         raise
@@ -748,6 +772,9 @@ class JobMaster(RpcEndpoint):
                 notify_complete=notify_complete,
                 min_pause_ms=cp_cfg.get("min_pause", 0),
                 async_persist=bool(cp_cfg.get("async_persist", False)),
+                checkpoint_timeout_ms=cp_cfg.get("timeout"),
+                tolerable_checkpoint_failures=cp_cfg.get(
+                    "tolerable_failures"),
                 metadata_extra={"master_epoch": self.master_epoch,
                                 "attempt": attempt},
             )
@@ -1143,6 +1170,8 @@ class TaskExecutor(RpcEndpoint):
                     del entry[old]
             except Exception:  # noqa: BLE001 — unpicklable snapshot:
                 pass           # the JM fallback path still works
+            if faults.check("checkpoint.ack"):
+                return  # ack lost in transit — coordinator times out
             _jm.tell.acknowledge_checkpoint(_att, task_key, cid, snapshot)
 
         def decline(cid, _jm=jm, _att=attempt):

@@ -90,10 +90,29 @@ def compute_pipelined_regions(job_graph) -> List[FrozenSet[TaskKey]]:
     return [frozenset(g) for g in groups.values()]
 
 
-def region_of(regions: List[FrozenSet[TaskKey]],
-              task_key: TaskKey) -> FrozenSet[TaskKey]:
+def build_region_index(regions: List[FrozenSet[TaskKey]]
+                       ) -> Dict[TaskKey, FrozenSet[TaskKey]]:
+    """TaskKey -> region map, built once per attempt so per-failure
+    lookups are O(1) instead of a linear scan over every region (a
+    10k-subtask embarrassingly parallel job has 10k regions)."""
+    index: Dict[TaskKey, FrozenSet[TaskKey]] = {}
     for region in regions:
-        if task_key in region:
+        for key in region:
+            index[key] = region
+    return index
+
+
+def region_of(regions: List[FrozenSet[TaskKey]],
+              task_key: TaskKey,
+              index: Dict[TaskKey, FrozenSet[TaskKey]] = None
+              ) -> FrozenSet[TaskKey]:
+    if index is not None:
+        region = index.get(task_key)
+        if region is not None:
             return region
+    else:
+        for region in regions:
+            if task_key in region:
+                return region
     # unattributed failures scope to everything (full restart)
     return frozenset().union(*regions) if regions else frozenset()

@@ -47,8 +47,10 @@ from flink_tpu.runtime.checkpoints import (
     make_checkpoint_storage,
     make_restart_strategy,
 )
+from flink_tpu.runtime import faults
 from flink_tpu.runtime.failover import (
     TaskFailureException,
+    build_region_index,
     compute_pipelined_regions,
     region_of,
 )
@@ -57,6 +59,7 @@ from flink_tpu.runtime.metrics import (
     MetricRegistry,
     TaskIOMetricGroup,
     register_checkpoint_gauges,
+    register_faulttolerance_gauges,
 )
 from flink_tpu.runtime.tracing import (
     get_tracer,
@@ -706,6 +709,8 @@ class SubtaskInstance:
 
     # ---- input path (ref: StreamInputProcessor.processInput :176) ---
     def process_record(self, input_index: int, record: StreamRecord):
+        if faults._active is not None:
+            faults.fire("task.process")
         if self.io_metrics is not None:
             self.io_metrics.num_records_in.count += 1
         head = self.head
@@ -836,6 +841,24 @@ class JobClient:
         self._thread: Optional[threading.Thread] = None
         #: live view for tests/monitoring; swapped on restart
         self.executor_state: Optional[dict] = None
+        #: per-attempt failure records (ref: the JobExceptionsHandler
+        #: payload behind /jobs/:jobid/exceptions), newest last
+        self.exception_history: List[dict] = []
+
+    def _record_failure(self, error: BaseException, attempt: int) -> None:
+        entry = {
+            "attempt": attempt,
+            "timestamp": _time.time(),
+            "exception": f"{type(error).__name__}: {error}",
+        }
+        task_key = getattr(error, "task_key", None)
+        if task_key is not None:
+            entry["task_key"] = list(task_key)
+        cause = getattr(error, "cause", None)
+        if cause is not None:
+            entry["root_exception"] = f"{type(cause).__name__}: {cause}"
+        self.exception_history.append(entry)
+        del self.exception_history[:-32]  # bounded history
 
     def cancel(self) -> None:
         self._cancel.set()
@@ -955,6 +978,11 @@ class LocalExecutor:
         carryover = None
         regions = (compute_pipelined_regions(job_graph)
                    if self.failover_strategy == "region" else None)
+        # TaskKey -> region, built once per job: per-failure lookups
+        # must not scan every region of a wide embarrassingly
+        # parallel graph
+        region_index = (build_region_index(regions)
+                        if regions is not None else None)
         try:
             while True:
                 try:
@@ -967,8 +995,10 @@ class LocalExecutor:
                     client._finish(result=result)
                     return
                 except SuppressRestartsException as e:
+                    client._record_failure(e.cause, result.restarts)
                     raise e.cause
                 except Exception as e:  # noqa: BLE001
+                    client._record_failure(e, result.restarts)
                     restart.notify_failure(_time.monotonic() * 1000.0)
                     if client.cancel_requested or not restart.can_restart():
                         if isinstance(e, TaskFailureException):
@@ -982,11 +1012,13 @@ class LocalExecutor:
                     if (regions is not None
                             and isinstance(e, TaskFailureException)
                             and getattr(e, "live_state", None) is not None):
-                        failed_region = set(region_of(regions, e.task_key))
+                        failed_region = set(region_of(
+                            regions, e.task_key, region_index))
                         # a healthy subtask whose capture failed pulls
                         # its whole region into the restart scope
                         for fk in getattr(e, "capture_failed_keys", []):
-                            failed_region |= region_of(regions, fk)
+                            failed_region |= region_of(
+                                regions, fk, region_index)
                         healthy = {k for k, v in e.live_state.items()
                                    if k not in failed_region}
                         if healthy:
@@ -1070,17 +1102,23 @@ class LocalExecutor:
                 notify_complete=notify_complete,
                 min_pause_ms=cfg.get("min_pause", 0),
                 async_persist=bool(cfg.get("async_persist", False)),
+                checkpoint_timeout_ms=cfg.get("timeout"),
+                tolerable_checkpoint_failures=cfg.get("tolerable_failures"),
             )
             coordinator.vertex_parallelisms = {
                 vid: v.parallelism for vid, v in job_graph.vertices.items()}
             register_checkpoint_gauges(self.metrics, job_graph.job_name,
                                        coordinator)
+            register_faulttolerance_gauges(self.metrics, job_graph.job_name,
+                                           coordinator)
             # continue the id sequence across restarts
             ids = storage.checkpoint_ids()
             if ids:
                 coordinator._id_counter = ids[-1]
 
         def ack(task_key, cid, snapshot):
+            if faults.check("checkpoint.ack"):
+                return  # ack lost in transit — coordinator times out
             ack_queue.append((task_key, cid, snapshot))
 
         def decline(cid):

@@ -418,3 +418,27 @@ def register_checkpoint_gauges(metrics: MetricRegistry, job_name: str,
         lambda: (coordinator.stats[coordinator.latest_completed_id].state_bytes
                  if coordinator.latest_completed_id in coordinator.stats
                  else None))
+
+
+def register_faulttolerance_gauges(metrics: MetricRegistry, job_name: str,
+                                   coordinator=None) -> None:
+    """Publish the `faulttolerance.*` gauge surface: the process-wide
+    retry/fallback counters maintained by `runtime.faults` plus the
+    coordinator's abort/consecutive-failure bookkeeping when one is
+    supplied.  Like the checkpoint gauges this re-registers per
+    attempt and the fresh suppliers win."""
+    from flink_tpu.runtime import faults
+
+    g = metrics.job_group(job_name).add_group("faulttolerance")
+    for name in ("storage_retries", "rpc_connect_retries",
+                 "netchannel_connect_retries", "retries_total",
+                 "checkpoint_fallbacks", "checkpoint_timeouts",
+                 "checkpoint_failures"):
+        g.gauge(name, (lambda n=name: faults.retry_counters.get(n, 0)))
+    if coordinator is not None:
+        g.gauge("numberOfAbortedCheckpoints",
+                lambda: coordinator.aborted_count)
+        g.gauge("numberOfTimedOutCheckpoints",
+                lambda: coordinator.timeout_aborts)
+        g.gauge("consecutiveFailedCheckpoints",
+                lambda: coordinator.consecutive_failures)

@@ -56,9 +56,11 @@ from flink_tpu.runtime.local import (
     gather_accumulators,
     initial_restore_point,
 )
+from flink_tpu.runtime import faults
 from flink_tpu.runtime.metrics import (
     MetricRegistry,
     register_checkpoint_gauges,
+    register_faulttolerance_gauges,
 )
 from flink_tpu.streaming.elements import LatencyMarker
 from flink_tpu.streaming.graph import JobGraph
@@ -238,8 +240,10 @@ class MiniCluster:
                     client._finish(result=result)
                     return
                 except SuppressRestartsException as e:
+                    client._record_failure(e.cause, result.restarts)
                     raise e.cause
-                except Exception:  # noqa: BLE001
+                except Exception as e:  # noqa: BLE001
+                    client._record_failure(e, result.restarts)
                     restart.notify_failure(_time.monotonic() * 1000.0)
                     if client.cancel_requested or not restart.can_restart():
                         raise
@@ -308,16 +312,22 @@ class MiniCluster:
                 notify_complete=notify_complete,
                 min_pause_ms=cfg.get("min_pause", 0),
                 async_persist=bool(cfg.get("async_persist", False)),
+                checkpoint_timeout_ms=cfg.get("timeout"),
+                tolerable_checkpoint_failures=cfg.get("tolerable_failures"),
             )
             coordinator.vertex_parallelisms = {
                 vid: v.parallelism for vid, v in job_graph.vertices.items()}
             register_checkpoint_gauges(self.metrics, job_graph.job_name,
                                        coordinator)
+            register_faulttolerance_gauges(self.metrics, job_graph.job_name,
+                                           coordinator)
             ids = storage.checkpoint_ids()
             if ids:
                 coordinator._id_counter = ids[-1]
 
         def ack(task_key, cid, snapshot):
+            if faults.check("checkpoint.ack"):
+                return  # ack lost in transit — coordinator times out
             ack_queue.append((task_key, cid, snapshot))
 
         def decline(cid):

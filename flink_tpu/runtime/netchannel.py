@@ -42,6 +42,7 @@ import time as _time
 from collections import deque
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
+from flink_tpu.runtime import faults
 from flink_tpu.runtime.rpc import MAX_FRAME, recv_exact
 
 _LEN = struct.Struct(">I")
@@ -107,6 +108,12 @@ def decode_elements(enc):
 def _send(sock: socket.socket, obj: Any, lock: threading.Lock) -> None:
     # plain pickle, not cloudpickle: the data plane carries records
     # (data), never code — and pickle is measurably faster
+    try:
+        faults.fire("netchannel.send")
+    except faults.FaultInjected as e:
+        # surface as OSError so an injected send failure takes exactly
+        # the code path a torn TCP connection would
+        raise OSError(str(e)) from e
     payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
     with lock:
         sock.sendall(_LEN.pack(len(payload)) + payload)
@@ -400,8 +407,23 @@ class DataClient:
             sock_entry = self._conns.get(address)
             if sock_entry is None:
                 host, port = address.rsplit(":", 1)
-                sock = socket.create_connection((host, int(port)),
-                                                timeout=10.0)
+
+                def _connect():
+                    faults.fire("netchannel.connect")
+                    return socket.create_connection((host, int(port)),
+                                                    timeout=10.0)
+
+                # a producer that is itself restarting after a failure
+                # brings its DataServer back within the deadline;
+                # bounded backoff bridges that window instead of
+                # failing the whole consumer task
+                try:
+                    sock = faults.retry_with_backoff(
+                        _connect, attempts=4, base_delay_ms=20.0,
+                        deadline_ms=8_000.0,
+                        counter="netchannel_connect_retries")
+                except faults.FaultInjected as e:
+                    raise OSError(str(e)) from e
                 sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
                 if self._tls_client_ctx is not None:
                     sock = self._tls_client_ctx.wrap_socket(

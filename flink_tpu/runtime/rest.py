@@ -228,12 +228,35 @@ class WebMonitor:
             if not dump and job not in self.jobs:
                 raise KeyError(path)
             return dump, "application/json"
+        if path.startswith("/jobs/") and path.endswith("/exceptions"):
+            job = urllib.parse.unquote(
+                path[len("/jobs/"):-len("/exceptions")])
+            if job not in self.jobs:
+                raise KeyError(path)
+            return self._job_exceptions(self.jobs[job]), "application/json"
         if path.startswith("/jobs/"):
             job = urllib.parse.unquote(path[len("/jobs/"):])
             if job not in self.jobs:
                 raise KeyError(path)
             return self._job_status(self.jobs[job]), "application/json"
         raise KeyError(path)
+
+    @staticmethod
+    def _job_exceptions(client) -> dict:
+        """Last failure cause plus the per-attempt failure history (ref:
+        JobExceptionsHandler behind /jobs/:jobid/exceptions)."""
+        history = list(getattr(client, "exception_history", None) or [])
+        result = getattr(client, "_result", None)
+        restarts = getattr(result, "restarts", None)
+        if restarts is None and history:
+            restarts = history[-1]["attempt"]
+        payload: dict = {"restarts": restarts or 0, "history": history}
+        if history:
+            payload["last_failure"] = history[-1]["exception"]
+        err = getattr(client, "_error", None)
+        if err is not None:
+            payload["root_exception"] = f"{type(err).__name__}: {err}"
+        return payload
 
     def _job_detail(self, name: str) -> dict:
         """Vertices, checkpoint stats, and backpressure for one job —

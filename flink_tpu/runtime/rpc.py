@@ -43,6 +43,8 @@ from typing import Any, Callable, Dict, Optional, Tuple
 
 import cloudpickle
 
+from flink_tpu.runtime import faults
+
 _LEN = struct.Struct(">I")
 
 #: max frame size (guards against corrupt length prefixes)
@@ -453,10 +455,27 @@ class _ClientConnection:
     """One multiplexed TCP connection to a remote RpcService; pending
     calls matched to responses by id."""
 
+    #: bounded exponential backoff on connect (a restarting peer's
+    #: listener comes back within the deadline; a dead one fails fast
+    #: enough for heartbeat timeouts to stay meaningful)
+    CONNECT_ATTEMPTS = 4
+    CONNECT_BASE_MS = 20.0
+    CONNECT_DEADLINE_MS = 8_000.0
+
     def __init__(self, address: str, tls_ctx=None):
         host, port = address.rsplit(":", 1)
         self.address = address
-        self._sock = socket.create_connection((host, int(port)), timeout=10.0)
+
+        def _connect():
+            faults.fire("rpc.connect")
+            return socket.create_connection((host, int(port)),
+                                            timeout=10.0)
+
+        self._sock = faults.retry_with_backoff(
+            _connect, attempts=self.CONNECT_ATTEMPTS,
+            base_delay_ms=self.CONNECT_BASE_MS,
+            deadline_ms=self.CONNECT_DEADLINE_MS,
+            counter="rpc_connect_retries")
         self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         if tls_ctx is not None:
             self._sock = tls_ctx.wrap_socket(self._sock,
@@ -484,11 +503,13 @@ class _ClientConnection:
             with self._pending_lock:
                 self._pending[call_id] = future
         try:
+            faults.fire("rpc.call")
             with self._write_lock:
                 send_frame(self._sock, frame)
-        except OSError as e:
+        except (OSError, faults.FaultInjected) as e:
             self._fail_all(RpcException(f"connection to {self.address} "
                                         f"lost: {e}"))
+            faults.count("rpc_call_failures")
             if future is not None:
                 return future
             raise RpcException(str(e)) from e

@@ -140,17 +140,30 @@ class StreamExecutionEnvironment:
 
     def enable_checkpointing(self, interval_ms: int,
                              mode: str = "exactly_once",
-                             async_persist: bool = False
+                             async_persist: bool = False,
+                             timeout_ms: Optional[int] = None,
+                             tolerable_failures: Optional[int] = None
                              ) -> "StreamExecutionEnvironment":
         """``async_persist=True`` materializes completed checkpoints on
         a writer thread (processing continues during the storage
         write; operators are notified only after durability — the 2PC
         ordering).  Opt-in, like the reference's incremental/async
         snapshot flags: a non-transactional sink observing replay
-        after a failure sees a wider post-barrier gap."""
+        after a failure sees a wider post-barrier gap.
+
+        ``timeout_ms`` aborts a pending checkpoint that has not fully
+        acked within the window, releasing its concurrency slot so the
+        coordinator can re-trigger (ref: checkpointing timeout).
+        ``tolerable_failures`` = N tolerates N CONSECUTIVE
+        failed/aborted checkpoints before escalating to a task failure
+        (ref: execution.checkpointing.tolerable-failed-checkpoints);
+        None keeps the legacy behavior (aborts never escalate, a
+        failed persist fails the job)."""
         self.checkpoint_interval = interval_ms
         self.checkpoint_mode = mode
         self.checkpoint_async_persist = async_persist
+        self.checkpoint_timeout_ms = timeout_ms
+        self.checkpoint_tolerable_failures = tolerable_failures
         return self
 
     _UNSET = object()
@@ -296,6 +309,12 @@ class StreamExecutionEnvironment:
                                          False),
                 **self.checkpoint_storage,
             }
+            if getattr(self, "checkpoint_timeout_ms", None) is not None:
+                jg.checkpoint_config["timeout"] = self.checkpoint_timeout_ms
+            if getattr(self, "checkpoint_tolerable_failures",
+                       None) is not None:
+                jg.checkpoint_config["tolerable_failures"] = \
+                    self.checkpoint_tolerable_failures
             if hasattr(self, "alignment_spill_threshold"):
                 jg.checkpoint_config["alignment_spill_threshold"] = \
                     self.alignment_spill_threshold

@@ -1,0 +1,269 @@
+"""Chaos suite: seeded infrastructure faults, exactly-once verified.
+
+The harness (flink_tpu/runtime/chaos.py) runs the same keyed
+windowed-aggregation job fault-free and under a deterministic
+`FaultInjector` schedule, then compares output MULTISETS — recovery
+must erase every injected fault without losing or duplicating a
+single record (ref: Basiri et al., "Chaos Engineering", IEEE Software
+2016; the reference's StreamFaultToleranceTestBase family asserts the
+same property with throwing user functions only).
+
+Tier-1 keeps one seeded case per executor plus the unit-level fault
+paths; the randomized multi-seed sweeps are `@pytest.mark.slow`.
+"""
+
+import os
+import threading
+import time
+
+import pytest
+
+from flink_tpu.runtime import faults
+from flink_tpu.runtime.chaos import run_chaos_case, run_windowed_job
+from flink_tpu.runtime.checkpoints import FsCheckpointStorage
+from flink_tpu.runtime.faults import (
+    FaultInjected,
+    FaultInjector,
+    InjectedCrash,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    """Every test starts and ends with no injector and zeroed
+    counters — the injector is process-global."""
+    faults.deactivate()
+    faults.reset_counters()
+    yield
+    faults.deactivate()
+    faults.reset_counters()
+
+
+# ---------------------------------------------------------------------
+# the seeded chaos cases (tier-1: one per executor)
+# ---------------------------------------------------------------------
+
+@pytest.mark.parametrize("executor", ["local", "minicluster"])
+def test_chaos_exactly_once(executor, tmp_path):
+    """Storage-write failures + lost checkpoint acks + an induced task
+    crash (+ a netchannel connect failure where a data plane exists),
+    all under one fixed seed: the output multiset must equal the
+    fault-free run's, and the restart/abort counters must match the
+    schedule."""
+    r = run_chaos_case(executor, seed=7,
+                       checkpoint_dir=str(tmp_path / "chk"))
+    assert r["baseline_restarts"] == 0
+    # exactly-once: not one record lost or duplicated
+    assert r["chaos"] == r["baseline"], {
+        "restarts": r["restarts"],
+        "checkpoints": r["checkpoints_completed"],
+        "counters": r["counters"],
+        "fired": dict(r["injector"].fired),
+        "fire_counts": dict(r["injector"].fire_counts),
+    }
+    # the induced task crash forced exactly one restart
+    assert r["restarts"] == 1
+    assert r["injector"].injected("task.process") == 1
+    # both storage-write failures healed via backoff retry
+    assert r["injector"].injected("storage.persist") == 2
+    assert r["counters"].get("storage_retries") == 2
+    # the lost acks stalled a pending checkpoint until the timeout
+    # aborted it and the coordinator re-triggered
+    assert r["injector"].injected("checkpoint.ack") == 2
+    assert r["counters"].get("checkpoint_timeouts", 0) >= 1
+    assert r["checkpoints_completed"] >= 1
+
+
+def test_chaos_deterministic_replay(tmp_path):
+    """Same seed, same schedule → identical injected-fault counts
+    (the whole point of seeding the injector)."""
+    a = run_chaos_case("local", seed=21,
+                       checkpoint_dir=str(tmp_path / "a"))
+    b = run_chaos_case("local", seed=21,
+                       checkpoint_dir=str(tmp_path / "b"))
+    assert dict(a["injector"].fired) == dict(b["injector"].fired)
+    assert a["chaos"] == b["chaos"] == a["baseline"]
+
+
+# ---------------------------------------------------------------------
+# unit-level fault paths
+# ---------------------------------------------------------------------
+
+def test_netchannel_connect_retry_heals():
+    """A DataClient subscribe rides out injected connect failures via
+    bounded backoff instead of failing the consumer task."""
+    from flink_tpu.runtime.netchannel import DataClient, DataServer
+
+    received = []
+    done = threading.Event()
+
+    class Inbox:
+        def push(self, el):
+            received.append(el)
+            done.set()
+
+    key = ("job", 0, 1, 0, 0)
+    server = DataServer()
+    out = server.register_out_channel(key, capacity=8)
+    FaultInjector(seed=3).fail_n_times("netchannel.connect", 2).install()
+    try:
+        client = DataClient()
+        client.subscribe(server.address, key, Inbox(), capacity=8)
+        out.push(("hello", 1))
+        server.wake()
+        assert done.wait(5.0), "element never arrived after retries"
+    finally:
+        faults.deactivate()
+        client.stop()
+        server.stop()
+    assert received == [("hello", 1)]
+    assert faults.counter_snapshot().get("netchannel_connect_retries") == 2
+
+
+def test_netchannel_connect_retry_exhaustion_is_oserror():
+    """When the backoff budget runs out the consumer sees an OSError —
+    the same shape as a genuinely dead producer."""
+    from flink_tpu.runtime.netchannel import DataClient, DataServer
+
+    key = ("job", 0, 1, 0, 0)
+    server = DataServer()
+    FaultInjector(seed=3).fail_n_times("netchannel.connect", 99).install()
+    try:
+        with pytest.raises(OSError):
+            DataClient().subscribe(server.address, key, object(),
+                                   capacity=8)
+    finally:
+        faults.deactivate()
+        server.stop()
+    snap = faults.counter_snapshot()
+    assert snap.get("netchannel_connect_retries_exhausted") == 1
+
+
+def test_rpc_connect_retry_heals():
+    """Gateway connect retries through injected connect failures."""
+    from flink_tpu.runtime.rpc import RpcEndpoint, RpcService
+
+    class Echo(RpcEndpoint):
+        def ping(self):
+            return "pong"
+
+    svc = RpcService()
+    svc.start_server(Echo("echo"))
+    FaultInjector(seed=5).fail_n_times("rpc.connect", 2).install()
+    try:
+        gw = svc.connect(svc.address, "echo")
+        assert gw.ping().get(5.0) == "pong"
+    finally:
+        faults.deactivate()
+        svc.stop()
+    assert faults.counter_snapshot().get("rpc_connect_retries") == 2
+
+
+def test_injected_crash_is_not_absorbed(tmp_path):
+    """crash_once models a hard process death: InjectedCrash is a
+    BaseException, so restart strategies must NOT absorb it and the
+    job dies without retrying."""
+    FaultInjector(seed=0).crash_once("task.process", after=50).install()
+    try:
+        with pytest.raises(InjectedCrash):
+            run_windowed_job("local", per_key=100,
+                             checkpoint_dir=str(tmp_path / "chk"))
+    finally:
+        faults.deactivate()
+
+
+def test_corrupted_latest_falls_back_at_restore(tmp_path):
+    """A real job's retained checkpoints; the newest file gets
+    corrupted on disk; `latest()` serves the next-older retained
+    checkpoint instead of failing the restore."""
+    chk_dir = str(tmp_path / "chk")
+    # a pure-delay schedule (no failures) stretches the run so several
+    # checkpoints complete and retention keeps two
+    FaultInjector(seed=0).delay("task.process", 0.2).install()
+    try:
+        run_windowed_job("local", per_key=150, checkpoint_dir=chk_dir)
+    finally:
+        faults.deactivate()
+    storage = FsCheckpointStorage(chk_dir, retain=2)
+    ids = storage.checkpoint_ids()
+    assert len(ids) >= 2, "job retained fewer than 2 checkpoints"
+    newest = os.path.join(chk_dir, f"chk-{ids[-1]}")
+    with open(newest, "r+b") as f:  # flip payload bytes, keep length
+        f.seek(12)
+        f.write(b"\xff\xff\xff\xff")
+    reopened = FsCheckpointStorage(chk_dir, retain=2)
+    entry = reopened.latest()
+    assert entry is not None
+    assert entry["checkpoint_id"] == ids[-2]
+    assert faults.counter_snapshot().get("checkpoint_fallbacks", 0) >= 1
+
+
+def test_disabled_injector_fire_is_cheap():
+    """With no injector installed `faults.fire` is one attribute read
+    + None check; a generous wall-clock bound guards against anyone
+    adding locks or dict lookups to the disabled path."""
+    n = 200_000
+    start = time.perf_counter()
+    for _ in range(n):
+        faults.fire("task.process")
+    elapsed = time.perf_counter() - start
+    assert elapsed < 1.0, f"{n} disabled fires took {elapsed:.3f}s"
+
+
+def test_schedule_after_offset_and_determinism():
+    """`after=` skips exactly that many fires; probability schedules
+    replay identically for a fixed seed."""
+    inj = FaultInjector(seed=9)
+    inj.fail_n_times("rpc.call", 2, after=3)
+    outcomes = []
+    for _ in range(8):
+        try:
+            inj.fire("rpc.call")
+            outcomes.append(False)
+        except FaultInjected:
+            outcomes.append(True)
+    assert outcomes == [False, False, False, True, True,
+                        False, False, False]
+
+    def prob_outcomes():
+        p = FaultInjector(seed=9)
+        p.fail_with_probability("rpc.call", 0.4)
+        out = []
+        for _ in range(64):
+            try:
+                p.fire("rpc.call")
+                out.append(False)
+            except FaultInjected:
+                out.append(True)
+        return out
+
+    assert prob_outcomes() == prob_outcomes()
+
+
+# ---------------------------------------------------------------------
+# randomized sweeps (slow: excluded from tier-1)
+# ---------------------------------------------------------------------
+
+def _random_schedule(inj: FaultInjector) -> FaultInjector:
+    inj.fail_with_probability("storage.persist", 0.10)
+    inj.fail_with_probability("checkpoint.ack", 0.05)
+    inj.fail_n_times("task.process", 1, after=400)
+    inj.delay("task.process", 0.2)
+    return inj
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", [1, 2, 3, 4, 5])
+def test_chaos_sweep_local(seed, tmp_path):
+    r = run_chaos_case("local", seed=seed, schedule=_random_schedule,
+                       checkpoint_dir=str(tmp_path / "chk"))
+    assert r["chaos"] == r["baseline"]
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", [11, 12, 13])
+def test_chaos_sweep_minicluster(seed, tmp_path):
+    r = run_chaos_case("minicluster", seed=seed,
+                       schedule=_random_schedule,
+                       checkpoint_dir=str(tmp_path / "chk"))
+    assert r["chaos"] == r["baseline"]

@@ -555,3 +555,141 @@ def test_alignment_abort_cap_declines_checkpoint():
     # declines the checkpoint, not the job)
     assert any(st.alignment_aborts > 0 for st in ops), \
         [st.alignment_aborts for st in ops]
+
+
+# ---------------------------------------------------------------------
+# coordinator timeout / tolerable-failure hardening (unit level)
+# ---------------------------------------------------------------------
+
+def _make_coordinator(**kw):
+    """CheckpointCoordinator on a fake clock with two expected tasks."""
+    from flink_tpu.runtime.checkpoints import CheckpointCoordinator
+
+    clock = [1000.0]
+    triggered = []
+
+    def trigger_sources(cid, ts, options):
+        triggered.append(cid)
+        return True
+
+    coord = CheckpointCoordinator(
+        interval_ms=10,
+        mode="exactly_once",
+        storage=MemoryCheckpointStorage(retain=2),
+        expected_tasks={(1, 0), (2, 0)},
+        trigger_sources=trigger_sources,
+        notify_complete=lambda cid: None,
+        clock=lambda: clock[0],
+        **kw)
+    return coord, clock, triggered
+
+
+def test_declined_checkpoint_releases_slot():
+    """A decline frees the max_concurrent slot on the spot: the very
+    next interval tick triggers again instead of stalling forever."""
+    coord, clock, triggered = _make_coordinator()
+    cid1 = coord.maybe_trigger()
+    assert cid1 is not None
+    clock[0] += 20
+    assert coord.maybe_trigger() is None  # slot held by cid1
+    coord.decline(cid1)
+    assert not coord.pending
+    clock[0] += 20
+    cid2 = coord.maybe_trigger()
+    assert cid2 == cid1 + 1
+    assert coord.aborted_count == 1
+
+
+def test_timed_out_checkpoint_releases_slot():
+    """A pending past checkpoint_timeout_ms is aborted by the next
+    maybe_trigger call, which then re-triggers in the same call — a
+    lost ack cannot pin the slot."""
+    coord, clock, triggered = _make_coordinator(checkpoint_timeout_ms=50)
+    cid1 = coord.maybe_trigger()
+    assert cid1 is not None
+    coord.acknowledge((1, 0), cid1, {"s": 1})   # second ack never comes
+    clock[0] += 60
+    cid2 = coord.maybe_trigger()
+    assert cid2 == cid1 + 1
+    assert cid1 not in coord.pending
+    assert coord.timeout_aborts == 1
+    assert coord.completed_count == 0
+
+
+def test_late_ack_of_aborted_checkpoint_ignored():
+    """An ack arriving after its checkpoint timed out hits the
+    pending-map miss and is dropped; a later checkpoint still
+    completes normally."""
+    coord, clock, triggered = _make_coordinator(checkpoint_timeout_ms=50)
+    cid1 = coord.maybe_trigger()
+    coord.acknowledge((1, 0), cid1, {"s": 1})
+    clock[0] += 60
+    cid2 = coord.maybe_trigger()
+    # the straggler finally answers for the aborted id
+    coord.acknowledge((2, 0), cid1, {"s": 2})
+    assert coord.completed_count == 0
+    assert cid1 not in coord.pending
+    # the re-triggered checkpoint is unaffected
+    coord.acknowledge((1, 0), cid2, {"s": 1})
+    coord.acknowledge((2, 0), cid2, {"s": 2})
+    assert coord.completed_count == 1
+    assert coord.latest_completed_id == cid2
+
+
+def test_tolerable_failures_escalates_after_budget():
+    """N consecutive aborted checkpoints are tolerated; the N+1-th
+    raises CheckpointFailuresExceeded (ref:
+    CheckpointFailureManager.java)."""
+    from flink_tpu.runtime.checkpoints import CheckpointFailuresExceeded
+
+    coord, clock, triggered = _make_coordinator(
+        tolerable_checkpoint_failures=2)
+    for _ in range(2):
+        cid = coord.maybe_trigger()
+        assert cid is not None
+        coord.decline(cid)
+        clock[0] += 20
+    cid = coord.maybe_trigger()
+    with pytest.raises(CheckpointFailuresExceeded):
+        coord.decline(cid)
+
+
+def test_completed_checkpoint_resets_consecutive_failures():
+    """The counter is CONSECUTIVE: one success rearms the full
+    tolerable budget."""
+    coord, clock, triggered = _make_coordinator(
+        tolerable_checkpoint_failures=1)
+    cid = coord.maybe_trigger()
+    coord.decline(cid)
+    clock[0] += 20
+    cid = coord.maybe_trigger()
+    coord.acknowledge((1, 0), cid, {"s": 1})
+    coord.acknowledge((2, 0), cid, {"s": 2})
+    assert coord.completed_count == 1
+    assert coord.consecutive_failures == 0
+    clock[0] += 20
+    cid = coord.maybe_trigger()
+    coord.decline(cid)  # back within budget — must NOT raise
+    assert coord.consecutive_failures == 1
+
+
+def test_fs_storage_sweeps_orphaned_part_files(tmp_path):
+    """A crash mid-write leaves `*.part` files behind; the next
+    storage open removes them (checkpoint dir and shared/) and keeps
+    the committed files."""
+    import os
+
+    d = str(tmp_path / "chk")
+    storage = FsCheckpointStorage(d, retain=2)
+    storage.persist(1, {"mode": "exactly_once"}, {(1, 0): {"s": 1}})
+    os.makedirs(os.path.join(d, "shared"), exist_ok=True)
+    for orphan in [os.path.join(d, "chk-9.part"),
+                   os.path.join(d, "shared", "chunk-abc.part")]:
+        with open(orphan, "wb") as f:
+            f.write(b"torn")
+    reopened = FsCheckpointStorage(d, retain=2)
+    assert reopened.checkpoint_ids() == [1]
+    assert not [p for p in os.listdir(d) if p.endswith(".part")]
+    assert not [p for p in os.listdir(os.path.join(d, "shared"))
+                if p.endswith(".part")]
+    assert reopened.latest()["checkpoint_id"] == 1

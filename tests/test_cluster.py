@@ -436,6 +436,20 @@ def test_cross_host_mesh_survives_tm_kill(tmp_path):
     survivor = _spawn_mesh_tm(jm.address, 4, "a-mesh-survivor")
     victim = _spawn_mesh_tm(jm.address, 2, "z-mesh-victim")
     try:
+        # the survivor alone could host the whole 3-subtask job: wait
+        # until BOTH TMs are registered so the slot round-robin places
+        # subtasks on the victim (else the kill hits an idle worker and
+        # the no-restart assert below is vacuous)
+        deadline = time.monotonic() + 30.0
+        ov = {}
+        while time.monotonic() < deadline:
+            ov = jm.resource_manager.run_async(
+                jm.resource_manager.cluster_overview).get(5.0)
+            if ov["task_executors"] >= 2:
+                break
+            time.sleep(0.05)
+        assert ov["task_executors"] >= 2, "victim TM never registered"
+
         from flink_tpu.ops.sketches import HyperLogLogAggregate
         env = StreamExecutionEnvironment()
         env.use_remote_cluster(jm.address)

@@ -162,3 +162,38 @@ def test_region_failover_scopes_restart_to_failed_slice():
 def test_full_failover_restarts_everything():
     result = _run_failover_job("full")
     assert result.region_restarts == 0
+
+def _pointwise_regions():
+    env = StreamExecutionEnvironment()
+    env.set_parallelism(3)
+    (env.add_source(FromCollectionSource([1, 2, 3]), parallelism=3)
+        .map(lambda v: v, name="m")
+        .add_sink(NullSink()))
+    return compute_pipelined_regions(_graph_of(env))
+
+
+def test_region_index_matches_linear_scan():
+    """build_region_index is a pure lookup accelerator: indexed and
+    linear region_of agree for every subtask."""
+    from flink_tpu.runtime.failover import build_region_index
+
+    regions = _pointwise_regions()
+    index = build_region_index(regions)
+    for region in regions:
+        for key in region:
+            assert region_of(regions, key, index) == \
+                region_of(regions, key)
+            assert region_of(regions, key, index) is index[key]
+
+
+def test_region_of_unknown_key_with_index_scopes_everything():
+    """Regression: an unattributed failure (a task_key the index does
+    not know) must still scope to the union of all regions — a full
+    restart — exactly as the linear path does."""
+    from flink_tpu.runtime.failover import build_region_index
+
+    regions = _pointwise_regions()
+    index = build_region_index(regions)
+    everything = frozenset().union(*regions)
+    assert region_of(regions, (99, 99), index) == everything
+    assert region_of(regions, (99, 99)) == everything

@@ -287,3 +287,43 @@ def test_dashboard_page_and_job_detail(tmp_path):
         client.wait(30.0)
     finally:
         monitor.stop()
+
+
+def test_monitor_serves_job_exceptions():
+    """/jobs/<name>/exceptions: last failure cause, per-attempt
+    history, restart count (ref: JobExceptionsHandler)."""
+    from flink_tpu.core.functions import MapFunction
+
+    class FailTwice(MapFunction):
+        def __init__(self):
+            self.failures = 0
+
+        def map(self, value):
+            if value == 5 and self.failures < 2:
+                self.failures += 1
+                raise RuntimeError(f"induced #{self.failures}")
+            return value
+
+    env = StreamExecutionEnvironment()
+    env.set_restart_strategy("fixed_delay", restart_attempts=3,
+                             delay_ms=0)
+    sink = CollectSink()
+    (env.from_collection(list(range(10)))
+        .map(FailTwice())
+        .add_sink(sink))
+    client = env.execute_async("failing-job")
+    result = client.wait(timeout=30)
+    assert result.restarts == 2
+
+    monitor = WebMonitor(env.get_metric_registry()).start()
+    try:
+        monitor.track_job("failing-job", client)
+        exc, _ = _get(monitor.port, "/jobs/failing-job/exceptions")
+        assert exc["restarts"] == 2
+        assert len(exc["history"]) == 2
+        assert "induced #2" in exc["last_failure"]
+        assert [h["attempt"] for h in exc["history"]] == [0, 1]
+        assert all("timestamp" in h and "exception" in h
+                   for h in exc["history"])
+    finally:
+        monitor.stop()
