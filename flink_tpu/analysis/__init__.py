@@ -1,0 +1,50 @@
+"""Pre-flight static analysis: graph linter + UDF liftability.
+
+Two passes over a job before any record flows:
+
+- **Pass 1 — graph linter** (:mod:`flink_tpu.analysis.graph_linter`):
+  walks the StreamGraph/JobGraph and checks key selectors, window
+  configurations, state serializers, chaining, reachability and
+  cycles.  Every finding is a :class:`Diagnostic` with a stable
+  ``FTxxx`` code from :data:`CODES`.
+- **Pass 2 — liftability analyzer**
+  (:mod:`flink_tpu.analysis.liftability`): bytecode analysis of
+  AggregateFunction implementations and map/filter/reduce UDFs,
+  classifying each as LIFTABLE / SCALAR_ONLY / IMPURE / INCONCLUSIVE.
+  Conclusive verdicts pre-decide the generic tier's lift mode so the
+  runtime probe is skipped.
+
+Entry points: ``env.validate()``, ``execute()`` with the ``lint.mode``
+config key (``off`` | ``warn`` | ``strict``), and the ``flink_tpu
+lint`` CLI subcommand.  See docs/static_analysis.md.
+"""
+
+from flink_tpu.analysis.diagnostics import (  # noqa: F401
+    CODES,
+    ERROR,
+    INFO,
+    WARNING,
+    Diagnostic,
+    Diagnostics,
+    JobValidationError,
+)
+from flink_tpu.analysis.graph_linter import lint_graph  # noqa: F401
+from flink_tpu.analysis.liftability import (  # noqa: F401
+    IMPURE,
+    INCONCLUSIVE,
+    LIFTABLE,
+    SCALAR_ONLY,
+    AggregateReport,
+    UdfReport,
+    analyze_aggregate,
+    analyze_udf,
+)
+
+__all__ = [
+    "CODES", "ERROR", "WARNING", "INFO",
+    "Diagnostic", "Diagnostics", "JobValidationError",
+    "lint_graph",
+    "LIFTABLE", "SCALAR_ONLY", "IMPURE", "INCONCLUSIVE",
+    "AggregateReport", "UdfReport",
+    "analyze_aggregate", "analyze_udf",
+]

@@ -14,7 +14,6 @@ choices; the MemoryManager's role disappears (numpy owns buffers).
 
 from __future__ import annotations
 
-import itertools
 from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple
 
 from flink_tpu.core.functions import as_key_selector

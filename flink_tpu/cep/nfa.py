@@ -26,7 +26,7 @@ Semantics implemented:
 
 from __future__ import annotations
 
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Any, Dict, List, Tuple
 
 from flink_tpu.cep.pattern import (
     SKIP_TILL_ANY,

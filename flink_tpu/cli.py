@@ -2,6 +2,10 @@
 bin/flink script).
 
     python -m flink_tpu run <script.py> [args...]   execute a job script
+    python -m flink_tpu lint <script.py|dir> [args...] pre-flight checks
+                                   [--strict]        without executing:
+                                   [--json]          graph linter + UDF
+                                   [--check-imports] liftability analysis
     python -m flink_tpu profile <script.py> [args...] run with the tracer
                                    [--trace-out F]   attached; write a
                                                      Chrome trace-event
@@ -64,6 +68,8 @@ def main(argv=None) -> int:
         sys.argv = rest
         runpy.run_path(rest[0], run_name="__main__")
         return 0
+    if verb == "lint":
+        return _lint(rest)
     if verb == "profile":
         return _profile(rest)
     if verb == "bench":
@@ -87,10 +93,102 @@ def main(argv=None) -> int:
     if verb == "stop":
         return _stop(rest)
     print(f"unknown command {verb!r}; "
-          f"try: run | profile | list | cancel | savepoint | stop | info "
-          f"| bench | jobmanager | taskmanager",
+          f"try: run | lint | profile | list | cancel | savepoint | stop "
+          f"| info | bench | jobmanager | taskmanager",
           file=sys.stderr)
     return 2
+
+
+def _lint(rest) -> int:
+    """Pre-flight static analysis of job scripts: capture the
+    topologies a script builds (execute() is neutered), run the graph
+    linter + liftability analyzer, and report FTxxx diagnostics.
+    Exit code 0 = no errors, 1 = errors found, 2 = usage."""
+    import json as _json
+    import os
+
+    strict = json_out = check_imports = False
+    args = []
+    for a in rest:
+        if a == "--strict":
+            strict = True
+        elif a == "--json":
+            json_out = True
+        elif a == "--check-imports":
+            check_imports = True
+        else:
+            args.append(a)
+    if not args:
+        print("usage: flink_tpu lint [--strict] [--json] "
+              "[--check-imports] <script.py|dir> [script args...]",
+              file=sys.stderr)
+        return 2
+    target, script_args = args[0], args[1:]
+
+    if os.path.isdir(target):
+        scripts = sorted(
+            os.path.join(target, f) for f in os.listdir(target)
+            if f.endswith(".py") and not f.startswith("_"))
+        if script_args:
+            print("script args only apply to a single script",
+                  file=sys.stderr)
+            return 2
+    else:
+        scripts = [target]
+
+    import contextlib
+
+    from flink_tpu.analysis.script_lint import lint_script
+    total_errors = total_warnings = 0
+    payload = []
+    for script in scripts:
+        if json_out:
+            # the linted script's own prints must not corrupt the
+            # machine-readable payload on stdout
+            with contextlib.redirect_stdout(sys.stderr):
+                res = lint_script(script, script_args)
+        else:
+            res = lint_script(script, script_args)
+        c = res.counts()
+        total_errors += c["error"]
+        total_warnings += c["warning"]
+        if json_out:
+            payload.append({
+                "script": script,
+                "script_error": (repr(res.script_error)
+                                 if res.script_error else None),
+                "jobs": [r.to_dict() for _, r in res.reports],
+            })
+            continue
+        print(f"== {script}")
+        if res.script_error is not None:
+            print(f"   script raised during graph construction: "
+                  f"{res.script_error!r}")
+        if not res.reports:
+            print("   (no topology captured)")
+        for _, report in res.reports:
+            print("   " + report.render().replace("\n", "\n   "))
+
+    imports_rc = 0
+    if check_imports:
+        from flink_tpu.analysis.imports_check import check_file, check_tree
+        findings = []
+        for t in args:
+            findings.extend(check_tree(t) if os.path.isdir(t)
+                            else check_file(t))
+        if json_out:
+            payload.append({"unused_imports": [
+                f.__dict__ for f in findings]})
+        else:
+            for f in findings:
+                print(f.render())
+        imports_rc = 1 if findings else 0
+
+    if json_out:
+        print(_json.dumps(payload, indent=2))
+    if total_errors or (strict and (total_warnings or imports_rc)):
+        return 1
+    return imports_rc if strict else 0
 
 
 def _profile(rest) -> int:

@@ -22,7 +22,7 @@ DateTimeBucketer default); rolls happen on bucket change or
 from __future__ import annotations
 
 import os
-from typing import Dict, Optional
+from typing import Dict
 
 from flink_tpu.streaming.sources import RichSinkFunction
 

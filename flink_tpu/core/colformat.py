@@ -21,7 +21,6 @@ Layout:
 
 from __future__ import annotations
 
-import io
 import json
 import os
 import struct

@@ -185,10 +185,21 @@ class AggregateFunction(Function, Generic[IN, ACC, OUT], abc.ABC):
     the probe and pin the scalar fold; operator construction
     (``GenericWindowOperator(force_scalar=True)``) offers the same
     opt-out per operator.
+
+    **Ahead-of-time analysis.**  Before the probe ever runs, the
+    static liftability analyzer (:mod:`flink_tpu.analysis.liftability`)
+    inspects the bytecode of ``add``/``merge``/``get_result``.  A
+    conclusive verdict pre-decides the mode and the runtime probe is
+    skipped; an inconclusive one leaves the probe in charge.  Set
+    ``force_probe = True`` to ignore the static verdict and always let
+    the runtime probe decide — the escape hatch if the analyzer ever
+    misjudges an implementation.
     """
 
     #: opt-out of the generic tier's lift probe (see class docstring)
     force_scalar: bool = False
+    #: opt-out of ahead-of-time liftability analysis: always probe
+    force_probe: bool = False
 
     @abc.abstractmethod
     def create_accumulator(self) -> ACC:

@@ -27,7 +27,7 @@ into the jitted step; `combine` picks the segment reduction
 
 from __future__ import annotations
 
-from typing import Callable, Optional
+from typing import Callable
 
 import jax
 import jax.numpy as jnp

@@ -8,8 +8,6 @@ device programs per sweep (users then items)."""
 
 from __future__ import annotations
 
-from typing import Tuple
-
 import jax
 import jax.numpy as jnp
 import numpy as np

@@ -23,7 +23,7 @@ are legal and resolved by the scatter combinator (add/max/min).
 from __future__ import annotations
 
 import abc
-from typing import Dict, NamedTuple, Optional, Sequence, Tuple
+from typing import Dict, NamedTuple, Tuple
 
 import jax
 import jax.numpy as jnp

@@ -25,7 +25,7 @@ from __future__ import annotations
 import collections
 import tempfile
 import time as _time
-from typing import Callable, Optional, Tuple
+from typing import Callable, Optional
 
 from flink_tpu.core.functions import AggregateFunction
 from flink_tpu.runtime import faults

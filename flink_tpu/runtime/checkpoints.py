@@ -23,7 +23,6 @@ dict (the `jobmanager` backend analogue).
 
 from __future__ import annotations
 
-import os
 import pickle
 import shutil
 import struct

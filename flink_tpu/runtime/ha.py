@@ -28,7 +28,7 @@ import pickle
 import threading
 import time as _time
 import uuid
-from typing import Callable, Dict, List, Optional
+from typing import Callable, List, Optional
 
 
 class FileLeaderElection:

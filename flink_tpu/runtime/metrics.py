@@ -442,3 +442,21 @@ def register_faulttolerance_gauges(metrics: MetricRegistry, job_name: str,
                 lambda: coordinator.timeout_aborts)
         g.gauge("consecutiveFailedCheckpoints",
                 lambda: coordinator.consecutive_failures)
+
+
+def register_lint_gauges(metrics: MetricRegistry, job_name: str,
+                         report) -> None:
+    """Publish the `lint.*` surface from a pre-flight
+    :class:`flink_tpu.analysis.Diagnostics` report: severity counters
+    plus one gauge per distinct FT-code.  Re-registering on a repeated
+    execute() lets the fresh report's suppliers win, same as the
+    checkpoint gauges."""
+    g = metrics.job_group(job_name).add_group("lint")
+    counts = report.counts()
+    g.gauge("errors", lambda c=counts.get("error", 0): c)
+    g.gauge("warnings", lambda c=counts.get("warning", 0): c)
+    g.gauge("infos", lambda c=counts.get("info", 0): c)
+    by_code = {code: len(report.by_code(code)) for code in report.codes()}
+    codes = g.add_group("codes")
+    for code, n in by_code.items():
+        codes.gauge(code, lambda n=n: n)

@@ -37,7 +37,7 @@ from __future__ import annotations
 import threading
 import time as _time
 from collections import deque
-from typing import Dict, List, Optional, Tuple
+from typing import List, Optional
 
 from flink_tpu.runtime.checkpoints import (
     CheckpointCoordinator,

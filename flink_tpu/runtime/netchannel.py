@@ -38,7 +38,6 @@ import pickle
 import socket
 import struct
 import threading
-import time as _time
 from collections import deque
 from typing import Any, Callable, Dict, List, Optional, Tuple
 

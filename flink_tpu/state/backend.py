@@ -18,7 +18,7 @@ Differences from the reference, on purpose:
 from __future__ import annotations
 
 import abc
-from typing import Any, Dict, Iterable, List, Optional, Tuple
+from typing import Any, Dict, Iterable, Optional
 
 from flink_tpu.core.keygroups import (
     KeyGroupRange,

@@ -14,8 +14,6 @@ heap and TPU deployments.  Shortcuts accepted:
 
 from __future__ import annotations
 
-from typing import Optional
-
 from flink_tpu.core.config import Configuration
 from flink_tpu.core.keygroups import KeyGroupRange
 from flink_tpu.state.backend import KeyedStateBackend

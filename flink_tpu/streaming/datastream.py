@@ -13,7 +13,7 @@ env.from_collection(...).key_by(...).time_window(Time.seconds(5))
 
 from __future__ import annotations
 
-from typing import Any, Callable, Iterable, List, Optional, Union
+from typing import Any, Iterable, List, Optional, Union
 
 from flink_tpu.core.config import Configuration
 from flink_tpu.core.functions import (
@@ -114,6 +114,8 @@ class StreamExecutionEnvironment:
         self.remote_tls = None
         self._last_executor = None
         self._executed = False
+        #: most recent pre-flight Diagnostics (validate()/execute())
+        self._last_validation = None
 
     # ---- factory ----------------------------------------------------
     @staticmethod
@@ -385,16 +387,65 @@ class StreamExecutionEnvironment:
             self._last_executor = LocalExecutor(**kw)
         return self._last_executor
 
+    # ---- pre-flight validation --------------------------------------
+    def validate(self, strict: bool = False):
+        """Run the pre-flight static analysis (graph linter + UDF
+        liftability) over the current topology WITHOUT executing it.
+
+        Returns a :class:`flink_tpu.analysis.Diagnostics` report; with
+        ``strict=True`` raises
+        :class:`flink_tpu.analysis.JobValidationError` when the report
+        contains any ERROR diagnostic.  See docs/static_analysis.md
+        for the code catalog.
+        """
+        from flink_tpu.analysis import JobValidationError, lint_graph
+        report = lint_graph(self.graph, config=self.config, env=self)
+        self._last_validation = report
+        if strict and report.has_errors():
+            raise JobValidationError(report)
+        return report
+
+    def _preflight(self, job_name: str):
+        """execute()-time lint gate, controlled by the ``lint.mode``
+        config key: ``off`` skips it, ``warn`` (default) logs errors
+        and warnings, ``strict`` raises on any ERROR diagnostic."""
+        mode = self.config.get_string("lint.mode", "warn").lower()
+        if mode == "off":
+            return None
+        self.graph.job_name = job_name
+        report = self.validate(strict=(mode == "strict"))
+        if len(report):
+            report.log()
+        return report
+
+    def _publish_lint_metrics(self, report):
+        if report is None or self._last_executor is None:
+            return
+        registry = getattr(self._last_executor, "metrics", None)
+        if registry is None:
+            return
+        try:
+            from flink_tpu.runtime.metrics import register_lint_gauges
+            register_lint_gauges(registry, self.graph.job_name, report)
+        except Exception:
+            pass  # metrics are best-effort; never block submission
+
     def execute(self, job_name: str = "job"):
         """(ref: execute :1508) — runs on the local executor."""
+        report = self._preflight(job_name)
         self.graph.job_name = job_name
-        return self._make_executor().execute(self.get_job_graph())
+        executor = self._make_executor()
+        self._publish_lint_metrics(report)
+        return executor.execute(self.get_job_graph())
 
     def execute_async(self, job_name: str = "job"):
         """Submit and return a JobClient with cancel()/wait() — the
         detached-submission shape of ClusterClient.run()."""
+        report = self._preflight(job_name)
         self.graph.job_name = job_name
-        return self._make_executor().execute_async(self.get_job_graph())
+        executor = self._make_executor()
+        self._publish_lint_metrics(report)
+        return executor.execute_async(self.get_job_graph())
 
 
 def _source_factory(source_function: SourceFunction, time_characteristic: str):

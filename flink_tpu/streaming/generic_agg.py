@@ -36,6 +36,7 @@ O(records), matching the reference's accumulator-per-key state.
 
 from __future__ import annotations
 
+import logging
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -44,6 +45,13 @@ from flink_tpu.streaming.operators import (
     StreamOperator,
     TimestampedCollector,
 )
+
+log = logging.getLogger("flink_tpu.generic_agg")
+
+#: (aggregate class name, reason head) pairs already warned about —
+#: the probe fallback warning fires once per aggregate/cause, not once
+#: per subtask
+_FALLBACK_WARNED: set = set()
 
 __all__ = [
     "LiftedAggregate",
@@ -56,6 +64,11 @@ __all__ = [
 ]
 
 _NUMERIC = (int, float, bool, np.integer, np.floating, np.bool_)
+
+
+class _ProbeDisagreement(Exception):
+    """Lifted fold and scalar reference disagreed on the probe sample
+    (message carries the failing field/dtype for the fallback log)."""
 
 
 def _stable_argsort(keys: np.ndarray) -> np.ndarray:
@@ -147,13 +160,72 @@ class LiftedAggregate:
         self.agg = agg
         self.acc0 = agg.create_accumulator()
         self.acc_spec = self._spec_of(self.acc0)
+        pinned = bool(getattr(agg, "force_scalar", False))
         #: "lifted" | "scalar" | None (undecided — probe on first use)
-        self.mode: Optional[str] = (
-            "scalar" if getattr(agg, "force_scalar", False) else None)
+        self.mode: Optional[str] = "scalar" if pinned else None
         self.field_dtypes: Optional[List[np.dtype]] = None
         #: whether get_result lifts too (it can fail independently of
         #: add — e.g. a result built via data-dependent branching)
         self.result_lifted = False
+        #: who decided the mode: "static" (AOT analysis), "probe"
+        #: (runtime sample), "pin" (force_scalar), "restore"
+        self.decided_by: Optional[str] = "pin" if pinned else None
+        #: why the scalar path was chosen (None while undecided/lifted)
+        self.fallback_reason: Optional[str] = (
+            "force_scalar" if pinned else None)
+        #: operator uid/name for log + trace context (set by the owner)
+        self.owner: str = ""
+        self._static_lift = False
+        self._static_result_lift = False
+
+    # ---- ahead-of-time verdict --------------------------------------
+    def apply_static(self, report) -> None:
+        """Feed a conclusive AOT verdict (analysis.liftability).
+
+        SCALAR_ONLY / IMPURE locks the scalar fold immediately; a
+        LIFTABLE proof arms a probe-skip fast path — the probe still
+        dry-runs one ``add`` to learn field dtypes, but skips the
+        scalar-reference replay and comparison (one less warm-up
+        batch).  Inconclusive (or None) leaves the runtime probe in
+        charge."""
+        if report is None or self.mode is not None:
+            return
+        verdict = getattr(report, "verdict", None)
+        if verdict == "LIFTABLE":
+            self._static_lift = True
+            self._static_result_lift = bool(
+                getattr(report, "result_liftable", False))
+        elif verdict in ("SCALAR_ONLY", "IMPURE"):
+            reasons = "; ".join(getattr(report, "reasons", [])) \
+                or verdict.lower()
+            self._lock("scalar", "static", reasons, warn=False)
+
+    def _lock(self, mode: str, decided_by: str,
+              reason: Optional[str] = None, warn: bool = True) -> None:
+        self.mode = mode
+        self.decided_by = decided_by
+        if mode == "scalar" and reason:
+            self.fallback_reason = reason
+            if warn:
+                self._warn_fallback(reason)
+        try:
+            from flink_tpu.runtime.tracing import get_tracer
+            get_tracer().record_instant(
+                "lift.decision", mode=mode, decided_by=decided_by,
+                reason=reason or "", operator=self.owner,
+                aggregate=type(self.agg).__name__)
+        except Exception:
+            pass
+
+    def _warn_fallback(self, reason: str) -> None:
+        key = (type(self.agg).__name__, reason.split(":")[0])
+        if key in _FALLBACK_WARNED:
+            return
+        _FALLBACK_WARNED.add(key)
+        where = f" (operator {self.owner})" if self.owner else ""
+        log.warning(
+            "aggregate %s%s falls back to the per-record scalar "
+            "fold: %s", type(self.agg).__name__, where, reason)
 
     # ---- accumulator structure --------------------------------------
     @staticmethod
@@ -209,8 +281,27 @@ class LiftedAggregate:
             return self.mode
         agg = self.agg
         if self.acc_spec is None or vspec is None:
-            self.mode = "scalar"
+            self._lock("scalar", "probe",
+                       "accumulator or value rows are not "
+                       "column-representable", warn=False)
             return self.mode
+        if self._static_lift:
+            # AOT-proven liftable: skip the scalar-reference replay.
+            # One dry-run add still runs to learn the field dtypes.
+            try:
+                probe_fields = self._fields_of(
+                    agg.add(_value_struct([c[:1] for c in cols], vspec),
+                            self._acc_struct([np.asarray([v]) for v in (
+                                [self.acc0] if self.acc_spec == "scalar"
+                                else list(self.acc0))])), 1)
+                self.field_dtypes = [f.dtype for f in probe_fields]
+                self.result_lifted = self._static_result_lift
+                self._lock("lifted", "static")
+                return self.mode
+            except Exception:
+                # the proof did not survive contact with real data —
+                # fall back to the full runtime probe
+                self._static_lift = False
         m = min(64, len(cols[0]))
         sample = [c[:m] for c in cols]
         rows = list(zip(*[c.tolist() for c in sample])) \
@@ -229,8 +320,9 @@ class LiftedAggregate:
                     acc = agg.add(r, acc)
                 ref.append(acc)
             ref_res = [agg.get_result(a) for a in ref]
-        except Exception:
-            self.mode = "scalar"
+        except Exception as e:
+            self._lock("scalar", "probe",
+                       f"scalar reference replay raised {e!r}")
             return self.mode
         # lifted: the same groups as slot columns, diagonal rounds
         try:
@@ -256,16 +348,22 @@ class LiftedAggregate:
                     f[slots] = nf.astype(f.dtype, copy=False)
             lift = [self._acc_struct([np.asarray([f[g]]) for f in fields])
                     for g in range(n_groups)]
-            ok = all(self._acc_close(l, r, scalar_side=True)
-                     for l, r in zip(lift, ref[:n_groups]))
-            if ok and n_groups == 2:
+            mismatch = None
+            for g in range(n_groups):
+                detail = self._acc_mismatch(lift[g], ref[g])
+                if detail is not None:
+                    mismatch = f"group {g}: {detail}"
+                    break
+            if mismatch is None and n_groups == 2:
                 merged = agg.merge(lift[0], lift[1])
                 mf = self._fields_of(merged, 1)
-                ok = self._acc_close(self._acc_struct(
+                detail = self._acc_mismatch(self._acc_struct(
                     [np.asarray([f[0]]) for f in mf]),
-                    agg.merge(ref[0], ref[1]), scalar_side=True)
-            if not ok:
-                raise ValueError("lifted fold disagrees with scalar")
+                    agg.merge(ref[0], ref[1]))
+                if detail is not None:
+                    mismatch = f"merge: {detail}"
+            if mismatch is not None:
+                raise _ProbeDisagreement(mismatch)
             # result lifting probed separately (failure only demotes
             # get_result, not the fold)
             try:
@@ -276,21 +374,32 @@ class LiftedAggregate:
                     res, ref_res[:n_groups])
             except Exception:
                 self.result_lifted = False
-            self.mode = "lifted"
-        except Exception:
-            self.mode = "scalar"
+            self._lock("lifted", "probe")
+        except _ProbeDisagreement as e:
+            self._lock("scalar", "probe",
+                       f"lifted fold disagrees with the scalar "
+                       f"reference — {e}")
+        except Exception as e:
+            self._lock("scalar", "probe",
+                       f"lifted replay raised {e!r}")
         return self.mode
 
-    def _acc_close(self, lifted_struct, scalar_acc, scalar_side=False):
+    def _acc_mismatch(self, lifted_struct, scalar_acc) -> Optional[str]:
+        """First disagreeing accumulator field between a 1-slot lifted
+        struct and a scalar reference, or None when they agree.  The
+        detail (field index, dtype, both values) feeds the structured
+        fallback warning."""
         lf = self._fields_of(lifted_struct, 1)
         sf = ([scalar_acc] if self.acc_spec == "scalar"
               else list(scalar_acc))
-        for a, b in zip(lf, sf):
+        for i, (a, b) in enumerate(zip(lf, sf)):
             if not np.allclose(np.asarray(a, np.float64),
                                np.float64(b), rtol=1e-9, atol=1e-12,
                                equal_nan=True):
-                return False
-        return True
+                return (f"field {i} (dtype {np.asarray(a).dtype}): "
+                        f"lifted={np.asarray(a)[0]!r} "
+                        f"scalar={b!r}")
+        return None
 
     @staticmethod
     def _res_close(lifted_res, scalar_results):
@@ -804,6 +913,7 @@ class _GenericLogEngine:
             "vspec": self.vspec,
             "vspec_locked": self._vspec_locked,
             "mode": self.lift.mode,
+            "decided_by": self.lift.decided_by,
             "result_lifted": self.lift.result_lifted,
             "field_dtypes": ([str(d) for d in self.lift.field_dtypes]
                              if self.lift.field_dtypes else None),
@@ -818,6 +928,8 @@ class _GenericLogEngine:
             self.vspec = tuple(self.vspec)
         self._vspec_locked = snap["vspec_locked"]
         self.lift.mode = snap["mode"]
+        if self.lift.mode is not None:
+            self.lift.decided_by = snap.get("decided_by") or "restore"
         self.lift.result_lifted = snap["result_lifted"]
         if snap["field_dtypes"]:
             self.lift.field_dtypes = [np.dtype(d)
@@ -853,12 +965,18 @@ class _GenericLogEngine:
                 self.vspec = None
                 self._vspec_locked = True
                 self.lift.mode = "scalar"
+                self.lift.decided_by = "restore"
+                self.lift.fallback_reason = \
+                    "mixed-mode snapshot set restored on the common " \
+                    "denominator"
             self.watermark = max(self.watermark, other.watermark)
             self.num_late_dropped += other.num_late_dropped
             if self.lift.mode is None and other.lift.mode is not None:
                 self.vspec = other.vspec
                 self._vspec_locked = other._vspec_locked
                 self.lift.mode = other.lift.mode
+                self.lift.decided_by = other.lift.decided_by \
+                    or "restore"
                 self.lift.result_lifted = other.lift.result_lifted
                 self.lift.field_dtypes = other.lift.field_dtypes
             for start, log in other.windows.items():
@@ -1311,12 +1429,18 @@ class GenericLogSessionWindows(_GenericLogEngine):
                 self.vspec = None
                 self._vspec_locked = True
                 self.lift.mode = "scalar"
+                self.lift.decided_by = "restore"
+                self.lift.fallback_reason = \
+                    "mixed-mode snapshot set restored on the common " \
+                    "denominator"
             self.watermark = max(self.watermark, other.watermark)
             self.num_late_dropped += other.num_late_dropped
             if self.lift.mode is None and other.lift.mode is not None:
                 self.vspec = other.vspec
                 self._vspec_locked = other._vspec_locked
                 self.lift.mode = other.lift.mode
+                self.lift.decided_by = other.lift.decided_by \
+                    or "restore"
                 self.lift.result_lifted = other.lift.result_lifted
                 self.lift.field_dtypes = other.lift.field_dtypes
             keep = (keep_fn(other._r_keys) if keep_fn is not None
@@ -1391,6 +1515,8 @@ class GenericWindowOperator(StreamOperator):
         #: AggregateFunction.force_scalar for when that matters)
         self.force_scalar = force_scalar
         self.engine = None
+        #: AOT liftability report (computed lazily, sentinel = unset)
+        self._lift_report = False
         self._keys: List[Any] = []
         self._ts: List[int] = []
         self._values: List[Any] = []
@@ -1406,6 +1532,32 @@ class GenericWindowOperator(StreamOperator):
         if self.metrics is not None:
             ctr = self.metrics.counter("numLateRecordsDropped")
             ctr.count = 0
+            g = self.metrics.add_group("lift")
+            g.gauge("decision", lambda: (
+                (self.engine.lift.mode if self.engine is not None
+                 else None) or "undecided"))
+            g.gauge("decided_by", lambda: (
+                (self.engine.lift.decided_by if self.engine is not None
+                 else None) or "undecided"))
+            g.gauge("fallback_reason", lambda: (
+                (self.engine.lift.fallback_reason
+                 if self.engine is not None else None) or ""))
+
+    def _static_verdict(self):
+        """AOT liftability analysis of the aggregate (pass 2), cached;
+        None when opted out (force_probe) or the analyzer errored."""
+        if self._lift_report is False:
+            self._lift_report = None
+            if not self.force_scalar \
+                    and not getattr(self.agg, "force_probe", False):
+                try:
+                    from flink_tpu.analysis.liftability import (
+                        analyze_aggregate,
+                    )
+                    self._lift_report = analyze_aggregate(self.agg)
+                except Exception:
+                    self._lift_report = None
+        return self._lift_report
 
     def set_key_context(self, record):
         pass  # keys resolve vectorized at flush
@@ -1427,8 +1579,13 @@ class GenericWindowOperator(StreamOperator):
         if self.engine is None:
             self.engine = generic_engine_for_assigner(
                 self.assigner, self.agg, self.compact_threshold)
+            self.engine.lift.owner = self.operator_id or ""
             if self.force_scalar:
                 self.engine.lift.mode = "scalar"
+                self.engine.lift.decided_by = "pin"
+                self.engine.lift.fallback_reason = "force_scalar"
+            else:
+                self.engine.lift.apply_static(self._static_verdict())
 
     def _flush_buffer(self):
         if not self._keys:
@@ -1522,6 +1679,8 @@ class GenericWindowOperator(StreamOperator):
         if self.force_scalar:
             # the pin outranks a checkpoint taken without it
             self.engine.lift.mode = "scalar"
+            self.engine.lift.decided_by = "pin"
+            self.engine.lift.fallback_reason = "force_scalar"
 
 
 def generic_engine_for_assigner(assigner, aggregate,

@@ -13,7 +13,7 @@ returning a fresh operator instance — because each parallel subtask
 from __future__ import annotations
 
 import itertools
-from typing import Any, Callable, Dict, List, Optional, Tuple
+from typing import Any, Callable, Dict, List, Optional
 
 from flink_tpu.streaming.partitioners import (
     ForwardPartitioner,
@@ -183,20 +183,43 @@ class JobGraph:
         return order
 
 
+def chain_rejection_reasons(edge: StreamEdge,
+                            graph: StreamGraph) -> List[str]:
+    """Why this edge cannot be operator-chained — empty list means
+    chainable.  The boolean gate (:func:`is_chainable`) and the
+    pre-flight linter's FT130 diagnostic share this single source of
+    truth."""
+    up = graph.nodes[edge.source_id]
+    down = graph.nodes[edge.target_id]
+    reasons: List[str] = []
+    if not isinstance(edge.partitioner, ForwardPartitioner):
+        reasons.append(
+            f"partitioner is {type(edge.partitioner).__name__}, "
+            "not forward")
+    if edge.is_feedback:
+        reasons.append("iteration feedback edge")
+    if up.parallelism != down.parallelism:
+        reasons.append(
+            f"parallelism mismatch ({up.parallelism} -> "
+            f"{down.parallelism})")
+    if len(graph.in_edges(down.id)) != 1:
+        reasons.append(
+            f"downstream has {len(graph.in_edges(down.id))} inputs")
+    if down.chaining_strategy != "always":
+        reasons.append(
+            f"downstream chaining strategy is "
+            f"'{down.chaining_strategy}'")
+    if up.chaining_strategy == "never":
+        reasons.append("upstream chaining strategy is 'never'")
+    if edge.side_output_tag is not None:
+        reasons.append("side-output edge")
+    return reasons
+
+
 def is_chainable(edge: StreamEdge, graph: StreamGraph) -> bool:
     """(ref: StreamingJobGraphGenerator.isChainable :228): forward
     partitioner, same parallelism, single input, chaining allowed."""
-    up = graph.nodes[edge.source_id]
-    down = graph.nodes[edge.target_id]
-    return (
-        isinstance(edge.partitioner, ForwardPartitioner)
-        and not edge.is_feedback
-        and up.parallelism == down.parallelism
-        and len(graph.in_edges(down.id)) == 1
-        and down.chaining_strategy == "always"
-        and up.chaining_strategy != "never"
-        and edge.side_output_tag is None
-    )
+    return not chain_rejection_reasons(edge, graph)
 
 
 def create_job_graph(stream_graph: StreamGraph) -> JobGraph:

@@ -14,7 +14,7 @@ from __future__ import annotations
 
 from typing import Any, List, Optional
 
-from flink_tpu.core.functions import KeySelector, as_key_selector
+from flink_tpu.core.functions import as_key_selector
 from flink_tpu.core.keygroups import KeyGroupRange
 from flink_tpu.state.loader import load_state_backend
 from flink_tpu.state.operator_state import OperatorStateBackend

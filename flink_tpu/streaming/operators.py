@@ -14,13 +14,10 @@ from __future__ import annotations
 import abc
 import threading
 import time as _time_mod
-from typing import Any, Callable, Generic, Iterable, List, Optional, TypeVar
+from typing import List, Optional, TypeVar
 
 from flink_tpu.core.functions import (
-    FilterFunction,
-    FlatMapFunction,
     KeySelector,
-    MapFunction,
     ReduceFunction,
     RichFunction,
 )

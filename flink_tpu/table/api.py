@@ -22,7 +22,6 @@ from typing import Any, Callable, Dict, List, Optional, Sequence
 
 from flink_tpu.table.expressions import (
     AggCall,
-    Alias,
     BinaryOp,
     Column,
     Expr,

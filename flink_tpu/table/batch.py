@@ -20,7 +20,7 @@ input), and INSERT INTO registered sinks (BatchTableSink path).
 
 from __future__ import annotations
 
-from typing import Any, Callable, Dict, List, Optional, Sequence
+from typing import Any, Callable, Dict, List, Sequence
 
 from flink_tpu.table.expressions import (
     AggCall,

@@ -1,0 +1,32 @@
+#!/usr/bin/env bash
+# Repo-wide pre-flight lint:
+#   1. `flink_tpu lint` over every example job script — captures the
+#      topologies they build (execute() is neutered) and runs the
+#      graph linter + UDF liftability analyzer; fails on any FTxxx
+#      ERROR diagnostic.
+#   2. the built-in unused-import checker over the flink_tpu package
+#      (pyflakes-lite; the container has no pyflakes).
+#
+# Usage: scripts/lint_repo.sh  (from the repo root; rc 0 = clean)
+set -uo pipefail
+cd "$(dirname "$0")/.."
+
+export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
+rc=0
+
+echo "== linting example job scripts =="
+python -m flink_tpu lint examples/ || rc=1
+
+echo
+echo "== checking flink_tpu for unused imports =="
+python - <<'EOF' || rc=1
+import sys
+from flink_tpu.analysis.imports_check import check_tree
+findings = check_tree("flink_tpu")
+for f in findings:
+    print(f.render())
+print(f"{len(findings)} unused import(s)")
+sys.exit(1 if findings else 0)
+EOF
+
+exit $rc
