@@ -70,7 +70,7 @@ from flink_tpu.runtime.local import (
     merge_accumulators,
 )
 from flink_tpu.runtime import faults
-from flink_tpu.runtime.metrics import MetricRegistry
+from flink_tpu.runtime.metrics import MetricRegistry, register_network_gauges
 from flink_tpu.runtime.netchannel import DataClient, DataServer
 from flink_tpu.runtime.rpc import (
     RpcEndpoint,
@@ -982,9 +982,23 @@ class _JobAttempt:
                         raise s.thread_error
                     s.try_inject_threaded_trigger()
                     s.try_deliver_notifications()
+                    if s.router.has_queued_output() \
+                            and s.emission_lock.acquire(blocking=False):
+                        try:
+                            s.router.flush_records()
+                        finally:
+                            s.emission_lock.release()
                 for st in self.non_sources:
                     progress += st.step(self.STEP_BUDGET)
-                progress += self.pts.fire_due()
+                fired = self.pts.fire_due()
+                if fired:
+                    # timer emissions flush before the quiescence
+                    # protocol (sent==received) can observe the pause
+                    for st in self.non_sources:
+                        st.router.flush_records()
+                    for s in self.coop_sources:
+                        s.router.flush_records()
+                progress += fired
                 if self.data_client.error is not None:
                     raise self.data_client.error
                 self.data_client.replenish_credits()
@@ -1056,6 +1070,10 @@ class TaskExecutor(RpcEndpoint):
         self.num_slots = num_slots
         self.metrics = MetricRegistry()
         self._attempts: Dict[str, _JobAttempt] = {}  # job_id -> live attempt
+        register_network_gauges(
+            self.metrics, data_server=data_server,
+            data_clients=lambda: [a.data_client
+                                  for a in list(self._attempts.values())])
         self._blob_cache: Dict[str, bytes] = {}
         #: local recovery (ref: TaskLocalStateStore/TaskStateManager):
         #: the last TWO acked snapshots per task (cid -> pickled) —
@@ -1258,6 +1276,11 @@ class TaskExecutor(RpcEndpoint):
             match = (lambda k: k[0] == job_id and k[1] == attempt)
             queued = sum(len(ch.queue) for st in att.subtasks
                          for ch in st.input_channels)
+            # un-flushed router buffers count as queued: quiescence
+            # must not be declared while emissions sit in an emit
+            # buffer (the worker is paused at a step boundary, so the
+            # read is stable)
+            queued += sum(len(st.router._buf) for st in att.subtasks)
             queued += self.data_server.pending_out(match)
             status["queued"] = queued
             status["sent"] = sum(
@@ -1288,6 +1311,8 @@ class TaskExecutor(RpcEndpoint):
             for st in att.subtasks:
                 st.notify_checkpoint_complete(cid)
         att.pts.fire_all_pending()
+        for st in att.subtasks:
+            st.router.flush_records()
         moved = sum(st.step(1 << 30) for st in att.non_sources)
         att.data_client.replenish_credits()
         self.data_server.wake()
@@ -1300,6 +1325,7 @@ class TaskExecutor(RpcEndpoint):
             if st.task_key[0] == vertex_id:
                 for op in st.operators:
                     op.finish()
+                st.router.flush_records()
         self.data_server.wake()
 
     def finish_job(self, job_id: str, attempt: int) -> dict:

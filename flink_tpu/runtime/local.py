@@ -39,6 +39,8 @@ import time as _time
 from collections import deque
 from typing import Any, Dict, List, Optional, Set, Tuple
 
+import numpy as np
+
 from flink_tpu.core.keygroups import (
     compute_key_group_range_for_operator_index,
 )
@@ -147,10 +149,25 @@ class _ChainedOutput(Output):
         self.op.process_latency_marker(marker)
 
 
+#: records buffered in a router before the batched fan-out runs; any
+#: control emission (watermark/barrier/EOS/marker/side output) and the
+#: end of every subtask step flush earlier, so this only caps memory
+#: under very chatty operators
+_ROUTER_BUFFER_CAP = 4096
+
+
 class _RouterOutput(Output):
     """Chain-tail output: routes records through each out-edge's
     partitioner to downstream subtask channels
-    (ref: RecordWriterOutput + RecordWriter)."""
+    (ref: RecordWriterOutput + RecordWriter).
+
+    Records BUFFER here and fan out in batches: the partitioner's
+    vectorized `select_channels_batch` indexes a whole emit batch at
+    once and a stable argsort splits it into per-channel sub-batches —
+    replacing the per-record Python dispatch loop.  Element order per
+    (producer, channel) pair is preserved exactly (the stable sort),
+    and every control element flushes the buffer first, so barriers,
+    watermarks, and EOS never overtake records."""
 
     def __init__(self):
         #: (partitioner, channels: List[_InputChannel], side_tag)
@@ -162,6 +179,8 @@ class _RouterOutput(Output):
         #: numRecordsOut counter, set by the task layer when metrics
         #: are enabled (ref: RecordWriterOutput's outputs counter)
         self.records_out_counter = None
+        #: pending records awaiting the batched fan-out
+        self._buf: list = []
 
     def add_route(self, partitioner, channels, side_tag=None,
                   feedback: bool = False):
@@ -173,13 +192,48 @@ class _RouterOutput(Output):
     def collect(self, record):
         if self.records_out_counter is not None:
             self.records_out_counter.count += 1
+        buf = self._buf
+        buf.append(record)
+        if len(buf) >= _ROUTER_BUFFER_CAP:
+            self.flush_records()
+
+    def flush_records(self):
+        """Fan the buffered records out to every non-side route."""
+        buf = self._buf
+        if not buf:
+            return
+        self._buf = []
         for partitioner, channels, side_tag in self.routes:
             if side_tag is not None:
                 continue
-            for idx in partitioner.select_channels(record.value, len(channels)):
-                channels[idx].push(record)
+            n_ch = len(channels)
+            if getattr(partitioner, "broadcast_all", False):
+                for ch in channels:
+                    ch.push_batch(buf)
+            elif not partitioner.supports_batch or len(buf) == 1:
+                # multicast (tagged broadcast) or trivial batch: the
+                # per-record scalar path
+                for record in buf:
+                    for idx in partitioner.select_channels(record.value,
+                                                           n_ch):
+                        channels[idx].push(record)
+            elif n_ch == 1:
+                channels[0].push_batch(buf)
+            else:
+                idx = partitioner.select_channels_batch(
+                    [r.value for r in buf], n_ch)
+                order = np.argsort(idx, kind="stable")
+                bounds = np.searchsorted(idx[order],
+                                         np.arange(n_ch + 1))
+                ol = order.tolist()
+                for c in range(n_ch):
+                    lo, hi = int(bounds[c]), int(bounds[c + 1])
+                    if lo < hi:
+                        channels[c].push_batch([buf[j]
+                                                for j in ol[lo:hi]])
 
     def collect_side(self, tag, record):
+        self.flush_records()
         for partitioner, channels, side_tag in self.routes:
             if side_tag is not None and side_tag.tag_id == tag.tag_id:
                 for idx in partitioner.select_channels(record.value, len(channels)):
@@ -187,6 +241,7 @@ class _RouterOutput(Output):
 
     def emit_watermark(self, watermark):
         # watermarks broadcast to every channel of every route
+        self.flush_records()
         for _, channels, _ in self.routes:
             for ch in channels:
                 ch.push(watermark)
@@ -197,12 +252,14 @@ class _RouterOutput(Output):
         # (O(p^depth) at the sink) and duplicate histogram samples
         # (ref: RecordWriterOutput forwards each marker to a single
         # random channel for the same reason)
+        self.flush_records()
         for _, channels, side_tag in self.routes:
             if side_tag is None and channels:
                 channels[_rand.randrange(len(channels))].push(marker)
 
     def broadcast_barrier(self, barrier: CheckpointBarrier):
         """(ref: OperatorChain.broadcastCheckpointBarrier)"""
+        self.flush_records()
         for i, (_, channels, _) in enumerate(self.routes):
             if i in self.feedback_routes:
                 continue
@@ -210,11 +267,15 @@ class _RouterOutput(Output):
                 ch.push(barrier)
 
     def broadcast_end_of_stream(self):
+        self.flush_records()
         for i, (_, channels, _) in enumerate(self.routes):
             if i in self.feedback_routes:
                 continue
             for ch in channels:
                 ch.push(END_OF_STREAM)
+
+    def has_queued_output(self) -> bool:
+        return bool(self._buf)
 
     def has_capacity(self) -> bool:
         """Producer runnable check — credit-based flow control
@@ -276,6 +337,16 @@ class _InputChannel:
                     self.unspill()
                     self._spill_disabled = True
         self.queue.append(element)
+
+    def push_batch(self, elements: list) -> None:
+        """Bulk append for the batched router fan-out; alignment-
+        blocked channels take the per-element path (spill
+        accounting)."""
+        if self.blocked:
+            for el in elements:
+                self.push(el)
+        else:
+            self.queue.extend(elements)
 
     def _try_spill(self, element) -> bool:
         import pickle as _pickle
@@ -484,6 +555,7 @@ class SubtaskInstance:
             self.source_context(), max_records)
         if not more:
             self.finish_source()
+        self.router.flush_records()
         return 1
 
     def finish_source(self):
@@ -584,6 +656,10 @@ class SubtaskInstance:
             element = ch.queue.popleft()
             self._dispatch(ch, element)
             processed += 1
+        # the step boundary is a flush point: downstream (and the
+        # executor's quiescence check) must see everything this step
+        # emitted
+        self.router.flush_records()
         return processed
 
     def _dispatch(self, ch: _InputChannel, element):
@@ -705,7 +781,10 @@ class SubtaskInstance:
             self.router.broadcast_end_of_stream()
 
     def has_queued_input(self) -> bool:
-        return any(c.queue for c in self.input_channels)
+        # un-flushed router output counts: a quiescence check must not
+        # terminate the job while records sit in the emit buffer
+        return (self.router.has_queued_output()
+                or any(c.queue for c in self.input_channels))
 
     # ---- input path (ref: StreamInputProcessor.processInput :176) ---
     def process_record(self, input_index: int, record: StreamRecord):
@@ -814,6 +893,9 @@ class _LockedSourceOutput(Output):
             st._deliver_notifications_locked()
             st.handle_pending_trigger()
             fn(element)
+            # threaded sources flush per emission: the executor loop
+            # never steps them, so nothing else would drain the buffer
+            st.router.flush_records()
 
     def collect(self, record):
         self._emit(self._inner.collect, record)
@@ -1225,6 +1307,15 @@ class LocalExecutor:
                         from s.thread_error
                 s.try_inject_threaded_trigger()
                 s.try_deliver_notifications()
+                if s.router.has_queued_output() \
+                        and s.emission_lock.acquire(blocking=False):
+                    # executor-side emissions (timer callbacks) into a
+                    # threaded source's router flush under its
+                    # emission lock, opportunistically like triggers
+                    try:
+                        s.router.flush_records()
+                    finally:
+                        s.emission_lock.release()
 
             # 2. operators
             for st in non_sources:
@@ -1237,7 +1328,17 @@ class LocalExecutor:
             # the single-owner replacement for the reference's timer
             # thread + checkpoint lock)
             if pts_poll is not None:
-                progress += pts_poll()
+                fired = pts_poll()
+                if fired:
+                    # timer callbacks emit outside step()/source_step —
+                    # flush their router buffers so the output is
+                    # visible (termination check + downstream queues).
+                    # Threaded sources flush above, under their lock.
+                    for st in non_sources:
+                        st.router.flush_records()
+                    for s in coop_sources:
+                        s.router.flush_records()
+                progress += fired
 
             # 4. checkpoints
             if coordinator is not None:
@@ -1277,6 +1378,8 @@ class LocalExecutor:
         if isinstance(pts, TestProcessingTimeService):
             for _ in range(1000):  # bounded cascade
                 pts.fire_all_pending()
+                for st in all_tasks:
+                    st.router.flush_records()
                 moved = sum(st.step(1 << 30) for st in non_sources)
                 if moved == 0 and not pts.has_pending():
                     break
@@ -1294,6 +1397,7 @@ class LocalExecutor:
             for st in all_tasks:
                 for op in st.operators:
                     op.finish()
+                st.router.flush_records()
                 for t in non_sources:
                     t.step(1 << 30)
         except Exception as e:  # noqa: BLE001

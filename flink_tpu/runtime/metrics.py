@@ -460,3 +460,55 @@ def register_lint_gauges(metrics: MetricRegistry, job_name: str,
     codes = g.add_group("codes")
     for code, n in by_code.items():
         codes.gauge(code, lambda n=n: n)
+
+
+def register_network_gauges(metrics: MetricRegistry,
+                            data_server=None,
+                            data_clients=None) -> None:
+    """Publish the `network.*` gauge surface for a process: the
+    process-wide shuffle counters maintained by
+    `runtime.netchannel.NET_STATS` (frames/bytes in and out, codec-path
+    counters, split-frame count, frame-size histogram stats) plus
+    per-channel byte gauges when the owning `DataServer` /
+    `DataClient`s are supplied.  Registered under the registry root —
+    the data plane is shared by every job an executor runs."""
+    from flink_tpu.runtime import netchannel
+
+    stats = netchannel.NET_STATS
+    g = metrics.root.add_group("network")
+    g.gauge("framesOut", lambda: stats.frames_out)
+    g.gauge("framesIn", lambda: stats.frames_in)
+    g.gauge("bytesOut", lambda: stats.bytes_out)
+    g.gauge("bytesIn", lambda: stats.bytes_in)
+    g.gauge("framesColumnar", lambda: stats.frames_col)
+    g.gauge("framesPickle", lambda: stats.frames_pickle)
+    g.gauge("decodedColumnar", lambda: stats.decoded_col)
+    g.gauge("decodedPickle", lambda: stats.decoded_pickle)
+    g.gauge("framesSplit", lambda: stats.frames_split)
+
+    def _hstats(h, field):
+        s = h.get_statistics()
+        if s.count == 0:
+            return None
+        return {"count": s.count, "mean": s.mean, "min": s.min,
+                "max": s.max, "p50": s.quantile(0.5),
+                "p99": s.quantile(0.99)}[field]
+
+    fb = g.add_group("frameBytes")
+    fe = g.add_group("frameElements")
+    for field in ("count", "mean", "min", "max", "p50", "p99"):
+        fb.gauge(field, lambda f=field: _hstats(stats.frame_bytes, f))
+        fe.gauge(field, lambda f=field: _hstats(stats.frame_elements, f))
+
+    if data_server is not None:
+        g.gauge("bytesOutPerChannel",
+                lambda: data_server.bytes_out_by_channel())
+    if data_clients is not None:
+        def _bytes_in_per_channel():
+            merged = {}
+            for client in data_clients():
+                if client is None:
+                    continue
+                merged.update(client.bytes_in_by_channel())
+            return merged
+        g.gauge("bytesInPerChannel", _bytes_in_per_channel)

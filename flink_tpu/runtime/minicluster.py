@@ -173,10 +173,25 @@ class TaskManagerRunner:
                         raise s.thread_error
                     s.try_inject_threaded_trigger()
                     s.try_deliver_notifications()
+                    if s.router.has_queued_output() \
+                            and s.emission_lock.acquire(blocking=False):
+                        try:
+                            s.router.flush_records()
+                        finally:
+                            s.emission_lock.release()
                 for st in self.non_sources:
                     progress += st.step(self.STEP_BUDGET)
                 if pts_poll is not None:
-                    progress += pts_poll()
+                    fired = pts_poll()
+                    if fired:
+                        # timer callbacks emit outside step() — flush
+                        # so the master's quiescence check (and the
+                        # data plane) see the output
+                        for st in self.non_sources:
+                            st.router.flush_records()
+                        for s in self.coop_sources:
+                            s.router.flush_records()
+                    progress += fired
                 if progress:
                     self.progress += progress
                 else:
@@ -450,6 +465,8 @@ class MiniCluster:
             for tm in tms:
                 if isinstance(tm.pts, TestProcessingTimeService):
                     tm.pts.fire_all_pending()
+            for st in all_tasks:
+                st.router.flush_records()
             moved = sum(st.step(1 << 30) for st in non_sources)
             if moved == 0 and not any(
                     isinstance(tm.pts, TestProcessingTimeService)
@@ -463,6 +480,7 @@ class MiniCluster:
             for st in all_tasks:
                 for op in st.operators:
                     op.finish()
+                st.router.flush_records()
                 for t in non_sources:
                     t.step(1 << 30)
         except Exception as e:  # noqa: BLE001

@@ -27,9 +27,20 @@ the same ordered frame stream, so barrier alignment downstream is
 unchanged.  Per-channel `sent`/`received` element counters support the
 master's global-quiescence check (in-flight = sent - received).
 
-Wire format: 4-byte length + pickle payload (records are data, not
-code; the job's code travels once via the blob server, not per
-record).
+Wire format (docs/network.md has the byte-level layout):
+
+- PLAIN frame: ``>I`` length word + pickle payload.  Control frames
+  (PartitionRequest / AddCredit) and buffer-free data frames.
+- VECTORED frame: bit 31 of the length word set, low bits = segment
+  count; then a ``>I``-per-segment size table; then the segments.
+  Segment 0 is a pickle protocol-5 payload whose out-of-band buffers
+  are segments 1..N — numpy columns travel as raw bytes, gather-written
+  with ``sendmsg`` (no concat copy) and rebuilt on the consumer as
+  ``memoryview`` slices over ONE contiguous receive buffer (no
+  per-column copy).
+
+Records are data, not code: the job's code travels once via the blob
+server, never per record — hence pickle, not cloudpickle.
 """
 
 from __future__ import annotations
@@ -41,104 +52,452 @@ import threading
 from collections import deque
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
+import numpy as np
+
 from flink_tpu.runtime import faults
+from flink_tpu.runtime.metrics import Histogram
 from flink_tpu.runtime.rpc import MAX_FRAME, recv_exact
+from flink_tpu.runtime.tracing import get_tracer
+from flink_tpu.streaming.elements import StreamRecord
 
 _LEN = struct.Struct(">I")
 
-#: elements per data frame (the buffer-size analogue)
+#: bit 31 of the length word marks a VECTORED frame — safe because
+#: MAX_FRAME is 1<<30, so a plain byte length can never set it; the
+#: low bits then carry the segment count instead of a byte length
+_VEC_FLAG = 0x8000_0000
+_MAX_SEGMENTS = 0xFFFF
+
+#: elements per data frame at the adaptive baseline (the buffer-size
+#: analogue).  Also the queue-room unit of the consumer's credit grant
+#: (`replenish_credits`) — the two uses must stay in sync.
 FRAME_BATCH = 256
+#: adaptive ceiling — one frame never carries more elements than this,
+#: bounding decode latency under deep backlog
+MAX_FRAME_BATCH = 4096
 #: initial per-channel credit (exclusive buffers per channel)
 INITIAL_CREDIT = 8
+
+#: byte budget per wire frame: a serialized data batch above this is
+#: split into continuation frames so nothing ever trips the MAX_FRAME
+#: guard in `_recv`.  Module-level so tests can shrink it and exercise
+#: the split path without gigabyte payloads.
+SPLIT_FRAME_BYTES = MAX_FRAME
+
+#: gates the columnar fast path; bench A/B passes and differential
+#: tests force the per-batch pickle fallback by clearing it
+COLUMNAR_ENABLED = True
 
 ChannelKey = Tuple  # (job_id, attempt, edge_id, up_idx, down_idx)
 
 
+class NetStats:
+    """Process-wide data-plane instrumentation, surfaced as gauges via
+    `runtime.metrics.register_network_gauges`.  Updated from the
+    writer/reader threads without locks: plain int increments under the
+    GIL, read by monitoring only (the same contract as the rest of the
+    metrics stack)."""
+
+    __slots__ = ("frames_out", "frames_in", "bytes_out", "bytes_in",
+                 "frames_col", "frames_pickle", "decoded_col",
+                 "decoded_pickle", "frames_split", "frame_bytes",
+                 "frame_elements")
+
+    def __init__(self):
+        self.reset()
+
+    def reset(self) -> None:
+        self.frames_out = 0
+        self.frames_in = 0
+        self.bytes_out = 0
+        self.bytes_in = 0
+        #: data batches encoded per codec tier
+        self.frames_col = 0
+        self.frames_pickle = 0
+        #: data batches decoded per codec tier
+        self.decoded_col = 0
+        self.decoded_pickle = 0
+        #: continuation splits forced by SPLIT_FRAME_BYTES
+        self.frames_split = 0
+        #: sliding-window distributions of outbound frames
+        self.frame_bytes = Histogram(window=1024)
+        self.frame_elements = Histogram(window=1024)
+
+    def snapshot(self) -> dict:
+        fb = self.frame_bytes.get_statistics()
+        fe = self.frame_elements.get_statistics()
+        return {
+            "framesOut": self.frames_out, "framesIn": self.frames_in,
+            "bytesOut": self.bytes_out, "bytesIn": self.bytes_in,
+            "framesColumnar": self.frames_col,
+            "framesPickle": self.frames_pickle,
+            "decodedColumnar": self.decoded_col,
+            "decodedPickle": self.decoded_pickle,
+            "framesSplit": self.frames_split,
+            "frameBytesMean": fb.mean if fb.count else 0.0,
+            "frameBytesP99": fb.quantile(0.99) if fb.count else 0.0,
+            "frameElementsMean": fe.mean if fe.count else 0.0,
+        }
+
+
+NET_STATS = NetStats()
+
+
+# ---------------------------------------------------------------------
+# columnar wire codec
+# ---------------------------------------------------------------------
+#
+# encode_elements returns one of two forms:
+#
+#   ("pickle", [element, ...])          — universal fallback, per-batch
+#                                         pickle of the raw elements
+#   ("col", n, value_col, ts_col)       — columnar: n records, value
+#                                         column (tree), timestamp col
+#
+# value column tiers (each carries numpy arrays that ride the wire as
+# out-of-band protocol-5 buffers):
+#
+#   ("i8", int64[n])                    — Python ints within int64
+#   ("f8", float64[n])                  — Python floats
+#   ("str", int64[n+1], uint8[bytes])   — UTF-8 bytes + offsets
+#   ("tuple", [col, ...])               — one column per field,
+#                                         recursively (same arity and
+#                                         field types across the batch)
+#
+# timestamp column: None (all None) | ("i8", int64[n]) (all int) |
+# ("mask", bool[n], int64[n]) (mixed None/int via validity mask).
+#
+# Anything else — bools (must round-trip as bool, not int), ints beyond
+# int64, heterogeneous batches, watermarks/barriers/EOS — falls back to
+# pickle.  Both forms decode to a semantically identical element
+# stream; differential tests in tests/test_netchannel_codec.py hold the
+# codec to that.
+
+#: sentinel: "timestamps need pickle" (distinct from None = all-None)
+_TS_PICKLE = object()
+
+
+def _encode_value_column(vals: list):
+    """One column (tree) for a homogeneous value list, or None when the
+    values fit no columnar tier.  int64 overflow raises through to the
+    caller's pickle fallback."""
+    vt = type(vals[0])
+    if vt is int:
+        for v in vals:
+            if type(v) is not int:
+                return None
+        return ("i8", np.array(vals, np.int64))
+    if vt is float:
+        for v in vals:
+            if type(v) is not float:
+                return None
+        return ("f8", np.array(vals, np.float64))
+    if vt is str:
+        for v in vals:
+            if type(v) is not str:
+                return None
+        chunks = [v.encode("utf-8") for v in vals]
+        offsets = np.zeros(len(chunks) + 1, np.int64)
+        np.cumsum(np.fromiter((len(c) for c in chunks), np.int64,
+                              len(chunks)), out=offsets[1:])
+        return ("str", offsets, np.frombuffer(b"".join(chunks), np.uint8))
+    if vt is tuple:
+        arity = len(vals[0])
+        for v in vals:
+            if type(v) is not tuple or len(v) != arity:
+                return None
+        fields = []
+        for j in range(arity):
+            col = _encode_value_column([v[j] for v in vals])
+            if col is None:
+                return None
+            fields.append(col)
+        return ("tuple", fields)
+    return None
+
+
+def _encode_timestamps(ts: list):
+    if all(t is None for t in ts):
+        return None
+    has_none = False
+    for t in ts:
+        if t is None:
+            has_none = True
+        elif type(t) is not int:
+            return _TS_PICKLE
+    if not has_none:
+        return ("i8", np.array(ts, np.int64))
+    return ("mask",
+            np.fromiter((t is not None for t in ts), np.bool_, len(ts)),
+            np.array([0 if t is None else t for t in ts], np.int64))
+
+
 def encode_elements(batch: list):
-    """Wire record encoding (ref: SpanningRecordSerializer — the
-    typed per-record codecs of the reference's data plane).  Pure
-    StreamRecord batches of homogeneous primitives take a COLUMNAR
-    fast path (two numpy buffers instead of N pickled objects —
-    numeric shuffles dominate the keyBy exchange); everything else
-    (watermarks, barriers, EOS, composite values) rides pickle, the
-    universal Python codec."""
-    import numpy as np
+    """Wire record encoding (ref: SpanningRecordSerializer — the typed
+    per-record codecs of the reference's data plane).  Pure
+    StreamRecord batches of primitives — ints, floats, strings, and
+    tuples thereof — take the COLUMNAR path: one numpy buffer per
+    column instead of N pickled objects.  Everything else rides
+    per-batch pickle, the universal Python codec."""
+    enc = _encode_elements(batch)
+    if enc[0] == "col":
+        NET_STATS.frames_col += 1
+    else:
+        NET_STATS.frames_pickle += 1
+    return enc
 
-    from flink_tpu.streaming.elements import StreamRecord
 
-    if batch and all(type(el) is StreamRecord for el in batch):
-        vals = [el.value for el in batch]
-        vt = type(vals[0])
-        if vt in (int, float) and all(type(v) is vt for v in vals):
-            try:
-                ts = [el.timestamp for el in batch]
-                if all(t is None for t in ts):
-                    ts_arr = None
-                elif all(type(t) is int for t in ts):
-                    ts_arr = np.asarray(ts, np.int64).tobytes()
-                else:
-                    return ("pickle", batch)
-                dtype = np.int64 if vt is int else np.float64
-                return ("col", np.asarray(vals, dtype).tobytes(),
-                        np.dtype(dtype).name, ts_arr)
-            except OverflowError:
-                # arbitrary-precision ints beyond int64: pickle keeps
-                # them exact (the codec must never lose a record)
-                return ("pickle", batch)
-    return ("pickle", batch)
+def _encode_elements(batch: list):
+    if not COLUMNAR_ENABLED or not batch:
+        return ("pickle", batch)
+    for el in batch:
+        if type(el) is not StreamRecord:
+            return ("pickle", batch)
+    try:
+        col = _encode_value_column([el.value for el in batch])
+        if col is None:
+            return ("pickle", batch)
+        ts = _encode_timestamps([el.timestamp for el in batch])
+        if ts is _TS_PICKLE:
+            return ("pickle", batch)
+        return ("col", len(batch), col, ts)
+    except OverflowError:
+        # arbitrary-precision ints beyond int64: pickle keeps them
+        # exact (the codec must never lose a record)
+        return ("pickle", batch)
+
+
+def _decode_value_column(col, n: int) -> list:
+    kind = col[0]
+    if kind == "i8" or kind == "f8":
+        return col[1].tolist()
+    if kind == "str":
+        offs = col[1].tolist()
+        data = col[2].tobytes()
+        return [data[offs[i]:offs[i + 1]].decode("utf-8")
+                for i in range(n)]
+    fields = [_decode_value_column(f, n) for f in col[1]]
+    if not fields:
+        return [()] * n
+    return list(zip(*fields))
 
 
 def decode_elements(enc):
-    import numpy as np
-
-    from flink_tpu.streaming.elements import StreamRecord
-
     if enc[0] == "pickle":
+        NET_STATS.decoded_pickle += 1
         return enc[1]
-    _, val_bytes, dtype_name, ts_bytes = enc
-    vals = np.frombuffer(val_bytes, np.dtype(dtype_name))
-    cast = int if vals.dtype.kind == "i" else float
-    if ts_bytes is None:
-        return [StreamRecord(cast(v), None) for v in vals]
-    ts = np.frombuffer(ts_bytes, np.int64)
-    return [StreamRecord(cast(v), int(t)) for v, t in zip(vals, ts)]
+    NET_STATS.decoded_col += 1
+    _, n, col, ts = enc
+    values = _decode_value_column(col, n)
+    if ts is None:
+        return [StreamRecord(v) for v in values]
+    if ts[0] == "i8":
+        return [StreamRecord(v, t) for v, t in zip(values, ts[1].tolist())]
+    stamps = ts[2].tolist()
+    return [StreamRecord(v, stamps[i] if valid else None)
+            for i, (v, valid) in enumerate(zip(values, ts[1].tolist()))]
 
 
-def _send(sock: socket.socket, obj: Any, lock: threading.Lock) -> None:
-    # plain pickle, not cloudpickle: the data plane carries records
-    # (data), never code — and pickle is measurably faster
+# ---------------------------------------------------------------------
+# framing / transport
+# ---------------------------------------------------------------------
+
+class FrameOversizeError(Exception):
+    """Internal: a serialized data frame exceeded SPLIT_FRAME_BYTES and
+    the producer should split the element batch and retry (nothing has
+    hit the socket yet)."""
+
+
+def _serialize(obj: Any) -> Tuple[bytes, List[memoryview]]:
+    """Protocol-5 pickle with out-of-band buffer extraction: numpy
+    columns (and any buffer-protocol payload inside user values) come
+    back as raw memoryviews instead of being copied into the pickle
+    stream."""
+    raw: List[memoryview] = []
+    payload = pickle.dumps(obj, protocol=5,
+                           buffer_callback=lambda pb: raw.append(pb.raw()))
+    return payload, raw
+
+
+def _sendmsg_all(sock: socket.socket, segments: List) -> None:
+    """Gather-write every segment (header + payload + raw columns)
+    with no concat copy.  ``sendmsg`` may stop short mid-vector, so
+    loop; TLS sockets don't implement it and get one joined
+    ``sendall`` (the record layer copies internally anyway)."""
+    views = [v for v in (memoryview(s).cast("B") for s in segments)
+             if v.nbytes]
+    while views:
+        try:
+            sent = sock.sendmsg(views)
+        except (AttributeError, NotImplementedError):
+            sock.sendall(b"".join(views))
+            return
+        while sent:
+            head = views[0]
+            if sent >= head.nbytes:
+                sent -= head.nbytes
+                views.pop(0)
+            else:
+                views[0] = head[sent:]
+                sent = 0
+
+
+def _send(sock: socket.socket, obj: Any, lock: threading.Lock,
+          split_guard: bool = False) -> int:
+    """Serialize + ship one frame; returns wire bytes written.  With
+    `split_guard`, raises FrameOversizeError instead of sending once
+    the serialized size tops SPLIT_FRAME_BYTES, so the producer can
+    split the batch."""
     try:
         faults.fire("netchannel.send")
     except faults.FaultInjected as e:
         # surface as OSError so an injected send failure takes exactly
         # the code path a torn TCP connection would
         raise OSError(str(e)) from e
-    payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
-    with lock:
-        sock.sendall(_LEN.pack(len(payload)) + payload)
+    payload, bufs = _serialize(obj)
+    sizes = [len(payload)] + [b.nbytes for b in bufs]
+    total = sum(sizes)
+    if split_guard and total > SPLIT_FRAME_BYTES:
+        raise FrameOversizeError(total)
+    if total > MAX_FRAME or len(sizes) > _MAX_SEGMENTS:
+        raise OSError(f"data frame too large: {total} bytes in "
+                      f"{len(sizes)} segment(s)")
+    if not bufs:
+        header = _LEN.pack(total)
+        with lock:
+            sock.sendall(header + payload)
+        wire = _LEN.size + total
+    else:
+        header = (_LEN.pack(_VEC_FLAG | len(sizes))
+                  + struct.pack(f">{len(sizes)}I", *sizes))
+        with lock:
+            _sendmsg_all(sock, [header, payload, *bufs])
+        wire = len(header) + total
+    NET_STATS.frames_out += 1
+    NET_STATS.bytes_out += wire
+    NET_STATS.frame_bytes.update(wire)
+    return wire
 
 
-def _recv(sock: socket.socket) -> Optional[Any]:
+def _recv_into(sock: socket.socket, view: memoryview) -> bool:
+    pos, n = 0, view.nbytes
+    while pos < n:
+        got = sock.recv_into(view[pos:])
+        if not got:
+            return False
+        pos += got
+    return True
+
+
+def _recv(sock: socket.socket) -> Optional[Tuple[Any, int]]:
+    """One frame off the wire → (object, wire_bytes), or None on clean
+    EOF.  Vectored frames reassemble over ONE contiguous receive
+    buffer; pickle5 buffer loading rebuilds numpy columns as
+    memoryview slices of it — no per-column copy."""
     header = recv_exact(sock, _LEN.size)
     if header is None:
         return None
-    (length,) = _LEN.unpack(header)
-    if length > MAX_FRAME:
-        raise OSError(f"data frame too large: {length}")
-    payload = recv_exact(sock, length)
-    if payload is None:
+    (word,) = _LEN.unpack(header)
+    if not word & _VEC_FLAG:
+        if word > MAX_FRAME:
+            raise OSError(f"data frame too large: {word}")
+        payload = recv_exact(sock, word)
+        if payload is None:
+            return None
+        wire = _LEN.size + word
+        NET_STATS.frames_in += 1
+        NET_STATS.bytes_in += wire
+        return pickle.loads(payload), wire
+    nsegs = word & ~_VEC_FLAG
+    if not 1 <= nsegs <= _MAX_SEGMENTS:
+        raise OSError(f"bad vectored frame: {nsegs} segments")
+    table = recv_exact(sock, 4 * nsegs)
+    if table is None:
         return None
-    return pickle.loads(payload)
+    sizes = struct.unpack(f">{nsegs}I", table)
+    total = sum(sizes)
+    if total > MAX_FRAME:
+        raise OSError(f"data frame too large: {total}")
+    body = memoryview(bytearray(total))
+    if not _recv_into(sock, body):
+        return None
+    segs, off = [], 0
+    for s in sizes:
+        segs.append(body[off:off + s])
+        off += s
+    obj = pickle.loads(segs[0], buffers=segs[1:])
+    wire = _LEN.size + 4 * nsegs + total
+    NET_STATS.frames_in += 1
+    NET_STATS.bytes_in += wire
+    return obj, wire
+
+
+def _frame_budget(queue_len: int, credit_left: int) -> int:
+    """Elements for the next data frame, adapting to backlog and the
+    remaining credit window.  Shallow queues ship immediately at their
+    natural size (the latency cap: never wait for more elements); deep
+    queues spread the backlog across the credits still available so
+    the window isn't burned on base-size frames and stalled — the LAST
+    credit packs up to the ceiling, since nothing more can ship until
+    the consumer replenishes."""
+    if queue_len <= FRAME_BATCH:
+        return queue_len
+    if credit_left <= 0:
+        return min(queue_len, MAX_FRAME_BATCH)
+    share = -(-queue_len // (credit_left + 1))
+    return min(queue_len, max(FRAME_BATCH, share), MAX_FRAME_BATCH)
+
+
+def _data_frame(key: ChannelKey, batch: list, more: bool) -> dict:
+    frame = {"kind": "data", "channel": key,
+             "elements": encode_elements(batch)}
+    if more:
+        # continuation marker: this frame is a split slice of one
+        # credited batch and the consumer must NOT debit credit for it
+        frame["part"] = True
+    return frame
+
+
+def send_data_batch(sock: socket.socket, lock: threading.Lock,
+                    key: ChannelKey, batch: list,
+                    _more: bool = False) -> int:
+    """Encode + ship one credited element batch, splitting into
+    continuation frames whenever the serialized size tops
+    SPLIT_FRAME_BYTES.  Non-final parts carry ``part: True`` and the
+    consumer debits exactly ONE credit per credited batch (on the
+    final frame), so splitting never drifts the flow-control window.
+    Returns wire bytes written."""
+    if len(batch) > 1:
+        try:
+            return _send(sock, _data_frame(key, batch, _more), lock,
+                         split_guard=True)
+        except FrameOversizeError:
+            NET_STATS.frames_split += 1
+            mid = len(batch) // 2
+            n = send_data_batch(sock, lock, key, batch[:mid], _more=True)
+            return n + send_data_batch(sock, lock, key, batch[mid:],
+                                       _more=_more)
+    # a single element either fits or is a hard error — no further
+    # split is possible
+    try:
+        return _send(sock, _data_frame(key, batch, _more), lock,
+                     split_guard=True)
+    except FrameOversizeError as e:
+        raise OSError(
+            f"data frame too large: one element serializes to "
+            f"{e.args[0]} bytes, over the {SPLIT_FRAME_BYTES}-byte "
+            f"frame limit") from None
 
 
 class RemoteOutChannel:
     """Producer-side stand-in for a downstream `_InputChannel`: the
     router pushes StreamElements; a writer thread ships them.  Shape-
     compatible with `_InputChannel` where `_RouterOutput` cares
-    (`push`, `queue`, `capacity`, `blocked`, `is_feedback`)."""
+    (`push`, `push_batch`, `queue`, `capacity`, `blocked`,
+    `is_feedback`)."""
 
     __slots__ = ("key", "queue", "capacity", "blocked", "is_feedback",
-                 "credit", "sent", "closed", "_credit_lock")
+                 "credit", "sent", "bytes_out", "closed", "_credit_lock")
 
     def __init__(self, key: ChannelKey, capacity: int):
         self.key = key
@@ -151,12 +510,17 @@ class RemoteOutChannel:
         #: flow-control credit permanently and stall the channel)
         self.credit = 0
         self._credit_lock = threading.Lock()
-        #: total elements shipped (quiescence accounting)
+        #: total elements / wire bytes shipped (quiescence accounting
+        #: and the per-channel bytesOut gauge)
         self.sent = 0
+        self.bytes_out = 0
         self.closed = False
 
     def push(self, element) -> None:
         self.queue.append(element)
+
+    def push_batch(self, elements: list) -> None:
+        self.queue.extend(elements)
 
     def add_credit(self, n: int) -> None:
         with self._credit_lock:
@@ -191,9 +555,10 @@ class _ProducerConnection:
     def _read_loop(self) -> None:
         try:
             while self._running:
-                frame = _recv(self.sock)
-                if frame is None:
+                got = _recv(self.sock)
+                if got is None:
                     break
+                frame, _ = got
                 kind = frame["kind"]
                 if kind == "request":
                     # PartitionRequest: bind (or create) the channel
@@ -216,16 +581,29 @@ class _ProducerConnection:
         try:
             while self._running:
                 progressed = False
+                tracer = get_tracer()
                 for ch in list(self.channels.values()):
-                    if not ch.queue or not ch.try_take_credit():
+                    qlen = len(ch.queue)
+                    if not qlen or not ch.try_take_credit():
                         continue
+                    # ch.credit is read without the lock — a stale
+                    # value only skews the adaptive budget, never the
+                    # credit accounting itself
+                    budget = _frame_budget(qlen, ch.credit)
                     batch = []
-                    while ch.queue and len(batch) < FRAME_BATCH:
-                        batch.append(ch.queue.popleft())
+                    q = ch.queue
+                    while q and len(batch) < budget:
+                        batch.append(q.popleft())
                     ch.sent += len(batch)
-                    _send(self.sock, {"kind": "data", "channel": ch.key,
-                                      "elements": encode_elements(batch)},
-                          self.write_lock)
+                    NET_STATS.frame_elements.update(len(batch))
+                    if tracer.enabled:
+                        with tracer.span("net.frame.send",
+                                         elements=len(batch)):
+                            ch.bytes_out += send_data_batch(
+                                self.sock, self.write_lock, ch.key, batch)
+                    else:
+                        ch.bytes_out += send_data_batch(
+                            self.sock, self.write_lock, ch.key, batch)
                     progressed = True
                 if not progressed:
                     self._wake.wait(0.001)
@@ -309,6 +687,11 @@ class DataServer:
             return {k: ch.sent for k, ch in self._out_channels.items()
                     if match(k)}
 
+    def bytes_out_by_channel(self) -> Dict[str, int]:
+        with self._lock:
+            return {"/".join(map(str, k)): ch.bytes_out
+                    for k, ch in self._out_channels.items()}
+
     def _accept_loop(self) -> None:
         while self._running:
             try:
@@ -368,13 +751,16 @@ class RemoteInputBinding:
     """Consumer-side record of one subscribed channel: the local
     `_InputChannel` the elements land in + credit bookkeeping."""
 
-    __slots__ = ("key", "input_channel", "received", "granted", "lock")
+    __slots__ = ("key", "input_channel", "received", "bytes_in",
+                 "granted", "lock")
 
     def __init__(self, key: ChannelKey, input_channel):
         self.key = key
         self.input_channel = input_channel
-        #: total elements received (quiescence accounting)
+        #: total elements received (quiescence accounting) and wire
+        #: bytes (the per-channel bytesIn gauge)
         self.received = 0
+        self.bytes_in = 0
         #: credits currently announced to the producer — decremented on
         #: the read thread, topped up from the task loop; guarded so a
         #: lost update cannot overstate the window and starve the
@@ -443,21 +829,35 @@ class DataClient:
     def _read_loop(self, sock: socket.socket, address: str) -> None:
         try:
             while True:
-                frame = _recv(sock)
-                if frame is None:
+                got = _recv(sock)
+                if got is None:
                     break
+                frame, wire = got
                 if frame["kind"] != "data":
                     continue
                 binding = self._bindings.get(tuple(frame["channel"]))
                 if binding is None:
                     continue
-                elements = decode_elements(frame["elements"])
+                tracer = get_tracer()
+                if tracer.enabled:
+                    with tracer.span("net.frame.recv"):
+                        elements = decode_elements(frame["elements"])
+                else:
+                    elements = decode_elements(frame["elements"])
                 binding.received += len(elements)
-                with binding.lock:
-                    binding.granted -= 1
+                binding.bytes_in += wire
+                if not frame.get("part"):
+                    # exactly one credit per credited batch: the
+                    # continuation frames of a split batch don't debit
+                    with binding.lock:
+                        binding.granted -= 1
                 ch = binding.input_channel
-                for el in elements:
-                    ch.push(el)
+                push_batch = getattr(ch, "push_batch", None)
+                if push_batch is not None:
+                    push_batch(elements)
+                else:
+                    for el in elements:
+                        ch.push(el)
         except OSError:
             pass
 
@@ -499,6 +899,11 @@ class DataClient:
     def received_counts(self) -> Dict[ChannelKey, int]:
         with self._lock:
             return {k: b.received for k, b in self._bindings.items()}
+
+    def bytes_in_by_channel(self) -> Dict[str, int]:
+        with self._lock:
+            return {"/".join(map(str, k)): b.bytes_in
+                    for k, b in self._bindings.items()}
 
     def unsubscribe_all(self) -> None:
         with self._lock:

@@ -1273,18 +1273,69 @@ class GenericLogSessionWindows(_GenericLogEngine):
             return
         live = ts + self.gap - 1 > self.watermark
         if not live.all():
+            # merge-before-drop: the reference merges a late record
+            # with existing sessions FIRST and only drops when the
+            # MERGED window is late (WindowOperator.java:308-343,
+            # isWindowLate after mergeWindows).  A record whose own
+            # window [ts, ts+gap) is behind the watermark therefore
+            # survives when it chains — directly or through other
+            # rows within the gap — to a session that is still open.
+            # (log_windows.py:884 cannot offer this refinement: its
+            # kernel keeps no host-visible open-session rows.)
+            live |= self._revive_late(keys, ts, live)
             self.num_late_dropped += int((~live).sum())
             if not live.any():
                 return
-            keys, ts = keys[live], ts[live]
-            if values is not None:
-                values = (values[live]
-                          if isinstance(values, np.ndarray)
-                          else [v for v, ok in zip(values, live) if ok])
+            if not live.all():
+                keys, ts = keys[live], ts[live]
+                if values is not None:
+                    values = (values[live]
+                              if isinstance(values, np.ndarray)
+                              else [v for v, ok in zip(values, live)
+                                    if ok])
         cols, obj = self._prep_values(values, len(keys))
         self._n_keys.append(keys)
         self._n_ts.append(ts)
         self._n_cols.append(cols if obj is None else obj)
+
+    def _revive_late(self, keys, ts, live) -> np.ndarray:
+        """Mask of initially-late rows that still belong to an OPEN
+        session.  Anchors are every accepted open row: the retained
+        set, pending new rows, and this batch's live rows.  Rows and
+        anchors of one key are chained into components with the same
+        inclusive-touch rule the fire path uses (Δts <= gap); a late
+        row in a component that contains any anchor is revived —
+        including rows that only reach an anchor through OTHER late
+        rows (the transitive merge the reference performs session by
+        session)."""
+        out = np.zeros(len(keys), bool)
+        late_idx = np.flatnonzero(~live)
+        ak = ([self._r_keys] + list(self._n_keys) + [keys[live]])
+        at = ([self._r_ts] + list(self._n_ts) + [ts[live]])
+        ak = np.concatenate(ak)
+        if len(ak) == 0:
+            return out
+        at = np.concatenate(at)
+        allk = np.concatenate([ak, keys[late_idx]])
+        allt = np.concatenate([at, ts[late_idx]])
+        anchor = np.zeros(len(allk), bool)
+        anchor[:len(ak)] = True
+        src = np.full(len(allk), -1, np.int64)
+        src[len(ak):] = late_idx
+        order = np.lexsort((allt, allk))
+        k2, t2 = allk[order], allt[order]
+        a2, s2 = anchor[order], src[order]
+        newc = np.empty(len(k2), bool)
+        newc[0] = True
+        np.not_equal(k2[1:], k2[:-1], out=newc[1:])
+        np.logical_or(newc[1:], t2[1:] - t2[:-1] > self.gap,
+                      out=newc[1:])
+        comp = np.cumsum(newc) - 1
+        has_anchor = np.zeros(int(comp[-1]) + 1, bool)
+        np.logical_or.at(has_anchor, comp, a2)
+        revived = s2[has_anchor[comp] & (s2 >= 0)]
+        out[revived] = True
+        return out
 
     def _merge_sorted_streams(self, keys, ts, payload):
         """Merge (key,ts)-sorted retained rows with the (key,ts)-sorted

@@ -876,11 +876,15 @@ class LogStructuredSessionWindows:
         # lateness 0: an event whose own window [ts, ts+gap) has
         # end-1 <= watermark is late.  (A post-merge refinement — the
         # event might still touch a LIVE session — cannot apply here:
-        # closed sessions already fired, so accepting it would change
-        # an emitted result.  The vectorized engine keeps live-session
-        # state across the watermark and can accept those stragglers;
-        # both behaviors are within the reference's lateness-0
-        # contract, which drops by isWindowLate before merging.)
+        # the kernel keeps no host-visible open-session rows to test
+        # against, and closed sessions already fired, so accepting it
+        # could change an emitted result.  The vectorized engine DOES
+        # apply it: GenericLogSessionWindows._revive_late keeps a
+        # merge-chained straggler exactly as the reference's
+        # merge-then-isWindowLate order does, WindowOperator.java:
+        # 308-343.  This engine's stricter drop remains within the
+        # reference's lateness-0 contract for events that merge into
+        # nothing open.)
         live = ts + self.gap - 1 > self.watermark
         if not live.all():
             self.num_late_dropped += int((~live).sum())

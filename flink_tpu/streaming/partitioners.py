@@ -4,7 +4,10 @@ Re-designs flink-streaming-java/.../runtime/partitioner/ (10 files:
 KeyGroupStreamPartitioner, ForwardPartitioner, RebalancePartitioner,
 RescalePartitioner, BroadcastPartitioner, ShufflePartitioner,
 GlobalPartitioner, CustomPartitionerWrapper).  select_channels returns
-the list of target channel indices for one record.
+the list of target channel indices for one record;
+select_channels_batch is the vectorized twin the batched router fan-out
+uses — one numpy index per record, bit-identical to running
+select_channels record by record.
 """
 
 from __future__ import annotations
@@ -13,20 +16,59 @@ import abc
 import random
 from typing import Any, Callable, List, Optional
 
+import numpy as np
+
 from flink_tpu.core.functions import KeySelector
 from flink_tpu.core.keygroups import (
+    assign_operator_indexes_np,
     assign_to_key_group,
     compute_operator_index_for_key_group,
+    splitmix64_np,
+    stable_hash64,
 )
+
+
+def _routing_hashes(keys: list) -> np.ndarray:
+    """64-bit stable hash per key, EXACTLY matching `stable_hash64` —
+    the scalar routing path.  All-int key columns vectorize fully
+    (splitmix64 over an int64 array is the same masked arithmetic as
+    the scalar hash); anything else hashes per key in Python with only
+    the murmur+index math vectorized downstream.  NOTE: the 2-D tuple
+    combine in `native.vectorized.hash_keys_np` intentionally differs
+    from `stable_hash64(tuple)` and must never be used here — keyed
+    state would land on the wrong subtask."""
+    n = len(keys)
+    for k in keys:
+        if type(k) is not int:
+            return np.fromiter((stable_hash64(k) for k in keys),
+                               np.uint64, n)
+    try:
+        arr = np.array(keys, np.int64)
+    except OverflowError:
+        return np.fromiter((stable_hash64(k) for k in keys), np.uint64, n)
+    return splitmix64_np(arr)
 
 
 class StreamPartitioner(abc.ABC):
     is_broadcast = False
     is_pointwise = False
+    #: True ⇒ unicast and safe to route a whole emit batch at once via
+    #: select_channels_batch (multicast partitioners stay per-record)
+    supports_batch = False
 
     @abc.abstractmethod
     def select_channels(self, value: Any, num_channels: int) -> List[int]:
         ...
+
+    def select_channels_batch(self, values: list,
+                              num_channels: int) -> np.ndarray:
+        """One channel index per value.  Default: the scalar loop;
+        the hot partitioners (Hash/Rebalance/Rescale/Forward/Global)
+        override with vectorized math."""
+        out = np.empty(len(values), np.int64)
+        for i, v in enumerate(values):
+            out[i] = self.select_channels(v, num_channels)[0]
+        return out
 
     def setup(self, num_channels: int) -> None:  # noqa: B027
         pass
@@ -36,9 +78,13 @@ class ForwardPartitioner(StreamPartitioner):
     """Local forward, requires equal parallelism (ref: ForwardPartitioner)."""
 
     is_pointwise = True
+    supports_batch = True
 
     def select_channels(self, value, num_channels):
         return [0]
+
+    def select_channels_batch(self, values, num_channels):
+        return np.zeros(len(values), np.int64)
 
     def __repr__(self):
         return "FORWARD"
@@ -46,6 +92,8 @@ class ForwardPartitioner(StreamPartitioner):
 
 class RebalancePartitioner(StreamPartitioner):
     """Round-robin (ref: RebalancePartitioner)."""
+
+    supports_batch = True
 
     def __init__(self):
         self._next = -1
@@ -57,6 +105,13 @@ class RebalancePartitioner(StreamPartitioner):
         self._next = (self._next + 1) % num_channels
         return [self._next]
 
+    def select_channels_batch(self, values, num_channels):
+        idx = ((self._next + 1 + np.arange(len(values), dtype=np.int64))
+               % num_channels)
+        if len(values):
+            self._next = int(idx[-1])
+        return idx
+
     def __repr__(self):
         return "REBALANCE"
 
@@ -67,6 +122,7 @@ class RescalePartitioner(StreamPartitioner):
     round-robin over its subset."""
 
     is_pointwise = True
+    supports_batch = True
 
     def __init__(self):
         self._next = -1
@@ -75,12 +131,21 @@ class RescalePartitioner(StreamPartitioner):
         self._next = (self._next + 1) % num_channels
         return [self._next]
 
+    def select_channels_batch(self, values, num_channels):
+        idx = ((self._next + 1 + np.arange(len(values), dtype=np.int64))
+               % num_channels)
+        if len(values):
+            self._next = int(idx[-1])
+        return idx
+
     def __repr__(self):
         return "RESCALE"
 
 
 class ShufflePartitioner(StreamPartitioner):
     """Uniform random (ref: ShufflePartitioner)."""
+
+    supports_batch = True  # unicast; the default scalar-loop batch path
 
     def select_channels(self, value, num_channels):
         return [random.randrange(num_channels)]
@@ -93,6 +158,9 @@ class BroadcastPartitioner(StreamPartitioner):
     """All channels (ref: BroadcastPartitioner)."""
 
     is_broadcast = True
+    #: the batched router replicates a whole buffered batch to every
+    #: channel instead of fanning per record
+    broadcast_all = True
 
     def select_channels(self, value, num_channels):
         return list(range(num_channels))
@@ -104,8 +172,13 @@ class BroadcastPartitioner(StreamPartitioner):
 class GlobalPartitioner(StreamPartitioner):
     """Everything to subtask 0 (ref: GlobalPartitioner)."""
 
+    supports_batch = True
+
     def select_channels(self, value, num_channels):
         return [0]
+
+    def select_channels_batch(self, values, num_channels):
+        return np.zeros(len(values), np.int64)
 
     def __repr__(self):
         return "GLOBAL"
@@ -114,6 +187,8 @@ class GlobalPartitioner(StreamPartitioner):
 class KeyGroupStreamPartitioner(StreamPartitioner):
     """hash(key) → key group → operator index
     (ref: KeyGroupStreamPartitioner.java)."""
+
+    supports_batch = True
 
     def __init__(self, key_selector: KeySelector, max_parallelism: int):
         self.key_selector = key_selector
@@ -124,6 +199,12 @@ class KeyGroupStreamPartitioner(StreamPartitioner):
         kg = assign_to_key_group(key, self.max_parallelism)
         return [compute_operator_index_for_key_group(
             self.max_parallelism, num_channels, kg)]
+
+    def select_channels_batch(self, values, num_channels):
+        get_key = self.key_selector.get_key
+        hashes = _routing_hashes([get_key(v) for v in values])
+        return assign_operator_indexes_np(hashes, self.max_parallelism,
+                                          num_channels)
 
     def __repr__(self):
         return "HASH"

@@ -474,6 +474,18 @@ class _CompositeAgg(_AggBase):
 
     def __init__(self, parts):
         self.parts = parts  # [(agg_fn, input_fn)]
+        # a composite whose every sub-accumulator is a plain number
+        # presents a flat numeric list and may still lift; any
+        # sketch/object sub-accumulator conclusively pins the
+        # per-record scalar path — declare that (the force_scalar
+        # opt-out the pre-flight linter honors) so a deliberate plan
+        # choice doesn't surface as an FT181 warning on every run
+        try:
+            self.force_scalar = any(
+                not isinstance(a.create_accumulator(), (int, float))
+                for a, _ in parts)
+        except Exception:  # noqa: BLE001 — probing must never fail a plan
+            self.force_scalar = False
 
     def create_accumulator(self):
         return [a.create_accumulator() for a, _ in self.parts]

@@ -1,0 +1,37 @@
+#!/usr/bin/env bash
+# One-command local CI gate (documented in README "CI"):
+#   1. repo-wide pre-flight lint (scripts/lint_repo.sh: graph lint +
+#      UDF liftability over examples/, unused-import sweep)
+#   2. strict graph lint — warnings promoted to failures
+#   3. the tier-1 test suite (everything not marked slow)
+#
+# Stages keep running after a failure so one report covers
+# everything; rc is non-zero if ANY stage failed.
+#
+# Usage: scripts/ci_check.sh  (from the repo root; rc 0 = clean)
+set -uo pipefail
+cd "$(dirname "$0")/.."
+
+export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
+rc=0
+
+echo "== stage 1/3: repo lint =="
+scripts/lint_repo.sh || rc=1
+
+echo
+echo "== stage 2/3: strict graph lint over examples/ =="
+python -m flink_tpu lint --strict examples/ || rc=1
+
+echo
+echo "== stage 3/3: tier-1 test suite =="
+python -m pytest tests/ -q -m 'not slow' \
+    --continue-on-collection-errors \
+    -p no:cacheprovider -p no:xdist -p no:randomly || rc=1
+
+echo
+if [ "$rc" -eq 0 ]; then
+    echo "ci_check: ALL STAGES PASSED"
+else
+    echo "ci_check: FAILURES (see stages above)"
+fi
+exit $rc

@@ -228,6 +228,56 @@ def test_late_records_dropped():
     assert len(eng.emitted) == 2  # nothing new fired
 
 
+def test_session_late_record_merging_into_open_session_survives():
+    """Merge-before-drop (ref: WindowOperator.java:308-343 — a late
+    record merges with existing sessions FIRST; only a merged window
+    behind the watermark is dropped): a straggler within the gap of an
+    open session is accepted and extends it backwards."""
+    eng = GenericLogSessionWindows(MeanMax(), 10)
+    eng.process_batch(np.array([1, 1]), np.array([100, 108], np.int64),
+                      np.array([1.0, 2.0]))
+    eng.advance_watermark(105)  # session open: last ts 108
+    # ts=95: own window [95,105) is late, but |100-95| <= gap
+    eng.process_batch(np.array([1]), np.array([95], np.int64),
+                      np.array([9.0]))
+    assert eng.num_late_dropped == 0
+    eng.advance_watermark(200)
+    assert [(k, s, e) for k, _, s, e in eng.emitted] == [(1, 95, 118)]
+    np.testing.assert_allclose(eng.emitted[0][1][1], 9.0)  # max
+
+
+def test_session_late_record_chains_transitively():
+    """A late row that only reaches an open session through ANOTHER
+    late row in the same batch is revived too (the reference merges
+    session by session until a fixpoint)."""
+    eng = GenericLogSessionWindows(MeanMax(), 10)
+    eng.process_batch(np.array([1]), np.array([110], np.int64),
+                      np.array([1.0]))
+    eng.advance_watermark(112)
+    # 92 -> 101 (gap 9) -> 110 (gap 9): both late on their own horizon
+    eng.process_batch(np.array([1, 1]), np.array([92, 101], np.int64),
+                      np.array([2.0, 3.0]))
+    assert eng.num_late_dropped == 0
+    eng.advance_watermark(300)
+    assert [(k, s, e) for k, _, s, e in eng.emitted] == [(1, 92, 120)]
+
+
+def test_session_late_record_without_open_session_still_drops():
+    eng = GenericLogSessionWindows(MeanMax(), 10)
+    eng.process_batch(np.array([1]), np.array([100], np.int64),
+                      np.array([1.0]))
+    eng.advance_watermark(105)
+    # too far behind the open session (gap 20 > 10)
+    eng.process_batch(np.array([1]), np.array([80], np.int64),
+                      np.array([5.0]))
+    # an open session for ANOTHER key never revives
+    eng.process_batch(np.array([2]), np.array([95], np.int64),
+                      np.array([5.0]))
+    assert eng.num_late_dropped == 2
+    eng.advance_watermark(300)
+    assert [(k, s, e) for k, _, s, e in eng.emitted] == [(1, 100, 110)]
+
+
 def test_snapshot_restore_mid_window():
     keys, ts, vals = _stream(n=3000)
     agg = MeanMax()

@@ -508,9 +508,14 @@ def test_wire_codec_columnar_and_fallback():
     assert [r.value for r in decode_elements(enc)] == \
         [r.value for r in batch]
 
-    # mixed elements (watermarks/composites) -> pickle fallback
-    for batch in ([StreamRecord((1, 2), 5)],
-                  [StreamRecord(1, 5), Watermark(9)],
+    # tuples of primitives -> columnar (one column per field)
+    batch = [StreamRecord((i, f"s{i}", i * 0.5), i) for i in range(10)]
+    enc = encode_elements(batch)
+    assert enc[0] == "col"
+    assert decode_elements(enc) == batch
+
+    # mixed elements (watermarks/non-record controls) -> pickle fallback
+    for batch in ([StreamRecord(1, 5), Watermark(9)],
                   [MAX_WATERMARK],
                   []):
         enc = encode_elements(batch)
