@@ -10,6 +10,10 @@ bin/flink script).
                                    [--trace-out F]   attached; write a
                                                      Chrome trace-event
                                                      file + span summary
+    python -m flink_tpu top <rest-url>               live per-vertex view of
+                                   [--job NAME]      a running job (records/s,
+                                   [--interval S]    backpressure, watermark
+                                   [--once]          lag, last checkpoint)
     python -m flink_tpu list --master H:P            list cluster jobs
     python -m flink_tpu cancel --master H:P <job>    cancel a running job
                                    [-s DIR]          ... with a savepoint
@@ -84,6 +88,8 @@ def main(argv=None) -> int:
         return _jobmanager(rest)
     if verb == "taskmanager":
         return _taskmanager(rest)
+    if verb == "top":
+        return _top(rest)
     if verb == "list":
         return _list(rest)
     if verb == "cancel":
@@ -93,8 +99,8 @@ def main(argv=None) -> int:
     if verb == "stop":
         return _stop(rest)
     print(f"unknown command {verb!r}; "
-          f"try: run | lint | profile | list | cancel | savepoint | stop "
-          f"| info | bench | jobmanager | taskmanager",
+          f"try: run | lint | profile | top | list | cancel | savepoint "
+          f"| stop | info | bench | jobmanager | taskmanager",
           file=sys.stderr)
     return 2
 
@@ -230,6 +236,137 @@ def _profile(rest) -> int:
                   f"total={s['total_ms']:.1f}ms p99={s['p99_ms']:.3f}ms",
                   file=sys.stderr)
     return 0
+
+
+def _top_fetch(base, path):
+    import json as _json
+    import urllib.request
+    with urllib.request.urlopen(base + path, timeout=5.0) as resp:
+        return _json.loads(resp.read().decode())
+
+
+def _top_rows(job, detail, metrics, prev, dt_s):
+    """One table row per vertex: records/s (Δ numRecordsOut across the
+    vertex's subtasks between refreshes), worst backpressure, max
+    watermarkLag."""
+    rows = []
+    for v in detail.get("vertices") or []:
+        prefix = f"{job}.{v['id']}_"
+        out_now = sum(val for k, val in metrics.items()
+                      if k.startswith(prefix) and k.endswith(".numRecordsOut")
+                      and isinstance(val, (int, float)))
+        out_prev = sum(val for k, val in prev.items()
+                       if k.startswith(prefix) and k.endswith(".numRecordsOut")
+                       and isinstance(val, (int, float))) if prev else None
+        rate = ((out_now - out_prev) / dt_s
+                if out_prev is not None and dt_s > 0 else None)
+        lags = [val for k, val in metrics.items()
+                if k.startswith(prefix) and k.endswith(".watermarkLag")
+                and isinstance(val, (int, float))]
+        bp = (detail.get("backpressure") or {}).get(str(v["id"])) or {}
+        rows.append({
+            "id": v["id"], "name": v["name"],
+            "parallelism": v.get("parallelism"),
+            "records_per_s": rate,
+            "bp_ratio": bp.get("max_ratio"), "bp_level": bp.get("level"),
+            "watermark_lag_ms": max(lags) if lags else None,
+        })
+    return rows
+
+
+def _top_render(job, status, rows, checkpoints, alerts) -> str:
+    def fmt(v, spec="{:.0f}", dash="-"):
+        return dash if v is None else spec.format(v)
+
+    lines = [f"job: {job}  [{status}]",
+             f"{'id':>4}  {'vertex':<36} {'par':>3}  {'rec/s':>10}  "
+             f"{'backpressure':<18} {'wmLag ms':>10}"]
+    for r in rows:
+        bp = "-"
+        if r["bp_ratio"] is not None:
+            bp = f"{r['bp_ratio'] * 100:5.1f}%"
+            if r["bp_level"]:
+                bp += f" ({r['bp_level']})"
+        lines.append(
+            f"{r['id']:>4}  {r['name'][:36]:<36} "
+            f"{fmt(r['parallelism'], '{:d}'):>3}  "
+            f"{fmt(r['records_per_s'], '{:,.0f}'):>10}  {bp:<18} "
+            f"{fmt(r['watermark_lag_ms'], '{:,.0f}'):>10}")
+    counts = checkpoints.get("counts") or {}
+    last = None
+    for c in checkpoints.get("history") or []:
+        if c.get("status") == "completed":
+            last = c
+    cp = (f"checkpoints: {counts.get('completed', 0)} completed, "
+          f"{counts.get('failed', 0)} failed")
+    if last is not None:
+        cp += (f"; last #{last['id']} "
+               f"{fmt(last.get('duration_ms'), '{:.0f}')} ms, "
+               f"{last.get('state_bytes', 0)} B")
+    lines.append(cp)
+    firing = alerts.get("rules_firing") or []
+    lines.append(f"alerts: {alerts.get('total', 0)} total"
+                 + (f"; FIRING: {', '.join(firing)}" if firing else ""))
+    return "\n".join(lines)
+
+
+def _top(rest) -> int:
+    """Live per-vertex job view over the WebMonitor/HistoryServer REST
+    API — the `flink list -r` + web dashboard combination as a
+    terminal table (think `top` for one job)."""
+    import argparse
+    import time
+    import urllib.parse
+
+    ap = argparse.ArgumentParser(prog="flink_tpu top")
+    ap.add_argument("url", help="WebMonitor base url, e.g. "
+                                "http://127.0.0.1:8081")
+    ap.add_argument("--job", default=None,
+                    help="job name (default: first tracked job)")
+    ap.add_argument("--interval", type=float, default=1.0)
+    ap.add_argument("--once", action="store_true",
+                    help="print one snapshot and exit")
+    args = ap.parse_args(rest)
+    base = args.url.rstrip("/")
+    if "://" not in base:
+        base = "http://" + base
+
+    prev_metrics: dict = {}
+    prev_t = None
+    try:
+        while True:
+            jobs = _top_fetch(base, "/jobs")
+            job = args.job or (sorted(jobs) or [None])[0]
+            if job is None:
+                print("(no tracked jobs)")
+                return 0
+            q = urllib.parse.quote(job, safe="")
+            detail = _top_fetch(base, f"/jobs/{q}/detail")
+            metrics = _top_fetch(base, f"/jobs/{q}/metrics")
+            checkpoints = _top_fetch(base, f"/jobs/{q}/checkpoints")
+            alerts = _top_fetch(base, f"/jobs/{q}/alerts")
+            now = time.monotonic()
+            if args.once and prev_t is None:
+                # rates need two samples: take a quick second one
+                prev_metrics, prev_t = metrics, now
+                time.sleep(min(args.interval, 0.5))
+                continue
+            dt = (now - prev_t) if prev_t is not None else 0.0
+            rows = _top_rows(job, detail, metrics, prev_metrics, dt)
+            out = _top_render(job, detail.get("status"), rows,
+                              checkpoints, alerts)
+            if args.once:
+                print(out)
+                return 0
+            # full-redraw refresh (clear + home), like watch(1)
+            print("\x1b[2J\x1b[H" + out, flush=True)
+            prev_metrics, prev_t = metrics, now
+            time.sleep(args.interval)
+    except KeyboardInterrupt:
+        return 0
+    except OSError as e:
+        print(f"cannot reach {base}: {e}", file=sys.stderr)
+        return 1
 
 
 def _client(master, secret=None, tls_dir=None):

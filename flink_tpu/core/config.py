@@ -274,3 +274,17 @@ class TpuOptions:
 class MetricOptions:
     REPORTERS_LIST = ConfigOptions.key("metrics.reporters").string_type().no_default_value()
     SCOPE_DELIMITER = ConfigOptions.key("metrics.scope.delimiter").string_type().default_value(".")
+    # Time-series journal (runtime/timeseries.py). Sampling is OFF unless
+    # an interval is configured; the journal then snapshots the registry
+    # into per-metric ring buffers of `metrics.history.size` samples.
+    SAMPLE_INTERVAL_MS = ConfigOptions.key(
+        "metrics.sample.interval.ms").int_type().no_default_value()
+    HISTORY_SIZE = ConfigOptions.key(
+        "metrics.history.size").int_type().default_value(1024)
+
+
+class HistoryServerOptions:
+    # When set, executors archive the finished-job bundle (summary +
+    # metrics history + checkpoint stats + alerts) for the HistoryServer.
+    ARCHIVE_DIR = ConfigOptions.key(
+        "history.archive.dir").string_type().no_default_value()

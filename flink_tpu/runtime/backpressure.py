@@ -21,6 +21,17 @@ from typing import Dict, List
 OK_THRESHOLD = 0.10
 LOW_THRESHOLD = 0.50
 
+#: how long one observed out-of-capacity moment keeps a subtask
+#: counting as blocked in the gauge read.  A truly blocked producer
+#: thread briefly shows free capacity right after the consumer pops a
+#: record and before the producer refills (its wait-loop poll needs
+#: the GIL, switch interval 5 ms) — the producer stamps
+#: `router.last_blocked_mono` while waiting, and the gauge honours
+#: stamps this recent, so a point read cannot race the refill.  Kept
+#: well under one alert window (5 samples) so a single transient
+#: blockage cannot read as sustained.
+BLOCKED_STICKY_WINDOW_S = 0.015
+
 
 def classify(ratio: float) -> str:
     if ratio < OK_THRESHOLD:
@@ -69,16 +80,27 @@ def sample_client(client, num_samples: int = 20,
 def register_backpressure_gauges(vertex_group, subtasks: List) -> None:
     """Publish the vertex's backpressure classification as gauges
     (``backpressure.ratio`` numeric + ``backpressure.level`` string).
-    Read-time sampling is a single pass over the capacity predicate —
-    cheap enough for every metrics dump; callers wanting the smoothed
-    N-sample window keep using :func:`sample_backpressure`."""
+    Read-time sampling is a single pass over the capacity predicate
+    plus the producers' recent-blockage stamps (the
+    ``backPressuredTimeMsPerSecond`` idea: time-aware, not a racy
+    instant) — cheap enough for every metrics dump; callers wanting
+    the smoothed N-sample window keep using
+    :func:`sample_backpressure`."""
     group = vertex_group.add_group("backpressure")
 
     def ratio() -> float:
         if not subtasks:
             return 0.0
-        blocked = sum(1 for st in subtasks
-                      if not st.router.has_capacity())
+        now = _time.monotonic()
+        blocked = 0
+        for st in subtasks:
+            router = st.router
+            if not router.has_capacity():
+                router.last_blocked_mono = now
+                blocked += 1
+            elif (now - getattr(router, "last_blocked_mono", 0.0)
+                    < BLOCKED_STICKY_WINDOW_S):
+                blocked += 1
         return blocked / len(subtasks)
 
     group.gauge("ratio", ratio)

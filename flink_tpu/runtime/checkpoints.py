@@ -385,7 +385,10 @@ class PendingCheckpoint:
 
 class CheckpointStats:
     """Per-checkpoint stats the reference tracks in
-    CheckpointStatsTracker.java: trigger→complete duration + byte size."""
+    CheckpointStatsTracker.java: trigger→complete duration, byte size,
+    per-subtask ack latency, and — for failed/aborted checkpoints —
+    the failure cause (retained, like AbstractCheckpointStats +
+    FailedCheckpointStats)."""
 
     def __init__(self, checkpoint_id: int, trigger_ms: float):
         self.checkpoint_id = checkpoint_id
@@ -395,6 +398,29 @@ class CheckpointStats:
         #: durably persisted (includes the async write)
         self.complete_ms: Optional[float] = None
         self.state_bytes = 0
+        #: "vertexId-subtaskIndex" -> ms from trigger to ack (ref:
+        #: SubtaskStateStats ack timestamps)
+        self.ack_latency_ms: Dict[str, float] = {}
+        #: why the checkpoint failed/was aborted (None while pending
+        #: or on success)
+        self.failure_cause: Optional[str] = None
+        self.failed_ms: Optional[float] = None
+
+    def record_ack(self, task_key: Tuple[int, int],
+                   latency_ms: float) -> None:
+        self.ack_latency_ms[f"{task_key[0]}-{task_key[1]}"] = latency_ms
+
+    def mark_failed(self, cause: str, now_ms: float) -> None:
+        self.failure_cause = str(cause)
+        self.failed_ms = now_ms
+
+    @property
+    def status(self) -> str:
+        if self.failure_cause is not None:
+            return "failed"
+        if self.complete_ms is not None:
+            return "completed"
+        return "in_progress"
 
     @property
     def sync_duration_ms(self) -> Optional[float]:
@@ -403,10 +429,83 @@ class CheckpointStats:
         return self.sync_ms - self.trigger_ms
 
     @property
+    def async_duration_ms(self) -> Optional[float]:
+        if self.complete_ms is None or self.sync_ms is None:
+            return None
+        return self.complete_ms - self.sync_ms
+
+    @property
+    def alignment_ms(self) -> Optional[float]:
+        """Ack spread (slowest − fastest subtask ack): the
+        coordinator-visible proxy for barrier-alignment time — the
+        fastest subtask acks as soon as its barriers meet, the slowest
+        one was still aligning for the difference."""
+        if len(self.ack_latency_ms) < 2:
+            return None
+        lats = self.ack_latency_ms.values()
+        return max(lats) - min(lats)
+
+    @property
     def duration_ms(self) -> Optional[float]:
         if self.complete_ms is None:
             return None
         return self.complete_ms - self.trigger_ms
+
+    def to_dict(self) -> dict:
+        return {
+            "id": self.checkpoint_id,
+            "status": self.status,
+            "trigger_ms": self.trigger_ms,
+            "duration_ms": self.duration_ms,
+            "sync_duration_ms": self.sync_duration_ms,
+            "async_duration_ms": self.async_duration_ms,
+            "alignment_ms": self.alignment_ms,
+            "state_bytes": self.state_bytes,
+            "ack_latency_ms": dict(self.ack_latency_ms),
+            "failure_cause": self.failure_cause,
+        }
+
+
+def checkpoint_stats_payload(coordinator, completed_base: int = 0) -> dict:
+    """The `/jobs/<name>/checkpoints` payload: full retained history
+    plus a percentile summary over the completed ones (ref:
+    CheckpointStatsHistory + CompletedCheckpointStatsSummary behind
+    the /checkpoints REST handler)."""
+    from flink_tpu.runtime.timeseries import rollup
+
+    stats = getattr(coordinator, "stats", {}) or {}
+    history = [stats[cid].to_dict() for cid in sorted(stats)]
+    completed = [h for h in history if h["status"] == "completed"]
+    ack_latencies = [lat for h in completed
+                     for lat in h["ack_latency_ms"].values()]
+    summary = {
+        "count": len(completed),
+        "duration_ms": rollup(
+            [h["duration_ms"] for h in completed]),
+        "sync_duration_ms": rollup(
+            [h["sync_duration_ms"] for h in completed
+             if h["sync_duration_ms"] is not None]),
+        "async_duration_ms": rollup(
+            [h["async_duration_ms"] for h in completed
+             if h["async_duration_ms"] is not None]),
+        "state_bytes": rollup(
+            [h["state_bytes"] for h in completed]),
+        "ack_latency_ms": rollup(ack_latencies),
+    }
+    return {
+        "counts": {
+            "completed": completed_base
+            + getattr(coordinator, "completed_count", 0),
+            "failed": getattr(coordinator, "failed_count", 0),
+            "aborted": getattr(coordinator, "aborted_count", 0),
+            "timeout_aborts": getattr(coordinator, "timeout_aborts", 0),
+            "in_progress": len(getattr(coordinator, "pending", {}) or {}),
+        },
+        "latest_completed_id": getattr(
+            coordinator, "latest_completed_id", None),
+        "summary": summary,
+        "history": history,
+    }
 
 
 class SavepointRequest:
@@ -658,6 +757,9 @@ class CheckpointCoordinator:
         if pc is None:
             return  # late ack of an aborted checkpoint
         pc.acknowledge(task_key, snapshot)
+        st = self.stats.get(checkpoint_id)
+        if st is not None and task_key in pc.acks:
+            st.record_ack(task_key, self._clock() - st.trigger_ms)
         if pc.fully_acknowledged:
             self._complete(pc)
 
@@ -672,6 +774,9 @@ class CheckpointCoordinator:
                 "savepoint declined: a source already finished"))
         if pc is not None:
             self.aborted_count += 1
+            st = self.stats.get(checkpoint_id)
+            if st is not None:
+                st.mark_failed("declined", self._clock())
             self._register_failure(RuntimeError(
                 f"checkpoint {checkpoint_id} declined"))
 
@@ -697,6 +802,9 @@ class CheckpointCoordinator:
                 f"checkpoint {cid} expired after "
                 f"{self.checkpoint_timeout_ms}ms "
                 f"({len(pc.acks)}/{len(pc.expected)} acks)")
+            st = self.stats.get(cid)
+            if st is not None:
+                st.mark_failed(str(err), now)
             if req is not None:
                 req.fail(err)
             self._register_failure(err)
@@ -801,8 +909,12 @@ class CheckpointCoordinator:
             # outright: silent checkpoint stalls would let 2PC sinks
             # commit against an ever-staler recovery point.  _finish
             # always runs on the loop thread (sync path or drained),
-            # so a raise surfaces as a task/job failure
-            self.stats.pop(pc.checkpoint_id, None)
+            # so a raise surfaces as a task/job failure.  The stats
+            # entry is RETAINED with its cause — failed checkpoints
+            # are part of the history the REST layer serves
+            st = self.stats.get(pc.checkpoint_id)
+            if st is not None:
+                st.mark_failed(f"{type(err).__name__}: {err}", now)
             if req is not None:
                 req.fail(err)
             if self.tolerable_checkpoint_failures is None:

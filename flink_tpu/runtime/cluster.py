@@ -345,14 +345,22 @@ class Dispatcher(RpcEndpoint):
         if self._ha_store is not None:
             self._ha_store.remove(job_id)
         if self.archive_dir is not None:
-            from flink_tpu.runtime.history import FsJobArchivist
-            FsJobArchivist.archive(self.archive_dir, job_id, {
-                "job_name": snapshot.get("job_name"),
-                "state": snapshot.get("state"),
-                "restarts": snapshot.get("restarts"),
-                "checkpoints_completed":
-                    snapshot.get("checkpoints_completed"),
-            })
+            from flink_tpu.runtime.history import (
+                FsJobArchivist,
+                build_archive_summary,
+            )
+            FsJobArchivist.archive(
+                self.archive_dir, job_id,
+                build_archive_summary(
+                    snapshot.get("job_name"), snapshot.get("state"),
+                    restarts=snapshot.get("restarts") or 0,
+                    checkpoints_completed=snapshot.get(
+                        "checkpoints_completed") or 0,
+                    metrics=master._last_metrics,
+                    journal=master.journal, evaluator=master.health,
+                    coordinator=master._last_coordinator,
+                    checkpoints_base=master._coordinator_base,
+                    exceptions=master.exception_history))
 
     def request_job_status(self, job_id: str) -> dict:
         master = self._masters.get(job_id)
@@ -420,7 +428,8 @@ class JobMaster(RpcEndpoint):
     ExecutionGraph future pipeline on the JM main thread."""
 
     RPC_METHODS = ("acknowledge_checkpoint", "decline_checkpoint",
-                   "update_task_execution_state", "fetch_restore_state")
+                   "update_task_execution_state", "fetch_restore_state",
+                   "report_metrics")
 
     def __init__(self, job_id: str, blob_key: str, graph_blob: bytes,
                  job_config: dict, rpc_service: RpcService):
@@ -448,6 +457,27 @@ class JobMaster(RpcEndpoint):
         self.exception_history: List[dict] = []
         self._ack_queue: deque = deque()
         self._failure_queue: deque = deque()
+        #: metrics samples shipped by TaskExecutors (report_metrics);
+        #: drained into the journal by the driver's supervise loop —
+        #: the cross-process leg of the MetricsJournal plane
+        self._metrics_queue: deque = deque()
+        self.journal = None
+        self.health = None
+        self._last_metrics: Optional[dict] = None
+        self._last_coordinator: Optional[CheckpointCoordinator] = None
+        self._coordinator_base = 0
+        if job_config.get("sample_interval_ms") is not None:
+            from flink_tpu.runtime.timeseries import (
+                HealthEvaluator,
+                MetricsJournal,
+            )
+            self.journal = MetricsJournal(
+                interval_ms=job_config["sample_interval_ms"],
+                history_size=job_config.get("metrics_history_size", 1024))
+            self.health = HealthEvaluator(
+                self.journal,
+                coordinator_supplier=lambda: (self._live_coordinator
+                                              or self._last_coordinator))
         self._driver: Optional[threading.Thread] = None
         self._gateways: Dict[str, Any] = {}
         #: the running attempt's coordinator (live metrics view)
@@ -507,6 +537,12 @@ class JobMaster(RpcEndpoint):
                                     error_blob: bytes) -> None:
         """A task failed on its TaskExecutor (ref: JobMaster.java:440)."""
         self._failure_queue.append((attempt, task_key, error_blob))
+
+    def report_metrics(self, attempt: int, t_wall_ms: float,
+                       metrics: dict) -> None:
+        """A TaskExecutor shipped one metrics-registry dump at its
+        sampling cadence; the supervise loop journals it."""
+        self._metrics_queue.append((attempt, t_wall_ms, metrics))
 
     def fetch_restore_state(self, attempt: int, task_keys) -> dict:
         """Local-recovery miss path: serve the restore snapshots for
@@ -719,6 +755,8 @@ class JobMaster(RpcEndpoint):
                         "channel_capacity", DEFAULT_CHANNEL_CAPACITY),
                     "restore": restore,
                     "restore_refs": restore_refs,
+                    "sample_interval_ms": self.job_config.get(
+                        "sample_interval_ms"),
                     "jm_address": self._rpc.address,
                     "jm_name": self.name,
                 }
@@ -781,6 +819,7 @@ class JobMaster(RpcEndpoint):
             ids = storage.checkpoint_ids()
             if ids:
                 coordinator._id_counter = ids[-1]
+            self._coordinator_base = self.checkpoints_completed
             self._live_coordinator = coordinator
 
         def drain_acks():
@@ -792,6 +831,18 @@ class JobMaster(RpcEndpoint):
                     coordinator.acknowledge(task_key, cid, snapshot)
                 else:
                     coordinator.decline(cid)
+
+        def drain_metrics():
+            ingested = False
+            while self._metrics_queue:
+                att, t_wall_ms, dump = self._metrics_queue.popleft()
+                if att != attempt or self.journal is None:
+                    continue
+                self.journal.ingest(t_wall_ms, dump)
+                self._last_metrics = dump
+                ingested = True
+            if ingested and self.health is not None:
+                self.health.evaluate()
 
         def poll_statuses() -> List[dict]:
             statuses = []
@@ -811,6 +862,7 @@ class JobMaster(RpcEndpoint):
                     if att == attempt:
                         raise cloudpickle.loads(error_blob)
                 drain_acks()
+                drain_metrics()
                 if coordinator is not None:
                     coordinator.maybe_trigger()
                 now = _time.monotonic()
@@ -827,6 +879,9 @@ class JobMaster(RpcEndpoint):
                         break
         finally:
             if coordinator is not None:
+                # keep the final coordinator for the post-mortem
+                # archive (checkpoint stats outlive the attempt)
+                self._last_coordinator = coordinator
                 self._live_coordinator = None
                 try:
                     coordinator.drain()  # land in-flight async writes
@@ -840,6 +895,7 @@ class JobMaster(RpcEndpoint):
                 coordinator.fail_pending_savepoints(RuntimeError(
                     "job attempt ended before the savepoint completed"))
         drain_acks()
+        drain_metrics()
 
         # ---- end-of-job phases: workers stopped, endpoint-threaded --
         for entry in tm_entries:
@@ -944,6 +1000,10 @@ class _JobAttempt:
         self._paused = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self.jm_gateway = None
+        #: metrics shipping cadence (None = sampling disabled); set at
+        #: submit_tasks from the TDD, registry is the TaskExecutor's
+        self.sample_interval_ms: Optional[int] = None
+        self.metrics_registry = None
 
     def assign(self, st: SubtaskInstance) -> None:
         self.subtasks.append(st)
@@ -963,6 +1023,9 @@ class _JobAttempt:
         self._thread.start()
 
     def _run(self, data_server: DataServer) -> None:
+        interval = self.sample_interval_ms
+        next_sample = (_time.monotonic() * 1000.0 + interval
+                       if interval else None)
         try:
             while not self._stop.is_set():
                 if self._pause.is_set():
@@ -1003,6 +1066,16 @@ class _JobAttempt:
                     raise self.data_client.error
                 self.data_client.replenish_credits()
                 data_server.wake()
+                if next_sample is not None:
+                    now_ms = _time.monotonic() * 1000.0
+                    if now_ms >= next_sample:
+                        next_sample = now_ms + interval
+                        try:  # fire-and-forget: sampling never fails
+                            self.jm_gateway.tell.report_metrics(
+                                self.attempt, _time.time() * 1000.0,
+                                self.metrics_registry.dump())
+                        except Exception:  # noqa: BLE001
+                            pass
                 if not progress:
                     _time.sleep(0.0002)
         except BaseException as e:  # noqa: BLE001
@@ -1124,6 +1197,8 @@ class TaskExecutor(RpcEndpoint):
         att = _JobAttempt(job_id, attempt, tls=self.tls)
         att.master_epoch = epoch
         att.jm_gateway = self._rpc.connect(tdd["jm_address"], tdd["jm_name"])
+        att.sample_interval_ms = tdd.get("sample_interval_ms")
+        att.metrics_registry = self.metrics
         mine: Set[Tuple[int, int]] = {tuple(a) for a in tdd["assignments"]}
         job_group = self.metrics.job_group(job_graph.job_name)
         for vid, vertex in job_graph.vertices.items():
@@ -1520,7 +1595,9 @@ class RemoteExecutor:
                  channel_capacity: int = DEFAULT_CHANNEL_CAPACITY,
                  metric_registry=None, latency_interval_ms=None,
                  secret: Optional[str] = None,
-                 ha_dir: Optional[str] = None, tls=None):
+                 ha_dir: Optional[str] = None, tls=None,
+                 sample_interval_ms: Optional[int] = None,
+                 metrics_history_size: int = 1024):
         assert jm_address is not None or ha_dir is not None
         self.ha_dir = ha_dir
         self.jm_address = jm_address
@@ -1529,6 +1606,10 @@ class RemoteExecutor:
         self.restart_strategy_config = restart_strategy or {"strategy": "none"}
         self.channel_capacity = channel_capacity
         self.metrics = metric_registry or MetricRegistry()
+        #: forwarded to the JobMaster: the metrics journal + health
+        #: plane run master-side, fed over report_metrics RPC
+        self.sample_interval_ms = sample_interval_ms
+        self.metrics_history_size = metrics_history_size
         self._rpc = RpcService(secret=secret, tls=tls)
 
     def execute(self, job_graph: JobGraph) -> JobExecutionResult:
@@ -1556,6 +1637,8 @@ class RemoteExecutor:
             "max_parallelism": self.max_parallelism,
             "restart_strategy": self.restart_strategy_config,
             "channel_capacity": self.channel_capacity,
+            "sample_interval_ms": self.sample_interval_ms,
+            "metrics_history_size": self.metrics_history_size,
         }
         return dispatcher.sync.submit_job(cloudpickle.dumps(job_graph),
                                           config)

@@ -8,15 +8,21 @@ standalone process that scans archive directories and serves them
 over HTTP).  Here:
 
 - `FsJobArchivist.archive(path, job_summary)` writes one JSON file
-  per finished job (atomic rename);
+  per finished job (atomic rename); `build_archive_summary` assembles
+  the full post-mortem bundle — final metrics snapshot, metrics
+  time-series journal, checkpoint stats history + summary, health
+  alerts, and the Chrome trace export when tracing was on — shared by
+  every executor so the bundles cannot diverge;
 - `HistoryServer` scans one or more archive directories, caches the
-  summaries, and serves `/jobs`, `/jobs/<id>`, `/overview` over a
-  threaded HTTP server — the same route shapes as the live
-  WebMonitor (runtime/rest.py), so dashboards can point at either.
+  summaries, and serves `/jobs`, `/jobs/<id>`, `/overview` plus the
+  per-job sub-routes `/metrics`, `/metrics/history`, `/checkpoints`,
+  `/alerts`, `/traces`, `/exceptions` over a threaded HTTP server —
+  the same route shapes (and error bodies) as the live WebMonitor
+  (runtime/rest.py), so dashboards can point at either.
 
 Executors archive automatically when `history.archive.dir` is set on
-the environment's Configuration (CheckpointingOptions-style typed
-key, core/config.py).
+the environment's Configuration (HistoryServerOptions.ARCHIVE_DIR,
+core/config.py).
 """
 
 from __future__ import annotations
@@ -27,6 +33,53 @@ import threading
 import time as _time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, List, Optional
+
+
+def build_archive_summary(job_name: str, state: str,
+                          restarts: int = 0,
+                          checkpoints_completed: int = 0,
+                          registry=None, metrics=None,
+                          journal=None, evaluator=None,
+                          coordinator=None, checkpoints_base: int = 0,
+                          exceptions=None) -> dict:
+    """Assemble the post-mortem REST bundle for one finished job (ref:
+    FsJobArchivist.archiveJob collecting every JsonArchivist's
+    responses).  Every field mirrors what the live WebMonitor serves
+    so the HistoryServer routes return identical data.  Pass either a
+    live `registry` or an already-dumped `metrics` dict (the cluster
+    Dispatcher only holds shipped dumps, not a registry)."""
+    summary: dict = {
+        "job_name": job_name,
+        "state": state,
+        "restarts": restarts,
+        "checkpoints_completed": checkpoints_completed,
+    }
+    if metrics is None and registry is not None:
+        metrics = registry.dump()
+    if metrics is not None:
+        summary["metrics"] = metrics
+    if journal is not None:
+        summary["metrics_history"] = journal.to_payload()
+    if evaluator is not None:
+        summary["alerts"] = {
+            "alerts": evaluator.snapshot_alerts(),
+            "total": evaluator.alerts_total,
+            "rules_firing": evaluator.active_rules,
+        }
+    if coordinator is not None:
+        from flink_tpu.runtime.checkpoints import checkpoint_stats_payload
+        summary["checkpoints"] = checkpoint_stats_payload(
+            coordinator, checkpoints_base)
+    if exceptions:
+        summary["exceptions"] = list(exceptions)
+    try:
+        from flink_tpu.runtime.tracing import get_tracer
+        tracer = get_tracer()
+        if tracer.enabled:
+            summary["trace"] = tracer.chrome_trace()
+    except Exception:  # noqa: BLE001 — tracing must never block archiving
+        pass
+    return summary
 
 
 class FsJobArchivist:
@@ -80,14 +133,19 @@ class HistoryServer:
                 pass
 
             def do_GET(self):
+                from flink_tpu.runtime.rest import BadRequest
+                status = 200
                 try:
                     body = server._route(self.path)
-                except KeyError:
-                    self.send_response(404)
-                    self.end_headers()
-                    return
-                payload = json.dumps(body).encode()
-                self.send_response(200)
+                except KeyError as e:
+                    status = 404
+                    body = {"error": "not found: "
+                            + str(e.args[0] if e.args else self.path)}
+                except BadRequest as e:
+                    status = 400
+                    body = {"error": str(e)}
+                payload = json.dumps(body, default=str).encode()
+                self.send_response(status)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(payload)))
                 self.end_headers()
@@ -127,7 +185,26 @@ class HistoryServer:
             self._jobs = jobs
 
     # ---- routes -----------------------------------------------------
-    def _route(self, path: str):
+    @staticmethod
+    def _find(jobs: Dict[str, dict], key: str) -> dict:
+        """Archived bundles are keyed by job_id; the live WebMonitor
+        routes by job NAME — accept either so the route shapes stay
+        interchangeable."""
+        import urllib.parse
+        key = urllib.parse.unquote(key)
+        if key in jobs:
+            return jobs[key]
+        for j in jobs.values():
+            if j.get("job_name") == key:
+                return j
+        raise KeyError(f"/jobs/{key}")
+
+    def _route(self, raw_path: str):
+        import urllib.parse
+        from flink_tpu.runtime.rest import parse_history_params
+        split = urllib.parse.urlsplit(raw_path)
+        path = split.path
+        query = urllib.parse.parse_qs(split.query, keep_blank_values=True)
         with self._lock:
             jobs = dict(self._jobs)
         if path in ("/", "/overview"):
@@ -136,8 +213,45 @@ class HistoryServer:
             return {"jobs": [
                 {"job_id": jid, "job_name": j.get("job_name"),
                  "state": j.get("state")} for jid, j in jobs.items()]}
+        if path.startswith("/jobs/") and path.endswith("/metrics/history"):
+            job = self._find(jobs, path[len("/jobs/"):-len("/metrics/history")])
+            metric, since, buckets = parse_history_params(query)
+            payload = job.get("metrics_history")
+            if payload is None:
+                return {"metric": metric, "since": since,
+                        "sample_interval_ms": None,
+                        "sampling_disabled": True, "series": {}}
+            from flink_tpu.runtime.timeseries import MetricsJournal
+            journal = MetricsJournal.from_payload(payload)
+            return journal.query(metric, since, buckets)
+        if path.startswith("/jobs/") and path.endswith("/checkpoints"):
+            job = self._find(jobs, path[len("/jobs/"):-len("/checkpoints")])
+            return job.get("checkpoints") or {
+                "counts": {"completed": job.get(
+                    "checkpoints_completed", 0) or 0,
+                    "failed": 0, "aborted": 0, "timeout_aborts": 0,
+                    "in_progress": 0},
+                "latest_completed_id": None,
+                "summary": {"count": 0}, "history": []}
+        if path.startswith("/jobs/") and path.endswith("/alerts"):
+            job = self._find(jobs, path[len("/jobs/"):-len("/alerts")])
+            return job.get("alerts") or {
+                "alerts": [], "total": 0, "rules_firing": []}
+        if path.startswith("/jobs/") and path.endswith("/metrics"):
+            job = self._find(jobs, path[len("/jobs/"):-len("/metrics")])
+            metrics = job.get("metrics") or {}
+            name = job.get("job_name") or ""
+            # live route shape: keys scoped under the job name
+            return {k: v for k, v in metrics.items()
+                    if k.startswith(name + ".")}
+        if path.startswith("/jobs/") and path.endswith("/traces"):
+            job = self._find(jobs, path[len("/jobs/"):-len("/traces")])
+            trace = job.get("trace")
+            return {"enabled": trace is not None,
+                    "trace": trace or {"traceEvents": []}}
+        if path.startswith("/jobs/") and path.endswith("/exceptions"):
+            job = self._find(jobs, path[len("/jobs/"):-len("/exceptions")])
+            return {"history": job.get("exceptions") or []}
         if path.startswith("/jobs/"):
-            jid = path[len("/jobs/"):]
-            if jid in jobs:
-                return jobs[jid]
+            return self._find(jobs, path[len("/jobs/"):])
         raise KeyError(path)

@@ -181,6 +181,11 @@ class _RouterOutput(Output):
         self.records_out_counter = None
         #: pending records awaiting the batched fan-out
         self._buf: list = []
+        #: monotonic time of the last observed out-of-capacity moment;
+        #: producer wait loops stamp it so the backpressure gauge can
+        #: report "blocked recently" instead of racing the refill
+        #: window of a blocked producer thread with a point read
+        self.last_blocked_mono = 0.0
 
     def add_route(self, partitioner, channels, side_tag=None,
                   feedback: bool = False):
@@ -550,6 +555,7 @@ class SubtaskInstance:
             return 0
         self.handle_pending_trigger()
         if not self.router.has_capacity():
+            self.router.last_blocked_mono = _time.monotonic()
             return 0
         more = self.head.user_function.emit_step(
             self.source_context(), max_records)
@@ -888,6 +894,7 @@ class _LockedSourceOutput(Output):
         # the thread can observe cancellation instead of spinning
         while (not st.router.has_capacity() and not st.closed
                and not st.cancelling):
+            st.router.last_blocked_mono = _time.monotonic()
             _time.sleep(0.0005)
         with st.emission_lock:
             st._deliver_notifications_locked()
@@ -1002,6 +1009,61 @@ class JobClient:
         self._done.set()
 
 
+def make_health_plane(metrics, sample_interval_ms: Optional[int],
+                      history_size: int, job_name: str, client):
+    """Journal + health evaluator for one job — created once per job
+    (shared across restart attempts so history survives failover).
+    Returns (None, None) when sampling is disabled, so the executor
+    loop's tick is a single None check.  Shared by LocalExecutor and
+    MiniCluster."""
+    if sample_interval_ms is None:
+        return None, None
+    from flink_tpu.runtime.timeseries import (
+        HealthEvaluator, MetricsJournal, register_health_gauges)
+    journal = MetricsJournal(metrics, interval_ms=sample_interval_ms,
+                             history_size=history_size)
+    evaluator = HealthEvaluator(
+        journal,
+        coordinator_supplier=lambda: (
+            getattr(client, "executor_state", None) or {}
+        ).get("coordinator"))
+    register_health_gauges(metrics, job_name, evaluator)
+    return journal, evaluator
+
+
+def archive_finished_job(archive_dir: Optional[str], metrics,
+                         job_graph: JobGraph, client,
+                         journal, evaluator) -> None:
+    """Write the finished job's post-mortem bundle (summary + metrics
+    + journal + checkpoint stats + alerts + trace) when archive_dir is
+    set; archiving never fails the job.  Shared by LocalExecutor and
+    MiniCluster (the cluster Dispatcher archives in _archive_job)."""
+    if archive_dir is None:
+        return
+    try:
+        from flink_tpu.runtime.history import (
+            FsJobArchivist, build_archive_summary)
+        from flink_tpu.runtime.rest import WebMonitor
+        state = getattr(client, "executor_state", None) or {}
+        result = getattr(client, "_result", None)
+        FsJobArchivist.archive(
+            archive_dir, job_graph.job_name,
+            build_archive_summary(
+                job_graph.job_name,
+                WebMonitor._job_status(client)["status"],
+                restarts=getattr(result, "restarts", 0) or 0,
+                checkpoints_completed=getattr(
+                    result, "checkpoints_completed", 0) or 0,
+                registry=metrics, journal=journal,
+                evaluator=evaluator,
+                coordinator=state.get("coordinator"),
+                checkpoints_base=state.get("checkpoints_base", 0),
+                exceptions=list(
+                    getattr(client, "exception_history", None) or [])))
+    except Exception:  # noqa: BLE001 — post-mortem only
+        pass
+
+
 class LocalExecutor:
     """Runs a JobGraph in-process with a cooperative streaming loop
     (the single-worker MiniCluster analogue)."""
@@ -1017,7 +1079,10 @@ class LocalExecutor:
                  channel_capacity: int = DEFAULT_CHANNEL_CAPACITY,
                  metric_registry=None,
                  latency_interval_ms: Optional[int] = None,
-                 failover_strategy: str = "full"):
+                 failover_strategy: str = "full",
+                 sample_interval_ms: Optional[int] = None,
+                 metrics_history_size: int = 1024,
+                 archive_dir: Optional[str] = None):
         self.state_backend = state_backend
         self.max_parallelism = max_parallelism
         self.restart_strategy_config = restart_strategy or {"strategy": "none"}
@@ -1028,6 +1093,13 @@ class LocalExecutor:
         #: "full" | "region" (ref: FailoverStrategyLoader /
         #: jobmanager.execution.failover-strategy)
         self.failover_strategy = failover_strategy
+        #: metrics time-series journal cadence (None = disabled: no
+        #: journal object exists, zero per-loop cost)
+        self.sample_interval_ms = sample_interval_ms
+        self.metrics_history_size = metrics_history_size
+        #: when set, finished jobs archive their post-mortem bundle
+        #: here for the HistoryServer (history.archive.dir)
+        self.archive_dir = archive_dir
 
     # ---- graph → subtasks ------------------------------------------
     def build_subtasks(self, job_graph: JobGraph) -> Dict[int, List[SubtaskInstance]]:
@@ -1051,6 +1123,16 @@ class LocalExecutor:
         return client
 
     # ---- job driver (with restarts) ---------------------------------
+    def _make_health_plane(self, job_name: str, client):
+        return make_health_plane(self.metrics, self.sample_interval_ms,
+                                 self.metrics_history_size, job_name,
+                                 client)
+
+    def _maybe_archive(self, job_graph: JobGraph, client,
+                       journal, evaluator) -> None:
+        archive_finished_job(self.archive_dir, self.metrics, job_graph,
+                             client, journal, evaluator)
+
     def _run_job(self, job_graph: JobGraph, client: JobClient) -> None:
         result = JobExecutionResult(job_graph.job_name)
         cp_config = job_graph.checkpoint_config
@@ -1058,6 +1140,8 @@ class LocalExecutor:
         restart = make_restart_strategy(self.restart_strategy_config)
         restore_from = initial_restore_point(job_graph)
         carryover = None
+        journal, evaluator = self._make_health_plane(
+            job_graph.job_name, client)
         regions = (compute_pipelined_regions(job_graph)
                    if self.failover_strategy == "region" else None)
         # TaskKey -> region, built once per job: per-failure lookups
@@ -1069,7 +1153,8 @@ class LocalExecutor:
             while True:
                 try:
                     self._run_attempt(job_graph, client, result, storage,
-                                      restore_from, carryover)
+                                      restore_from, carryover,
+                                      journal, evaluator)
                     client._finish(result=result)
                     return
                 except JobCancelledException:
@@ -1121,11 +1206,14 @@ class LocalExecutor:
                                         if k in failed_region}}
         except BaseException as e:  # noqa: BLE001
             client._finish(error=e)
+        finally:
+            self._maybe_archive(job_graph, client, journal, evaluator)
 
     def _run_attempt(self, job_graph: JobGraph, client: JobClient,
                      result: JobExecutionResult, storage,
                      restore_from: Optional[dict],
-                     carryover: Optional[dict] = None) -> None:
+                     carryover: Optional[dict] = None,
+                     journal=None, evaluator=None) -> None:
         subtasks = self.build_subtasks(job_graph)
         all_tasks: List[SubtaskInstance] = [
             st for v in job_graph.topological_vertices() for st in subtasks[v.id]]
@@ -1223,6 +1311,7 @@ class LocalExecutor:
             # the current coordinator's count so totals never reset
             # across restarts (same accumulation as the result object)
             "checkpoints_base": getattr(result, "_cp_base", 0),
+            "journal": journal, "health": evaluator,
         }
 
         for s in threaded_sources:
@@ -1231,7 +1320,7 @@ class LocalExecutor:
         try:
             self._loop(client, result, coordinator, ack_queue,
                        all_tasks, sources, coop_sources, threaded_sources,
-                       non_sources)
+                       non_sources, journal, evaluator)
         except TaskFailureException as tfe:
             if self.failover_strategy == "region" and not any(
                     not s.supports_stepping for s in sources):
@@ -1265,7 +1354,8 @@ class LocalExecutor:
 
     # ---- the loop ---------------------------------------------------
     def _loop(self, client, result, coordinator, ack_queue, all_tasks,
-              sources, coop_sources, threaded_sources, non_sources):
+              sources, coop_sources, threaded_sources, non_sources,
+              journal=None, evaluator=None):
         pts = self.pts
         pts_poll = getattr(pts, "fire_due", None)
         last_latency_emit = _time.monotonic()
@@ -1356,6 +1446,11 @@ class LocalExecutor:
                         cid = s.pending_trigger[0]
                         s.pending_trigger = None
                         coordinator.decline(cid)
+
+            # 4.5 metrics journal tick (two comparisons when no
+            # journal exists or none is due) + health rules on sample
+            if journal is not None and journal.maybe_sample():
+                evaluator.evaluate()
 
             # 5. termination: sources done, every queue drained, and
             # no source thread still able to produce

@@ -50,12 +50,15 @@ class Counter:
 
 
 class Gauge:
-    """Wraps a supplier (ref: flink-metrics-core Gauge<T>)."""
+    """Wraps a supplier (ref: flink-metrics-core Gauge<T>).  An optional
+    human description feeds the Prometheus `# HELP` line."""
 
-    __slots__ = ("_fn",)
+    __slots__ = ("_fn", "description")
 
-    def __init__(self, fn: Callable[[], Any]):
+    def __init__(self, fn: Callable[[], Any],
+                 description: Optional[str] = None):
         self._fn = fn
+        self.description = description
 
     def get_value(self) -> Any:
         return self._fn()
@@ -198,10 +201,11 @@ class MetricGroup:
     def counter(self, name: str) -> Counter:
         return self._register(name, Counter())
 
-    def gauge(self, name: str, fn: Callable[[], Any]) -> Gauge:
+    def gauge(self, name: str, fn: Callable[[], Any],
+              description: Optional[str] = None) -> Gauge:
         # gauges re-register on restart attempts: the new supplier
         # must win (it closes over the live coordinator/operator)
-        g = Gauge(fn)
+        g = Gauge(fn, description)
         self.metrics[name] = g
         self._registry._on_register(self, name, g)
         return g
@@ -255,12 +259,28 @@ def _metric_value(m) -> Any:
 class MetricReporter:
     """(ref: flink-metrics-core MetricReporter SPI)"""
 
+    def open(self, registry: "MetricRegistry") -> None:  # noqa: B027
+        """Called once when attached via `add_reporter` — gives the
+        reporter access to registry-level metadata (descriptions)."""
+        pass
+
     def notify_of_added_metric(self, metric, name: str,
                                group: MetricGroup) -> None:  # noqa: B027
         pass
 
     def report(self, snapshot: Dict[str, Any]) -> None:  # noqa: B027
+        """`snapshot` is either a flat metrics dict or the timestamped
+        envelope produced by `MetricRegistry.report()` — use
+        `unwrap_snapshot` to accept both."""
         pass
+
+
+def unwrap_snapshot(snapshot: Dict[str, Any]) -> Dict[str, Any]:
+    """Peel the timestamp envelope off a `report()` payload; flat
+    metric dumps pass through unchanged."""
+    if "metrics" in snapshot and "t_mono_ms" in snapshot:
+        return snapshot["metrics"]
+    return snapshot
 
 
 class JsonLinesReporter(MetricReporter):
@@ -272,8 +292,11 @@ class JsonLinesReporter(MetricReporter):
         self._stream = stream
 
     def report(self, snapshot: Dict[str, Any]) -> None:
-        line = json.dumps({"ts": _time.time(), "metrics": snapshot},
-                          default=str)
+        envelope = {"ts": _time.time(),
+                    "t_mono_ms": snapshot.get("t_mono_ms"),
+                    "t_wall_ms": snapshot.get("t_wall_ms"),
+                    "metrics": unwrap_snapshot(snapshot)}
+        line = json.dumps(envelope, default=str)
         if self._path is not None:
             with open(self._path, "a") as f:
                 f.write(line + "\n")
@@ -288,32 +311,46 @@ class PrometheusTextReporter(MetricReporter):
 
     def __init__(self):
         self._last: Dict[str, Any] = {}
+        self._registry: Optional["MetricRegistry"] = None
+
+    def open(self, registry: "MetricRegistry") -> None:
+        self._registry = registry
 
     def report(self, snapshot: Dict[str, Any]) -> None:
-        self._last = snapshot
+        self._last = unwrap_snapshot(snapshot)
 
     @staticmethod
     def _sanitize(key: str) -> str:
         return "".join(c if (c.isalnum() or c == "_") else "_" for c in key)
 
     @staticmethod
-    def _emit(lines: List[str], name: str, value) -> None:
+    def _emit(lines: List[str], name: str, value,
+              help_text: Optional[str] = None) -> None:
         if value != value:  # NaN — invalid exposition value; flag it
             lines.append(f"# flink_tpu: skipped NaN sample {name}")
             return
+        help_text = (help_text or name).replace("\\", "\\\\") \
+                                       .replace("\n", "\\n")
+        lines.append(f"# HELP {name} {help_text}")
         lines.append(f"# TYPE {name} gauge")
         lines.append(f"{name} {value}")
 
     def render(self) -> str:
         lines: List[str] = []
+        descriptions = (self._registry.descriptions
+                        if self._registry is not None else {})
         for key, value in sorted(self._last.items()):
             name = "flink_tpu_" + self._sanitize(key)
+            # registered gauges may carry a description; everything
+            # else gets the raw dotted key as its HELP text
+            help_text = descriptions.get(key, key)
             if isinstance(value, dict):
                 for sub, v in value.items():
                     if isinstance(v, (int, float)) and not isinstance(v, bool):
-                        self._emit(lines, f"{name}_{self._sanitize(sub)}", v)
+                        self._emit(lines, f"{name}_{self._sanitize(sub)}", v,
+                                   f"{help_text} ({sub})")
             elif isinstance(value, (int, float)) and not isinstance(value, bool):
-                self._emit(lines, name, value)
+                self._emit(lines, name, value, help_text)
         return "\n".join(lines) + ("\n" if lines else "")
 
 
@@ -324,12 +361,19 @@ class MetricRegistry:
     def __init__(self):
         self.root = MetricGroup(self, ())
         self.reporters: List[MetricReporter] = []
+        #: dotted metric key -> HELP description for described gauges
+        self.descriptions: Dict[str, str] = {}
 
     def add_reporter(self, reporter: MetricReporter) -> MetricReporter:
         self.reporters.append(reporter)
+        reporter.open(self)
         return reporter
 
     def _on_register(self, group: MetricGroup, name: str, metric) -> None:
+        desc = getattr(metric, "description", None)
+        if desc:
+            prefix = group.scope_string()
+            self.descriptions[f"{prefix}.{name}" if prefix else name] = desc
         for r in self.reporters:
             r.notify_of_added_metric(metric, name, group)
 
@@ -341,10 +385,17 @@ class MetricRegistry:
         return self.root.dump()
 
     def report(self) -> Dict[str, Any]:
-        snapshot = self.dump()
+        """Snapshot every metric and fan out to the reporters.  The
+        returned envelope stamps the snapshot with both clocks so
+        journal samples and reporter output align with tracer spans."""
+        envelope = {
+            "t_mono_ms": _time.monotonic() * 1000.0,
+            "t_wall_ms": _time.time() * 1000.0,
+            "metrics": self.dump(),
+        }
         for r in self.reporters:
-            r.report(snapshot)
-        return snapshot
+            r.report(envelope)
+        return envelope
 
 
 # ---------------------------------------------------------------------------

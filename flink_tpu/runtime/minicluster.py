@@ -51,10 +51,12 @@ from flink_tpu.runtime.local import (
     JobExecutionResult,
     SubtaskInstance,
     SuppressRestartsException,
+    archive_finished_job,
     assign_restore_snapshots,
     build_and_wire_subtasks,
     gather_accumulators,
     initial_restore_point,
+    make_health_plane,
 )
 from flink_tpu.runtime import faults
 from flink_tpu.runtime.metrics import (
@@ -212,7 +214,10 @@ class MiniCluster:
                  processing_time_service=None,
                  channel_capacity: int = DEFAULT_CHANNEL_CAPACITY,
                  metric_registry=None,
-                 latency_interval_ms: Optional[int] = None):
+                 latency_interval_ms: Optional[int] = None,
+                 sample_interval_ms: Optional[int] = None,
+                 metrics_history_size: int = 1024,
+                 archive_dir: Optional[str] = None):
         self.num_task_managers = num_task_managers
         self.state_backend = state_backend
         self.max_parallelism = max_parallelism
@@ -221,6 +226,11 @@ class MiniCluster:
         self.channel_capacity = channel_capacity
         self.metrics = metric_registry or MetricRegistry()
         self.latency_interval_ms = latency_interval_ms
+        #: metrics time-series journal cadence (None = disabled)
+        self.sample_interval_ms = sample_interval_ms
+        self.metrics_history_size = metrics_history_size
+        #: when set, finished jobs archive their post-mortem bundle
+        self.archive_dir = archive_dir
 
     # ---- public API -----------------------------------------------------
     def execute(self, job_graph: JobGraph) -> JobExecutionResult:
@@ -243,11 +253,14 @@ class MiniCluster:
         storage = make_checkpoint_storage(cp_config) if cp_config else None
         restart = make_restart_strategy(self.restart_strategy_config)
         restore_from = initial_restore_point(job_graph)
+        journal, evaluator = make_health_plane(
+            self.metrics, self.sample_interval_ms,
+            self.metrics_history_size, job_graph.job_name, client)
         try:
             while True:
                 try:
                     self._run_attempt(job_graph, client, result, storage,
-                                      restore_from)
+                                      restore_from, journal, evaluator)
                     client._finish(result=result)
                     return
                 except JobCancelledException:
@@ -268,11 +281,15 @@ class MiniCluster:
                     restore_from = storage.latest() if storage else None
         except BaseException as e:  # noqa: BLE001
             client._finish(error=e)
+        finally:
+            archive_finished_job(self.archive_dir, self.metrics,
+                                 job_graph, client, journal, evaluator)
 
     # ---- one attempt -------------------------------------------------------
     def _run_attempt(self, job_graph: JobGraph, client: JobClient,
                      result: JobExecutionResult, storage,
-                     restore_from: Optional[dict]) -> None:
+                     restore_from: Optional[dict],
+                     journal=None, evaluator=None) -> None:
         tms = [TaskManagerRunner(i, self.shared_pts,
                                  latency_interval_ms=self.latency_interval_ms)
                for i in range(self.num_task_managers)]
@@ -365,6 +382,7 @@ class MiniCluster:
             # live checkpoint views add the current coordinator's
             # count to this — totals survive restarts (see local.py)
             "checkpoints_base": getattr(result, "_cp_base", 0),
+            "journal": journal, "health": evaluator,
         }
 
         for s in threaded_sources:
@@ -375,7 +393,7 @@ class MiniCluster:
         try:
             self._master_loop(client, coordinator, ack_queue, tms,
                               all_tasks, sources, non_sources,
-                              threaded_sources)
+                              threaded_sources, journal, evaluator)
             gather_accumulators(all_tasks, result.accumulators)
         finally:
             if coordinator is not None:
@@ -406,13 +424,19 @@ class MiniCluster:
     def _master_loop(self, client: JobClient, coordinator, ack_queue,
                      tms: List[TaskManagerRunner],
                      all_tasks, sources, non_sources,
-                     threaded_sources) -> None:
+                     threaded_sources, journal=None,
+                     evaluator=None) -> None:
         while True:
             if client.cancel_requested:
                 raise JobCancelledException()
             for tm in tms:
                 if tm.error is not None:
                     raise tm.error
+            # metrics journal tick: the master samples the shared
+            # registry — workers publish into it in-process, so no
+            # shipping is needed here (contrast cluster.py)
+            if journal is not None and journal.maybe_sample():
+                evaluator.evaluate()
             if coordinator is not None:
                 if all(not s.finished for s in sources):
                     coordinator.maybe_trigger()

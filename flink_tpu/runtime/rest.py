@@ -3,8 +3,13 @@
 
 A small threaded HTTP server over the live MetricRegistry and job
 clients: `/jobs` (status per tracked job), `/jobs/<name>/metrics`
-(scoped dump), `/metrics` (full dump), `/metrics/prometheus`
-(text exposition via PrometheusTextReporter).  JSON out, stdlib only.
+(scoped dump), `/jobs/<name>/metrics/history` (time-series journal
+query: `?metric=<glob>&since=<wall ms>&buckets=<n>` with min/max/avg/
+p95 rollups), `/jobs/<name>/checkpoints` (full stats history +
+summary percentiles), `/jobs/<name>/alerts` (health events),
+`/metrics` (full dump), `/metrics/prometheus` (text exposition via
+PrometheusTextReporter).  JSON out, stdlib only.  Errors are JSON
+bodies: unknown routes/jobs are 404, malformed query params 400.
 """
 
 from __future__ import annotations
@@ -16,6 +21,39 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, Optional
 
 from flink_tpu.runtime.metrics import MetricRegistry, PrometheusTextReporter
+
+
+class BadRequest(Exception):
+    """Malformed query parameters — surfaces as HTTP 400."""
+
+
+def parse_history_params(query: Dict[str, list]) -> tuple:
+    """Validate `/metrics/history` query params into
+    (metric_glob, since_ms, buckets); raises BadRequest on garbage.
+    Shared by the live WebMonitor and the HistoryServer so the two
+    routes cannot diverge."""
+    metric = query.get("metric", ["*"])[0]
+    if not metric:
+        raise BadRequest("empty 'metric' glob")
+    since = None
+    if "since" in query:
+        try:
+            since = float(query["since"][0])
+        except (ValueError, TypeError):
+            raise BadRequest(
+                f"malformed 'since' (want wall-clock ms): "
+                f"{query['since'][0]!r}") from None
+    buckets = None
+    if "buckets" in query:
+        try:
+            buckets = int(query["buckets"][0])
+        except (ValueError, TypeError):
+            raise BadRequest(
+                f"malformed 'buckets' (want int): "
+                f"{query['buckets'][0]!r}") from None
+        if buckets <= 0:
+            raise BadRequest(f"'buckets' must be positive: {buckets}")
+    return metric, since, buckets
 
 #: the dashboard (ref: flink-runtime-web/web-dashboard — scaled to one
 #: dependency-free page over the JSON routes below).  Status colors
@@ -134,6 +172,7 @@ class WebMonitor:
     def __init__(self, registry: MetricRegistry, port: int = 0):
         self.registry = registry
         self.prometheus = PrometheusTextReporter()
+        self.prometheus.open(registry)  # HELP texts from descriptions
         #: job name -> JobClient
         self.jobs: Dict[str, object] = {}
         monitor = self
@@ -143,17 +182,22 @@ class WebMonitor:
                 pass
 
             def do_GET(self):
+                status = 200
                 try:
                     body, ctype = monitor._route(self.path)
-                except KeyError:
-                    self.send_response(404)
-                    self.end_headers()
-                    return
+                except KeyError as e:
+                    status = 404
+                    body = {"error": f"not found: {e.args[0] if e.args else self.path}"}
+                    ctype = "application/json"
+                except BadRequest as e:
+                    status = 400
+                    body = {"error": str(e)}
+                    ctype = "application/json"
                 payload = (body if isinstance(body, (bytes, str))
                            else json.dumps(body, default=str))
                 if isinstance(payload, str):
                     payload = payload.encode()
-                self.send_response(200)
+                self.send_response(status)
                 self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(payload)))
                 self.end_headers()
@@ -178,7 +222,14 @@ class WebMonitor:
         self.jobs[name] = client
 
     # ---- routing -----------------------------------------------------
-    def _route(self, path: str):
+    def _route(self, raw_path: str):
+        # split the query string off BEFORE dispatch — the suffix
+        # matches below must see the bare path
+        split = urllib.parse.urlsplit(raw_path)
+        path = split.path
+        # keep blanks: `?metric=` must surface as an empty glob (400),
+        # not silently fall back to the `*` default
+        query = urllib.parse.parse_qs(split.query, keep_blank_values=True)
         if path == "/web":
             return _DASHBOARD_HTML, "text/html; charset=utf-8"
         if path in ("/", "/overview"):
@@ -220,6 +271,32 @@ class WebMonitor:
             return ({"enabled": tracer.enabled,
                      "spans": tracer.recent(200),
                      "stats": tracer.stats()}, "application/json")
+        if path.startswith("/jobs/") and path.endswith("/metrics/history"):
+            job = urllib.parse.unquote(
+                path[len("/jobs/"):-len("/metrics/history")])
+            if job not in self.jobs:
+                raise KeyError(path)
+            metric, since, buckets = parse_history_params(query)
+            journal = (getattr(self.jobs[job], "executor_state", None)
+                       or {}).get("journal")
+            if journal is None:
+                return {"metric": metric, "since": since,
+                        "sample_interval_ms": None,
+                        "sampling_disabled": True,
+                        "series": {}}, "application/json"
+            return journal.query(metric, since, buckets), "application/json"
+        if path.startswith("/jobs/") and path.endswith("/checkpoints"):
+            job = urllib.parse.unquote(
+                path[len("/jobs/"):-len("/checkpoints")])
+            if job not in self.jobs:
+                raise KeyError(path)
+            return self._job_checkpoints(self.jobs[job]), "application/json"
+        if path.startswith("/jobs/") and path.endswith("/alerts"):
+            job = urllib.parse.unquote(
+                path[len("/jobs/"):-len("/alerts")])
+            if job not in self.jobs:
+                raise KeyError(path)
+            return self._job_alerts(self.jobs[job]), "application/json"
         if path.startswith("/jobs/") and path.endswith("/metrics"):
             job = urllib.parse.unquote(
                 path[len("/jobs/"):-len("/metrics")])
@@ -240,6 +317,35 @@ class WebMonitor:
                 raise KeyError(path)
             return self._job_status(self.jobs[job]), "application/json"
         raise KeyError(path)
+
+    @staticmethod
+    def _job_checkpoints(client) -> dict:
+        """Full retained checkpoint history + percentile summary (ref:
+        CheckpointingStatistics behind /jobs/:jobid/checkpoints)."""
+        from flink_tpu.runtime.checkpoints import checkpoint_stats_payload
+        state = getattr(client, "executor_state", None) or {}
+        coordinator = state.get("coordinator")
+        base = state.get("checkpoints_base", 0)
+        if coordinator is None:
+            return {"counts": {"completed": base, "failed": 0,
+                               "aborted": 0, "timeout_aborts": 0,
+                               "in_progress": 0},
+                    "latest_completed_id": None,
+                    "summary": {"count": 0},
+                    "history": []}
+        return checkpoint_stats_payload(coordinator, base)
+
+    @staticmethod
+    def _job_alerts(client) -> dict:
+        """Structured health alerts (the ROADMAP-3 autoscaler's
+        trigger feed)."""
+        state = getattr(client, "executor_state", None) or {}
+        evaluator = state.get("health")
+        if evaluator is None:
+            return {"alerts": [], "total": 0, "rules_firing": []}
+        return {"alerts": evaluator.snapshot_alerts(),
+                "total": evaluator.alerts_total,
+                "rules_firing": evaluator.active_rules}
 
     @staticmethod
     def _job_exceptions(client) -> dict:

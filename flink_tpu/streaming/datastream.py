@@ -359,6 +359,7 @@ class StreamExecutionEnvironment:
         return get_tracer()
 
     def _make_executor(self):
+        from flink_tpu.core.config import HistoryServerOptions, MetricOptions
         kw = dict(
             state_backend=self.state_backend,
             max_parallelism=self.max_parallelism,
@@ -366,10 +367,19 @@ class StreamExecutionEnvironment:
             processing_time_service=self.processing_time_service,
             latency_interval_ms=getattr(self, "latency_tracking_interval",
                                         None),
+            sample_interval_ms=self.config.get_integer(
+                MetricOptions.SAMPLE_INTERVAL_MS),
+            metrics_history_size=self.config.get_integer(
+                MetricOptions.HISTORY_SIZE),
+            archive_dir=self.config.get_string(
+                HistoryServerOptions.ARCHIVE_DIR),
         )
         if self.remote_address is not None:
             from flink_tpu.runtime.cluster import RemoteExecutor
             kw.pop("processing_time_service", None)
+            # cluster mode archives Dispatcher-side (its archive dir is
+            # a JobManagerProcess setting, not a per-job one)
+            kw.pop("archive_dir", None)
             self._last_executor = RemoteExecutor(
                 self.remote_address, secret=self.remote_secret,
                 tls=self.remote_tls, **kw)

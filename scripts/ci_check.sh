@@ -4,6 +4,9 @@
 #      UDF liftability over examples/, unused-import sweep)
 #   2. strict graph lint — warnings promoted to failures
 #   3. the tier-1 test suite (everything not marked slow)
+#   4. observability smoke — a short MiniCluster job with metric
+#      sampling (history + checkpoints routes must fill) and a seeded
+#      backpressure job that must fire exactly one health alert
 #
 # Stages keep running after a failure so one report covers
 # everything; rc is non-zero if ANY stage failed.
@@ -15,18 +18,22 @@ cd "$(dirname "$0")/.."
 export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
 rc=0
 
-echo "== stage 1/3: repo lint =="
+echo "== stage 1/4: repo lint =="
 scripts/lint_repo.sh || rc=1
 
 echo
-echo "== stage 2/3: strict graph lint over examples/ =="
+echo "== stage 2/4: strict graph lint over examples/ =="
 python -m flink_tpu lint --strict examples/ || rc=1
 
 echo
-echo "== stage 3/3: tier-1 test suite =="
+echo "== stage 3/4: tier-1 test suite =="
 python -m pytest tests/ -q -m 'not slow' \
     --continue-on-collection-errors \
     -p no:cacheprovider -p no:xdist -p no:randomly || rc=1
+
+echo
+echo "== stage 4/4: observability smoke =="
+python scripts/observability_smoke.py || rc=1
 
 echo
 if [ "$rc" -eq 0 ]; then

@@ -246,42 +246,71 @@ def test_meter_rate_zero_after_window_expires():
 
 def _parse_prometheus(text):
     """Tiny exposition-format parser for the round-trip test: returns
-    ({name: value}, {name: type}, [flag comments])."""
-    samples, types, flags = {}, {}, []
+    ({name: value}, {name: type}, {name: help}, [flag comments])."""
+    samples, types, helps, flags = {}, {}, {}, []
     for line in text.splitlines():
         if not line.strip():
             continue
         if line.startswith("# TYPE "):
             _, _, name, mtype = line.split(None, 3)
             types[name] = mtype
+        elif line.startswith("# HELP "):
+            rest = line[len("# HELP "):]
+            name, _, help_text = rest.partition(" ")
+            helps[name] = help_text
         elif line.startswith("#"):
             flags.append(line)
         else:
             name, value = line.rsplit(None, 1)
             samples[name] = float(value)
-    return samples, types, flags
+    return samples, types, helps, flags
 
 
 def test_prometheus_round_trip_types_and_nan():
     reg = MetricRegistry()
     g = reg.job_group("rt").add_group("op")
     g.counter("records").inc(42)
-    g.gauge("lag", lambda: 7.5)
+    g.gauge("lag", lambda: 7.5,
+            description="milliseconds behind the newest watermark")
     g.gauge("bad", lambda: float("nan"))
     g.gauge("label", lambda: "a-string")  # non-numeric: excluded
     rep = reg.add_reporter(PrometheusTextReporter())
     reg.report()
-    samples, types, flags = _parse_prometheus(rep.render())
+    samples, types, helps, flags = _parse_prometheus(rep.render())
     assert samples["flink_tpu_rt_op_records"] == 42.0
     assert samples["flink_tpu_rt_op_lag"] == 7.5
-    # every sample is preceded by a # TYPE comment of type gauge
+    # every sample is preceded by # TYPE gauge and a # HELP line
     for name in samples:
         assert types[name] == "gauge"
+        assert name in helps
+    # a described gauge carries its description as the HELP text
+    assert helps["flink_tpu_rt_op_lag"] == \
+        "milliseconds behind the newest watermark"
+    # undescribed families fall back to the raw dotted key
+    assert helps["flink_tpu_rt_op_records"] == "rt.op.records"
     # NaN is skipped from samples but flagged as a comment
     assert "flink_tpu_rt_op_bad" not in samples
+    assert "flink_tpu_rt_op_bad" not in helps
     assert any("skipped NaN sample flink_tpu_rt_op_bad" in f for f in flags)
     # strings never leak into the exposition
     assert "flink_tpu_rt_op_label" not in samples
+
+
+def test_report_envelope_carries_both_clocks():
+    import time as _t
+    reg = MetricRegistry()
+    reg.job_group("env-job").counter("c").inc(3)
+    before_wall = _t.time() * 1000.0
+    before_mono = _t.monotonic() * 1000.0
+    envelope = reg.report()
+    assert set(envelope) == {"t_mono_ms", "t_wall_ms", "metrics"}
+    assert before_wall <= envelope["t_wall_ms"] <= _t.time() * 1000.0
+    assert before_mono <= envelope["t_mono_ms"] <= _t.monotonic() * 1000.0
+    assert envelope["metrics"]["env-job.c"] == 3
+    # reporters can peel the envelope off; flat dumps pass through
+    from flink_tpu.runtime.metrics import unwrap_snapshot
+    assert unwrap_snapshot(envelope) == envelope["metrics"]
+    assert unwrap_snapshot({"a.b": 1}) == {"a.b": 1}
 
 
 def test_latency_stats_caches_histograms():

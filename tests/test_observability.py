@@ -1,0 +1,497 @@
+"""Metrics time-series journal, checkpoint stats tracker, health
+alerts, and the REST/HistoryServer history plane (ref: MetricStore +
+CheckpointStatsTracker + the webmonitor handlers — SURVEY.md §2.2)."""
+
+import json
+import os
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from flink_tpu.runtime.history import FsJobArchivist, HistoryServer
+from flink_tpu.runtime.metrics import MetricRegistry
+from flink_tpu.runtime.rest import WebMonitor
+from flink_tpu.runtime.timeseries import (
+    HealthEvaluator,
+    MetricsJournal,
+    rollup,
+)
+from flink_tpu.streaming.datastream import StreamExecutionEnvironment
+from flink_tpu.streaming.sources import CollectSink, SourceFunction
+
+
+def _get(port, path):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=5) as r:
+        return json.loads(r.read().decode())
+
+
+def _get_error(port, path):
+    try:
+        _get(port, path)
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read().decode())
+    raise AssertionError(f"expected HTTP error for {path}")
+
+
+def _wait_for_archive(directory, timeout=15.0):
+    """The archivist writes after the client unblocks — poll briefly."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if os.path.isdir(directory) and any(
+                not f.endswith(".part") for f in os.listdir(directory)):
+            return
+        time.sleep(0.05)
+    raise AssertionError(f"no archive appeared in {directory}")
+
+
+# ---------------------------------------------------------------------
+# journal unit tests (deterministic clocks)
+# ---------------------------------------------------------------------
+
+class _FakeClock:
+    def __init__(self, start=0.0):
+        self.t = start
+
+    def __call__(self):
+        return self.t
+
+
+def _journal_with(samples_per_key, interval_ms=10, history_size=1024):
+    """Build a journal by ingesting synthetic dumps: {key: [v, ...]}."""
+    clock, wall = _FakeClock(), _FakeClock(1_000_000.0)
+    j = MetricsJournal(interval_ms=interval_ms, history_size=history_size,
+                       clock=clock, wall_clock=wall)
+    n = max(len(v) for v in samples_per_key.values())
+    for i in range(n):
+        dump = {k: vs[i] for k, vs in samples_per_key.items()
+                if i < len(vs)}
+        j.ingest(wall.t, dump)
+        clock.t += interval_ms
+        wall.t += interval_ms
+    return j
+
+
+def test_journal_sampling_rollups_and_buckets():
+    clock, wall = _FakeClock(), _FakeClock(5_000.0)
+    registry = MetricRegistry()
+    g = registry.job_group("j").add_group("v")
+    value = {"x": 0.0}
+    g.gauge("load", lambda: value["x"])
+    j = MetricsJournal(registry, interval_ms=10, history_size=64,
+                       clock=clock, wall_clock=wall)
+
+    assert j.enabled
+    for i in range(20):
+        value["x"] = float(i)
+        assert j.maybe_sample()          # exactly due every tick
+        assert not j.maybe_sample()      # not due twice at one instant
+        clock.t += 10
+        wall.t += 10
+    assert j.samples_taken == 20
+
+    q = j.query("j.v.load")
+    entry = q["series"]["j.v.load"]
+    assert len(entry["samples"]) == 20
+    r = entry["rollup"]
+    assert r["count"] == 20 and r["min"] == 0.0 and r["max"] == 19.0
+    assert r["avg"] == pytest.approx(9.5)
+    assert r["p95"] == 19.0
+
+    # since filter: drop the first half by wall-clock
+    q2 = j.query("j.v.load", since_wall_ms=5_000.0 + 10 * 10)
+    assert q2["series"]["j.v.load"]["rollup"]["count"] == 10
+
+    # bucketed rollups cover the window and carry correct extrema
+    q3 = j.query("j.v.load", buckets=4)
+    buckets = q3["series"]["j.v.load"]["buckets"]
+    assert len(buckets) == 4
+    assert buckets[0]["min"] == 0.0
+    assert buckets[-1]["max"] == 19.0
+    total = sum(b["count"] for b in buckets)
+    assert total == 20
+
+
+def test_journal_ring_buffer_cap_and_payload_roundtrip():
+    j = _journal_with({"a.b": list(range(50))}, history_size=16)
+    assert len(j.series("a.b")) == 16          # ring buffer caps
+    assert j.latest("a.b") == 49.0
+    j2 = MetricsJournal.from_payload(j.to_payload())
+    assert j2.series("a.b") == j.series("a.b")
+    assert j2.samples_taken == j.samples_taken
+    # non-numeric values never enter the journal
+    j.ingest(0.0, {"s": "high", "flag": True, "none": None, "n": 1})
+    assert j.keys("s") == [] and j.keys("flag") == [] and j.keys("n") == ["n"]
+
+
+def test_rollup_empty_and_percentile():
+    assert rollup([]) == {"count": 0}
+    r = rollup(list(range(100)))
+    assert r["p95"] == 95
+
+
+# ---------------------------------------------------------------------
+# health rules: episode semantics
+# ---------------------------------------------------------------------
+
+def test_backpressure_alert_fires_exactly_once_per_episode():
+    clock, wall = _FakeClock(), _FakeClock(1_000.0)
+    j = MetricsJournal(interval_ms=10, clock=clock, wall_clock=wall)
+    ev = HealthEvaluator(j, bp_ratio_threshold=0.5, bp_consecutive=3,
+                         wall_clock=wall)
+
+    def feed(ratio, n):
+        for _ in range(n):
+            j.ingest(wall.t, {"job.1_v.backpressure.ratio": ratio})
+            ev.evaluate()
+            clock.t += 10
+            wall.t += 10
+
+    feed(0.2, 5)
+    assert ev.alerts_total == 0
+    feed(0.9, 10)                    # sustained: ONE alert, not 8
+    assert ev.alerts_total == 1
+    alert = ev.snapshot_alerts()[0]
+    assert alert["rule"] == "backpressure-sustained"
+    assert alert["metric"] == "job.1_v.backpressure.ratio"
+    assert "backpressure-sustained" in ev.active_rules
+    feed(0.0, 3)                     # clears -> re-arms
+    assert ev.active_rules == []
+    feed(0.9, 3)                     # second episode
+    assert ev.alerts_total == 2
+
+
+def test_watermark_lag_and_checkpoint_budget_rules():
+    clock, wall = _FakeClock(), _FakeClock(0.0)
+    j = MetricsJournal(interval_ms=10, clock=clock, wall_clock=wall)
+
+    class _Stat:
+        def __init__(self, d):
+            self.duration_ms = d
+
+    class _Coord:
+        stats = {1: _Stat(5.0), 2: _Stat(500.0)}
+
+    ev = HealthEvaluator(j, lag_consecutive=4,
+                         checkpoint_p95_budget_ms=100.0,
+                         coordinator_supplier=lambda: _Coord(),
+                         wall_clock=wall)
+    # strictly growing lag over 4 samples fires once
+    for lag in (10, 20, 30, 40, 40, 50):
+        j.ingest(wall.t, {"job.1_v.0.op-1-src.watermarkLag": lag})
+        ev.evaluate()
+        wall.t += 10
+    rules = [a["rule"] for a in ev.snapshot_alerts()]
+    assert rules.count("watermark-lag-growing") == 1
+    # p95 (500 ms) over the 100 ms budget fires once despite 6 evals
+    assert rules.count("checkpoint-duration-over-budget") == 1
+
+
+# ---------------------------------------------------------------------
+# MiniCluster end-to-end: live routes, then HistoryServer parity
+# ---------------------------------------------------------------------
+
+class _Slowish(SourceFunction):
+    def __init__(self, n=3000, delay=0.001):
+        self.n = n
+        self.delay = delay
+        self._running = True
+
+    def run(self, ctx):
+        for i in range(self.n):
+            if not self._running:
+                return
+            ctx.collect(i)
+            time.sleep(self.delay)
+
+    def cancel(self):
+        self._running = False
+
+
+def test_minicluster_history_checkpoints_alerts_routes(tmp_path):
+    archive = str(tmp_path / "archive")
+    env = StreamExecutionEnvironment()
+    env.set_parallelism(2)
+    env.use_mini_cluster(2)
+    env.enable_checkpointing(20)
+    env.config.set("metrics.sample.interval.ms", 5)
+    env.config.set("metrics.history.size", 512)
+    env.config.set("history.archive.dir", archive)
+    sink = CollectSink()
+    (env.add_source(_Slowish())
+        .key_by(lambda v: v % 4)
+        .map(lambda v: v + 1)
+        .add_sink(sink))
+    client = env.execute_async("journaled-job")
+    monitor = WebMonitor(env.get_metric_registry()).start()
+    live_history = live_cps = None
+    try:
+        monitor.track_job("journaled-job", client)
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            live_history = _get(monitor.port,
+                                "/jobs/journaled-job/metrics/history"
+                                "?metric=*&buckets=4")
+            if (live_history.get("series")
+                    and not live_history.get("sampling_disabled")
+                    and max(len(e["samples"]) for e in
+                            live_history["series"].values()) >= 10):
+                break
+            time.sleep(0.05)
+        assert live_history["sample_interval_ms"] == 5
+        key, entry = max(live_history["series"].items(),
+                         key=lambda kv: len(kv[1]["samples"]))
+        assert len(entry["samples"]) >= 10
+        r = entry["rollup"]
+        vals = [v for _, v in entry["samples"]]
+        assert r["count"] == len(vals)
+        assert r["min"] == min(vals) and r["max"] == max(vals)
+        assert r["avg"] == pytest.approx(sum(vals) / len(vals))
+        assert sum(b["count"] for b in entry["buckets"]) == len(vals)
+
+        # checkpoints route: per-subtask ack latencies + summary
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            live_cps = _get(monitor.port, "/jobs/journaled-job/checkpoints")
+            if live_cps["summary"]["count"] >= 2:
+                break
+            time.sleep(0.05)
+        completed = [h for h in live_cps["history"]
+                     if h["status"] == "completed"]
+        assert live_cps["summary"]["count"] == len(completed) >= 2
+        assert completed[0]["ack_latency_ms"]  # per-subtask latencies
+        for h in completed:
+            assert h["duration_ms"] is not None
+            assert set(h["ack_latency_ms"]) == set(
+                completed[0]["ack_latency_ms"])
+        assert live_cps["summary"]["duration_ms"]["count"] == len(completed)
+        assert live_cps["summary"]["ack_latency_ms"]["count"] > 0
+
+        alerts = _get(monitor.port, "/jobs/journaled-job/alerts")
+        assert set(alerts) == {"alerts", "total", "rules_firing"}
+
+        result = client.wait(timeout=60)
+        assert sorted(result.accumulators["collected"]) == sorted(
+            v + 1 for v in range(3000))
+
+        # the live coordinator count and the route must agree at end
+        final_cps = _get(monitor.port, "/jobs/journaled-job/checkpoints")
+        assert (final_cps["counts"]["completed"]
+                == result.checkpoints_completed)
+    finally:
+        monitor.stop()
+
+    # ---- HistoryServer: identical route shapes post-finish ----------
+    _wait_for_archive(archive)
+    hs = HistoryServer([archive]).start()
+    try:
+        jobs = _get(hs.port, "/jobs")["jobs"]
+        assert any(j["job_name"] == "journaled-job" for j in jobs)
+        arch_history = _get(hs.port, "/jobs/journaled-job/metrics/history"
+                                     "?metric=*&buckets=4")
+        assert set(arch_history) == set(live_history)
+        assert arch_history["sample_interval_ms"] == 5
+        assert key in arch_history["series"]
+        arch_entry = arch_history["series"][key]
+        assert set(arch_entry) == set(entry)
+        assert len(arch_entry["samples"]) >= 10
+        arch_cps = _get(hs.port, "/jobs/journaled-job/checkpoints")
+        assert set(arch_cps) == set(live_cps)
+        assert (arch_cps["counts"]["completed"]
+                == result.checkpoints_completed)
+        arch_alerts = _get(hs.port, "/jobs/journaled-job/alerts")
+        assert set(arch_alerts) == {"alerts", "total", "rules_firing"}
+        arch_metrics = _get(hs.port, "/jobs/journaled-job/metrics")
+        assert arch_metrics and all(k.startswith("journaled-job.")
+                                    for k in arch_metrics)
+    finally:
+        hs.stop()
+
+
+def test_local_executor_seeded_backpressure_fires_one_alert():
+    """A tiny channel (capacity 8) + a slow keyed map forces the
+    threaded source's emit to block on a full queue for the whole run
+    — sustained backpressure on the source vertex.  The health plane
+    must emit exactly ONE backpressure-sustained alert for it (episode
+    semantics), not one per sample."""
+    from flink_tpu.runtime.local import LocalExecutor
+
+    env = StreamExecutionEnvironment()
+    sink = CollectSink()
+
+    def slow(v):
+        # per-record time far above the emit-wait wakeup latency, so
+        # the source refills the 8-slot queue between records and the
+        # sampled ratio never dips mid-run; the journal ticks once per
+        # loop pass (~256 map-sleeps), so n/256 passes must comfortably
+        # exceed the 5-consecutive-sample alert threshold
+        time.sleep(0.0005)
+        return v
+
+    (env.add_source(_Slowish(n=2500, delay=0.0))
+        .key_by(lambda v: v % 2)
+        .map(slow)
+        .add_sink(sink))
+    env.graph.job_name = "bp-job"
+    executor = LocalExecutor(channel_capacity=8, sample_interval_ms=2)
+    client = executor.execute_async(env.get_job_graph())
+    client.wait(timeout=120)
+
+    evaluator = client.executor_state["health"]
+    journal = client.executor_state["journal"]
+    assert evaluator is not None and journal.samples_taken >= 5
+    bp_alerts = [a for a in evaluator.snapshot_alerts()
+                 if a["rule"] == "backpressure-sustained"]
+    assert len(bp_alerts) == 1, bp_alerts
+    assert bp_alerts[0]["metric"].endswith(".backpressure.ratio")
+    assert bp_alerts[0]["metric"].startswith("bp-job.")
+    assert bp_alerts[0]["value"] > 0.5
+
+
+def test_journal_disabled_by_default(tmp_path):
+    env = StreamExecutionEnvironment()
+    sink = CollectSink()
+    env.from_collection(range(50)).map(lambda v: v).add_sink(sink)
+    client = env.execute_async("nojournal-job")
+    monitor = WebMonitor(env.get_metric_registry()).start()
+    try:
+        monitor.track_job("nojournal-job", client)
+        client.wait(timeout=30)
+        assert client.executor_state["journal"] is None
+        assert client.executor_state["health"] is None
+        body = _get(monitor.port, "/jobs/nojournal-job/metrics/history")
+        assert body["sampling_disabled"] is True and body["series"] == {}
+    finally:
+        monitor.stop()
+
+
+# ---------------------------------------------------------------------
+# REST error paths: 404 JSON bodies + 400 on malformed params
+# ---------------------------------------------------------------------
+
+def test_rest_error_paths_on_live_monitor():
+    monitor = WebMonitor(MetricRegistry()).start()
+
+    class _Client:
+        executor_state = {"journal": None, "health": None,
+                          "coordinator": None}
+        done = False
+
+    try:
+        monitor.track_job("real-job", _Client())
+        for sub in ("", "/metrics", "/metrics/history", "/checkpoints",
+                    "/alerts", "/backpressure", "/detail", "/exceptions",
+                    "/traces"):
+            code, body = _get_error(monitor.port, f"/jobs/nope{sub}")
+            assert code == 404, f"/jobs/nope{sub} -> {code}"
+            assert "error" in body and "not found" in body["error"]
+        for q in ("since=abc", "buckets=zero", "buckets=-3", "metric="):
+            code, body = _get_error(
+                monitor.port, f"/jobs/real-job/metrics/history?{q}")
+            assert code == 400, f"?{q} -> {code}"
+            assert "error" in body
+    finally:
+        monitor.stop()
+
+
+def test_rest_error_paths_on_history_server(tmp_path):
+    archive = str(tmp_path)
+    FsJobArchivist.archive(archive, "job-1", {
+        "job_name": "done-job", "state": "FINISHED", "restarts": 0,
+        "checkpoints_completed": 0})
+    hs = HistoryServer([archive]).start()
+    try:
+        for sub in ("", "/metrics", "/metrics/history", "/checkpoints",
+                    "/alerts", "/traces", "/exceptions"):
+            code, body = _get_error(hs.port, f"/jobs/nope{sub}")
+            assert code == 404 and "error" in body
+        code, body = _get_error(
+            hs.port, "/jobs/done-job/metrics/history?since=abc")
+        assert code == 400 and "error" in body
+        # archived-but-never-sampled job serves the disabled shape
+        body = _get(hs.port, "/jobs/done-job/metrics/history")
+        assert body["sampling_disabled"] is True
+        # lookup works by job_id AND job_name (live-route parity)
+        assert _get(hs.port, "/jobs/job-1")["state"] == "FINISHED"
+        assert _get(hs.port, "/jobs/done-job")["state"] == "FINISHED"
+    finally:
+        hs.stop()
+
+
+# ---------------------------------------------------------------------
+# cluster mode: workers ship samples to the JobMaster over RPC
+# ---------------------------------------------------------------------
+
+def test_cluster_metrics_shipping_and_archive(tmp_path):
+    from flink_tpu.runtime.cluster import (
+        JobManagerProcess,
+        TaskManagerProcess,
+    )
+    archive = str(tmp_path / "archive")
+    jm = JobManagerProcess(archive_dir=archive)
+    tms = [TaskManagerProcess(jm_address=jm.address, num_slots=2)
+           for _ in range(2)]
+    try:
+        env = StreamExecutionEnvironment()
+        env.set_parallelism(2)
+        env.config.set("metrics.sample.interval.ms", 10)
+        env.use_remote_cluster(jm.address)
+        (env.from_collection(range(20000))
+            .key_by(lambda v: v % 4)
+            .map(lambda v: v * 2)
+            .add_sink(CollectSink()))
+        env.execute("cluster-journal-job")
+
+        _wait_for_archive(archive)
+        hs = HistoryServer([archive]).start()
+        try:
+            jobs = _get(hs.port, "/jobs")["jobs"]
+            assert any(j["job_name"] == "cluster-journal-job"
+                       for j in jobs)
+            body = _get(hs.port,
+                        "/jobs/cluster-journal-job/metrics/history")
+            assert not body.get("sampling_disabled")
+            assert body["series"], "workers should have shipped samples"
+            assert body["sample_interval_ms"] == 10
+            # the shipped dumps also land as the final metrics snapshot
+            dump = _get(hs.port, "/jobs/cluster-journal-job/metrics")
+            assert dump
+        finally:
+            hs.stop()
+    finally:
+        for tm in tms:
+            tm.stop()
+        jm.stop()
+
+
+# ---------------------------------------------------------------------
+# CLI: flink_tpu top
+# ---------------------------------------------------------------------
+
+def test_cli_top_once(capsys):
+    from flink_tpu.cli import main as cli_main
+    env = StreamExecutionEnvironment()
+    env.enable_checkpointing(20)
+    sink = CollectSink()
+    env.add_source(_Slowish(n=4000, delay=0.0005)) \
+       .map(lambda v: v + 1).add_sink(sink)
+    client = env.execute_async("topped-job")
+    monitor = WebMonitor(env.get_metric_registry()).start()
+    try:
+        monitor.track_job("topped-job", client)
+        time.sleep(0.4)
+        rc = cli_main(["top", f"http://127.0.0.1:{monitor.port}",
+                       "--once"])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "topped-job" in out and "RUNNING" in out
+        assert "rec/s" in out and "backpressure" in out
+        assert "checkpoints:" in out and "alerts:" in out
+    finally:
+        client.cancel()
+        client.wait(timeout=30)
+        monitor.stop()
+    # unreachable endpoint: clean error, not a traceback
+    assert cli_main(["top", "http://127.0.0.1:1", "--once"]) == 1
