@@ -710,10 +710,13 @@ def bench_shuffle(n_events=1 << 17, n_keys=1024):
     """Cross-host shuffle data plane: a keyBy exchange of (int, str,
     float) tuple records through the batched router fan-out onto real
     TCP DataServer/DataClient channels.  A/B is INTERLEAVED in one
-    process: the columnar zero-copy wire codec (A) against the
-    per-batch pickle path (B, COLUMNAR_ENABLED off) over the identical
-    record stream — both sides pay the same router, socket, credit,
-    and decode loop; only the codec tier differs."""
+    process: the columnar zero-copy wire codec with batch-mode
+    consumer decode (A) against the per-batch pickle path (B,
+    COLUMNAR_ENABLED off) over the identical record stream — both
+    sides pay the same router, socket, credit, and decode loop; the
+    codec tier and the consumer's boxing differ.  The subscription is
+    batch-mode for both passes: pickle frames pass through it as
+    records, so B is unchanged while A skips per-record boxing."""
     from flink_tpu.core.functions import as_key_selector
     from flink_tpu.runtime import netchannel
     from flink_tpu.runtime.local import _RouterOutput
@@ -738,10 +741,11 @@ def bench_shuffle(n_events=1 << 17, n_keys=1024):
             self.count = 0
 
         def push(self, el):
-            self.count += 1
+            self.count += len(el) if el.is_batch else 1
 
         def push_batch(self, els):
-            self.count += len(els)
+            for el in els:
+                self.push(el)
 
     n_ch = 4
     server = DataServer()
@@ -752,7 +756,8 @@ def bench_shuffle(n_events=1 << 17, n_keys=1024):
     for c in range(n_ch):
         key = ("bench-shuffle", 0, 1, c, 0)
         outs.append(server.register_out_channel(key, capacity=1 << 20))
-        client.subscribe(server.address, key, sinks[c], capacity=1 << 20)
+        client.subscribe(server.address, key, sinks[c], capacity=1 << 20,
+                         columnar=True)
     router.add_route(
         KeyGroupStreamPartitioner(as_key_selector(lambda v: v[0]), 128),
         outs)
@@ -789,6 +794,195 @@ def bench_shuffle(n_events=1 << 17, n_keys=1024):
         "frames_columnar": snap["framesColumnar"],
         "frames_pickle": snap["framesPickle"],
         "frame_bytes_mean": round(snap["frameBytesMean"]),
+    }
+
+
+def bench_columnar_chain(n_events=1 << 17, n_keys=256, window_ms=1000,
+                         chunk=8192):
+    """End-to-end columnar operator pipeline over real TCP: batched
+    source -> map -> filter (column kernels) -> vectorized keyBy split
+    -> wire -> batch-mode decode -> generic tumbling-window sum (A)
+    against the identical chain fed per-record with boxed decode (B).
+    A/B is INTERLEAVED in one process and both passes must produce
+    the same window sums — this measures exactly the per-record
+    StreamRecord tax the batch element model removes."""
+    from flink_tpu.core.functions import (
+        AggregateFunction,
+        _LambdaFilter,
+        _LambdaMap,
+        as_key_selector,
+    )
+    from flink_tpu.runtime import netchannel
+    from flink_tpu.runtime.local import _ChainedOutput, _RouterOutput
+    from flink_tpu.runtime.netchannel import DataClient, DataServer
+    from flink_tpu.streaming.elements import (
+        MAX_TIMESTAMP,
+        RecordBatch,
+        StreamRecord,
+        Watermark,
+    )
+    from flink_tpu.streaming.generic_agg import GenericWindowOperator
+    from flink_tpu.streaming.operators import (
+        Output,
+        StreamFilter,
+        StreamMap,
+    )
+    from flink_tpu.streaming.partitioners import KeyGroupStreamPartitioner
+    from flink_tpu.streaming.windowing import TumblingEventTimeWindows
+
+    rng = np.random.default_rng(23)
+    keys64 = rng.integers(0, n_keys, n_events).astype(np.int64)
+    vals64 = rng.integers(0, 100, n_events).astype(np.int64)
+    ts64 = np.arange(n_events, dtype=np.int64)
+    records = [StreamRecord((int(k), int(v)), int(t))
+               for k, v, t in zip(keys64, vals64, ts64)]
+    # numpy reference for the whole pipeline (exact: int sums)
+    v3 = vals64 * 3
+    keep = (v3 % 7) != 0
+    wstart = ts64 - ts64 % window_ms
+    expected_rows = int(np.count_nonzero(keep))
+    ref = {}
+    for k, w, v in zip(keys64[keep].tolist(), wstart[keep].tolist(),
+                       v3[keep].tolist()):
+        ref[(k, w)] = ref.get((k, w), 0) + v
+    expected = sorted((k, w, s) for (k, w), s in ref.items())
+
+    class SumAgg(AggregateFunction):
+        def create_accumulator(self):
+            return 0
+
+        def add(self, value, acc):
+            return acc + value[1]
+
+        def get_result(self, acc):
+            return acc
+
+        def merge(self, a, b):
+            return a + b
+
+    class _ResultOut(Output):
+        def __init__(self):
+            self.values = []
+
+        def collect(self, record):
+            self.values.append(record.value)
+
+        def emit_watermark(self, watermark):
+            pass
+
+    class _ChainSink:
+        """Consumer-side `_InputChannel` stand-in feeding the window
+        operator directly on the reader thread (A gets RecordBatches,
+        B gets per-record StreamRecords — same wire, same operator)."""
+        blocked = False
+        capacity = 1 << 30
+        queue = ()
+
+        def __init__(self):
+            self.rows = 0
+            self.head = None
+
+        def push(self, el):
+            if el.is_batch:
+                self.head.process_batch(el)
+                self.rows += len(el)
+            else:
+                self.head.process_element(el)
+                self.rows += 1
+
+        def push_batch(self, els):
+            for el in els:
+                self.push(el)
+
+    n_ch = 4
+    server = DataServer()
+    clients, sinks, routers = [], [], []
+    for columnar, tag in ((True, "A"), (False, "B")):
+        client = DataClient()
+        side_sinks = [_ChainSink() for _ in range(n_ch)]
+        router = _RouterOutput()
+        outs = []
+        for c in range(n_ch):
+            key = (f"bench-colchain-{tag}", 0, 1, c, 0)
+            outs.append(server.register_out_channel(key, capacity=1 << 20))
+            client.subscribe(server.address, key, side_sinks[c],
+                             capacity=1 << 20, columnar=columnar)
+        router.add_route(KeyGroupStreamPartitioner(as_key_selector(0), 128),
+                         outs)
+        clients.append(client)
+        sinks.append(side_sinks)
+        routers.append(router)
+
+    def one_pass(batched):
+        client = clients[0 if batched else 1]
+        side = sinks[0 if batched else 1]
+        router = routers[0 if batched else 1]
+        # fresh operators per pass: kernel probes and window state are
+        # per-run
+        map_op = StreamMap(_LambdaMap(lambda t: (t[0], t[1] * 3)))
+        filt_op = StreamFilter(_LambdaFilter(lambda t: t[1] % 7 != 0))
+        filt_op.setup(router)
+        map_op.setup(_ChainedOutput(filt_op, router))
+        map_op.open()
+        filt_op.open()
+        results = []
+        for s in side:
+            gwo = GenericWindowOperator(
+                TumblingEventTimeWindows.of(window_ms), SumAgg(),
+                window_function=lambda k, w, rs: [(k, w.start, rs[0])])
+            out = _ResultOut()
+            gwo.setup(out, key_selector=as_key_selector(0))
+            gwo.open()
+            s.head = gwo
+            s.rows = 0
+            results.append(out)
+        t0 = time.perf_counter()
+        if batched:
+            for i in range(0, n_events, chunk):
+                map_op.process_batch(RecordBatch(
+                    {"f0": keys64[i:i + chunk], "f1": vals64[i:i + chunk]},
+                    ts64[i:i + chunk]))
+        else:
+            for r in records:
+                map_op.process_element(r)
+        router.flush_records()
+        server.wake()
+        while sum(s.rows for s in side) < expected_rows:
+            if client.error is not None:
+                raise client.error
+            client.replenish_credits()
+            time.sleep(0.0005)
+        for s in side:
+            s.head.process_watermark(Watermark(MAX_TIMESTAMP))
+        elapsed = time.perf_counter() - t0
+        got = sorted((int(k), int(w), int(v))
+                     for out in results for k, w, v in out.values)
+        assert got == expected, \
+            f"{'batched' if batched else 'boxed'} pipeline diverged " \
+            f"({len(got)} vs {len(expected)} windows)"
+        if batched:
+            assert map_op.boxed_fallbacks == 0 \
+                and filt_op.boxed_fallbacks == 0, (
+                    map_op.columnar_fallback_reason,
+                    filt_op.columnar_fallback_reason)
+        return n_events / elapsed
+
+    try:
+        one_pass(True)    # warm: connections, probes, engine dispatch
+        one_pass(False)
+        col_rate = box_rate = 0.0
+        for _rep in range(3):
+            box_rate = max(box_rate, one_pass(False))
+            col_rate = max(col_rate, one_pass(True))
+    finally:
+        for client in clients:
+            client.stop()
+        server.stop()
+    snap = netchannel.NET_STATS.snapshot()
+    return col_rate, box_rate, {
+        "rows_after_filter": expected_rows,
+        "frames_columnar": snap["framesColumnar"],
+        "frames_pickle": snap["framesPickle"],
     }
 
 
@@ -853,6 +1047,7 @@ def main():
         ("sql", bench_sql),
         ("sql_join", bench_sql_join),
         ("shuffle", bench_shuffle),
+        ("columnar_chain", bench_columnar_chain),
     ]
     # diagnostics: runnable by name, excluded from the default suite
     # (they document measured LIMITS, not headline configs)

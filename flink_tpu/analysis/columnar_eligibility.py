@@ -1,0 +1,114 @@
+"""Ahead-of-time columnar eligibility for operator instances.
+
+One authority answering "will this operator consume RecordBatches
+without boxing?" — shared by the cluster's channel wiring (batch-mode
+subscriptions are only worth paying for when the consuming head can
+use them), the graph linter's FT184 chain report, and tests.
+
+Three modes:
+
+- ``kernel`` — stateless UDF operator whose UDF the AOT liftability
+  analyzer (PR 4) proved LIFTABLE: the runtime applies it to numpy
+  columns directly (subject to the first-batch runtime probe).
+- ``native`` — the operator ingests columns structurally (generic
+  window-agg buffers, the vectorized CEP operator, sinks exposing
+  ``invoke_batch``): no per-row UDF at the batch boundary.
+- ``boxed`` — everything else: `StreamOperator.process_batch` boxes
+  the batch into per-row `process_element` calls (with the reason
+  recorded in the operator's ``columnar.fallback_reason`` gauge).
+
+The verdict is AOT and conservative: a ``kernel`` operator can still
+demote itself at runtime if the probe fails, but a ``boxed`` verdict
+here is final, so the linter can name the first fallback-forcing
+operator of a chain before the job runs.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+KERNEL = "kernel"
+NATIVE = "native"
+BOXED = "boxed"
+
+
+def operator_batch_report(op) -> Tuple[str, str]:
+    """(mode, reason) for one instantiated operator.  `reason` is
+    non-empty only for ``boxed`` — it names what forces the fallback."""
+    from flink_tpu.streaming.operators import (
+        StreamFilter,
+        StreamMap,
+        StreamSink,
+        TwoInputStreamOperator,
+        _udf_liftable,
+    )
+    from flink_tpu.streaming.sources import StreamSource
+
+    if isinstance(op, (StreamMap, StreamFilter)):
+        ok, reason = _udf_liftable(op.user_function, op._KERNEL_ATTR)
+        return (KERNEL, "") if ok else (BOXED, reason)
+    if isinstance(op, StreamSink):
+        if hasattr(op.user_function, "invoke_batch"):
+            return NATIVE, ""
+        return BOXED, "sink has no invoke_batch"
+    if isinstance(op, StreamSource):
+        # sources emit, never consume; vectorized emit is a property
+        # of the source function, not a consumption mode
+        fn = getattr(op, "user_function", None)
+        if hasattr(fn, "emit_step") and getattr(fn, "emits_batches",
+                                                False):
+            return NATIVE, ""
+        return BOXED, "source emits per-row"
+    if isinstance(op, TwoInputStreamOperator):
+        return BOXED, "two-input operator (per-input key contexts)"
+
+    # structural consumers declare themselves via a process_batch
+    # override — anything still on the StreamOperator default boxes
+    from flink_tpu.streaming.operators import StreamOperator
+    pb = type(op).process_batch
+    if pb is not StreamOperator.process_batch:
+        return NATIVE, ""
+    return BOXED, f"no batch kernel on {type(op).__name__}"
+
+
+def chain_report(operators: List) -> dict:
+    """Columnar eligibility of one operator chain (head first):
+    ``{"modes": [(name, mode, reason)...], "eligible": bool,
+    "first_blocker": name | None, "prefix_len": int}``.
+
+    ``eligible`` means the HEAD consumes batches (so a batch-mode
+    subscription pays off at all); ``prefix_len`` counts how many
+    operators a batch survives before the first boxed hop reboxes it;
+    ``first_blocker`` names that hop."""
+    modes = []
+    first_blocker: Optional[str] = None
+    prefix = 0
+    for op in operators:
+        mode, reason = operator_batch_report(op)
+        name = type(op).__name__
+        modes.append((name, mode, reason))
+        if mode == BOXED and first_blocker is None:
+            first_blocker = name
+        elif first_blocker is None:
+            prefix += 1
+    return {
+        "modes": modes,
+        "eligible": bool(modes) and modes[0][1] != BOXED,
+        "first_blocker": first_blocker,
+        "prefix_len": prefix,
+    }
+
+
+def subtask_accepts_batches(subtask) -> bool:
+    """Should this consumer's remote subscription run in batch mode?
+    True when the chain head consumes batches without boxing AND the
+    columnar pipeline kill-switch is up — otherwise the plain decode
+    path (box in the reader thread) is strictly cheaper."""
+    from flink_tpu.streaming import columnar
+    if not columnar.PIPELINE_ENABLED:
+        return False
+    try:
+        mode, _ = operator_batch_report(subtask.head)
+    except Exception:  # noqa: BLE001
+        return False
+    return mode != BOXED

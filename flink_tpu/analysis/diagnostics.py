@@ -52,6 +52,7 @@ CODES: Dict[str, tuple] = {
     "FT181": (WARNING, "aggregate is conclusively scalar-only (perf footgun)"),
     "FT182": (INFO, "aggregate proven liftable; runtime probe will be skipped"),
     "FT183": (WARNING, "impure map/filter/reduce function"),
+    "FT184": (INFO, "columnar batch eligibility of an operator chain"),
     # --- pre-flight construction / linter self-errors ---------------
     "FT190": (ERROR, "operator factory raised during pre-flight construction"),
     "FT199": (INFO, "linter check skipped (internal error)"),

@@ -91,6 +91,7 @@ class _GraphLinter:
             self._check_unbounded_state,
             self._check_timestamps,
             self._check_liftability,
+            self._check_columnar,
         )
         for check in checks:
             try:
@@ -482,6 +483,57 @@ class _GraphLinter:
                         hint="impure UDFs break replay determinism — "
                              "recovery re-processes records after the "
                              "last checkpoint")
+
+    def _check_columnar(self):
+        """FT184: per-chain columnar eligibility (informational).
+
+        Reconstructs the greedy operator chains the job-graph builder
+        would form and asks the eligibility pass
+        (:mod:`~flink_tpu.analysis.columnar_eligibility`) how far a
+        RecordBatch survives down each chain before an operator boxes
+        it back to per-record StreamRecords — and which operator is
+        the first to force the fallback.  Chains whose head never
+        accepts batches (ordinary boxed sources) are silent: the
+        diagnostic is for pipelines that start columnar, not a blanket
+        nag on every legacy job."""
+        from flink_tpu.analysis.columnar_eligibility import chain_report
+        from flink_tpu.streaming.graph import is_chainable
+        chained_into = {e.target_id for e in self.graph.edges
+                        if is_chainable(e, self.graph)}
+        for nid, node in self.graph.nodes.items():
+            if nid in chained_into:
+                continue  # interior of some chain
+            chain_nodes = [node]
+            cur = nid
+            while True:
+                nxt = [e.target_id for e in self.graph.out_edges(cur)
+                       if is_chainable(e, self.graph)]
+                if len(nxt) != 1:
+                    break
+                cur = nxt[0]
+                chain_nodes.append(self.graph.nodes[cur])
+            ops = [self.ops.get(c.id) for c in chain_nodes]
+            if any(op is None for op in ops):
+                continue  # factory errors already reported (FT190)
+            rep = chain_report(ops)
+            names = " -> ".join(c.name for c in chain_nodes)
+            if rep["eligible"] and rep["first_blocker"] is None:
+                self._diag(
+                    "FT184",
+                    f"chain [{names}] consumes columnar batches end to "
+                    f"end ({', '.join(f'{n}:{m}' for n, m, _ in rep['modes'])})",
+                    node=node)
+            elif rep["eligible"]:
+                blocker_i = rep["prefix_len"]
+                _, _, reason = rep["modes"][blocker_i]
+                self._diag(
+                    "FT184",
+                    f"chain [{names}] rides columns for "
+                    f"{rep['prefix_len']} of {len(ops)} operators, then "
+                    f"boxes at '{chain_nodes[blocker_i].name}': {reason}",
+                    node=chain_nodes[blocker_i],
+                    hint="operators past the first boxing point pay "
+                         "per-record StreamRecord costs")
 
     def _lint_aggregate(self, node, agg, generic: bool):
         if getattr(agg, "force_scalar", False):

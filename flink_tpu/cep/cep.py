@@ -224,6 +224,28 @@ class _VectorizedCepOperator(_StreamOp):
         self._ts.append(record.timestamp)
         self._values.append(record.value)
 
+    def process_batch(self, batch):
+        """Columnar ingest: extend the watermark buffer straight from
+        the batch's columns — no StreamRecord boxing.  The buffer
+        still sorts/advances at watermarks, so arrival order inside
+        the batch is preserved exactly like per-row appends."""
+        n = len(batch)
+        if n == 0:
+            return
+        if batch.ts is None or (batch.ts_mask is not None
+                                and not batch.ts_mask.all()):
+            raise ValueError(
+                "vectorized CEP requires event-time records")
+        values = batch.row_values()
+        if self.key_selector is not None:
+            self._keys.extend(self.key_selector.get_key(v)
+                              for v in values)
+        else:
+            self._keys.extend(values)
+        self._ts.extend(batch.ts.tolist())
+        self._values.extend(values)
+        self._note_columnar(n)
+
     def process_watermark(self, watermark):
         import numpy as np
         wm = watermark.timestamp

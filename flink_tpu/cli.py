@@ -263,6 +263,16 @@ def _top_rows(job, detail, metrics, prev, dt_s):
         lags = [val for k, val in metrics.items()
                 if k.startswith(prefix) and k.endswith(".watermarkLag")
                 and isinstance(val, (int, float))]
+        # columnar pipeline health: worst per-subtask batch-row ratio
+        # (None until a batch is seen) and total boxed fallbacks
+        col_ratios = [val for k, val in metrics.items()
+                      if k.startswith(prefix)
+                      and k.endswith(".columnar.ratio")
+                      and isinstance(val, (int, float))]
+        col_boxed = sum(val for k, val in metrics.items()
+                        if k.startswith(prefix)
+                        and k.endswith(".columnar.boxed_fallbacks")
+                        and isinstance(val, (int, float)))
         bp = (detail.get("backpressure") or {}).get(str(v["id"])) or {}
         rows.append({
             "id": v["id"], "name": v["name"],
@@ -270,6 +280,8 @@ def _top_rows(job, detail, metrics, prev, dt_s):
             "records_per_s": rate,
             "bp_ratio": bp.get("max_ratio"), "bp_level": bp.get("level"),
             "watermark_lag_ms": max(lags) if lags else None,
+            "columnar_ratio": min(col_ratios) if col_ratios else None,
+            "columnar_boxed": col_boxed,
         })
     return rows
 
@@ -280,18 +292,22 @@ def _top_render(job, status, rows, checkpoints, alerts) -> str:
 
     lines = [f"job: {job}  [{status}]",
              f"{'id':>4}  {'vertex':<36} {'par':>3}  {'rec/s':>10}  "
-             f"{'backpressure':<18} {'wmLag ms':>10}"]
+             f"{'backpressure':<18} {'wmLag ms':>10} {'col%':>6} "
+             f"{'boxed':>6}"]
     for r in rows:
         bp = "-"
         if r["bp_ratio"] is not None:
             bp = f"{r['bp_ratio'] * 100:5.1f}%"
             if r["bp_level"]:
                 bp += f" ({r['bp_level']})"
+        col = ("-" if r.get("columnar_ratio") is None
+               else f"{r['columnar_ratio'] * 100:.0f}%")
         lines.append(
             f"{r['id']:>4}  {r['name'][:36]:<36} "
             f"{fmt(r['parallelism'], '{:d}'):>3}  "
             f"{fmt(r['records_per_s'], '{:,.0f}'):>10}  {bp:<18} "
-            f"{fmt(r['watermark_lag_ms'], '{:,.0f}'):>10}")
+            f"{fmt(r['watermark_lag_ms'], '{:,.0f}'):>10} {col:>6} "
+            f"{fmt(r.get('columnar_boxed'), '{:,.0f}'):>6}")
     counts = checkpoints.get("counts") or {}
     last = None
     for c in checkpoints.get("history") or []:

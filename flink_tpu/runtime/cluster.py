@@ -1289,6 +1289,9 @@ class TaskExecutor(RpcEndpoint):
         local pairs get direct in-memory channels, remote pairs go
         through the data plane (the ExecutionGraph POINTWISE/ALL_TO_ALL
         wiring + partition location table of the TDD)."""
+        from flink_tpu.analysis.columnar_eligibility import (
+            subtask_accepts_batches,
+        )
         from flink_tpu.runtime.failover import pointwise_targets
         locations = {tuple(k): v for k, v in tdd["locations"].items()}
         data_addresses = tdd["data_addresses"]
@@ -1322,8 +1325,14 @@ class TaskExecutor(RpcEndpoint):
                             edge.type_number)
                         ch.is_feedback = feedback
                         producer_tm = locations[(edge.source_vertex_id, i)]
+                        # batch-mode subscription when the consuming
+                        # chain head eats RecordBatches: "col" frames
+                        # then decode to ONE batch element, no
+                        # per-record boxing in the reader thread
                         att.data_client.subscribe(
-                            data_addresses[producer_tm], key, ch, capacity)
+                            data_addresses[producer_tm], key, ch, capacity,
+                            columnar=subtask_accepts_batches(
+                                att.by_key[down_key]))
                 if up_mine:
                     up = att.by_key[(edge.source_vertex_id, i)]
                     up.router.add_route(_clone_partitioner(edge.partitioner),

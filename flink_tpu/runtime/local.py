@@ -138,6 +138,11 @@ class _ChainedOutput(Output):
         self.op.set_key_context(record)
         self.op.process_element(record)
 
+    def collect_batch(self, batch):
+        # batches chain whole: the next operator's kernel (or its
+        # boxing fallback) decides, never this output
+        self.op.process_batch(batch)
+
     def emit_watermark(self, watermark):
         self.op.process_watermark(watermark)
 
@@ -237,6 +242,43 @@ class _RouterOutput(Output):
                         channels[c].push_batch([buf[j]
                                                 for j in ol[lo:hi]])
 
+    def collect_batch(self, batch):
+        """Route a whole RecordBatch: vectorized key-group split (one
+        hash pass + a stable argsort per route), whole-batch push on
+        single-channel/broadcast/rebalance routes, and per-row boxing
+        only for partitioners with no batch split (multicast, custom).
+        Buffered rows flush FIRST — they predate the batch, and the
+        per-(producer, channel) order contract must hold."""
+        n = len(batch)
+        if n == 0:
+            return
+        if self.records_out_counter is not None:
+            self.records_out_counter.count += n
+        self.flush_records()
+        boxed = None
+        for partitioner, channels, side_tag in self.routes:
+            if side_tag is not None:
+                continue
+            n_ch = len(channels)
+            if getattr(partitioner, "broadcast_all", False):
+                for ch in channels:
+                    ch.push(batch)  # immutable: shared, never copied
+                continue
+            if n_ch == 1:
+                channels[0].push(batch)
+                continue
+            split = partitioner.split_batch(batch, n_ch)
+            if split is not None:
+                for idx, sub in split:
+                    channels[idx].push(sub)
+                continue
+            if boxed is None:
+                boxed = batch.to_records()
+            for record in boxed:
+                for idx in partitioner.select_channels(record.value,
+                                                       n_ch):
+                    channels[idx].push(record)
+
     def collect_side(self, tag, record):
         self.flush_records()
         for partitioner, channels, side_tag in self.routes:
@@ -288,7 +330,9 @@ class _RouterOutput(Output):
         growth is the BufferSpiller analogue)."""
         for _, channels, _ in self.routes:
             for ch in channels:
-                if not ch.blocked and len(ch.queue) >= ch.capacity:
+                if not ch.blocked and (len(ch.queue)
+                                       + getattr(ch, "extra_rows", 0)
+                                       >= ch.capacity):
                     return False
         return True
 
@@ -305,7 +349,8 @@ class _InputChannel:
 
     __slots__ = ("subtask", "input_index", "channel_id", "queue",
                  "capacity", "blocked", "eos", "is_feedback",
-                 "_spill_file", "spilled_count", "_spill_disabled")
+                 "extra_rows", "_spill_file", "spilled_count",
+                 "_spill_disabled")
 
     def __init__(self, subtask: "SubtaskInstance", input_index: int,
                  channel_id: int, capacity: int = DEFAULT_CHANNEL_CAPACITY):
@@ -314,6 +359,11 @@ class _InputChannel:
         self.channel_id = channel_id
         self.queue: deque = deque()
         self.capacity = capacity
+        #: rows queued beyond the element count: each queued
+        #: RecordBatch adds len-1, so len(queue) + extra_rows is the
+        #: ROW depth and the capacity check stays row-bounded for
+        #: batch flow (plain records never touch this)
+        self.extra_rows = 0
         #: alignment-blocked (exactly-once barrier received, waiting
         #: for the rest — ref: BarrierBuffer blocked channels)
         self.blocked = False
@@ -341,6 +391,8 @@ class _InputChannel:
                     # rows are older) and stop spilling this channel
                     self.unspill()
                     self._spill_disabled = True
+        if element.is_batch:
+            self.extra_rows += len(element) - 1
         self.queue.append(element)
 
     def push_batch(self, elements: list) -> None:
@@ -384,7 +436,10 @@ class _InputChannel:
             if len(header) < 8:
                 break
             n = int.from_bytes(header, "little")
-            self.queue.append(_pickle.loads(f.read(n)))
+            el = _pickle.loads(f.read(n))
+            if el.is_batch:
+                self.extra_rows += len(el) - 1
+            self.queue.append(el)
         f.close()
         self._spill_file = None
         self.spilled_count = 0
@@ -660,8 +715,12 @@ class SubtaskInstance:
                 continue
             idle_scan = 0
             element = ch.queue.popleft()
+            if element.is_batch:
+                ch.extra_rows -= len(element) - 1
             self._dispatch(ch, element)
-            processed += 1
+            # a batch debits its row count, so step latency (barrier
+            # reaction, flush cadence) stays bounded in rows
+            processed += len(element) if element.is_batch else 1
         # the step boundary is a flush point: downstream (and the
         # executor's quiescence check) must see everything this step
         # emitted
@@ -676,6 +735,13 @@ class SubtaskInstance:
                     self.process_record(ch.input_index, element)
             else:
                 self.process_record(ch.input_index, element)
+        elif element.is_batch:
+            tracer = get_tracer()
+            if tracer.enabled:
+                with tracer.span(self._span_process):
+                    self.process_batch_element(ch.input_index, element)
+            else:
+                self.process_batch_element(ch.input_index, element)
         elif element.is_watermark:
             self.process_channel_watermark(ch.input_index, ch.channel_id,
                                            element)
@@ -811,6 +877,29 @@ class SubtaskInstance:
             head.set_key_context(record)
             head.process_element(record)
 
+    def process_batch_element(self, input_index: int, batch):
+        """RecordBatch through the head: the operator's process_batch
+        path (kernel or one-time boxing fallback).  Two-input heads
+        have per-input key contexts, so they box here."""
+        if faults._active is not None:
+            faults.fire("task.process")
+        if self.io_metrics is not None:
+            self.io_metrics.num_records_in.count += len(batch)
+        head = self.head
+        if isinstance(head, TwoInputStreamOperator):
+            if input_index == 0:
+                for record in batch.to_records():
+                    head.set_key_context(record)
+                    head.process_element1(record)
+            else:
+                has_kc2 = hasattr(head, "set_key_context2")
+                for record in batch.to_records():
+                    if has_kc2:
+                        head.set_key_context2(record)
+                    head.process_element2(record)
+        else:
+            head.process_batch(batch)
+
     def process_channel_watermark(self, input_index: int, channel_id: int,
                                   watermark: Watermark):
         """Per-channel min-combine (ref: StatusWatermarkValve)."""
@@ -906,6 +995,9 @@ class _LockedSourceOutput(Output):
 
     def collect(self, record):
         self._emit(self._inner.collect, record)
+
+    def collect_batch(self, batch):
+        self._emit(self._inner.collect_batch, batch)
 
     def emit_watermark(self, watermark):
         self._emit(self._inner.emit_watermark, watermark)

@@ -217,7 +217,8 @@ class MiniCluster:
                  latency_interval_ms: Optional[int] = None,
                  sample_interval_ms: Optional[int] = None,
                  metrics_history_size: int = 1024,
-                 archive_dir: Optional[str] = None):
+                 archive_dir: Optional[str] = None,
+                 columnar_pipeline: Optional[bool] = None):
         self.num_task_managers = num_task_managers
         self.state_backend = state_backend
         self.max_parallelism = max_parallelism
@@ -231,6 +232,10 @@ class MiniCluster:
         self.metrics_history_size = metrics_history_size
         #: when set, finished jobs archive their post-mortem bundle
         self.archive_dir = archive_dir
+        #: force the columnar batch pipeline on/off for jobs this
+        #: cluster runs (None = leave the global flag alone); the
+        #: differential suite executes the same graph both ways
+        self.columnar_pipeline = columnar_pipeline
 
     # ---- public API -----------------------------------------------------
     def execute(self, job_graph: JobGraph) -> JobExecutionResult:
@@ -256,6 +261,10 @@ class MiniCluster:
         journal, evaluator = make_health_plane(
             self.metrics, self.sample_interval_ms,
             self.metrics_history_size, job_graph.job_name, client)
+        from flink_tpu.streaming import columnar as _columnar
+        saved_pipeline = _columnar.PIPELINE_ENABLED
+        if self.columnar_pipeline is not None:
+            _columnar.PIPELINE_ENABLED = self.columnar_pipeline
         try:
             while True:
                 try:
@@ -282,6 +291,8 @@ class MiniCluster:
         except BaseException as e:  # noqa: BLE001
             client._finish(error=e)
         finally:
+            if self.columnar_pipeline is not None:
+                _columnar.PIPELINE_ENABLED = saved_pipeline
             archive_finished_job(self.archive_dir, self.metrics,
                                  job_graph, client, journal, evaluator)
 

@@ -58,7 +58,7 @@ from flink_tpu.runtime import faults
 from flink_tpu.runtime.metrics import Histogram
 from flink_tpu.runtime.rpc import MAX_FRAME, recv_exact
 from flink_tpu.runtime.tracing import get_tracer
-from flink_tpu.streaming.elements import StreamRecord
+from flink_tpu.streaming.elements import RecordBatch, StreamRecord
 
 _LEN = struct.Struct(">I")
 
@@ -100,8 +100,8 @@ class NetStats:
 
     __slots__ = ("frames_out", "frames_in", "bytes_out", "bytes_in",
                  "frames_col", "frames_pickle", "decoded_col",
-                 "decoded_pickle", "frames_split", "frame_bytes",
-                 "frame_elements")
+                 "decoded_pickle", "decoded_batch", "frames_split",
+                 "frame_bytes", "frame_elements")
 
     def __init__(self):
         self.reset()
@@ -117,6 +117,9 @@ class NetStats:
         #: data batches decoded per codec tier
         self.decoded_col = 0
         self.decoded_pickle = 0
+        #: "col" frames rebuilt as ONE RecordBatch (batch-mode
+        #: subscriptions: zero per-record boxing on the consumer)
+        self.decoded_batch = 0
         #: continuation splits forced by SPLIT_FRAME_BYTES
         self.frames_split = 0
         #: sliding-window distributions of outbound frames
@@ -133,6 +136,7 @@ class NetStats:
             "framesPickle": self.frames_pickle,
             "decodedColumnar": self.decoded_col,
             "decodedPickle": self.decoded_pickle,
+            "decodedBatch": self.decoded_batch,
             "framesSplit": self.frames_split,
             "frameBytesMean": fb.mean if fb.count else 0.0,
             "frameBytesP99": fb.quantile(0.99) if fb.count else 0.0,
@@ -296,6 +300,60 @@ def decode_elements(enc):
     stamps = ts[2].tolist()
     return [StreamRecord(v, stamps[i] if valid else None)
             for i, (v, valid) in enumerate(zip(values, ts[1].tolist()))]
+
+
+def _column_array(col, n: int) -> np.ndarray:
+    """One ndarray for a column tree: numeric columns pass straight
+    through (the received buffer IS the column — no copy, no per-row
+    work), strings and nested tuples box per cell into an object
+    array (still no StreamRecord allocation)."""
+    kind = col[0]
+    if kind == "i8" or kind == "f8":
+        return col[1]
+    out = np.empty(n, object)
+    vals = _decode_value_column(col, n)
+    for i in range(n):
+        out[i] = vals[i]
+    return out
+
+
+def decode_elements_batch(enc) -> Tuple[list, int]:
+    """Batch-mode decode for columnar subscriptions: a "col" frame
+    rebuilds ONE RecordBatch element — zero per-record StreamRecord
+    boxing on the consumer hot path — and pickle frames pass through
+    unchanged.  Returns ``(elements, wire_count)`` where wire_count is
+    how many wire elements the frame carried: the quiescence ledger
+    pairs it against the producer's per-element ``ch.sent``
+    increments, so a 4096-row batch still counts as 4096 in flight."""
+    if enc[0] == "pickle":
+        NET_STATS.decoded_pickle += 1
+        elements = enc[1]
+        return elements, len(elements)
+    NET_STATS.decoded_col += 1
+    NET_STATS.decoded_batch += 1
+    _, n, col, ts = enc
+    if col[0] == "tuple" and col[1]:
+        cols = {f"f{j}": _column_array(f, n)
+                for j, f in enumerate(col[1])}
+    else:
+        # scalar rows — including the degenerate zero-arity tuple,
+        # whose () rows ride an object column (there are no fields to
+        # carry them)
+        cols = {"v": _column_array(col, n)}
+    if ts is None:
+        batch = RecordBatch(cols)
+    elif ts[0] == "i8":
+        batch = RecordBatch(cols, ts[1])
+    else:
+        batch = RecordBatch(cols, ts[2], ts_mask=ts[1])
+    return [batch], n
+
+
+def _decode_frame(enc, columnar: bool) -> Tuple[list, int]:
+    if columnar:
+        return decode_elements_batch(enc)
+    elements = decode_elements(enc)
+    return elements, len(elements)
 
 
 # ---------------------------------------------------------------------
@@ -752,11 +810,15 @@ class RemoteInputBinding:
     `_InputChannel` the elements land in + credit bookkeeping."""
 
     __slots__ = ("key", "input_channel", "received", "bytes_in",
-                 "granted", "lock")
+                 "granted", "lock", "columnar")
 
-    def __init__(self, key: ChannelKey, input_channel):
+    def __init__(self, key: ChannelKey, input_channel,
+                 columnar: bool = False):
         self.key = key
         self.input_channel = input_channel
+        #: batch-mode subscription: "col" frames decode to ONE
+        #: RecordBatch instead of N StreamRecords
+        self.columnar = columnar
         #: total elements received (quiescence accounting) and wire
         #: bytes (the per-channel bytesIn gauge)
         self.received = 0
@@ -784,8 +846,10 @@ class DataClient:
         self.error: Optional[BaseException] = None
 
     def subscribe(self, address: str, key: ChannelKey, input_channel,
-                  capacity: int) -> RemoteInputBinding:
-        binding = RemoteInputBinding(key, input_channel)
+                  capacity: int,
+                  columnar: bool = False) -> RemoteInputBinding:
+        binding = RemoteInputBinding(key, input_channel,
+                                     columnar=columnar)
         with self._lock:
             self._bindings[key] = binding
             self._by_addr.setdefault(address, []).append(binding)
@@ -841,10 +905,12 @@ class DataClient:
                 tracer = get_tracer()
                 if tracer.enabled:
                     with tracer.span("net.frame.recv"):
-                        elements = decode_elements(frame["elements"])
+                        elements, count = _decode_frame(
+                            frame["elements"], binding.columnar)
                 else:
-                    elements = decode_elements(frame["elements"])
-                binding.received += len(elements)
+                    elements, count = _decode_frame(frame["elements"],
+                                                    binding.columnar)
+                binding.received += count
                 binding.bytes_in += wire
                 if not frame.get("part"):
                     # exactly one credit per credited batch: the

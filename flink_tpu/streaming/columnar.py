@@ -34,28 +34,180 @@ from typing import Any, Dict, List, Optional, Sequence
 import numpy as np
 
 from flink_tpu.ops.device_agg import DeviceAggregateFunction
-from flink_tpu.streaming.elements import StreamRecord, Watermark
+from flink_tpu.streaming.elements import (  # noqa: F401 — RecordBatch
+    RecordBatch,   # re-exported: the batch element moved to elements.py
+    StreamRecord,  # when it became a first-class StreamElement
+    Watermark,
+)
 from flink_tpu.streaming.operators import StreamOperator
 from flink_tpu.streaming.sources import SinkFunction, SourceFunction
 
+#: kill switch for the end-to-end batch pipeline (RecordBatch flowing
+#: as stream elements through sources, operator chains, and the
+#: netchannel consumer).  Off, vectorized sources emit per-row records
+#: and remote bindings decode row-at-a-time — the boxed path the
+#: differential tests and the bench A/B compare against.  The wire
+#: CODEC has its own independent flag (netchannel.COLUMNAR_ENABLED).
+PIPELINE_ENABLED = True
 
-class RecordBatch:
-    """A batch of rows as named numpy columns (+ event timestamps)."""
 
-    __slots__ = ("cols", "ts")
+def columns_from_values(values: Sequence) -> Optional[Dict[str, np.ndarray]]:
+    """Lower a list of row values onto the pipeline column convention
+    ("v" for scalar rows, "f0".."fk" for tuple rows) — or None when the
+    values don't fit a column shape (heterogeneous types, bools, ints
+    beyond int64, nested tuples...).  Mirrors the netchannel codec's
+    strict type tiers so a batch born here round-trips the wire
+    columnar."""
+    if not values:
+        return None
+    v0 = values[0]
+    if type(v0) is tuple:
+        arity = len(v0)
+        if arity == 0 or any(type(v) is not tuple or len(v) != arity
+                             for v in values):
+            return None
+        cols = {}
+        for i in range(arity):
+            col = _column_from_cells([v[i] for v in values])
+            if col is None:
+                return None
+            cols[f"f{i}"] = col
+        return cols
+    col = _column_from_cells(values)
+    if col is None:
+        return None
+    return {"v": col}
 
-    def __init__(self, cols: Dict[str, np.ndarray],
-                 ts: Optional[np.ndarray] = None):
-        self.cols = cols
-        self.ts = ts
 
-    def __len__(self) -> int:
-        return len(next(iter(self.cols.values()))) if self.cols else 0
+def _column_from_cells(cells: list) -> Optional[np.ndarray]:
+    """One homogeneous cell list → ndarray, or None.  `bool` is a
+    subclass of int and floats don't survive an int64 cast, hence the
+    exact `type is` checks (same discipline as the wire codec)."""
+    t = type(cells[0])
+    if any(type(c) is not t for c in cells):
+        return None
+    if t is int:
+        try:
+            return np.array(cells, np.int64)
+        except OverflowError:
+            return None
+    if t is float:
+        return np.array(cells, np.float64)
+    if t is str:
+        arr = np.empty(len(cells), object)
+        arr[:] = cells
+        return arr
+    return None
 
-    def rows(self):
-        names = list(self.cols)
-        arrays = [self.cols[n] for n in names]
-        return zip(*[a.tolist() for a in arrays])
+
+def batch_from_records(values: Sequence, timestamps: Optional[Sequence]
+                       ) -> Optional[RecordBatch]:
+    """Values + per-row Optional[int] timestamps → RecordBatch (with a
+    validity mask when timestamps are mixed None/int), or None when the
+    values don't columnarize."""
+    cols = columns_from_values(values)
+    if cols is None:
+        return None
+    if timestamps is None or all(t is None for t in timestamps):
+        return RecordBatch(cols)
+    if any(t is None for t in timestamps):
+        mask = np.array([t is not None for t in timestamps], bool)
+        stamps = np.array([t if t is not None else 0
+                           for t in timestamps], np.int64)
+        return RecordBatch(cols, stamps, mask)
+    return RecordBatch(cols, np.array(list(timestamps), np.int64))
+
+
+def batch_from_arrays(arrays, ts=None, ts_mask=None) -> RecordBatch:
+    """Build a pipeline-convention batch from ready numpy columns: one
+    array → scalar rows ("v"), a tuple/list of arrays → tuple rows
+    ("f0".."fk")."""
+    if isinstance(arrays, (tuple, list)):
+        return RecordBatch(
+            {f"f{i}": np.asarray(a) for i, a in enumerate(arrays)},
+            ts, ts_mask)
+    return RecordBatch({"v": np.asarray(arrays)}, ts, ts_mask)
+
+
+class VectorizedCollectionSource(SourceFunction):
+    """Bounded source over a Python collection that emits RecordBatch
+    elements (columns built ONCE at construction) — the vectorized
+    twin of FromCollectionSource, so a batch is *born* columnar
+    instead of being re-derived per hop.  Values that don't fit the
+    column convention raise at construction: callers fall back to
+    FromCollectionSource (datastream.from_collection does this
+    automatically when `vectorize=True` fails).
+
+    With ``timestamped=True`` the input is (value, ts) pairs, same as
+    FromCollectionSource.  Implements the cooperative emit_step +
+    offset-checkpoint contract; one step emits ONE batch (the batch is
+    the indivisible element)."""
+
+    #: eligibility marker read by analysis.columnar_eligibility
+    emits_batches = True
+
+    def __init__(self, values: Sequence, timestamped: bool = False,
+                 chunk: int = 16384):
+        values = list(values)
+        self.timestamped = timestamped
+        self.chunk = chunk
+        if timestamped:
+            raw = [v for v, _ in values]
+            ts = [t for _, t in values]
+        else:
+            raw, ts = values, None
+        batch = batch_from_records(raw, ts)
+        if batch is None and values:
+            raise TypeError(
+                "collection does not fit the columnar convention "
+                "(heterogeneous / non-scalar rows) — use "
+                "FromCollectionSource")
+        #: the whole input as one master batch; emit_step slices it
+        self._batch = batch
+        self._n = len(values)
+        self._running = True
+        #: resume offset in ROWS (always a chunk boundary)
+        self.offset = 0
+
+    def run(self, ctx) -> None:
+        while self.emit_step(ctx, self.chunk):
+            pass
+
+    def emit_step(self, ctx, max_records: int) -> bool:
+        if self.offset < self._n and self._running:
+            if not PIPELINE_ENABLED:
+                # boxed A/B path: same rows, per-record records
+                end = min(self.offset + self.chunk, self._n)
+                sl = self._batch.take(slice(self.offset, end))
+                self.offset = end
+                if self.timestamped:
+                    for v, t in zip(sl.row_values(), sl.timestamps()):
+                        ctx.collect_with_timestamp(v, t)
+                else:
+                    for v in sl.row_values():
+                        ctx.collect(v)
+            else:
+                end = min(self.offset + self.chunk, self._n)
+                ctx.collect_batch(
+                    self._batch.take(slice(self.offset, end)))
+                self.offset = end
+        return self.offset < self._n and self._running
+
+    def cancel(self) -> None:
+        self._running = False
+
+    def __deepcopy__(self, memo):
+        # batches are immutable — a clone only needs a fresh cursor
+        clone = type(self).__new__(type(self))
+        clone.__dict__.update(self.__dict__)
+        clone._running = True
+        return clone
+
+    def snapshot_function_state(self, checkpoint_id=None) -> dict:
+        return {"offset": self.offset}
+
+    def restore_function_state(self, state: dict) -> None:
+        self.offset = state["offset"]
 
 
 class ColumnarSource(SourceFunction):

@@ -28,6 +28,7 @@ class StreamElement:
     is_stream_status = False
     is_latency_marker = False
     is_barrier = False
+    is_batch = False
 
 
 class StreamRecord(StreamElement):
@@ -58,6 +59,113 @@ class StreamRecord(StreamElement):
     def __hash__(self):
         return hash((self.value if not isinstance(self.value, (list, dict)) else id(self.value),
                      self.timestamp))
+
+
+class RecordBatch(StreamElement):
+    """A batch of rows as named numpy columns (+ event timestamps) —
+    a FIRST-CLASS stream element: it flows through channels and
+    operator chains like a record, amortizing per-element costs over
+    thousands of rows (the Python analogue of the reference's codegen
+    / Blink vectorized execution closing the per-record
+    interpretation gap).
+
+    Column convention for generic pipelines: a single column named
+    ``"v"`` means scalar rows (row value = the cell); any other
+    column set means tuple rows in column order (``"f0".."fk"`` when
+    machine-built).  ``ts`` is an optional int64 row-timestamp
+    column; ``ts_mask`` (optional bool column, True = valid) carries
+    None-timestamp validity so boxing a batch reproduces the exact
+    per-record timestamps.
+
+    Batches are IMMUTABLE by contract once emitted: the router may
+    share one batch across broadcast channels and sub-batches are
+    gathered views/copies — operators must build new batches instead
+    of writing columns in place.
+    """
+
+    __slots__ = ("cols", "ts", "ts_mask")
+
+    is_batch = True
+
+    def __init__(self, cols, ts=None, ts_mask=None):
+        #: {name: np.ndarray} — all the same length
+        self.cols = cols
+        #: int64 ndarray of per-row event timestamps, or None
+        self.ts = ts
+        #: bool ndarray (True = row HAS a timestamp), or None when
+        #: every row's validity equals ``ts is not None``
+        self.ts_mask = ts_mask
+
+    def __len__(self) -> int:
+        return len(next(iter(self.cols.values()))) if self.cols else 0
+
+    @property
+    def is_scalar(self) -> bool:
+        """True when rows are the single column's cells (not 1-tuples)."""
+        return len(self.cols) == 1 and "v" in self.cols
+
+    def rows(self):
+        """Iterate row tuples over ALL columns in column order (the
+        table-tier contract; scalar batches yield 1-tuples here)."""
+        arrays = list(self.cols.values())
+        return zip(*[a.tolist() for a in arrays])
+
+    def row_values(self):
+        """Row values as the operators see them: the cell for scalar
+        batches, a tuple over columns otherwise."""
+        arrays = list(self.cols.values())
+        if self.is_scalar:
+            return arrays[0].tolist()
+        return list(zip(*[a.tolist() for a in arrays]))
+
+    def value_arrays(self):
+        """The columns a vectorized kernel consumes: one ndarray for
+        scalar batches, a tuple of ndarrays (in column order) for
+        tuple batches."""
+        arrays = tuple(self.cols.values())
+        if self.is_scalar:
+            return arrays[0]
+        return arrays
+
+    def timestamps(self):
+        """Per-row Optional[int] timestamps (exact boxing parity)."""
+        n = len(self)
+        if self.ts is None:
+            return [None] * n
+        stamps = self.ts.tolist()
+        if self.ts_mask is None:
+            return stamps
+        return [t if valid else None
+                for t, valid in zip(stamps, self.ts_mask.tolist())]
+
+    def to_records(self):
+        """Box into per-row StreamRecords — identical to what the
+        row-at-a-time path would have produced for the same rows."""
+        values = self.row_values()
+        if self.ts is None:
+            return [StreamRecord(v) for v in values]
+        if self.ts_mask is None:
+            return [StreamRecord(v, t)
+                    for v, t in zip(values, self.ts.tolist())]
+        stamps = self.ts.tolist()
+        return [StreamRecord(v, stamps[i] if valid else None)
+                for i, (v, valid)
+                in enumerate(zip(values, self.ts_mask.tolist()))]
+
+    def take(self, index):
+        """Gather rows by bool mask or index array → new batch."""
+        return RecordBatch(
+            {k: v[index] for k, v in self.cols.items()},
+            self.ts[index] if self.ts is not None else None,
+            self.ts_mask[index] if self.ts_mask is not None else None)
+
+    def with_cols(self, cols):
+        """New batch with replaced columns, same timestamps."""
+        return RecordBatch(cols, self.ts, self.ts_mask)
+
+    def __repr__(self):
+        return (f"RecordBatch({list(self.cols)} x {len(self)}"
+                f"{' +ts' if self.ts is not None else ''})")
 
 
 class Watermark(StreamElement):

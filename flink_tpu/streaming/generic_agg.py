@@ -1626,6 +1626,51 @@ class GenericWindowOperator(StreamOperator):
         if len(self._keys) >= self.flush_batch:
             self._flush_buffer()
 
+    def process_batch(self, batch):
+        """Columnar ingest: a RecordBatch feeds the engine as ready
+        columns — no StreamRecord boxing, no per-row buffer appends.
+        Buffered scalar rows flush first (they predate the batch, and
+        the engine must see rows in arrival order)."""
+        n = len(batch)
+        if n == 0:
+            return
+        if batch.ts is None or (batch.ts_mask is not None
+                                and not batch.ts_mask.all()):
+            # same contract as the scalar path: every row needs an
+            # event timestamp
+            raise ValueError(
+                "generic window operator requires event-time records "
+                "(assign timestamps upstream)")
+        self._flush_buffer()
+        self._ensure_engine()
+        values = batch.row_values()
+        keys_arr = self._batch_keys(batch, values)
+        self.engine.process_batch(
+            keys_arr, np.asarray(batch.ts, np.int64), values)
+        self._note_columnar(n)
+
+    def _batch_keys(self, batch, values):
+        """Key column for a batch: a ready column when the selector is
+        positional (or absent on scalar rows), else per-row get_key —
+        always the exact keys the scalar path would have buffered."""
+        from flink_tpu.core.functions import _FieldKeySelector
+        sel = self.key_selector
+        if sel is None and batch.is_scalar:
+            return np.asarray(next(iter(batch.cols.values())))
+        if isinstance(sel, _FieldKeySelector) \
+                and type(sel._field) is int and not batch.is_scalar:
+            col = batch.cols.get(f"f{sel._field}")
+            if col is not None:
+                return np.asarray(col)
+        keys = ([sel.get_key(v) for v in values] if sel is not None
+                else values)
+        keys_arr = np.asarray(keys)
+        if keys_arr.ndim != 1:
+            karr = np.empty(len(keys), object)
+            karr[:] = keys
+            keys_arr = karr
+        return keys_arr
+
     def _ensure_engine(self):
         if self.engine is None:
             self.engine = generic_engine_for_assigner(

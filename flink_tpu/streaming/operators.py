@@ -67,6 +67,14 @@ class Output(abc.ABC):
     @abc.abstractmethod
     def emit_watermark(self, watermark: Watermark) -> None: ...
 
+    def collect_batch(self, batch) -> None:
+        """Emit a whole RecordBatch element.  Default: box into
+        per-row records — outputs that can carry batches natively
+        (chained operators, the router) override this, so once a
+        batch survives an operator nothing downstream reboxes it."""
+        for record in batch.to_records():
+            self.collect(record)
+
     def collect_side(self, tag: OutputTag, record: StreamRecord) -> None:
         pass  # dropped unless a side output is wired
 
@@ -137,6 +145,13 @@ class StreamOperator(abc.ABC):
         self.subtask_index: int = 0
         self.num_subtasks: int = 1
         self.max_parallelism: int = 128
+        # columnar-pipeline accounting (over rows DELIVERED AS
+        # BATCHES; pure row streams leave the ratio undefined)
+        self.columnar_rows: int = 0
+        self.boxed_rows: int = 0
+        self.boxed_fallbacks: int = 0
+        self.columnar_fallback_reason: Optional[str] = None
+        self._boxed_fallbacks_counter = None
 
     # ---- wiring -----------------------------------------------------
     def setup(self, output: Output,
@@ -172,6 +187,29 @@ class StreamOperator(abc.ABC):
         self.metrics = group
         group.gauge("currentWatermark", lambda: self.current_watermark)
         group.gauge("watermarkLag", self._watermark_lag_ms)
+        col = group.add_group("columnar")
+        col.gauge("ratio", self._columnar_ratio)
+        col.gauge("fallback_reason",
+                  lambda: self.columnar_fallback_reason or "")
+        self._boxed_fallbacks_counter = col.counter("boxed_fallbacks")
+        self._boxed_fallbacks_counter.count = self.boxed_fallbacks
+
+    def _columnar_ratio(self):
+        total = self.columnar_rows + self.boxed_rows
+        if total == 0:
+            return None  # never saw a batch: ratio undefined
+        return self.columnar_rows / total
+
+    def _note_columnar(self, n: int) -> None:
+        self.columnar_rows += n
+
+    def _note_boxed(self, n: int, reason: str) -> None:
+        self.boxed_rows += n
+        self.boxed_fallbacks += 1
+        if self.columnar_fallback_reason is None:
+            self.columnar_fallback_reason = reason
+        if self._boxed_fallbacks_counter is not None:
+            self._boxed_fallbacks_counter.inc()
 
     def _watermark_lag_ms(self):
         wm = self.current_watermark
@@ -200,6 +238,20 @@ class StreamOperator(abc.ABC):
     # ---- elements ---------------------------------------------------
     @abc.abstractmethod
     def process_element(self, record: StreamRecord) -> None: ...
+
+    def process_batch(self, batch) -> None:
+        """Consume a whole RecordBatch.  The universal fallback boxes
+        the batch into per-row records ONCE at this operator (counted
+        in `columnar.boxed_fallbacks`) and runs the scalar path —
+        operators with a column kernel override this.  Downstream of
+        a boxing operator the stream is rows; downstream of a
+        surviving operator it stays a batch."""
+        self._note_boxed(
+            len(batch),
+            f"no batch kernel on {type(self).__name__}")
+        for record in batch.to_records():
+            self.set_key_context(record)
+            self.process_element(record)
 
     def process_watermark(self, watermark: Watermark) -> None:
         """(ref: AbstractStreamOperator.processWatermark :737)"""
@@ -361,11 +413,181 @@ class AbstractUdfStreamOperator(StreamOperator):
                     fn.restore_function_state(s["function"])
 
 
-class StreamMap(AbstractUdfStreamOperator):
+# ---------------------------------------------------------------------
+# Columnar kernels for the stateless UDF operators: a proven-LIFTABLE
+# UDF (PR 4's AOT bytecode analysis) applies directly to the batch's
+# numpy columns — arithmetic bytecode vectorizes through ndarray
+# operator overloading.  The first surviving batch is probe-validated
+# (vectorized row vs the scalar UDF on the same row); any exception,
+# shape mismatch, or probe divergence locks the operator onto the
+# boxed path permanently.  Verdicts and probes are per-operator, so an
+# opaque UDF boxes only its own hop.
+# ---------------------------------------------------------------------
+
+def _np_scalar(x):
+    import numpy as np
+    return x.item() if isinstance(x, np.generic) else x
+
+
+def _batch_row_value(batch, i):
+    arrays = tuple(batch.cols.values())
+    if batch.is_scalar:
+        return _np_scalar(arrays[0][i])
+    return tuple(_np_scalar(a[i]) for a in arrays)
+
+
+def _kernel_row_value(out, i):
+    """Row i of a kernel result (ndarray or tuple of ndarrays)."""
+    if type(out) is tuple:
+        return tuple(_np_scalar(a[i]) for a in out)
+    return _np_scalar(out[i])
+
+
+def _same_scalar(a, b) -> bool:
+    if type(a) is tuple or type(b) is tuple:
+        return (type(a) is tuple and type(b) is tuple
+                and len(a) == len(b)
+                and all(_same_scalar(x, y) for x, y in zip(a, b)))
+    if type(a) is not type(b):
+        return False
+    try:
+        if a == b:
+            return True
+        return a != a and b != b  # NaN == NaN for probe purposes
+    except Exception:  # noqa: BLE001
+        return False
+
+
+def _normalize_kernel_output(out, n):
+    """Kernel result → ndarray (scalar rows) or tuple of ndarrays
+    (tuple rows), broadcasting constant fields; None = not columnar."""
+    import numpy as np
+    if isinstance(out, np.ndarray):
+        return out if out.shape == (n,) else None
+    if type(out) is tuple and out:
+        cols = []
+        for item in out:
+            if isinstance(item, np.ndarray):
+                if item.shape != (n,):
+                    return None
+                cols.append(item)
+            elif isinstance(item, (int, float, str, np.generic)):
+                cols.append(np.full(n, item))
+            else:
+                return None
+        return tuple(cols)
+    return None
+
+
+def _kernel_output_batch(batch, arrays):
+    """Wrap normalized kernel output as a batch keeping timestamps."""
+    from flink_tpu.streaming.elements import RecordBatch
+    if type(arrays) is tuple:
+        cols = {f"f{i}": a for i, a in enumerate(arrays)}
+    else:
+        cols = {"v": arrays}
+    return RecordBatch(cols, batch.ts, batch.ts_mask)
+
+
+def _kernel_fn(user_function, attr: str):
+    """The callable the kernel path applies to column arrays: the raw
+    wrapped lambda when present (lambda adapters like _LambdaFilter
+    coerce their method's return — bool() chokes on a mask array), so
+    the kernel runs exactly the function the analyzer proved liftable."""
+    fn = getattr(user_function, "_fn", None)
+    if callable(fn):
+        return fn
+    return getattr(user_function, attr, user_function)
+
+
+def _udf_liftable(user_function, attr: str):
+    """(liftable, reason) for the wrapped UDF — conclusive LIFTABLE
+    from the AOT analyzer rides columns; everything else boxes."""
+    fn = _kernel_fn(user_function, attr)
+    try:
+        from flink_tpu.analysis.liftability import LIFTABLE, analyze_udf
+        rep = analyze_udf(fn)
+        if rep.verdict == LIFTABLE:
+            return True, ""
+        return False, f"{attr} UDF not liftable ({rep.verdict}: " \
+                      + "; ".join(rep.reasons[:2]) + ")"
+    except Exception as e:  # noqa: BLE001
+        return False, f"liftability analysis failed: {e!r}"
+
+
+class _ColumnKernelMixin:
+    """Shared decide/probe/fallback state machine for StreamMap and
+    StreamFilter.  `_batch_kernel` is None (undecided), True (riding
+    columns, probe passed), or False (locked onto the boxed path)."""
+
+    _batch_kernel = None
+    _KERNEL_ATTR = ""
+
+    def _decide_kernel(self) -> bool:
+        ok, reason = _udf_liftable(self.user_function, self._KERNEL_ATTR)
+        if not ok:
+            self._batch_kernel = False
+            self.columnar_fallback_reason = reason
+        return ok
+
+    def _kernel_fallback(self, batch, reason: str):
+        self._batch_kernel = False
+        self.columnar_fallback_reason = reason
+        StreamOperator.process_batch(self, batch)
+
+    def process_batch(self, batch):
+        n = len(batch)
+        if n == 0:
+            return
+        decided = self._batch_kernel
+        if decided is False or (decided is None
+                                and not self._decide_kernel()):
+            StreamOperator.process_batch(self, batch)
+            return
+        fn = _kernel_fn(self.user_function, self._KERNEL_ATTR)
+        try:
+            out = fn(batch.value_arrays())
+        except Exception as e:  # noqa: BLE001
+            self._kernel_fallback(batch, f"kernel raised {e!r}")
+            return
+        if decided is None:
+            # first surviving batch: validate the vectorized result
+            # against the scalar UDF on the edge rows (LIFTABLE UDFs
+            # are proven pure, so replaying rows is safe)
+            err = self._probe(batch, fn, out, n)
+            if err is not None:
+                self._kernel_fallback(batch, err)
+                return
+            self._batch_kernel = True
+        self._emit_kernel_result(batch, out, n)
+
+
+class StreamMap(_ColumnKernelMixin, AbstractUdfStreamOperator):
     """(ref: StreamMap.java)"""
+
+    _KERNEL_ATTR = "map"
 
     def process_element(self, record):
         self.output.collect(record.replace(self.user_function.map(record.value)))
+
+    def _probe(self, batch, fn, out, n):
+        arrays = _normalize_kernel_output(out, n)
+        if arrays is None:
+            return "kernel output is not a column shape"
+        for i in (0, n - 1):
+            if not _same_scalar(fn(_batch_row_value(batch, i)),
+                                _kernel_row_value(arrays, i)):
+                return "probe mismatch (vectorized != scalar result)"
+        return None
+
+    def _emit_kernel_result(self, batch, out, n):
+        arrays = _normalize_kernel_output(out, n)
+        if arrays is None:
+            self._kernel_fallback(batch,
+                                  "kernel output is not a column shape")
+            return
+        self._note_columnar(n)
+        self.output.collect_batch(_kernel_output_batch(batch, arrays))
 
 
 class StreamFlatMap(AbstractUdfStreamOperator):
@@ -378,12 +600,37 @@ class StreamFlatMap(AbstractUdfStreamOperator):
                 self.output.collect(record.replace(value))
 
 
-class StreamFilter(AbstractUdfStreamOperator):
+class StreamFilter(_ColumnKernelMixin, AbstractUdfStreamOperator):
     """(ref: StreamFilter.java)"""
+
+    _KERNEL_ATTR = "filter"
 
     def process_element(self, record):
         if self.user_function.filter(record.value):
             self.output.collect(record)
+
+    def _probe(self, batch, fn, out, n):
+        import numpy as np
+        if not (isinstance(out, np.ndarray) and out.shape == (n,)
+                and out.dtype == np.bool_):
+            return "filter kernel did not produce a bool mask"
+        for i in (0, n - 1):
+            if bool(fn(_batch_row_value(batch, i))) != bool(out[i]):
+                return "probe mismatch (vectorized != scalar result)"
+        return None
+
+    def _emit_kernel_result(self, batch, out, n):
+        import numpy as np
+        if not (isinstance(out, np.ndarray) and out.shape == (n,)
+                and out.dtype == np.bool_):
+            self._kernel_fallback(
+                batch, "filter kernel did not produce a bool mask")
+            return
+        self._note_columnar(n)
+        if out.all():
+            self.output.collect_batch(batch)
+        elif out.any():
+            self.output.collect_batch(batch.take(out))
 
 
 class StreamSink(AbstractUdfStreamOperator):
@@ -397,6 +644,17 @@ class StreamSink(AbstractUdfStreamOperator):
     def process_element(self, record):
         self.user_function.invoke(record.value,
                                   SinkContext(record.timestamp, self))
+
+    def process_batch(self, batch):
+        """Vectorized collect: a sink function exposing invoke_batch
+        takes the whole batch in one call (a batch dies columnar);
+        plain sinks box per row."""
+        fn = self.user_function
+        if hasattr(fn, "invoke_batch"):
+            self._note_columnar(len(batch))
+            fn.invoke_batch(batch)
+        else:
+            StreamOperator.process_batch(self, batch)
 
 
 class SinkContext:

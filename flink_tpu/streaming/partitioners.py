@@ -70,6 +70,13 @@ class StreamPartitioner(abc.ABC):
             out[i] = self.select_channels(v, num_channels)[0]
         return out
 
+    def split_batch(self, batch, num_channels: int):
+        """Route a whole RecordBatch element: list of (channel_index,
+        sub_batch) pairs, rows in original order within each channel.
+        None ⇒ no batch split for this partitioner (the router boxes
+        the batch and takes the per-record path)."""
+        return None
+
     def setup(self, num_channels: int) -> None:  # noqa: B027
         pass
 
@@ -85,6 +92,9 @@ class ForwardPartitioner(StreamPartitioner):
 
     def select_channels_batch(self, values, num_channels):
         return np.zeros(len(values), np.int64)
+
+    def split_batch(self, batch, num_channels):
+        return [(0, batch)]
 
     def __repr__(self):
         return "FORWARD"
@@ -112,6 +122,13 @@ class RebalancePartitioner(StreamPartitioner):
             self._next = int(idx[-1])
         return idx
 
+    def split_batch(self, batch, num_channels):
+        # rebalance at BATCH granularity: one whole batch per channel,
+        # round robin — load still spreads (batches are uniform-sized)
+        # without paying a per-row scatter on a keyless exchange
+        self._next = (self._next + 1) % num_channels
+        return [(self._next, batch)]
+
     def __repr__(self):
         return "REBALANCE"
 
@@ -138,6 +155,10 @@ class RescalePartitioner(StreamPartitioner):
             self._next = int(idx[-1])
         return idx
 
+    def split_batch(self, batch, num_channels):
+        self._next = (self._next + 1) % num_channels
+        return [(self._next, batch)]
+
     def __repr__(self):
         return "RESCALE"
 
@@ -149,6 +170,11 @@ class ShufflePartitioner(StreamPartitioner):
 
     def select_channels(self, value, num_channels):
         return [random.randrange(num_channels)]
+
+    def split_batch(self, batch, num_channels):
+        # uniform-random at batch granularity (same spirit as the
+        # per-record shuffle: no key affinity to preserve)
+        return [(random.randrange(num_channels), batch)]
 
     def __repr__(self):
         return "SHUFFLE"
@@ -180,8 +206,21 @@ class GlobalPartitioner(StreamPartitioner):
     def select_channels_batch(self, values, num_channels):
         return np.zeros(len(values), np.int64)
 
+    def split_batch(self, batch, num_channels):
+        return [(0, batch)]
+
     def __repr__(self):
         return "GLOBAL"
+
+
+def _batch_row_value(batch, i):
+    """Row i of a RecordBatch as the scalar path would see it."""
+    arrays = tuple(batch.cols.values())
+    if batch.is_scalar:
+        x = arrays[0][i]
+        return x.item() if isinstance(x, np.generic) else x
+    return tuple(x.item() if isinstance(x, np.generic) else x
+                 for x in (a[i] for a in arrays))
 
 
 class KeyGroupStreamPartitioner(StreamPartitioner):
@@ -193,6 +232,9 @@ class KeyGroupStreamPartitioner(StreamPartitioner):
     def __init__(self, key_selector: KeySelector, max_parallelism: int):
         self.key_selector = key_selector
         self.max_parallelism = max_parallelism
+        #: vectorized key-selector state: None = undecided, True =
+        #: selector rides columns (probe passed), False = per-row keys
+        self._key_kernel = None
 
     def select_channels(self, value, num_channels):
         key = self.key_selector.get_key(value)
@@ -205,6 +247,86 @@ class KeyGroupStreamPartitioner(StreamPartitioner):
         hashes = _routing_hashes([get_key(v) for v in values])
         return assign_operator_indexes_np(hashes, self.max_parallelism,
                                           num_channels)
+
+    def split_batch(self, batch, num_channels):
+        """The columnar keyBy exchange: ONE hash pass over the key
+        column (vectorized selector when liftable, else per-row keys),
+        one stable argsort, gathered sub-batches per channel.  Hash
+        parity with the scalar path is exact: int64 key columns take
+        the same splitmix64 arithmetic `_routing_hashes` applies to
+        all-int key lists."""
+        n = len(batch)
+        if n == 0:
+            return []
+        keys = self._vector_keys(batch, n)
+        if keys is not None:
+            hashes = splitmix64_np(keys)
+        else:
+            get_key = self.key_selector.get_key
+            hashes = _routing_hashes(
+                [get_key(v) for v in batch.row_values()])
+        idx = assign_operator_indexes_np(hashes, self.max_parallelism,
+                                         num_channels)
+        order = np.argsort(idx, kind="stable")
+        bounds = np.searchsorted(idx[order], np.arange(num_channels + 1))
+        out = []
+        for c in range(num_channels):
+            lo, hi = int(bounds[c]), int(bounds[c + 1])
+            if lo < hi:
+                # stable sort ⇒ order[lo:hi] ascends ⇒ original row
+                # order per channel is preserved
+                out.append((c, batch.take(order[lo:hi])))
+        return out
+
+    def _vector_keys(self, batch, n):
+        """int64 ndarray from the vectorized selector, or None (per-
+        row path).  Only int64 columns qualify — any other key type
+        must hash through scalar stable_hash64 for routing parity."""
+        kk = self._key_kernel
+        if kk is False:
+            return None
+        if kk is None and not self._decide_key_kernel():
+            return None
+        try:
+            out = self.key_selector.get_key(batch.value_arrays())
+        except Exception:  # noqa: BLE001
+            self._key_kernel = False
+            return None
+        if not (isinstance(out, np.ndarray) and out.shape == (n,)
+                and out.dtype == np.int64):
+            self._key_kernel = False
+            return None
+        if kk is None:
+            # first batch: probe the edge rows against the scalar
+            # selector before trusting the vectorized keys
+            get_key = self.key_selector.get_key
+            for i in (0, n - 1):
+                if get_key(_batch_row_value(batch, i)) != int(out[i]):
+                    self._key_kernel = False
+                    return None
+            self._key_kernel = True
+        return out
+
+    def _decide_key_kernel(self) -> bool:
+        from flink_tpu.core.functions import _FieldKeySelector
+        sel = self.key_selector
+        if isinstance(sel, _FieldKeySelector) \
+                and isinstance(sel._field, int):
+            return True  # positional field access: column indexing
+        try:
+            from flink_tpu.analysis.liftability import (
+                LIFTABLE,
+                analyze_udf,
+            )
+            fn = getattr(sel, "_fn", None)
+            if not callable(fn):
+                fn = getattr(sel, "get_key", sel)
+            if analyze_udf(fn).verdict == LIFTABLE:
+                return True
+        except Exception:  # noqa: BLE001
+            pass
+        self._key_kernel = False
+        return False
 
     def __repr__(self):
         return "HASH"

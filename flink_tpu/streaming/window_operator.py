@@ -307,6 +307,11 @@ class WindowOperator(AbstractUdfStreamOperator):
     # ---- lifecycle --------------------------------------------------
     def open(self):
         super().open()
+        # structural fallback, known AOT: triggers and per-(key,
+        # window) namespaced state are inherently per-row — batches
+        # reaching this operator box (the columnar.ratio gauge and
+        # linter FT184 surface this reason)
+        self.columnar_fallback_reason = "per-row window/trigger state"
         self._emit_batch_hist = None
         if self.metrics is not None:
             # eager so monitoring sees the zero (ref: the counter is

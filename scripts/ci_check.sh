@@ -7,6 +7,9 @@
 #   4. observability smoke — a short MiniCluster job with metric
 #      sampling (history + checkpoints routes must fill) and a seeded
 #      backpressure job that must fire exactly one health alert
+#   5. columnar gate — the boxed-vs-columnar differential suite, then
+#      a real-TCP shuffle smoke with the wire codec pinned ON and OFF
+#      (identical delivered streams required)
 #
 # Stages keep running after a failure so one report covers
 # everything; rc is non-zero if ANY stage failed.
@@ -18,22 +21,28 @@ cd "$(dirname "$0")/.."
 export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
 rc=0
 
-echo "== stage 1/4: repo lint =="
+echo "== stage 1/5: repo lint =="
 scripts/lint_repo.sh || rc=1
 
 echo
-echo "== stage 2/4: strict graph lint over examples/ =="
+echo "== stage 2/5: strict graph lint over examples/ =="
 python -m flink_tpu lint --strict examples/ || rc=1
 
 echo
-echo "== stage 3/4: tier-1 test suite =="
+echo "== stage 3/5: tier-1 test suite =="
 python -m pytest tests/ -q -m 'not slow' \
     --continue-on-collection-errors \
     -p no:cacheprovider -p no:xdist -p no:randomly || rc=1
 
 echo
-echo "== stage 4/4: observability smoke =="
+echo "== stage 4/5: observability smoke =="
 python scripts/observability_smoke.py || rc=1
+
+echo
+echo "== stage 5/5: columnar differential + shuffle codec smoke =="
+python -m pytest tests/test_columnar_pipeline.py -q \
+    -p no:cacheprovider -p no:xdist -p no:randomly || rc=1
+python scripts/columnar_smoke.py || rc=1
 
 echo
 if [ "$rc" -eq 0 ]; then

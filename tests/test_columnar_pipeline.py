@@ -1,0 +1,519 @@
+"""Differential suite for the end-to-end columnar operator pipeline:
+boxed and columnar executions of the same stream must be
+result-identical — values, timestamps (including None-timestamp
+validity masks), watermark/barrier ordering, and exactly-once under
+the seeded chaos injector — while the columnar side never boxes a
+StreamRecord on the batch path."""
+
+import numpy as np
+import pytest
+
+from flink_tpu.runtime import netchannel
+from flink_tpu.runtime.netchannel import (
+    decode_elements,
+    decode_elements_batch,
+    encode_elements,
+)
+from flink_tpu.streaming import columnar
+from flink_tpu.streaming.elements import (
+    MAX_TIMESTAMP,
+    RecordBatch,
+    StreamRecord,
+    Watermark,
+)
+
+
+def _records(values, ts=None):
+    if ts is None:
+        return [StreamRecord(v) for v in values]
+    return [StreamRecord(v, t) for v, t in zip(values, ts)]
+
+
+def _rows(elements):
+    """(value, timestamp) rows of a decoded element list, flattening
+    batches — the cross-mode equality currency of this suite."""
+    rows = []
+    for el in elements:
+        if el.is_batch:
+            rows.extend(zip(el.row_values(), el.timestamps()))
+        else:
+            rows.append((el.value, el.timestamp))
+    return rows
+
+
+# ---------------------------------------------------------------------
+# wire: batch-mode decode
+
+
+@pytest.mark.parametrize("values", [
+    [1, 2, -5, 2**40],
+    [0.5, -1.25, 3.0],
+    ["a", "bb", "", "ccc"],
+    [(1, "x", 0.5), (2, "y", 1.5)],
+])
+def test_decode_batch_matches_boxed_decode(values):
+    ts = list(range(len(values)))
+    enc = encode_elements(_records(values, ts))
+    assert enc[0] == "col"
+    boxed = decode_elements(enc)
+    elements, count = decode_elements_batch(enc)
+    assert count == len(values)
+    # ONE RecordBatch, zero StreamRecord allocations on this path
+    assert len(elements) == 1 and elements[0].is_batch
+    assert _rows(elements) == _rows(boxed)
+
+
+def test_decode_batch_none_timestamp_mask():
+    values = [10, 20, 30, 40]
+    records = [StreamRecord(10, 5), StreamRecord(20),
+               StreamRecord(30, 7), StreamRecord(40)]
+    enc = encode_elements(records)
+    assert enc[0] == "col" and enc[3][0] == "mask"
+    elements, count = decode_elements_batch(enc)
+    (batch,) = elements
+    assert count == 4
+    assert list(batch.timestamps()) == [5, None, 7, None]
+    assert [r.timestamp for r in batch.to_records()] == [5, None, 7, None]
+    assert batch.row_values() == values
+
+
+def test_decode_batch_numeric_columns_are_zero_copy():
+    enc = encode_elements(_records([1, 2, 3], [0, 1, 2]))
+    elements, _ = decode_elements_batch(enc)
+    (batch,) = elements
+    # the received buffer IS the column: no copy between wire and batch
+    assert batch.cols["v"] is enc[2][1]
+    assert batch.ts is enc[3][1]
+
+
+def test_decode_batch_pickle_passthrough():
+    # non-columnar payloads (here: a dict value) ride the pickle tier
+    # and count element-per-element
+    records = _records([{"k": 1}, {"k": 2}])
+    enc = encode_elements(records)
+    assert enc[0] == "pickle"
+    elements, count = decode_elements_batch(enc)
+    assert count == 2 and elements == records
+
+
+# ---------------------------------------------------------------------
+# routing: vectorized keyBy split vs per-record selection
+
+
+def _batch_of(values, ts=None):
+    return columnar.batch_from_records(list(values), ts)
+
+
+def _split_parity(key_selector, values, num_channels=4):
+    from flink_tpu.streaming.partitioners import KeyGroupStreamPartitioner
+    part_a = KeyGroupStreamPartitioner(key_selector, 128)
+    part_b = KeyGroupStreamPartitioner(key_selector, 128)
+    batch = _batch_of(values, list(range(len(values))))
+    split = part_a.split_batch(batch, num_channels)
+    assert split is not None
+    got = {c: list(zip(sub.row_values(), sub.timestamps()))
+           for c, sub in split}
+    want = {}
+    for i, v in enumerate(values):
+        (c,) = part_b.select_channels(v, num_channels)
+        want.setdefault(c, []).append((v, i))
+    assert got == {c: rows for c, rows in want.items()}
+
+
+def test_split_batch_parity_int_field_key():
+    from flink_tpu.core.functions import as_key_selector
+    values = [(int(k), float(k) * 0.5) for k in
+              np.random.default_rng(3).integers(0, 50, 500)]
+    _split_parity(as_key_selector(0), values)
+
+
+def test_split_batch_parity_liftable_lambda_key():
+    from flink_tpu.core.functions import as_key_selector
+    values = [(int(k), "pay") for k in range(200)]
+    _split_parity(as_key_selector(lambda v: v[0]), values)
+
+
+def test_split_batch_parity_opaque_key():
+    from flink_tpu.core.functions import as_key_selector
+    # string keys never vectorize: per-row stable hashing must agree
+    # with the per-record path bit for bit
+    values = [(f"user{k % 17}", k) for k in range(300)]
+    _split_parity(as_key_selector(lambda v: v[0]), values)
+
+
+def test_split_batch_preserves_order_per_channel():
+    from flink_tpu.core.functions import as_key_selector
+    from flink_tpu.streaming.partitioners import KeyGroupStreamPartitioner
+    part = KeyGroupStreamPartitioner(as_key_selector(0), 128)
+    values = [(i % 3, i) for i in range(100)]
+    split = part.split_batch(_batch_of(values), 2)
+    for _, sub in split:
+        seq = [v[1] for v in sub.row_values()]
+        assert seq == sorted(seq)
+
+
+# ---------------------------------------------------------------------
+# operators: kernel vs boxed differential
+
+
+class _Capture:
+    """Output capturing emissions in arrival order, batches kept."""
+
+    def __init__(self):
+        self.elements = []
+
+    def collect(self, record):
+        self.elements.append(record)
+
+    def collect_batch(self, batch):
+        self.elements.append(batch)
+
+    def emit_watermark(self, watermark):
+        self.elements.append(watermark)
+
+
+def _run_operator(make_op, values, ts, batched):
+    op = make_op()
+    out = _Capture()
+    op.setup(out)
+    op.open()
+    if batched:
+        op.process_batch(_batch_of(values, ts))
+    else:
+        for v, t in zip(values, ts):
+            op.process_element(StreamRecord(v, t))
+    return op, out
+
+
+@pytest.mark.parametrize("fn,values", [
+    (lambda v: v * 3 + 1, list(range(50))),
+    (lambda t: (t[0], t[1] * 2.0), [(i, float(i)) for i in range(50)]),
+    (lambda t: (t[1], "k"), [(i, i * 7) for i in range(20)]),
+])
+def test_map_kernel_differential(fn, values):
+    from flink_tpu.core.functions import _LambdaMap
+    from flink_tpu.streaming.operators import StreamMap
+    ts = list(range(len(values)))
+    op_b, boxed = _run_operator(lambda: StreamMap(_LambdaMap(fn)),
+                                values, ts, batched=False)
+    op_c, col = _run_operator(lambda: StreamMap(_LambdaMap(fn)),
+                              values, ts, batched=True)
+    assert _rows(col.elements) == _rows(boxed.elements)
+    assert op_c._batch_kernel is True
+    assert op_c.columnar_rows == len(values) and op_c.boxed_fallbacks == 0
+    # the batch survived: exactly one RecordBatch came out
+    assert len(col.elements) == 1 and col.elements[0].is_batch
+
+
+def test_filter_kernel_differential():
+    from flink_tpu.core.functions import _LambdaFilter
+    from flink_tpu.streaming.operators import StreamFilter
+    values = [(i % 11, i) for i in range(200)]
+    ts = list(range(200))
+    fn = lambda t: t[0] > 4  # noqa: E731
+    _, boxed = _run_operator(lambda: StreamFilter(_LambdaFilter(fn)),
+                             values, ts, batched=False)
+    op_c, col = _run_operator(lambda: StreamFilter(_LambdaFilter(fn)),
+                              values, ts, batched=True)
+    assert _rows(col.elements) == _rows(boxed.elements)
+    assert op_c._batch_kernel is True
+
+
+def test_opaque_udf_boxes_with_identical_results():
+    from flink_tpu.core.functions import _LambdaMap
+    from flink_tpu.streaming.operators import StreamMap
+
+    def branchy(v):  # data-dependent branch: conclusively not liftable
+        return v * 2 if v % 2 else v - 1
+
+    values, ts = list(range(40)), list(range(40))
+    _, boxed = _run_operator(lambda: StreamMap(_LambdaMap(branchy)),
+                             values, ts, batched=False)
+    op_c, col = _run_operator(lambda: StreamMap(_LambdaMap(branchy)),
+                              values, ts, batched=True)
+    assert _rows(col.elements) == _rows(boxed.elements)
+    assert op_c._batch_kernel is False
+    assert op_c.boxed_fallbacks == 1 and op_c.boxed_rows == 40
+    assert op_c.columnar_fallback_reason
+    # boxing is per-operator: the batch left as records
+    assert all(el.is_record for el in col.elements)
+
+
+def test_kernel_exception_locks_boxed_path():
+    from flink_tpu.core.functions import _LambdaMap
+    from flink_tpu.streaming.operators import StreamMap
+    # liftable by analysis, but the vectorized call raises (array
+    # index into a constant tuple): the operator must demote
+    # permanently and still produce boxed output
+    fn = lambda v: (10, 20, 30)[v]  # noqa: E731
+    values = [i % 3 for i in range(30)]
+    ts = list(range(30))
+    _, boxed = _run_operator(lambda: StreamMap(_LambdaMap(fn)),
+                             values, ts, batched=False)
+    op_c, col = _run_operator(lambda: StreamMap(_LambdaMap(fn)),
+                              values, ts, batched=True)
+    assert _rows(col.elements) == _rows(boxed.elements)
+    assert op_c._batch_kernel is False
+    assert "raised" in op_c.columnar_fallback_reason
+    # the lock is permanent: the next batch boxes without retrying
+    op_c.process_batch(_batch_of(values, ts))
+    assert op_c.boxed_fallbacks == 2
+
+
+def test_probe_catches_silent_vectorized_divergence():
+    from flink_tpu.core.functions import _LambdaMap
+    from flink_tpu.streaming.operators import StreamMap
+    # int64 << 70 silently wraps to 0 under numpy while Python ints
+    # keep the true value — the edge-row probe must catch it and box
+    fn = lambda v: v << 70  # noqa: E731
+    values, ts = list(range(1, 20)), list(range(19))
+    _, boxed = _run_operator(lambda: StreamMap(_LambdaMap(fn)),
+                             values, ts, batched=False)
+    op_c, col = _run_operator(lambda: StreamMap(_LambdaMap(fn)),
+                              values, ts, batched=True)
+    assert _rows(col.elements) == _rows(boxed.elements)
+    assert op_c._batch_kernel is False
+    assert "probe mismatch" in op_c.columnar_fallback_reason
+
+
+# ---------------------------------------------------------------------
+# control ordering: flush-before-control with batches in flight
+
+
+def test_router_flushes_rows_before_batch_and_control():
+    from flink_tpu.runtime.local import _RouterOutput
+    from flink_tpu.streaming.partitioners import ForwardPartitioner
+
+    class _Chan:
+        blocked = False
+        capacity = 1 << 20
+        queue = ()
+
+        def __init__(self):
+            self.seen = []
+
+        def push(self, el):
+            self.seen.append(el)
+
+        def push_batch(self, els):
+            self.seen.extend(els)
+
+    ch = _Chan()
+    router = _RouterOutput()
+    router.add_route(ForwardPartitioner(), [ch])
+    router.collect(StreamRecord(1, 0))
+    router.collect(StreamRecord(2, 1))
+    router.collect_batch(_batch_of([3, 4], [2, 3]))
+    router.collect(StreamRecord(5, 4))
+    router.emit_watermark(Watermark(100))
+    kinds = [("wm" if el.is_watermark else
+              "batch" if el.is_batch else el.value) for el in ch.seen]
+    # rows buffered before the batch flushed FIRST (they predate it),
+    # the tail row flushed before the watermark: wire order == emit
+    # order, control never overtakes records
+    assert kinds == [1, 2, "batch", 5, "wm"]
+    assert _rows(ch.seen[:-1]) == [(1, 0), (2, 1), (3, 2), (4, 3), (5, 4)]
+
+
+def test_input_channel_row_accounting():
+    from flink_tpu.runtime.local import SubtaskInstance, _InputChannel
+
+    class _Stub:
+        pass
+
+    ch = _InputChannel.__new__(_InputChannel)
+    _InputChannel.__init__(ch, _Stub(), 0, 0, capacity=64)
+    ch.push(StreamRecord(1))
+    assert ch.extra_rows == 0
+    ch.push(_batch_of(list(range(100))))
+    # a queued batch counts its rows toward channel capacity, so
+    # row-volume backpressure survives batching
+    assert len(ch.queue) + ch.extra_rows == 101
+    _ = SubtaskInstance  # imported for doc link
+
+
+# ---------------------------------------------------------------------
+# end to end: same job, pipeline on vs off
+
+
+class _SumAgg:
+    def create_accumulator(self):
+        return 0
+
+    def add(self, value, acc):
+        return acc + value[1]
+
+    def get_result(self, acc):
+        return acc
+
+    def merge(self, a, b):
+        return a + b
+
+
+def _windowed_job(values, executor=None, columnar_pipeline=None):
+    from flink_tpu.core.functions import AggregateFunction
+    from flink_tpu.streaming.columnar import VectorizedCollectionSource
+    from flink_tpu.streaming.datastream import StreamExecutionEnvironment
+    from flink_tpu.streaming.sources import CollectSink
+    from flink_tpu.streaming.windowing import Time
+
+    class SumAgg(_SumAgg, AggregateFunction):
+        pass
+
+    sink = CollectSink()
+    env = StreamExecutionEnvironment()
+    if executor == "minicluster":
+        env.use_mini_cluster(2)
+        env.set_parallelism(2)
+    (env.add_source(VectorizedCollectionSource(values, timestamped=True,
+                                               chunk=64),
+                    name="vec_source")
+        .map(lambda t: (t[0], t[1] * 3))
+        .filter(lambda t: t[1] % 7 != 0)
+        .key_by(lambda v: v[0])
+        .time_window(Time.milliseconds_of(100))
+        .aggregate(SumAgg())
+        .add_sink(sink))
+    saved = columnar.PIPELINE_ENABLED
+    if columnar_pipeline is not None:
+        columnar.PIPELINE_ENABLED = columnar_pipeline
+    try:
+        env.execute("columnar-diff")
+    finally:
+        columnar.PIPELINE_ENABLED = saved
+    return sorted(sink.values)
+
+
+def _diff_data(n=700, n_keys=7):
+    rng = np.random.default_rng(11)
+    keys = rng.integers(0, n_keys, n)
+    return [((int(k), int(v)), int(t)) for t, (k, v) in
+            enumerate(zip(keys, rng.integers(0, 100, n)))]
+
+
+def test_local_differential_columnar_vs_boxed():
+    data = _diff_data()
+    assert _windowed_job(data, columnar_pipeline=True) == \
+        _windowed_job(data, columnar_pipeline=False)
+
+
+def test_minicluster_differential_columnar_vs_boxed():
+    data = _diff_data()
+    assert _windowed_job(data, executor="minicluster",
+                         columnar_pipeline=True) == \
+        _windowed_job(data, executor="minicluster",
+                      columnar_pipeline=False)
+
+
+def test_minicluster_pipeline_knob_scopes_the_run():
+    from flink_tpu.runtime.minicluster import MiniCluster
+    from flink_tpu.streaming.columnar import VectorizedCollectionSource
+    from flink_tpu.streaming.datastream import StreamExecutionEnvironment
+    from flink_tpu.streaming.sources import CollectSink
+
+    sink = CollectSink()
+    env = StreamExecutionEnvironment()
+    (env.add_source(VectorizedCollectionSource(list(range(50)), chunk=16))
+        .map(lambda v: v + 1)
+        .add_sink(sink))
+    env.graph.job_name = "knob"
+    assert columnar.PIPELINE_ENABLED is True
+    MiniCluster(num_task_managers=1,
+                columnar_pipeline=False).execute(env.get_job_graph())
+    # forced off for the run, restored after
+    assert columnar.PIPELINE_ENABLED is True
+    assert sorted(sink.values) == list(range(1, 51))
+
+
+def test_chaos_exactly_once_with_columnar_batches():
+    """A seeded crash + storage fault mid-stream: the columnar job's
+    output multiset must equal the fault-free run (replay restores the
+    source offset at a batch boundary and re-emits batches)."""
+    import collections
+    import tempfile
+
+    from flink_tpu.runtime import faults
+    from flink_tpu.runtime.faults import FaultInjector
+    from flink_tpu.streaming.datastream import StreamExecutionEnvironment
+
+    def run():
+        from flink_tpu.core.functions import AggregateFunction
+        from flink_tpu.streaming.columnar import VectorizedCollectionSource
+        from flink_tpu.streaming.sources import CollectSink
+        from flink_tpu.streaming.windowing import Time
+
+        class SumAgg(_SumAgg, AggregateFunction):
+            pass
+
+        sink = CollectSink()
+        env = StreamExecutionEnvironment()
+        env.enable_checkpointing(10, tolerable_failures=16)
+        env.set_checkpoint_storage(
+            "filesystem",
+            directory=tempfile.mkdtemp(prefix="flink_tpu_coldiff_"))
+        env.set_restart_strategy("fixed_delay", restart_attempts=5,
+                                 delay_ms=0)
+        (env.add_source(VectorizedCollectionSource(_diff_data(400),
+                                                   timestamped=True,
+                                                   chunk=32))
+            .key_by(lambda v: v[0])
+            .time_window(Time.milliseconds_of(100))
+            .aggregate(SumAgg())
+            .add_sink(sink))
+        result = env.execute("columnar-chaos")
+        return collections.Counter(sink.values), result
+
+    faults.deactivate()
+    baseline, _ = run()
+    inj = FaultInjector(seed=13)
+    inj.fail_n_times("storage.persist", 1)
+    inj.fail_n_times("task.process", 1, after=4)
+    inj.delay("task.process", 2)
+    faults.install(inj)
+    try:
+        chaos, result = run()
+    finally:
+        faults.deactivate()
+    assert result.restarts >= 1, "the injected crash must have fired"
+    assert chaos == baseline
+
+
+# ---------------------------------------------------------------------
+# eligibility + linter
+
+
+def test_chain_report_names_first_blocker():
+    from flink_tpu.analysis.columnar_eligibility import (
+        BOXED,
+        KERNEL,
+        chain_report,
+    )
+    from flink_tpu.core.functions import _LambdaMap
+    from flink_tpu.streaming.operators import StreamMap
+
+    liftable = StreamMap(_LambdaMap(lambda v: v + 1))
+    opaque = StreamMap(_LambdaMap(lambda v: v * 2 if v else v))
+    rep = chain_report([liftable, opaque, liftable])
+    assert rep["eligible"] is True
+    assert rep["prefix_len"] == 1
+    assert rep["first_blocker"] == "StreamMap"
+    assert rep["modes"][0][1] == KERNEL
+    assert rep["modes"][1][1] == BOXED and rep["modes"][1][2]
+
+
+def test_linter_reports_ft184():
+    from flink_tpu.analysis.graph_linter import lint_graph
+    from flink_tpu.streaming.columnar import VectorizedCollectionSource
+    from flink_tpu.streaming.datastream import StreamExecutionEnvironment
+    from flink_tpu.streaming.sources import CollectSink
+
+    env = StreamExecutionEnvironment()
+    (env.add_source(VectorizedCollectionSource(list(range(10))))
+        .map(lambda v: v + 1)
+        .map(lambda v: v * 2 if v else v)   # first blocker
+        .add_sink(CollectSink()))
+    report = lint_graph(env.get_stream_graph())
+    ft184 = report.by_code("FT184")
+    assert ft184, "linter must report columnar chain eligibility"
+    assert any("boxes at" in d.message for d in ft184)
+    assert all(d.severity == "info" for d in ft184)
