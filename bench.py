@@ -1099,6 +1099,16 @@ def main():
                               key=lambda kv: -kv[1]["total_ms"])[:20]:
             log(f"[bench]   native.{name}: n={s['dispatches']} "
                 f"total={s['total_ms']:.1f}ms p99={s['p99_ms']:.3f}ms")
+        # lane-merged view: MiniCluster configs run worker threads in
+        # this process, so the merged trace shows one lane per worker
+        merged = tracing.build_cluster_trace(tracer.lane_buffers())
+        lanes = (merged.get("metadata") or {}).get("lanes") or {}
+        with open("bench_trace_cluster.json", "w") as f:
+            json.dump(merged, f)
+        log(f"[bench] cluster trace: {len(lanes)} lane(s) -> "
+            f"bench_trace_cluster.json"
+            + (f"; {tracer.dropped} events dropped at the ring limit"
+               if tracer.dropped else ""))
 
     with open("bench_report.json", "w") as f:
         json.dump(results, f, indent=2)

@@ -13,7 +13,8 @@ bin/flink script).
     python -m flink_tpu top <rest-url>               live per-vertex view of
                                    [--job NAME]      a running job (records/s,
                                    [--interval S]    backpressure, watermark
-                                   [--once]          lag, last checkpoint)
+                                   [--once]          lag, checkpoints,
+                                                     bottleneck)
     python -m flink_tpu list --master H:P            list cluster jobs
     python -m flink_tpu cancel --master H:P <job>    cancel a running job
                                    [-s DIR]          ... with a savepoint
@@ -286,14 +287,17 @@ def _top_rows(job, detail, metrics, prev, dt_s):
     return rows
 
 
-def _top_render(job, status, rows, checkpoints, alerts) -> str:
+def _top_render(job, status, rows, checkpoints, alerts,
+                bottleneck=None) -> str:
     def fmt(v, spec="{:.0f}", dash="-"):
         return dash if v is None else spec.format(v)
 
+    bn = (bottleneck or {}).get("bottleneck") or {}
+    bn_vid = bn.get("vertex_id")
     lines = [f"job: {job}  [{status}]",
              f"{'id':>4}  {'vertex':<36} {'par':>3}  {'rec/s':>10}  "
              f"{'backpressure':<18} {'wmLag ms':>10} {'col%':>6} "
-             f"{'boxed':>6}"]
+             f"{'boxed':>6} {'BOTTLENECK':<10}"]
     for r in rows:
         bp = "-"
         if r["bp_ratio"] is not None:
@@ -302,12 +306,13 @@ def _top_render(job, status, rows, checkpoints, alerts) -> str:
                 bp += f" ({r['bp_level']})"
         col = ("-" if r.get("columnar_ratio") is None
                else f"{r['columnar_ratio'] * 100:.0f}%")
+        marker = "<<<" if r["id"] == bn_vid else ""
         lines.append(
             f"{r['id']:>4}  {r['name'][:36]:<36} "
             f"{fmt(r['parallelism'], '{:d}'):>3}  "
             f"{fmt(r['records_per_s'], '{:,.0f}'):>10}  {bp:<18} "
             f"{fmt(r['watermark_lag_ms'], '{:,.0f}'):>10} {col:>6} "
-            f"{fmt(r.get('columnar_boxed'), '{:,.0f}'):>6}")
+            f"{fmt(r.get('columnar_boxed'), '{:,.0f}'):>6} {marker:<10}")
     counts = checkpoints.get("counts") or {}
     last = None
     for c in checkpoints.get("history") or []:
@@ -323,6 +328,15 @@ def _top_render(job, status, rows, checkpoints, alerts) -> str:
     firing = alerts.get("rules_firing") or []
     lines.append(f"alerts: {alerts.get('total', 0)} total"
                  + (f"; FIRING: {', '.join(firing)}" if firing else ""))
+    if bn_vid is not None:
+        ups = ", ".join(f"{u.get('name')} ({u.get('ratio', 0) * 100:.0f}%)"
+                        for u in bn.get("backpressured_upstreams") or [])
+        lines.append(
+            f"BOTTLENECK: {bn.get('name')} (vertex {bn_vid}) busy "
+            f"{fmt(bn.get('busyMsPerSecond'), '{:.0f}')} ms/s"
+            + (f"; backpressured upstreams: {ups}" if ups else ""))
+    else:
+        lines.append("BOTTLENECK: none")
     return "\n".join(lines)
 
 
@@ -361,6 +375,10 @@ def _top(rest) -> int:
             metrics = _top_fetch(base, f"/jobs/{q}/metrics")
             checkpoints = _top_fetch(base, f"/jobs/{q}/checkpoints")
             alerts = _top_fetch(base, f"/jobs/{q}/alerts")
+            try:
+                bottleneck = _top_fetch(base, f"/jobs/{q}/bottleneck")
+            except OSError:  # pre-bottleneck server: footer reads "none"
+                bottleneck = None
             now = time.monotonic()
             if args.once and prev_t is None:
                 # rates need two samples: take a quick second one
@@ -370,7 +388,7 @@ def _top(rest) -> int:
             dt = (now - prev_t) if prev_t is not None else 0.0
             rows = _top_rows(job, detail, metrics, prev_metrics, dt)
             out = _top_render(job, detail.get("status"), rows,
-                              checkpoints, alerts)
+                              checkpoints, alerts, bottleneck)
             if args.once:
                 print(out)
                 return 0

@@ -647,6 +647,9 @@ class CheckpointCoordinator:
         self._savepoint_queue: deque = deque()
         #: in-flight savepoint checkpoints: cid -> request
         self._savepoint_cids: Dict[int, SavepointRequest] = {}
+        #: cid -> propagated trace context (tracing enabled only):
+        #: lets the ack/complete instants link back to the trigger
+        self._trace_ctxs: Dict[int, dict] = {}
         #: vertex_id -> parallelism, recorded into savepoints
         self.vertex_parallelisms: Dict[int, int] = {}
         # asynchronous snapshot materialization (ref: the async part
@@ -715,11 +718,25 @@ class CheckpointCoordinator:
             # savepoints always use aligned exactly-once barriers
             options = {"mode": "exactly_once", "savepoint": True}
             self._savepoint_cids[cid] = savepoint
+        from flink_tpu.runtime.tracing import (get_tracer,
+                                               make_trace_context)
+        tracer = get_tracer()
+        if tracer.enabled:
+            # the barrier's causal root: every per-host barrier/align/
+            # ack span links back to this context as the barrier (and
+            # its options dict) travels the graph
+            ctx = make_trace_context()
+            options["trace"] = ctx
+            self._trace_ctxs[cid] = ctx
+            tracer.record_instant("checkpoint.trigger", checkpoint_id=cid,
+                                  trace_id=ctx["trace_id"],
+                                  span_id=ctx["span_id"])
         ok = self._trigger_sources(cid, int(now), options)
         if ok is False:
             del self.pending[cid]
             self.stats.pop(cid, None)
             self._savepoint_cids.pop(cid, None)
+            self._trace_ctxs.pop(cid, None)
             return None
         return cid
 
@@ -760,6 +777,14 @@ class CheckpointCoordinator:
         st = self.stats.get(checkpoint_id)
         if st is not None and task_key in pc.acks:
             st.record_ack(task_key, self._clock() - st.trigger_ms)
+        ctx = self._trace_ctxs.get(checkpoint_id)
+        if ctx is not None:
+            from flink_tpu.runtime.tracing import get_tracer
+            get_tracer().record_instant(
+                "checkpoint.ack", checkpoint_id=checkpoint_id,
+                task=list(task_key) if task_key else None,
+                trace_id=ctx["trace_id"],
+                parent_span_id=ctx["span_id"])
         if pc.fully_acknowledged:
             self._complete(pc)
 
@@ -768,6 +793,7 @@ class CheckpointCoordinator:
         the max_concurrent slot and counts toward the tolerable-
         failure budget (when one is configured)."""
         pc = self.pending.pop(checkpoint_id, None)
+        self._trace_ctxs.pop(checkpoint_id, None)
         req = self._savepoint_cids.pop(checkpoint_id, None)
         if req is not None:
             req.fail(RuntimeError(
@@ -793,6 +819,7 @@ class CheckpointCoordinator:
         for cid in [cid for cid, pc in self.pending.items()
                     if now - pc.timestamp >= self.checkpoint_timeout_ms]:
             pc = self.pending.pop(cid)
+            self._trace_ctxs.pop(cid, None)
             pc.discarded = True
             self.aborted_count += 1
             self.timeout_aborts += 1
@@ -915,6 +942,7 @@ class CheckpointCoordinator:
             st = self.stats.get(pc.checkpoint_id)
             if st is not None:
                 st.mark_failed(f"{type(err).__name__}: {err}", now)
+            self._trace_ctxs.pop(pc.checkpoint_id, None)
             if req is not None:
                 req.fail(err)
             if self.tolerable_checkpoint_failures is None:
@@ -930,6 +958,12 @@ class CheckpointCoordinator:
         if st is not None:
             st.complete_ms = now
             st.state_bytes = state_bytes if state_bytes is not None else -1
+        ctx = self._trace_ctxs.pop(pc.checkpoint_id, None)
+        if ctx is not None:
+            from flink_tpu.runtime.tracing import get_tracer
+            get_tracer().record_instant(
+                "checkpoint.complete", checkpoint_id=pc.checkpoint_id,
+                trace_id=ctx["trace_id"], parent_span_id=ctx["span_id"])
         if req is not None:
             try:
                 path = write_savepoint(

@@ -70,6 +70,13 @@ from flink_tpu.runtime.local import (
     merge_accumulators,
 )
 from flink_tpu.runtime import faults
+from flink_tpu.runtime.backpressure import (
+    derive_upstreams,
+    locate_bottleneck,
+    observe_subtask,
+    observe_threaded_source,
+    read_vertex_stats,
+)
 from flink_tpu.runtime.metrics import MetricRegistry, register_network_gauges
 from flink_tpu.runtime.netchannel import DataClient, DataServer
 from flink_tpu.runtime.rpc import (
@@ -77,6 +84,7 @@ from flink_tpu.runtime.rpc import (
     RpcException,
     RpcService,
 )
+from flink_tpu.runtime.tracing import estimate_clock_offset, get_tracer
 from flink_tpu.streaming.graph import JobGraph
 from flink_tpu.streaming.timers import PolledProcessingTimeService
 
@@ -360,7 +368,10 @@ class Dispatcher(RpcEndpoint):
                     journal=master.journal, evaluator=master.health,
                     coordinator=master._last_coordinator,
                     checkpoints_base=master._coordinator_base,
-                    exceptions=master.exception_history))
+                    exceptions=master.exception_history,
+                    upstreams=master.upstreams,
+                    trace_buffers=master.trace_buffers,
+                    trace_offsets=master.clock_offsets))
 
     def request_job_status(self, job_id: str) -> dict:
         master = self._masters.get(job_id)
@@ -429,7 +440,7 @@ class JobMaster(RpcEndpoint):
 
     RPC_METHODS = ("acknowledge_checkpoint", "decline_checkpoint",
                    "update_task_execution_state", "fetch_restore_state",
-                   "report_metrics")
+                   "report_metrics", "report_trace")
 
     def __init__(self, job_id: str, blob_key: str, graph_blob: bytes,
                  job_config: dict, rpc_service: RpcService):
@@ -461,6 +472,19 @@ class JobMaster(RpcEndpoint):
         #: drained into the journal by the driver's supervise loop —
         #: the cross-process leg of the MetricsJournal plane
         self._metrics_queue: deque = deque()
+        #: tracer ring-buffer batches shipped by TaskExecutors
+        #: (report_trace); drained into trace_buffers by the driver's
+        #: supervise loop — the cross-process leg of the merged trace
+        self._trace_queue: deque = deque()
+        #: lane -> {"events": [...], "anchor": {...}} accumulated
+        #: across the job's life (one logical process lane per TM)
+        self.trace_buffers: Dict[str, dict] = {}
+        #: lane -> estimated wall-clock offset in µs (min-RTT midpoint
+        #: of a clock_probe ping burst per TaskExecutor)
+        self.clock_offsets: Dict[str, float] = {}
+        #: vertex -> upstream vertices (bottleneck localization walks
+        #: this downstream-first against the shipped metrics)
+        self.upstreams = derive_upstreams(self.job_graph)
         self.journal = None
         self.health = None
         self._last_metrics: Optional[dict] = None
@@ -477,7 +501,8 @@ class JobMaster(RpcEndpoint):
             self.health = HealthEvaluator(
                 self.journal,
                 coordinator_supplier=lambda: (self._live_coordinator
-                                              or self._last_coordinator))
+                                              or self._last_coordinator),
+                bottleneck_supplier=self.locate_bottleneck)
         self._driver: Optional[threading.Thread] = None
         self._gateways: Dict[str, Any] = {}
         #: the running attempt's coordinator (live metrics view)
@@ -543,6 +568,22 @@ class JobMaster(RpcEndpoint):
         """A TaskExecutor shipped one metrics-registry dump at its
         sampling cadence; the supervise loop journals it."""
         self._metrics_queue.append((attempt, t_wall_ms, metrics))
+
+    def report_trace(self, attempt: int, lane: str, payload: dict) -> None:
+        """A TaskExecutor shipped an incremental tracer ring-buffer
+        batch (events newer than its cursor + its clock anchor); the
+        supervise loop folds it into the per-lane merged-trace store."""
+        self._trace_queue.append((attempt, lane, payload))
+
+    def locate_bottleneck(self) -> Optional[dict]:
+        """Downstream-first walk over the last shipped metrics dump:
+        the most-downstream busy-saturated vertex with backpressured
+        upstreams (None when nothing qualifies yet)."""
+        if self._last_metrics is None:
+            return None
+        return locate_bottleneck(
+            self.upstreams,
+            read_vertex_stats(self._last_metrics, self.job_graph.job_name))
 
     def fetch_restore_state(self, attempt: int, task_keys) -> dict:
         """Local-recovery miss path: serve the restore snapshots for
@@ -785,6 +826,20 @@ class JobMaster(RpcEndpoint):
         expected = {(vid, i) for vid, v in jg.vertices.items()
                     for i in range(v.parallelism)}
 
+        # clock alignment: one ping burst per TaskExecutor estimates
+        # its wall-clock offset (min-RTT midpoint) so shipped trace
+        # events can be merged onto one timeline
+        if get_tracer().enabled:
+            for entry in tm_entries:
+                tm_id = entry["slot"]["tm_id"]
+                gw = self._gateway(entry["slot"])
+                try:
+                    est = estimate_clock_offset(
+                        lambda g=gw: g.sync.clock_probe())
+                    self.clock_offsets[str(tm_id)] = est["offset_us"]
+                except Exception:  # noqa: BLE001 — probe lost: merge
+                    self.clock_offsets.setdefault(str(tm_id), 0.0)
+
         coordinator = None
         if storage is not None and (jg.checkpoint_config or {}).get("interval"):
             cp_cfg = jg.checkpoint_config
@@ -844,6 +899,18 @@ class JobMaster(RpcEndpoint):
             if ingested and self.health is not None:
                 self.health.evaluate()
 
+        def drain_traces():
+            while self._trace_queue:
+                att, lane, payload = self._trace_queue.popleft()
+                if att != attempt:
+                    continue
+                buf = self.trace_buffers.setdefault(
+                    lane, {"events": [], "anchor": payload.get("anchor")})
+                if payload.get("anchor"):
+                    buf["anchor"] = payload["anchor"]
+                buf["events"].extend(payload.get("events") or [])
+                del buf["events"][:-8192]  # bounded per lane
+
         def poll_statuses() -> List[dict]:
             statuses = []
             for entry in tm_entries:
@@ -863,6 +930,7 @@ class JobMaster(RpcEndpoint):
                         raise cloudpickle.loads(error_blob)
                 drain_acks()
                 drain_metrics()
+                drain_traces()
                 if coordinator is not None:
                     coordinator.maybe_trigger()
                 now = _time.monotonic()
@@ -896,6 +964,7 @@ class JobMaster(RpcEndpoint):
                     "job attempt ended before the savepoint completed"))
         drain_acks()
         drain_metrics()
+        drain_traces()
 
         # ---- end-of-job phases: workers stopped, endpoint-threaded --
         for entry in tm_entries:
@@ -1004,6 +1073,12 @@ class _JobAttempt:
         #: submit_tasks from the TDD, registry is the TaskExecutor's
         self.sample_interval_ms: Optional[int] = None
         self.metrics_registry = None
+        #: this worker's logical process lane in the merged cluster
+        #: trace (set at submit_tasks from the hosting TaskExecutor)
+        self.lane = "main"
+        #: tracer ring-buffer shipping cursor (events newer than this
+        #: seq ship with the next report_metrics tick)
+        self._trace_seq = 0
 
     def assign(self, st: SubtaskInstance) -> None:
         self.subtasks.append(st)
@@ -1027,6 +1102,9 @@ class _JobAttempt:
         next_sample = (_time.monotonic() * 1000.0 + interval
                        if interval else None)
         try:
+            # spans from this worker thread group under one pid lane in
+            # the merged cluster trace
+            get_tracer().set_lane(self.lane)
             while not self._stop.is_set():
                 if self._pause.is_set():
                     self._paused.set()
@@ -1039,10 +1117,13 @@ class _JobAttempt:
                         st.notify_checkpoint_complete(cid)
                 for s in self.coop_sources:
                     if not s.finished:
-                        progress += s.source_step(self.SOURCE_BATCH)
+                        n = s.source_step(self.SOURCE_BATCH)
+                        progress += n
+                        observe_subtask(s, n > 0)
                 for s in self.threaded_sources:
                     if s.thread_error is not None:
                         raise s.thread_error
+                    observe_threaded_source(s)
                     s.try_inject_threaded_trigger()
                     s.try_deliver_notifications()
                     if s.router.has_queued_output() \
@@ -1052,7 +1133,9 @@ class _JobAttempt:
                         finally:
                             s.emission_lock.release()
                 for st in self.non_sources:
-                    progress += st.step(self.STEP_BUDGET)
+                    n = st.step(self.STEP_BUDGET)
+                    progress += n
+                    observe_subtask(st, n > 0)
                 fired = self.pts.fire_due()
                 if fired:
                     # timer emissions flush before the quiescence
@@ -1076,6 +1159,17 @@ class _JobAttempt:
                                 self.metrics_registry.dump())
                         except Exception:  # noqa: BLE001
                             pass
+                        tracer = get_tracer()
+                        if tracer.enabled:
+                            try:  # ship new tracer events (same cadence)
+                                payload = tracer.export_since(
+                                    self._trace_seq, lane=self.lane)
+                                if payload["events"]:
+                                    self._trace_seq = payload["seq"]
+                                    self.jm_gateway.tell.report_trace(
+                                        self.attempt, self.lane, payload)
+                            except Exception:  # noqa: BLE001
+                                pass
                 if not progress:
                     _time.sleep(0.0002)
         except BaseException as e:  # noqa: BLE001
@@ -1126,11 +1220,11 @@ class TaskExecutor(RpcEndpoint):
     DataServer; each job attempt gets its own worker thread +
     DataClient."""
 
-    RPC_METHODS = ("ping", "allocate_slot", "submit_tasks", "start_tasks",
-                   "job_status", "pause_job", "resume_job", "stop_workers",
-                   "end_drain_round", "finish_vertex", "finish_job",
-                   "cancel_job", "release_job", "trigger_checkpoint",
-                   "notify_checkpoint_complete")
+    RPC_METHODS = ("ping", "clock_probe", "allocate_slot", "submit_tasks",
+                   "start_tasks", "job_status", "pause_job", "resume_job",
+                   "stop_workers", "end_drain_round", "finish_vertex",
+                   "finish_job", "cancel_job", "release_job",
+                   "trigger_checkpoint", "notify_checkpoint_complete")
 
     def __init__(self, tm_id: str, rpc_service: RpcService,
                  data_server: DataServer, num_slots: int = 2,
@@ -1162,6 +1256,11 @@ class TaskExecutor(RpcEndpoint):
     # -- liveness -----------------------------------------------------
     def ping(self) -> str:
         return "pong"
+
+    def clock_probe(self) -> float:
+        """This process's wall clock in µs — one sample of the
+        JobMaster's min-RTT-midpoint offset estimation burst."""
+        return _time.time() * 1e6
 
     # -- slots (allocation is RM-side bookkeeping; the TE trusts it) --
     def allocate_slot(self, job_id: str, slot_id: int) -> bool:
@@ -1196,6 +1295,9 @@ class TaskExecutor(RpcEndpoint):
 
         att = _JobAttempt(job_id, attempt, tls=self.tls)
         att.master_epoch = epoch
+        # the TM id already wears its tm- prefix; it doubles as the
+        # worker's lane label AND the JobMaster's clock_offsets key
+        att.lane = str(self.tm_id)
         att.jm_gateway = self._rpc.connect(tdd["jm_address"], tdd["jm_name"])
         att.sample_interval_ms = tdd.get("sample_interval_ms")
         att.metrics_registry = self.metrics

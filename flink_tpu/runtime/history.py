@@ -16,7 +16,9 @@ over HTTP).  Here:
 - `HistoryServer` scans one or more archive directories, caches the
   summaries, and serves `/jobs`, `/jobs/<id>`, `/overview` plus the
   per-job sub-routes `/metrics`, `/metrics/history`, `/checkpoints`,
-  `/alerts`, `/traces`, `/exceptions` over a threaded HTTP server —
+  `/alerts`, `/traces` (`?scope=cluster` replays the archived merged
+  cluster trace), `/bottleneck`, `/exceptions` over a threaded HTTP
+  server —
   the same route shapes (and error bodies) as the live WebMonitor
   (runtime/rest.py), so dashboards can point at either.
 
@@ -41,7 +43,8 @@ def build_archive_summary(job_name: str, state: str,
                           registry=None, metrics=None,
                           journal=None, evaluator=None,
                           coordinator=None, checkpoints_base: int = 0,
-                          exceptions=None) -> dict:
+                          exceptions=None, upstreams=None,
+                          trace_buffers=None, trace_offsets=None) -> dict:
     """Assemble the post-mortem REST bundle for one finished job (ref:
     FsJobArchivist.archiveJob collecting every JsonArchivist's
     responses).  Every field mirrors what the live WebMonitor serves
@@ -72,11 +75,25 @@ def build_archive_summary(job_name: str, state: str,
             coordinator, checkpoints_base)
     if exceptions:
         summary["exceptions"] = list(exceptions)
+    if upstreams is not None:
+        # vertex -> upstream vertices: the bottleneck route replays
+        # localization over the archived metrics snapshot
+        summary["upstreams"] = {str(k): list(v)
+                                for k, v in upstreams.items()}
     try:
         from flink_tpu.runtime.tracing import get_tracer
         tracer = get_tracer()
         if tracer.enabled:
             summary["trace"] = tracer.chrome_trace()
+        if trace_buffers is None and tracer.enabled:
+            # in-process executors: the shared tracer's lane buffers
+            # ARE the cluster view (offsets are zero by construction)
+            trace_buffers = tracer.lane_buffers()
+        if trace_buffers:
+            summary["trace_cluster"] = {
+                "buffers": trace_buffers,
+                "offsets": dict(trace_offsets or {}),
+            }
     except Exception:  # noqa: BLE001 — tracing must never block archiving
         pass
     return summary
@@ -108,7 +125,9 @@ class FsJobArchivist:
             try:
                 with open(os.path.join(directory, name)) as f:
                     out.append(json.load(f))
-            except (OSError, json.JSONDecodeError):
+            except (OSError, ValueError):
+                # foreign/corrupt files (including non-UTF-8 binaries
+                # dropped into the archive dir) are skipped, never fatal
                 continue
         return out
 
@@ -119,6 +138,8 @@ class HistoryServer:
 
     def __init__(self, archive_dirs: List[str], port: int = 0,
                  refresh_interval_s: float = 2.0):
+        if isinstance(archive_dirs, str):  # one dir, not its characters
+            archive_dirs = [archive_dirs]
         self.archive_dirs = list(archive_dirs)
         self.refresh_interval_s = refresh_interval_s
         self._jobs: Dict[str, dict] = {}
@@ -201,7 +222,11 @@ class HistoryServer:
 
     def _route(self, raw_path: str):
         import urllib.parse
-        from flink_tpu.runtime.rest import parse_history_params
+        from flink_tpu.runtime.rest import (
+            BadRequest,
+            parse_bottleneck_params,
+            parse_history_params,
+        )
         split = urllib.parse.urlsplit(raw_path)
         path = split.path
         query = urllib.parse.parse_qs(split.query, keep_blank_values=True)
@@ -246,9 +271,40 @@ class HistoryServer:
                     if k.startswith(name + ".")}
         if path.startswith("/jobs/") and path.endswith("/traces"):
             job = self._find(jobs, path[len("/jobs/"):-len("/traces")])
+            scope = query.get("scope", ["process"])[0]
+            if scope == "cluster":
+                from flink_tpu.runtime.tracing import build_cluster_trace
+                tc = job.get("trace_cluster")
+                if not tc:
+                    return {"enabled": False, "scope": "cluster",
+                            "trace": {"traceEvents": []}}
+                return {"enabled": True, "scope": "cluster",
+                        "trace": build_cluster_trace(
+                            tc.get("buffers") or {},
+                            tc.get("offsets") or {})}
+            if scope != "process":
+                raise BadRequest(
+                    f"unknown 'scope' (want process|cluster): {scope!r}")
             trace = job.get("trace")
             return {"enabled": trace is not None,
                     "trace": trace or {"traceEvents": []}}
+        if path.startswith("/jobs/") and path.endswith("/bottleneck"):
+            job = self._find(jobs, path[len("/jobs/"):-len("/bottleneck")])
+            from flink_tpu.runtime.backpressure import (
+                locate_bottleneck,
+                read_vertex_stats,
+            )
+            busy, ratio = parse_bottleneck_params(query)
+            upstreams = {int(k): list(v) for k, v in
+                         (job.get("upstreams") or {}).items()}
+            located = locate_bottleneck(
+                upstreams,
+                read_vertex_stats(job.get("metrics") or {},
+                                  job.get("job_name") or ""),
+                busy_threshold=busy, ratio_threshold=ratio)
+            return {"bottleneck": located,
+                    "busy_threshold_ms_per_s": busy,
+                    "ratio_threshold": ratio}
         if path.startswith("/jobs/") and path.endswith("/exceptions"):
             job = self._find(jobs, path[len("/jobs/"):-len("/exceptions")])
             return {"history": job.get("exceptions") or []}

@@ -50,6 +50,13 @@ from flink_tpu.runtime.checkpoints import (
     make_restart_strategy,
 )
 from flink_tpu.runtime import faults
+from flink_tpu.runtime.backpressure import (
+    derive_upstreams,
+    locate_bottleneck,
+    observe_subtask,
+    observe_threaded_source,
+    read_vertex_stats,
+)
 from flink_tpu.runtime.failover import (
     TaskFailureException,
     build_region_index,
@@ -519,6 +526,17 @@ class SubtaskInstance:
         self.latency_stats = latency_stats
         self.io_metrics = (TaskIOMetricGroup(metrics_group)
                            if metrics_group is not None else None)
+        #: busy/idle/backPressured attribution, observed once per
+        #: executor-loop pass (ref: TaskIOMetricGroup's
+        #: busyTimeMsPerSecond family)
+        from flink_tpu.runtime.backpressure import (
+            TimeAccounting,
+            register_time_attribution_gauges,
+        )
+        self.time_accounting = TimeAccounting()
+        if metrics_group is not None:
+            register_time_attribution_gauges(metrics_group,
+                                             self.time_accounting)
         # precomputed span names (the per-element tracing fast path
         # must not format strings)
         self._span_process = f"op.{vertex.name}.process"
@@ -676,9 +694,13 @@ class SubtaskInstance:
         self.pending_trigger = None
         cid, ts, options = trig
         barrier = CheckpointBarrier(cid, ts, options)
-        with get_tracer().span(self._span_checkpoint, checkpoint_id=cid,
-                               task=self.vertex.name,
-                               subtask=self.subtask_index):
+        # causally link the source-side snapshot+broadcast span to the
+        # coordinator's trigger (the context rides the barrier options)
+        ctx = options.get("trace") if isinstance(options, dict) else None
+        with get_tracer().span_linked(self._span_checkpoint, ctx,
+                                      checkpoint_id=cid,
+                                      task=self.vertex.name,
+                                      subtask=self.subtask_index):
             snapshot = self.snapshot(cid)
             self.router.broadcast_barrier(barrier)
             if self.ack_fn is not None:
@@ -779,6 +801,18 @@ class SubtaskInstance:
             self._align_id = barrier.checkpoint_id
             self._align_barrier = barrier
             self._align_received = set()
+            tracer = get_tracer()
+            if tracer.enabled:
+                # one marker per alignment episode, causally linked to
+                # the coordinator trigger via the barrier's context
+                ctx = barrier.options.get("trace") \
+                    if isinstance(barrier.options, dict) else None
+                tracer.record_instant(
+                    "checkpoint.align.begin",
+                    checkpoint_id=barrier.checkpoint_id,
+                    task=self.vertex.name, subtask=self.subtask_index,
+                    **({"trace_id": ctx["trace_id"],
+                        "parent_span_id": ctx["span_id"]} if ctx else {}))
         elif barrier.checkpoint_id != self._align_id:
             # a newer barrier cancels the in-flight alignment
             self._release_alignment()
@@ -834,10 +868,12 @@ class SubtaskInstance:
         StreamTask.triggerCheckpointOnBarrier :586 →
         performCheckpoint :618 — barrier forwarded first, then
         snapshot, both atomically on this loop)."""
-        with get_tracer().span(self._span_checkpoint,
-                               checkpoint_id=barrier.checkpoint_id,
-                               task=self.vertex.name,
-                               subtask=self.subtask_index):
+        ctx = (barrier.options.get("trace")
+               if isinstance(barrier.options, dict) else None)
+        with get_tracer().span_linked(self._span_checkpoint, ctx,
+                                      checkpoint_id=barrier.checkpoint_id,
+                                      task=self.vertex.name,
+                                      subtask=self.subtask_index):
             snapshot = self.snapshot(barrier.checkpoint_id)
             self.router.broadcast_barrier(barrier)
             if self.ack_fn is not None:
@@ -1114,11 +1150,19 @@ def make_health_plane(metrics, sample_interval_ms: Optional[int],
         HealthEvaluator, MetricsJournal, register_health_gauges)
     journal = MetricsJournal(metrics, interval_ms=sample_interval_ms,
                              history_size=history_size)
+
+    def bottleneck_supplier():
+        state = getattr(client, "executor_state", None) or {}
+        return locate_bottleneck(
+            state.get("upstreams") or {},
+            read_vertex_stats(metrics.dump(), job_name))
+
     evaluator = HealthEvaluator(
         journal,
         coordinator_supplier=lambda: (
             getattr(client, "executor_state", None) or {}
-        ).get("coordinator"))
+        ).get("coordinator"),
+        bottleneck_supplier=bottleneck_supplier)
     register_health_gauges(metrics, job_name, evaluator)
     return journal, evaluator
 
@@ -1151,7 +1195,8 @@ def archive_finished_job(archive_dir: Optional[str], metrics,
                 coordinator=state.get("coordinator"),
                 checkpoints_base=state.get("checkpoints_base", 0),
                 exceptions=list(
-                    getattr(client, "exception_history", None) or [])))
+                    getattr(client, "exception_history", None) or []),
+                upstreams=state.get("upstreams")))
     except Exception:  # noqa: BLE001 — post-mortem only
         pass
 
@@ -1404,6 +1449,7 @@ class LocalExecutor:
             # across restarts (same accumulation as the result object)
             "checkpoints_base": getattr(result, "_cp_base", 0),
             "journal": journal, "health": evaluator,
+            "upstreams": derive_upstreams(job_graph),
         }
 
         for s in threaded_sources:
@@ -1480,13 +1526,16 @@ class LocalExecutor:
             for s in coop_sources:
                 if not s.finished:
                     try:
-                        progress += s.source_step(self.SOURCE_BATCH)
+                        n = s.source_step(self.SOURCE_BATCH)
                     except Exception as e:  # noqa: BLE001
                         raise TaskFailureException(s.task_key, e) from e
+                    progress += n
+                    observe_subtask(s, n > 0)
             for s in threaded_sources:
                 if s.thread_error is not None:
                     raise TaskFailureException(s.task_key, s.thread_error) \
                         from s.thread_error
+                observe_threaded_source(s)
                 s.try_inject_threaded_trigger()
                 s.try_deliver_notifications()
                 if s.router.has_queued_output() \
@@ -1502,9 +1551,11 @@ class LocalExecutor:
             # 2. operators
             for st in non_sources:
                 try:
-                    progress += st.step(self.STEP_BUDGET)
+                    n = st.step(self.STEP_BUDGET)
                 except Exception as e:  # noqa: BLE001
                     raise TaskFailureException(st.task_key, e) from e
+                progress += n
+                observe_subtask(st, n > 0)
 
             # 3. processing time (polled services fire on this loop —
             # the single-owner replacement for the reference's timer

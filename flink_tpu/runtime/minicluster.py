@@ -59,11 +59,17 @@ from flink_tpu.runtime.local import (
     make_health_plane,
 )
 from flink_tpu.runtime import faults
+from flink_tpu.runtime.backpressure import (
+    derive_upstreams,
+    observe_subtask,
+    observe_threaded_source,
+)
 from flink_tpu.runtime.metrics import (
     MetricRegistry,
     register_checkpoint_gauges,
     register_faulttolerance_gauges,
 )
+from flink_tpu.runtime.tracing import get_tracer
 from flink_tpu.streaming.elements import LatencyMarker
 from flink_tpu.streaming.graph import JobGraph
 from flink_tpu.streaming.timers import TestProcessingTimeService
@@ -140,6 +146,9 @@ class TaskManagerRunner:
     # ---- the worker loop ------------------------------------------------
     def _run(self) -> None:
         try:
+            # logical process lane: this worker thread's spans group
+            # under one pid in the merged cluster trace
+            get_tracer().set_lane(f"tm-{self.tm_id}")
             pts_poll = getattr(self.pts, "fire_due", None)
             while not self._stop.is_set():
                 if self._pause.is_set():
@@ -169,10 +178,13 @@ class TaskManagerRunner:
                                 s.head.output.emit_latency_marker(marker)
                 for s in self.coop_sources:
                     if not s.finished:
-                        progress += s.source_step(self.SOURCE_BATCH)
+                        n = s.source_step(self.SOURCE_BATCH)
+                        progress += n
+                        observe_subtask(s, n > 0)
                 for s in self.threaded_sources:
                     if s.thread_error is not None:
                         raise s.thread_error
+                    observe_threaded_source(s)
                     s.try_inject_threaded_trigger()
                     s.try_deliver_notifications()
                     if s.router.has_queued_output() \
@@ -182,7 +194,9 @@ class TaskManagerRunner:
                         finally:
                             s.emission_lock.release()
                 for st in self.non_sources:
-                    progress += st.step(self.STEP_BUDGET)
+                    n = st.step(self.STEP_BUDGET)
+                    progress += n
+                    observe_subtask(st, n > 0)
                 if pts_poll is not None:
                     fired = pts_poll()
                     if fired:
@@ -394,6 +408,7 @@ class MiniCluster:
             # count to this — totals survive restarts (see local.py)
             "checkpoints_base": getattr(result, "_cp_base", 0),
             "journal": journal, "health": evaluator,
+            "upstreams": derive_upstreams(job_graph),
         }
 
         for s in threaded_sources:

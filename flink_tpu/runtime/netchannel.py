@@ -57,7 +57,7 @@ import numpy as np
 from flink_tpu.runtime import faults
 from flink_tpu.runtime.metrics import Histogram
 from flink_tpu.runtime.rpc import MAX_FRAME, recv_exact
-from flink_tpu.runtime.tracing import get_tracer
+from flink_tpu.runtime.tracing import get_tracer, make_trace_context
 from flink_tpu.streaming.elements import RecordBatch, StreamRecord
 
 _LEN = struct.Struct(">I")
@@ -506,19 +506,26 @@ def _frame_budget(queue_len: int, credit_left: int) -> int:
     return min(queue_len, max(FRAME_BATCH, share), MAX_FRAME_BATCH)
 
 
-def _data_frame(key: ChannelKey, batch: list, more: bool) -> dict:
+def _data_frame(key: ChannelKey, batch: list, more: bool,
+                tc: Optional[dict] = None) -> dict:
     frame = {"kind": "data", "channel": key,
              "elements": encode_elements(batch)}
     if more:
         # continuation marker: this frame is a split slice of one
         # credited batch and the consumer must NOT debit credit for it
         frame["part"] = True
+    if tc is not None:
+        # optional trace-context header (trace_id, span_id): consumers
+        # open a causally-linked span on decode; readers without the
+        # key ignore it (wire-compatible extension)
+        frame["tc"] = tc
     return frame
 
 
 def send_data_batch(sock: socket.socket, lock: threading.Lock,
                     key: ChannelKey, batch: list,
-                    _more: bool = False) -> int:
+                    _more: bool = False,
+                    tc: Optional[dict] = None) -> int:
     """Encode + ship one credited element batch, splitting into
     continuation frames whenever the serialized size tops
     SPLIT_FRAME_BYTES.  Non-final parts carry ``part: True`` and the
@@ -527,18 +534,19 @@ def send_data_batch(sock: socket.socket, lock: threading.Lock,
     Returns wire bytes written."""
     if len(batch) > 1:
         try:
-            return _send(sock, _data_frame(key, batch, _more), lock,
+            return _send(sock, _data_frame(key, batch, _more, tc), lock,
                          split_guard=True)
         except FrameOversizeError:
             NET_STATS.frames_split += 1
             mid = len(batch) // 2
-            n = send_data_batch(sock, lock, key, batch[:mid], _more=True)
+            n = send_data_batch(sock, lock, key, batch[:mid], _more=True,
+                                tc=tc)
             return n + send_data_batch(sock, lock, key, batch[mid:],
-                                       _more=_more)
+                                       _more=_more, tc=tc)
     # a single element either fits or is a hard error — no further
     # split is possible
     try:
-        return _send(sock, _data_frame(key, batch, _more), lock,
+        return _send(sock, _data_frame(key, batch, _more, tc), lock,
                      split_guard=True)
     except FrameOversizeError as e:
         raise OSError(
@@ -655,10 +663,16 @@ class _ProducerConnection:
                     ch.sent += len(batch)
                     NET_STATS.frame_elements.update(len(batch))
                     if tracer.enabled:
+                        # stamp a trace context onto the frame so the
+                        # consumer's decode span links to this send
+                        tc = make_trace_context()
                         with tracer.span("net.frame.send",
-                                         elements=len(batch)):
+                                         elements=len(batch),
+                                         trace_id=tc["trace_id"],
+                                         span_id=tc["span_id"]):
                             ch.bytes_out += send_data_batch(
-                                self.sock, self.write_lock, ch.key, batch)
+                                self.sock, self.write_lock, ch.key, batch,
+                                tc=tc)
                     else:
                         ch.bytes_out += send_data_batch(
                             self.sock, self.write_lock, ch.key, batch)
@@ -904,7 +918,8 @@ class DataClient:
                     continue
                 tracer = get_tracer()
                 if tracer.enabled:
-                    with tracer.span("net.frame.recv"):
+                    with tracer.span_linked("net.frame.recv",
+                                            frame.get("tc")):
                         elements, count = _decode_frame(
                             frame["elements"], binding.columnar)
                 else:

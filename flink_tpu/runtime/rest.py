@@ -55,6 +55,34 @@ def parse_history_params(query: Dict[str, list]) -> tuple:
             raise BadRequest(f"'buckets' must be positive: {buckets}")
     return metric, since, buckets
 
+
+def parse_bottleneck_params(query: Dict[str, list]) -> tuple:
+    """Validate `/bottleneck` query params into (busy_threshold_ms_per_s,
+    ratio_threshold); raises BadRequest on garbage.  Shared by the live
+    WebMonitor and the HistoryServer so the two routes cannot
+    diverge."""
+    from flink_tpu.runtime.backpressure import (
+        BUSY_SATURATION_MS_PER_S,
+        LOW_THRESHOLD,
+    )
+    busy = BUSY_SATURATION_MS_PER_S
+    ratio = LOW_THRESHOLD
+    if "busy_threshold" in query:
+        try:
+            busy = float(query["busy_threshold"][0])
+        except (ValueError, TypeError):
+            raise BadRequest(
+                f"malformed 'busy_threshold' (want ms/s): "
+                f"{query['busy_threshold'][0]!r}") from None
+    if "ratio_threshold" in query:
+        try:
+            ratio = float(query["ratio_threshold"][0])
+        except (ValueError, TypeError):
+            raise BadRequest(
+                f"malformed 'ratio_threshold' (want 0..1): "
+                f"{query['ratio_threshold'][0]!r}") from None
+    return busy, ratio
+
 #: the dashboard (ref: flink-runtime-web/web-dashboard — scaled to one
 #: dependency-free page over the JSON routes below).  Status colors
 #: always pair with a glyph + label (never color alone); all text
@@ -248,8 +276,13 @@ class WebMonitor:
                 path[len("/jobs/"):-len("/backpressure")])
             if job not in self.jobs:
                 raise KeyError(path)
-            from flink_tpu.runtime.backpressure import sample_client
-            stats = sample_client(self.jobs[job])
+            # served from the registry's time-aware sticky-window
+            # gauges: reading them never blocks the handler (the
+            # active 20-sample sampler stays CLI-only)
+            from flink_tpu.runtime.backpressure import (
+                read_backpressure_gauges,
+            )
+            stats = read_backpressure_gauges(self.registry.dump(), job)
             return ({str(vid): s for vid, s in stats.items()},
                     "application/json")
         if path.startswith("/jobs/") and path.endswith("/detail"):
@@ -263,14 +296,37 @@ class WebMonitor:
                 path[len("/jobs/"):-len("/traces")])
             if job not in self.jobs:
                 raise KeyError(path)
-            from flink_tpu.runtime.tracing import get_tracer
+            from flink_tpu.runtime.tracing import (
+                build_cluster_trace,
+                get_tracer,
+            )
             tracer = get_tracer()
+            scope = query.get("scope", ["process"])[0]
+            if scope == "cluster":
+                # one process lane per worker, clock offsets applied
+                # (zero for in-process workers sharing this tracer)
+                state = (getattr(self.jobs[job], "executor_state", None)
+                         or {})
+                offsets = state.get("clock_offsets") or {}
+                return ({"enabled": tracer.enabled, "scope": "cluster",
+                         "trace": build_cluster_trace(
+                             tracer.lane_buffers(), offsets)},
+                        "application/json")
+            if scope != "process":
+                raise BadRequest(
+                    f"unknown 'scope' (want process|cluster): {scope!r}")
             # the tracer is process-global: spans are not partitioned
             # per job, so this surfaces the recent window + aggregates
             # while the named job is tracked
             return ({"enabled": tracer.enabled,
                      "spans": tracer.recent(200),
                      "stats": tracer.stats()}, "application/json")
+        if path.startswith("/jobs/") and path.endswith("/bottleneck"):
+            job = urllib.parse.unquote(
+                path[len("/jobs/"):-len("/bottleneck")])
+            if job not in self.jobs:
+                raise KeyError(path)
+            return self._job_bottleneck(job, query), "application/json"
         if path.startswith("/jobs/") and path.endswith("/metrics/history"):
             job = urllib.parse.unquote(
                 path[len("/jobs/"):-len("/metrics/history")])
@@ -400,12 +456,35 @@ class WebMonitor:
                 })
         detail["checkpoints"] = cps
         try:
-            from flink_tpu.runtime.backpressure import sample_client
+            from flink_tpu.runtime.backpressure import (
+                read_backpressure_gauges,
+            )
             detail["backpressure"] = {
-                str(vid): s for vid, s in sample_client(client).items()}
+                str(vid): s for vid, s in read_backpressure_gauges(
+                    self.registry.dump(), name).items()}
         except Exception:  # noqa: BLE001 — job may be terminal
             detail["backpressure"] = {}
         return detail
+
+    def _job_bottleneck(self, name: str, query: Dict[str, list]) -> dict:
+        """Downstream-first bottleneck localization over the live
+        registry: the most-downstream busy-saturated vertex whose
+        upstreams are backpressured.  Thresholds are overridable via
+        `?busy_threshold=<ms/s>&ratio_threshold=<0..1>`."""
+        from flink_tpu.runtime.backpressure import (
+            locate_bottleneck,
+            read_vertex_stats,
+        )
+        busy, ratio = parse_bottleneck_params(query)
+        client = self.jobs[name]
+        state = getattr(client, "executor_state", None) or {}
+        located = locate_bottleneck(
+            state.get("upstreams") or {},
+            read_vertex_stats(self.registry.dump(), name),
+            busy_threshold=busy, ratio_threshold=ratio)
+        return {"bottleneck": located,
+                "busy_threshold_ms_per_s": busy,
+                "ratio_threshold": ratio}
 
     @staticmethod
     def _job_status(client) -> dict:

@@ -44,6 +44,7 @@ from typing import Any, Callable, Dict, Optional, Tuple
 import cloudpickle
 
 from flink_tpu.runtime import faults
+from flink_tpu.runtime.tracing import get_tracer, make_trace_context
 
 _LEN = struct.Struct(">I")
 
@@ -398,6 +399,14 @@ class RpcService:
         if endpoint is None:
             reply("error", EndpointNotFoundException(frame["endpoint"]))
             return
+        tracer = get_tracer()
+        tc = frame.get("tc")
+        if tracer.enabled and tc is not None:
+            # consumer-side leg of the call's causal tree
+            tracer.record_instant("rpc.invoke", method=frame["method"],
+                                  endpoint=frame["endpoint"],
+                                  trace_id=tc.get("trace_id"),
+                                  parent_span_id=tc.get("span_id"))
         if frame.get("oneway"):
             try:
                 endpoint._invoke(frame["method"], frame["args"],
@@ -497,6 +506,16 @@ class _ClientConnection:
         frame = {"kind": "call", "id": call_id, "endpoint": endpoint,
                  "method": method, "args": args, "kwargs": kwargs,
                  "token": token, "oneway": oneway, "secret": secret}
+        tracer = get_tracer()
+        if tracer.enabled:
+            # optional trace-context header: the serving endpoint opens
+            # a causally-linked span for this call
+            tc = make_trace_context()
+            frame["tc"] = tc
+            tracer.record_instant("rpc.call", method=method,
+                                  endpoint=endpoint,
+                                  trace_id=tc["trace_id"],
+                                  span_id=tc["span_id"])
         future: Optional[RpcFuture] = None
         if not oneway:
             future = RpcFuture()

@@ -242,6 +242,12 @@ class HealthEvaluator:
         completed-checkpoint duration p95 exceeds
         `checkpoint_p95_budget_ms` (rule disabled while the budget is
         None).
+      * ``bottleneck-stable`` — bottleneck localization
+        (`bottleneck_supplier`, runtime/backpressure.py
+        `locate_bottleneck`) named the SAME vertex for
+        `bottleneck_consecutive` consecutive evaluations — a stable
+        localization, not a transient blip (the autoscaler's scale-up
+        target signal).
     """
 
     def __init__(self, journal: MetricsJournal,
@@ -250,6 +256,8 @@ class HealthEvaluator:
                  lag_consecutive: int = 8,
                  checkpoint_p95_budget_ms: Optional[float] = None,
                  coordinator_supplier: Optional[Callable[[], Any]] = None,
+                 bottleneck_supplier: Optional[Callable[[], Any]] = None,
+                 bottleneck_consecutive: int = 5,
                  max_alerts: int = 256,
                  wall_clock: Callable[[], float] = None):
         self.journal = journal
@@ -258,6 +266,8 @@ class HealthEvaluator:
         self.lag_consecutive = max(3, lag_consecutive)
         self.checkpoint_p95_budget_ms = checkpoint_p95_budget_ms
         self.coordinator_supplier = coordinator_supplier
+        self.bottleneck_supplier = bottleneck_supplier
+        self.bottleneck_consecutive = max(2, bottleneck_consecutive)
         self.max_alerts = max_alerts
         self._wall = wall_clock or (lambda: _time.time() * 1000.0)
         self._lock = threading.Lock()
@@ -265,6 +275,10 @@ class HealthEvaluator:
         self.alerts_total = 0
         #: rule-instance key -> currently-firing episode flag
         self._active: Dict[Tuple[str, str], bool] = {}
+        #: bottleneck streak: (vertex_id, consecutive evaluations)
+        self._bottleneck_streak: Tuple[Optional[Any], int] = (None, 0)
+        #: the last stable localization (served on demand)
+        self.last_bottleneck: Optional[dict] = None
 
     # ---- emission ----------------------------------------------------
     def _fire(self, rule: str, metric: str, message: str,
@@ -304,6 +318,7 @@ class HealthEvaluator:
         self._eval_backpressure()
         self._eval_watermark_lag()
         self._eval_checkpoint_budget()
+        self._eval_bottleneck()
 
     def _tail(self, key: str, n: int) -> List[float]:
         samples = self.journal.series(key)
@@ -349,6 +364,31 @@ class HealthEvaluator:
             p95 > budget,
             f"completed-checkpoint duration p95 {p95:.1f} ms exceeds "
             f"budget {budget:.1f} ms", p95)
+
+    def _eval_bottleneck(self) -> None:
+        if self.bottleneck_supplier is None:
+            return
+        try:
+            located = self.bottleneck_supplier()
+        except Exception:  # noqa: BLE001 — localization must not kill
+            return         # the evaluation pass
+        vid = located.get("vertex_id") if located else None
+        prev_vid, streak = self._bottleneck_streak
+        streak = streak + 1 if (vid is not None and vid == prev_vid) \
+            else (1 if vid is not None else 0)
+        self._bottleneck_streak = (vid, streak)
+        firing = streak >= self.bottleneck_consecutive
+        if firing:
+            self.last_bottleneck = located
+        name = (located or {}).get("name") or vid
+        self._episode(
+            "bottleneck-stable", "bottleneck.vertex", firing,
+            f"bottleneck stable at vertex {name} (id {vid}) for "
+            f"{streak} consecutive evaluations "
+            f"(busy {((located or {}).get('busyMsPerSecond') or 0):.0f} "
+            f"ms/s, backpressured upstreams "
+            f"{[u['vertex_id'] for u in (located or {}).get('backpressured_upstreams', [])]})",
+            vid)
 
 
 def register_health_gauges(metrics, job_name: str,

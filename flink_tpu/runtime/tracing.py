@@ -37,14 +37,19 @@ import json
 import os
 import threading
 import time
+import uuid
 import weakref
 from collections import deque
-from typing import Any, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional
 
 __all__ = [
     "Tracer",
     "get_tracer",
     "set_tracer",
+    "make_trace_context",
+    "clock_anchor",
+    "estimate_clock_offset",
+    "build_cluster_trace",
     "traced_jit",
     "record_kernel",
     "record_compile_event",
@@ -166,6 +171,10 @@ class Tracer:
         self._lock = threading.Lock()
         self._tls = threading.local()
         self._pid = os.getpid()
+        #: spans evicted by the bounded ring (deque maxlen drops the
+        #: oldest silently; this makes truncation self-describing)
+        self.dropped = 0
+        self._seq = 0
         # metric groups (weakrefs) that want per-span-name gauges
         self._metric_groups: List[weakref.ref] = []
 
@@ -177,12 +186,46 @@ class Tracer:
             return _NULL_SPAN
         return _Span(self, name, attrs or None)
 
+    def span_linked(self, name: str, ctx: Optional[dict], **attrs):
+        """Like :meth:`span`, but causally linked to a propagated
+        trace context (``make_trace_context()`` dict stamped on a
+        barrier's options or a netchannel frame): the consumer-side
+        span carries the producer's ``trace_id`` and points at its
+        ``span_id``, so cross-host viewers can stitch the tree."""
+        if not self.enabled:
+            return _NULL_SPAN
+        if ctx:
+            attrs["trace_id"] = ctx.get("trace_id")
+            attrs["parent_span_id"] = ctx.get("span_id")
+        return _Span(self, name, attrs or None)
+
+    # ---- logical lanes ----------------------------------------------
+    # All task-manager runners in the single-process executors share
+    # THIS tracer; a thread-local lane label partitions their events so
+    # the merged cluster trace can render one process lane per worker.
+    def set_lane(self, label: Optional[str]) -> None:
+        """Tag every event recorded by the CURRENT thread with a
+        worker-lane label (e.g. ``tm-0``)."""
+        self._tls.lane = label
+
+    def current_lane(self) -> Optional[str]:
+        return getattr(self._tls, "lane", None)
+
     def _stack(self) -> list:
         stack = getattr(self._tls, "stack", None)
         if stack is None:
             stack = []
             self._tls.stack = stack
         return stack
+
+    def _append_locked(self, event: dict) -> None:
+        # caller holds self._lock; the ring is full exactly when the
+        # next append will evict its oldest event
+        if len(self._events) == self.max_events:
+            self.dropped += 1
+        self._seq += 1
+        event["seq"] = self._seq
+        self._events.append(event)
 
     def _finish(self, span: _Span, dur_ns: int) -> None:
         event = {
@@ -193,6 +236,9 @@ class Tracer:
             "pid": self._pid,
             "tid": threading.get_ident(),
         }
+        lane = getattr(self._tls, "lane", None)
+        if lane is not None:
+            event["lane"] = lane
         if span.parent is not None:
             event["parent"] = span.parent.name
         if span.attrs:
@@ -200,7 +246,7 @@ class Tracer:
         total_ms = dur_ns / 1e6
         self_ms = (dur_ns - span.child_ns) / 1e6
         with self._lock:
-            self._events.append(event)
+            self._append_locked(event)
             stat = self._stats.get(span.name)
             if stat is None:
                 stat = self._stats[span.name] = _SpanStat()
@@ -223,10 +269,13 @@ class Tracer:
             "tid": threading.get_ident(),
             "s": "t",
         }
+        lane = getattr(self._tls, "lane", None)
+        if lane is not None:
+            event["lane"] = lane
         if attrs:
             event["args"] = attrs
         with self._lock:
-            self._events.append(event)
+            self._append_locked(event)
 
     # ---- export -----------------------------------------------------
     def recent(self, limit: int = 200) -> List[dict]:
@@ -238,10 +287,52 @@ class Tracer:
     def chrome_trace(self) -> dict:
         """The Chrome trace-event JSON object (``traceEvents`` uses
         complete events: ``ph``/``ts``/``dur``/``pid``/``tid``/
-        ``name``; timestamps are microseconds)."""
+        ``name``; timestamps are microseconds).  When the bounded ring
+        has evicted events, the export says so in ``metadata`` instead
+        of silently presenting a truncated timeline as complete."""
         with self._lock:
             events = list(self._events)
-        return {"traceEvents": events, "displayTimeUnit": "ms"}
+            dropped = self.dropped
+        trace = {"traceEvents": events, "displayTimeUnit": "ms"}
+        if dropped:
+            trace["metadata"] = {
+                "dropped_events": dropped,
+                "warning": (f"trace truncated: {dropped} oldest events "
+                            f"dropped at the {self.max_events}-event "
+                            f"ring limit"),
+            }
+        return trace
+
+    def export_since(self, seq: int, lane: Optional[str] = None) -> dict:
+        """Incremental buffer export for cross-process shipping: every
+        event appended after sequence number ``seq`` (optionally only
+        one lane's), plus a clock anchor pairing this process's
+        ``perf_counter`` epoch with its wall clock — the receiver
+        converts span timestamps to wall time, then applies the
+        RPC-estimated inter-host offset."""
+        with self._lock:
+            events = [e for e in self._events if e.get("seq", 0) > seq]
+            max_seq = self._seq
+        if lane is not None:
+            events = [e for e in events if e.get("lane") == lane]
+        return {"events": events, "anchor": clock_anchor(),
+                "seq": max_seq, "pid": self._pid}
+
+    def lane_buffers(self, default_lane: str = "main") -> Dict[str, dict]:
+        """The full event buffer partitioned by worker lane, each with
+        the (shared, same-process) clock anchor — the single-process
+        executors' input to :func:`build_cluster_trace`."""
+        anchor = clock_anchor()
+        with self._lock:
+            events = list(self._events)
+        buffers: Dict[str, dict] = {}
+        for ev in events:
+            lane = ev.get("lane", default_lane)
+            buf = buffers.get(lane)
+            if buf is None:
+                buf = buffers[lane] = {"events": [], "anchor": anchor}
+            buf["events"].append(ev)
+        return buffers
 
     def write_chrome_trace(self, path: str) -> int:
         """Write the trace file; returns the number of events."""
@@ -269,6 +360,7 @@ class Tracer:
         with self._lock:
             self._events.clear()
             self._stats.clear()
+            self.dropped = 0
 
     # ---- metric registry feed --------------------------------------
     def install_metrics(self, group) -> None:
@@ -276,6 +368,7 @@ class Tracer:
         (a ``MetricGroup``); names that appear later back-fill."""
         with self._lock:
             self._metric_groups.append(weakref.ref(group))
+            group.gauge("dropped", lambda: self.dropped)
             for name, stat in self._stats.items():
                 self._add_gauges(group, name, stat)
 
@@ -311,6 +404,92 @@ def set_tracer(tracer: Tracer) -> Tracer:
     global _tracer
     _tracer = tracer
     return tracer
+
+
+# ---------------------------------------------------------------------
+# cluster-causal tracing: context propagation + clock alignment
+# ---------------------------------------------------------------------
+
+def make_trace_context() -> dict:
+    """A Dapper-style propagation context (Sigelman et al., 2010):
+    stamped onto checkpoint-barrier options and netchannel frames so
+    consumer-side spans on other hosts link back to the producer."""
+    return {"trace_id": uuid.uuid4().hex[:16],
+            "span_id": uuid.uuid4().hex[:16]}
+
+
+def clock_anchor() -> dict:
+    """One (perf_counter, wall clock) pair sampled together: converts
+    this process's span timestamps (perf-epoch µs) to wall-clock µs."""
+    return {"perf_us": _perf_ns() / 1000.0,
+            "wall_us": time.time() * 1e6}
+
+
+def estimate_clock_offset(probe: Callable[[], float],
+                          samples: int = 8) -> dict:
+    """Min-RTT-midpoint clock-offset estimate (the NTP idea, one
+    peer): ``probe()`` round-trips to the remote and returns its wall
+    clock in µs; the sample with the smallest RTT bounds the offset
+    tightest, and the midpoint assumption splits that RTT evenly.
+    Returns ``{"offset_us": remote − local, "rtt_us": best}``."""
+    best_rtt: Optional[float] = None
+    best_off = 0.0
+    for _ in range(max(1, samples)):
+        t0 = time.time()
+        remote_us = probe()
+        t1 = time.time()
+        rtt_us = (t1 - t0) * 1e6
+        offset_us = remote_us - (t0 * 1e6 + rtt_us / 2.0)
+        if best_rtt is None or rtt_us < best_rtt:
+            best_rtt = rtt_us
+            best_off = offset_us
+    return {"offset_us": best_off, "rtt_us": best_rtt or 0.0}
+
+
+def build_cluster_trace(buffers: Dict[str, dict],
+                        offsets: Optional[Dict[str, float]] = None
+                        ) -> dict:
+    """Merge per-worker tracer buffers into ONE Chrome trace with one
+    process lane per worker and clock-aligned timestamps.
+
+    ``buffers`` maps a lane label to ``{"events": [...], "anchor":
+    {"perf_us", "wall_us"}}`` (the :meth:`Tracer.export_since` /
+    :meth:`Tracer.lane_buffers` shape); ``offsets`` maps a lane to its
+    host's wall-clock offset in µs relative to the assembler
+    (``estimate_clock_offset`` — subtracted to align).  Timestamps are
+    normalized to the earliest aligned event so the merged view starts
+    at t=0."""
+    offsets = offsets or {}
+    merged: List[dict] = []
+    lanes_meta: Dict[str, dict] = {}
+    lane_order = sorted(buffers)
+    for idx, lane in enumerate(lane_order, start=1):
+        buf = buffers[lane] or {}
+        anchor = buf.get("anchor") or {}
+        shift = (anchor.get("wall_us", 0.0) - anchor.get("perf_us", 0.0)
+                 - float(offsets.get(lane, 0.0)))
+        events = buf.get("events") or []
+        lanes_meta[lane] = {"pid": idx,
+                            "offset_us": float(offsets.get(lane, 0.0)),
+                            "events": len(events)}
+        for ev in events:
+            e = dict(ev)
+            e["ts"] = float(ev.get("ts", 0.0)) + shift
+            e["pid"] = idx
+            e.pop("seq", None)
+            merged.append(e)
+    if merged:
+        t0 = min(e["ts"] for e in merged)
+        for e in merged:
+            e["ts"] -= t0
+    merged.sort(key=lambda e: e["ts"])
+    events: List[dict] = [
+        {"name": "process_name", "ph": "M", "pid": idx, "tid": 0,
+         "args": {"name": lane}}
+        for idx, lane in enumerate(lane_order, start=1)]
+    events.extend(merged)
+    return {"traceEvents": events, "displayTimeUnit": "ms",
+            "metadata": {"lanes": lanes_meta}}
 
 
 # ---------------------------------------------------------------------
@@ -351,8 +530,11 @@ def record_kernel(name: str, t0_ns: int, t1_ns: int) -> None:
             "pid": tracer._pid,
             "tid": threading.get_ident(),
         }
+        lane = tracer.current_lane()
+        if lane is not None:
+            event["lane"] = lane
         with tracer._lock:
-            tracer._events.append(event)
+            tracer._append_locked(event)
 
 
 def kernel_stats() -> Dict[str, dict]:

@@ -1,7 +1,7 @@
 #!/usr/bin/env python
 """CI observability smoke (ci_check.sh stage 4).
 
-Two short end-to-end checks over the history plane:
+Three short end-to-end checks over the observability plane:
 
 1. a MiniCluster job with metric sampling + checkpointing on: the live
    `/jobs/<name>/metrics/history` route must fill with samples and the
@@ -9,7 +9,12 @@ Two short end-to-end checks over the history plane:
    with per-subtask ack latencies;
 2. a LocalExecutor job with a tiny channel and a slow keyed map: the
    seeded sustained backpressure must fire exactly ONE
-   `backpressure-sustained` health alert (episode semantics).
+   `backpressure-sustained` health alert (episode semantics), and the
+   live `/jobs/<name>/bottleneck` route must name a vertex (the slow
+   map, with its backpressured upstream) while the job runs;
+3. a traced MiniCluster job: `/jobs/<name>/traces?scope=cluster` must
+   serve ONE merged Chrome trace containing spans from >=2 worker
+   lanes with clock-aligned, monotonic timestamps normalized to t=0.
 
 Exits 0 on success, 1 with a reason on the first failed check.
 """
@@ -119,12 +124,69 @@ def main():
     env.graph.job_name = "smoke-bp"
     executor = LocalExecutor(channel_capacity=8, sample_interval_ms=2)
     client = executor.execute_async(env.get_job_graph())
-    client.wait(timeout=120)
+    monitor = WebMonitor(executor.metrics).start()
+    located = None
+    try:
+        monitor.track_job("smoke-bp", client)
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            located = _get(monitor.port,
+                           "/jobs/smoke-bp/bottleneck")["bottleneck"]
+            if located is not None:
+                break
+            time.sleep(0.05)
+        client.wait(timeout=120)
+    finally:
+        monitor.stop()
+    check(located is not None,
+          "bottleneck route names a vertex under seeded backpressure")
+    check(bool(located.get("backpressured_upstreams")),
+          f"bottleneck {located.get('name')!r} has backpressured "
+          f"upstreams")
     evaluator = client.executor_state["health"]
     bp = [a for a in evaluator.snapshot_alerts()
           if a["rule"] == "backpressure-sustained"]
     check(len(bp) == 1,
           f"seeded backpressure fired exactly one alert ({len(bp)})")
+
+    # ---- 3. merged cluster trace: >=2 worker lanes, aligned ts ------
+    from flink_tpu.runtime.tracing import get_tracer
+    tracer = get_tracer()
+    tracer.enabled = True
+    try:
+        env = StreamExecutionEnvironment()
+        env.set_parallelism(2)
+        env.use_mini_cluster(2)
+        (env.add_source(Slowish(n=1500, delay=0.0))
+            .key_by(lambda v: v % 4)
+            .map(lambda v: v + 1)
+            .add_sink(CollectSink()))
+        client = env.execute_async("smoke-trace")
+        monitor = WebMonitor(env.get_metric_registry()).start()
+        try:
+            monitor.track_job("smoke-trace", client)
+            client.wait(timeout=60)
+            body = _get(monitor.port,
+                        "/jobs/smoke-trace/traces?scope=cluster")
+            check(body.get("enabled") and body.get("scope") == "cluster",
+                  "cluster-scope merged trace served")
+            trace = body["trace"]
+            lanes = (trace.get("metadata") or {}).get("lanes") or {}
+            tm_lanes = [l for l in lanes if l.startswith("tm-")]
+            check(len(tm_lanes) >= 2,
+                  f"merged trace spans >=2 worker lanes ({sorted(lanes)})")
+            spans = [e for e in trace["traceEvents"]
+                     if e.get("ph") != "M"]
+            ts = [e["ts"] for e in spans]
+            check(bool(spans) and ts == sorted(ts) and ts[0] == 0.0,
+                  "aligned timestamps are monotonic and start at t=0")
+            check(len({e["pid"] for e in spans}) >= 2,
+                  "merged spans come from >=2 process lanes")
+        finally:
+            monitor.stop()
+    finally:
+        tracer.enabled = False
+        tracer.reset()
 
     print("observability smoke: PASSED")
     return 0

@@ -10,6 +10,11 @@ import urllib.request
 
 import pytest
 
+from flink_tpu.runtime.backpressure import (
+    TimeAccounting,
+    locate_bottleneck,
+    read_backpressure_gauges,
+)
 from flink_tpu.runtime.history import FsJobArchivist, HistoryServer
 from flink_tpu.runtime.metrics import MetricRegistry
 from flink_tpu.runtime.rest import WebMonitor
@@ -368,6 +373,267 @@ def test_journal_disabled_by_default(tmp_path):
 
 
 # ---------------------------------------------------------------------
+# time attribution: busy+idle+backPressured tiles wall time
+# ---------------------------------------------------------------------
+
+def test_time_accounting_tiles_elapsed_time():
+    """Deterministic clock: every observed interval lands in exactly
+    one bucket, so the windowed rates sum to exactly 1000 ms/s."""
+    acct = TimeAccounting()
+    ms = 1_000_000  # ns
+    t = 0
+    acct.observe(False, False, now_ns=t)
+    for _ in range(100):                      # 100 ms busy
+        t += ms
+        acct.observe(True, False, now_ns=t)
+    for _ in range(60):                       # 60 ms idle
+        t += ms
+        acct.observe(False, False, now_ns=t)
+    for _ in range(40):                       # 40 ms backpressured
+        t += ms
+        acct.observe(False, True, now_ns=t)
+    busy, idle, bp = acct.rates()
+    assert busy == pytest.approx(500.0)
+    assert idle == pytest.approx(300.0)
+    assert bp == pytest.approx(200.0)
+    assert busy + idle + bp == pytest.approx(1000.0)
+
+
+def _attribution_rates(dump, job_name):
+    """{<vid>_<vname>.<i>: [busy, idle, backPressured]} from a dump."""
+    out = {}
+    suffixes = (".busyTimeMsPerSecond", ".idleTimeMsPerSecond",
+                ".backPressuredTimeMsPerSecond")
+    for k, v in dump.items():
+        if not k.startswith(job_name + "."):
+            continue
+        for i, suffix in enumerate(suffixes):
+            if k.endswith(suffix):
+                key = k[len(job_name) + 1:-len(suffix)]
+                out.setdefault(key, [0.0, 0.0, 0.0])[i] = float(v)
+    return out
+
+
+def _poll_attribution(registry, job_name, require=None, timeout=60.0):
+    """Poll until every subtask with a completed attribution window
+    tiles to 1000 ms/s (±10%) AND the scenario predicate holds.
+    Subtasks still inside their first window read (0, 0, 0) and are
+    excluded; three separate gauge reads can straddle a window swap,
+    so a torn read retries instead of failing."""
+    deadline = time.monotonic() + timeout
+    last = {}
+    while time.monotonic() < deadline:
+        rates = _attribution_rates(registry.dump(), job_name)
+        live = {k: tuple(v) for k, v in rates.items() if sum(v) > 0.0}
+        last = live
+        if (live
+                and all(abs(sum(v) - 1000.0) <= 100.0
+                        for v in live.values())
+                and (require is None or require(live))):
+            return live
+        time.sleep(0.05)
+    raise AssertionError(
+        f"attribution invariant/predicate never held for {job_name}: "
+        f"{last}")
+
+
+def test_attribution_invariant_idle_job():
+    """A trickle source leaves the downstream keyed map waiting on
+    empty input most of each second: idle dominates, and the three
+    gauges still tile to ~1000 ms/s."""
+    env = StreamExecutionEnvironment()
+    sink = CollectSink()
+    (env.add_source(_Slowish(n=600, delay=0.005))
+        .key_by(lambda v: v % 2)
+        .map(lambda v: v)
+        .add_sink(sink))
+    client = env.execute_async("idle-attr-job")
+    try:
+        _poll_attribution(
+            env.get_metric_registry(), "idle-attr-job",
+            require=lambda live: any(v[1] > 500.0 for v in live.values()))
+    finally:
+        client.wait(timeout=60)
+
+
+def test_attribution_invariant_saturated_job():
+    """A map that sleeps per record keeps its subtasks working the
+    whole pass: busy dominates on the map vertex."""
+    env = StreamExecutionEnvironment()
+    sink = CollectSink()
+
+    def heavy(v):
+        time.sleep(0.0005)
+        return v
+
+    (env.add_source(_Slowish(n=3000, delay=0.0))
+        .key_by(lambda v: v % 2)
+        .map(heavy)
+        .add_sink(sink))
+    client = env.execute_async("busy-attr-job")
+    try:
+        _poll_attribution(
+            env.get_metric_registry(), "busy-attr-job",
+            require=lambda live: any(v[0] > 500.0 for v in live.values()))
+    finally:
+        client.wait(timeout=120)
+
+
+def test_attribution_invariant_seeded_backpressure_job():
+    """The PR-6 seeded-backpressure fixture (8-slot channel + slow
+    keyed map): the blocked source reads backPressured, the slow map
+    busy, and both tile to ~1000 ms/s."""
+    from flink_tpu.runtime.local import LocalExecutor
+
+    env = StreamExecutionEnvironment()
+    sink = CollectSink()
+
+    def slow(v):
+        time.sleep(0.0005)
+        return v
+
+    (env.add_source(_Slowish(n=2500, delay=0.0))
+        .key_by(lambda v: v % 2)
+        .map(slow)
+        .add_sink(sink))
+    env.graph.job_name = "bp-attr-job"
+    executor = LocalExecutor(channel_capacity=8)
+    client = executor.execute_async(env.get_job_graph())
+    try:
+        _poll_attribution(
+            executor.metrics, "bp-attr-job",
+            require=lambda live: (
+                any(v[2] > 500.0 for v in live.values())
+                and any(v[0] > 500.0 for v in live.values())))
+    finally:
+        client.wait(timeout=120)
+
+
+# ---------------------------------------------------------------------
+# bottleneck localization
+# ---------------------------------------------------------------------
+
+def test_locate_bottleneck_picks_most_downstream_saturated_vertex():
+    # chain 1 -> 2 -> 3 -> 4: vertex 3 is the deepest busy-saturated
+    # vertex with a backpressured upstream — 1 and 2 are victims of
+    # the propagating pressure, 4 is merely starved
+    upstreams = {1: [], 2: [1], 3: [2], 4: [3]}
+    stats = {
+        1: {"vertex_id": 1, "name": "src", "busy_ms_per_s": 100.0,
+            "backpressure_ratio": 1.0},
+        2: {"vertex_id": 2, "name": "mid", "busy_ms_per_s": 900.0,
+            "backpressure_ratio": 0.8},
+        3: {"vertex_id": 3, "name": "slow", "busy_ms_per_s": 950.0,
+            "backpressure_ratio": 0.0},
+        4: {"vertex_id": 4, "name": "sink", "busy_ms_per_s": 50.0,
+            "backpressure_ratio": 0.0},
+    }
+    b = locate_bottleneck(upstreams, stats)
+    assert b["vertex_id"] == 3 and b["name"] == "slow"
+    assert [u["vertex_id"] for u in b["backpressured_upstreams"]] == [2]
+    assert b["busyMsPerSecond"] == 950.0
+    # no stats / raised thresholds -> no bottleneck, never a crash
+    assert locate_bottleneck(upstreams, {}) is None
+    assert locate_bottleneck(upstreams, stats,
+                             busy_threshold=2000.0) is None
+    # raising the ratio bar disqualifies 3 (upstream 2 at 0.8) but 2
+    # still qualifies through src at 1.0 — localization moves upstream
+    assert locate_bottleneck(upstreams, stats,
+                             ratio_threshold=0.9)["vertex_id"] == 2
+
+
+def test_read_backpressure_gauges_from_dump():
+    dump = {"j.1_src.backpressure.ratio": 0.75,
+            "j.1_src.backpressure.level": "high",
+            "j.2_map.backpressure.ratio": 0.0,
+            "other.1_x.backpressure.ratio": 1.0}
+    out = read_backpressure_gauges(dump, "j")
+    assert set(out) == {1, 2}
+    assert out[1]["max_ratio"] == 0.75 and out[1]["level"] == "high"
+    assert out[2]["level"] == "ok"
+
+
+def test_live_bottleneck_names_the_slowed_vertex():
+    """Acceptance: under seeded backpressure the REST route names the
+    artificially-slowed vertex exactly, and the bottleneck-stable
+    health rule fires once for the episode."""
+    from flink_tpu.runtime.local import LocalExecutor
+
+    env = StreamExecutionEnvironment()
+    sink = CollectSink()
+
+    def slow(v):
+        time.sleep(0.0005)
+        return v
+
+    (env.add_source(_Slowish(n=2500, delay=0.0))
+        .key_by(lambda v: v % 2)
+        .map(slow, name="slow-map")
+        .add_sink(sink))
+    env.graph.job_name = "bn-job"
+    graph = env.get_job_graph()
+    expected = [vid for vid, v in graph.vertices.items()
+                if "slow-map" in v.name]
+    assert len(expected) == 1, graph.vertices
+    executor = LocalExecutor(channel_capacity=8, sample_interval_ms=2)
+    client = executor.execute_async(graph)
+    monitor = WebMonitor(executor.metrics).start()
+    try:
+        monitor.track_job("bn-job", client)
+        located = None
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            located = _get(monitor.port,
+                           "/jobs/bn-job/bottleneck")["bottleneck"]
+            if located is not None:
+                break
+            time.sleep(0.05)
+        assert located is not None, "bottleneck never located"
+        assert located["vertex_id"] == expected[0]
+        assert "slow-map" in located["name"]
+        assert located["backpressured_upstreams"]
+        assert located["busyMsPerSecond"] > 500.0
+        # raised thresholds clear it (param plumbing end to end)
+        body = _get(monitor.port,
+                    "/jobs/bn-job/bottleneck?busy_threshold=2000")
+        assert body["bottleneck"] is None
+        assert body["busy_threshold_ms_per_s"] == 2000.0
+        client.wait(timeout=120)
+        evaluator = client.executor_state["health"]
+        stable = [a for a in evaluator.snapshot_alerts()
+                  if a["rule"] == "bottleneck-stable"]
+        assert len(stable) == 1, stable
+    finally:
+        monitor.stop()
+
+
+def test_history_server_bottleneck_replay_from_archive(tmp_path):
+    """`/bottleneck` replays localization over the archived metrics
+    snapshot + upstream map (JSON round-trips the vertex-id keys to
+    strings; the route converts them back)."""
+    metrics = {
+        "done-job.1_src.backpressure.ratio": 1.0,
+        "done-job.1_src.0.busyTimeMsPerSecond": 100.0,
+        "done-job.2_slowmap.backpressure.ratio": 0.0,
+        "done-job.2_slowmap.0.busyTimeMsPerSecond": 980.0,
+    }
+    FsJobArchivist.archive(str(tmp_path), "job-2", {
+        "job_name": "done-job", "state": "FINISHED",
+        "metrics": metrics, "upstreams": {"1": [], "2": [1]}})
+    hs = HistoryServer([str(tmp_path)]).start()
+    try:
+        body = _get(hs.port, "/jobs/done-job/bottleneck")
+        b = body["bottleneck"]
+        assert b["vertex_id"] == 2 and b["name"] == "slowmap"
+        assert b["backpressured_upstreams"][0]["vertex_id"] == 1
+        body = _get(hs.port,
+                    "/jobs/done-job/bottleneck?busy_threshold=2000")
+        assert body["bottleneck"] is None
+    finally:
+        hs.stop()
+
+
+# ---------------------------------------------------------------------
 # REST error paths: 404 JSON bodies + 400 on malformed params
 # ---------------------------------------------------------------------
 
@@ -383,7 +649,7 @@ def test_rest_error_paths_on_live_monitor():
         monitor.track_job("real-job", _Client())
         for sub in ("", "/metrics", "/metrics/history", "/checkpoints",
                     "/alerts", "/backpressure", "/detail", "/exceptions",
-                    "/traces"):
+                    "/traces", "/traces?scope=cluster", "/bottleneck"):
             code, body = _get_error(monitor.port, f"/jobs/nope{sub}")
             assert code == 404, f"/jobs/nope{sub} -> {code}"
             assert "error" in body and "not found" in body["error"]
@@ -392,6 +658,17 @@ def test_rest_error_paths_on_live_monitor():
                 monitor.port, f"/jobs/real-job/metrics/history?{q}")
             assert code == 400, f"?{q} -> {code}"
             assert "error" in body
+        for path in ("/jobs/real-job/traces?scope=bogus",
+                     "/jobs/real-job/bottleneck?busy_threshold=abc",
+                     "/jobs/real-job/bottleneck?ratio_threshold=much"):
+            code, body = _get_error(monitor.port, path)
+            assert code == 400, f"{path} -> {code}"
+            assert "error" in body
+        # a tracked job with no metrics: null bottleneck, not an error
+        body = _get(monitor.port, "/jobs/real-job/bottleneck")
+        assert body["bottleneck"] is None
+        assert body["busy_threshold_ms_per_s"] == 500.0
+        assert body["ratio_threshold"] == 0.5
     finally:
         monitor.stop()
 
@@ -404,12 +681,26 @@ def test_rest_error_paths_on_history_server(tmp_path):
     hs = HistoryServer([archive]).start()
     try:
         for sub in ("", "/metrics", "/metrics/history", "/checkpoints",
-                    "/alerts", "/traces", "/exceptions"):
+                    "/alerts", "/traces", "/traces?scope=cluster",
+                    "/exceptions", "/bottleneck"):
             code, body = _get_error(hs.port, f"/jobs/nope{sub}")
             assert code == 404 and "error" in body
         code, body = _get_error(
             hs.port, "/jobs/done-job/metrics/history?since=abc")
         assert code == 400 and "error" in body
+        for path in ("/jobs/done-job/traces?scope=bogus",
+                     "/jobs/done-job/bottleneck?busy_threshold=abc",
+                     "/jobs/done-job/bottleneck?ratio_threshold=much"):
+            code, body = _get_error(hs.port, path)
+            assert code == 400, f"{path} -> {code}"
+            assert "error" in body
+        # archived without a cluster bundle: empty merged trace shape
+        body = _get(hs.port, "/jobs/done-job/traces?scope=cluster")
+        assert body == {"enabled": False, "scope": "cluster",
+                        "trace": {"traceEvents": []}}
+        # archived without metrics/upstreams: null bottleneck
+        assert _get(hs.port,
+                    "/jobs/done-job/bottleneck")["bottleneck"] is None
         # archived-but-never-sampled job serves the disabled shape
         body = _get(hs.port, "/jobs/done-job/metrics/history")
         assert body["sampling_disabled"] is True
@@ -466,6 +757,60 @@ def test_cluster_metrics_shipping_and_archive(tmp_path):
         jm.stop()
 
 
+def test_cluster_trace_shipping_and_merged_archive(tmp_path):
+    """With tracing on, workers ship tracer ring buffers alongside the
+    report_metrics cadence; the Dispatcher archives the raw buffers +
+    ping-burst clock offsets, and the HistoryServer replays ONE merged
+    cluster trace with spans from both workers, clock-aligned and
+    normalized to t=0."""
+    from flink_tpu.runtime.cluster import (
+        JobManagerProcess,
+        TaskManagerProcess,
+    )
+    from flink_tpu.runtime.tracing import get_tracer
+
+    archive = str(tmp_path / "archive")
+    jm = JobManagerProcess(archive_dir=archive)
+    tms = [TaskManagerProcess(jm_address=jm.address, num_slots=2)
+           for _ in range(2)]
+    tracer = get_tracer()
+    tracer.enabled = True
+    try:
+        env = StreamExecutionEnvironment()
+        env.set_parallelism(2)
+        env.config.set("metrics.sample.interval.ms", 10)
+        env.use_remote_cluster(jm.address)
+        (env.from_collection(range(20000))
+            .key_by(lambda v: v % 4)
+            .map(lambda v: v * 2)
+            .add_sink(CollectSink()))
+        env.execute("cluster-trace-job")
+
+        _wait_for_archive(archive)
+        hs = HistoryServer([archive]).start()
+        try:
+            body = _get(hs.port,
+                        "/jobs/cluster-trace-job/traces?scope=cluster")
+            assert body["enabled"] is True and body["scope"] == "cluster"
+            trace = body["trace"]
+            lanes = trace["metadata"]["lanes"]
+            worker_lanes = [l for l in lanes if l.startswith("tm-")]
+            assert len(worker_lanes) >= 2, lanes
+            spans = [e for e in trace["traceEvents"] if e["ph"] != "M"]
+            assert spans
+            ts = [e["ts"] for e in spans]
+            assert ts == sorted(ts) and ts[0] == 0.0
+            assert len({e["pid"] for e in spans}) >= 2
+        finally:
+            hs.stop()
+    finally:
+        tracer.enabled = False
+        tracer.reset()
+        for tm in tms:
+            tm.stop()
+        jm.stop()
+
+
 # ---------------------------------------------------------------------
 # CLI: flink_tpu top
 # ---------------------------------------------------------------------
@@ -489,6 +834,7 @@ def test_cli_top_once(capsys):
         assert "topped-job" in out and "RUNNING" in out
         assert "rec/s" in out and "backpressure" in out
         assert "checkpoints:" in out and "alerts:" in out
+        assert "BOTTLENECK" in out  # column header + footer line
     finally:
         client.cancel()
         client.wait(timeout=30)

@@ -252,6 +252,189 @@ def test_minicluster_trace_prometheus_and_rest(tmp_path):
         monitor.stop()
 
 
+# ---------------------------------------------------------------------
+# cluster-causal tracing: ring drops, clock alignment, merged lanes,
+# barrier trace-context propagation
+# ---------------------------------------------------------------------
+
+def test_ring_overflow_counts_drops_and_annotates_export():
+    tr = Tracer(max_events=8)
+    tr.enabled = True
+    for _ in range(20):
+        with tr.span("s"):
+            pass
+    assert tr.dropped == 12
+    trace = tr.chrome_trace()
+    assert len(trace["traceEvents"]) == 8
+    meta = trace["metadata"]
+    assert meta["dropped_events"] == 12
+    assert "12 oldest events" in meta["warning"]
+    assert "8-event ring limit" in meta["warning"]
+    tr.reset()
+    assert tr.dropped == 0
+    assert "metadata" not in tr.chrome_trace()
+
+
+def test_dropped_counter_reaches_registry_gauge():
+    from flink_tpu.runtime.metrics import MetricRegistry
+    old = get_tracer()
+    tr = tracing.set_tracer(Tracer(max_events=4))
+    try:
+        tr.enabled = True
+        registry = MetricRegistry()
+        tracing.register_runtime_profile_gauges(registry)
+        assert registry.dump()["tracing.dropped"] == 0
+        for _ in range(10):
+            with tr.span("x"):
+                pass
+        assert registry.dump()["tracing.dropped"] == 6
+    finally:
+        tracing.set_tracer(old)
+
+
+def test_clock_offset_min_rtt_midpoint():
+    # a remote whose wall clock runs 5 s ahead: the estimate recovers
+    # the skew to well within the local probe's round-trip time
+    est = tracing.estimate_clock_offset(
+        lambda: (time.time() + 5.0) * 1e6, samples=4)
+    assert est["offset_us"] == pytest.approx(5_000_000.0, abs=100_000)
+    assert est["rtt_us"] >= 0.0
+
+
+def test_export_since_incremental_cursor_and_lane_filter():
+    tr = Tracer()
+    tr.enabled = True
+    tr.set_lane("tm-0")
+    with tr.span("first"):
+        pass
+    out1 = tr.export_since(0, lane="tm-0")
+    assert [e["name"] for e in out1["events"]] == ["first"]
+    assert {"perf_us", "wall_us"} <= set(out1["anchor"])
+    with tr.span("second"):
+        pass
+    out2 = tr.export_since(out1["seq"], lane="tm-0")
+    assert [e["name"] for e in out2["events"]] == ["second"]
+    # other lanes' events never ship under this lane's cursor
+    tr.set_lane("tm-1")
+    with tr.span("third"):
+        pass
+    assert tr.export_since(out2["seq"], lane="tm-0")["events"] == []
+
+
+def test_build_cluster_trace_aligns_lanes_and_rewrites_pids():
+    anchor = {"perf_us": 0.0, "wall_us": 1_000_000.0}
+    buffers = {
+        "tm-0": {"anchor": anchor, "events": [
+            {"name": "a", "ph": "X", "ts": 100.0, "dur": 5.0,
+             "pid": 999, "tid": 1, "seq": 3}]},
+        "tm-1": {"anchor": anchor, "events": [
+            {"name": "b", "ph": "X", "ts": 100.0, "dur": 5.0,
+             "pid": 999, "tid": 2, "seq": 4}]},
+    }
+    # tm-1's host clock runs 40 µs ahead: subtracting its offset puts
+    # its identically-stamped event 40 µs BEFORE tm-0's
+    merged = tracing.build_cluster_trace(buffers, offsets={"tm-1": 40.0})
+    lanes = merged["metadata"]["lanes"]
+    assert lanes["tm-0"]["pid"] == 1 and lanes["tm-1"]["pid"] == 2
+    assert lanes["tm-1"]["offset_us"] == 40.0
+    names = [e["args"]["name"] for e in merged["traceEvents"]
+             if e["ph"] == "M"]
+    assert names == ["tm-0", "tm-1"]          # one process lane each
+    spans = [e for e in merged["traceEvents"] if e["ph"] == "X"]
+    assert [e["name"] for e in spans] == ["b", "a"]
+    assert spans[0]["ts"] == 0.0              # normalized to t=0
+    assert spans[1]["ts"] == pytest.approx(40.0)
+    assert spans[0]["pid"] == 2 and spans[1]["pid"] == 1
+    assert all("seq" not in e for e in spans)
+
+
+def test_barrier_trace_context_causal_tree_across_lanes():
+    """One barrier's life — coordinator trigger → per-subtask barrier
+    spans → acks → complete — shares one trace_id, every child points
+    at the trigger's span_id, and the barrier spans land in BOTH
+    worker lanes (subtask i of every vertex runs on TM i mod N)."""
+    env = StreamExecutionEnvironment()
+    env.set_parallelism(2)
+    env.use_mini_cluster(2)
+    env.enable_checkpointing(20)
+    env.enable_tracing()
+    _run_window_job(env, n=4000, name="causal-trace")
+
+    tracer = env.get_tracer()
+    events = tracer.recent(limit=tracer.max_events)
+
+    def args(e):
+        return e.get("args") or {}
+
+    triggers = {args(e)["trace_id"]: args(e)["span_id"]
+                for e in events if e["name"] == "checkpoint.trigger"}
+    assert triggers, "no checkpoint.trigger instants recorded"
+    for tid, sid in triggers.items():
+        linked = {}
+        for e in events:
+            a = args(e)
+            if a.get("trace_id") == tid and a.get("parent_span_id") == sid:
+                linked.setdefault(e["name"], []).append(e)
+        if {"checkpoint.barrier", "checkpoint.ack",
+                "checkpoint.complete"} <= set(linked):
+            lanes = {e.get("lane") for e in linked["checkpoint.barrier"]}
+            assert len(lanes) >= 2, lanes
+            break
+    else:
+        raise AssertionError(
+            "no barrier with trigger->barrier->ack->complete links")
+
+
+def test_minicluster_cluster_scope_merged_trace_rest():
+    """`/jobs/<n>/traces?scope=cluster` serves ONE merged Chrome trace
+    with a process lane per worker, timestamps aligned, normalized to
+    t=0, and sorted; the default process scope keeps its shape."""
+    import urllib.error
+
+    from flink_tpu.runtime.rest import WebMonitor
+
+    env = StreamExecutionEnvironment()
+    env.set_parallelism(2)
+    env.use_mini_cluster(2)
+    env.enable_tracing()
+    sink = _run_window_job(env, n=4000, name="cluster-scope")
+    assert sink.values
+
+    monitor = WebMonitor(env.get_metric_registry()).start()
+    try:
+        monitor.track_job("cluster-scope", type("C", (), {
+            "executor_state": None, "wait": lambda *a, **k: None})())
+        body, _ = _http_get(monitor.port,
+                            "/jobs/cluster-scope/traces?scope=cluster")
+        payload = json.loads(body)
+        assert payload["enabled"] is True
+        assert payload["scope"] == "cluster"
+        trace = payload["trace"]
+        lanes = trace["metadata"]["lanes"]
+        assert sum(1 for l in lanes if l.startswith("tm-")) >= 2, lanes
+        meta_events = [e for e in trace["traceEvents"] if e["ph"] == "M"]
+        assert {e["args"]["name"] for e in meta_events} == set(lanes)
+        spans = [e for e in trace["traceEvents"] if e["ph"] != "M"]
+        assert spans
+        ts = [e["ts"] for e in spans]
+        assert ts == sorted(ts) and ts[0] == 0.0
+        worker_pids = {lanes[l]["pid"] for l in lanes
+                       if l.startswith("tm-")}
+        assert worker_pids <= {e["pid"] for e in spans}
+        # the default process scope is unchanged
+        body, _ = _http_get(monitor.port, "/jobs/cluster-scope/traces")
+        assert {"enabled", "spans", "stats"} <= set(json.loads(body))
+        # unknown scope is a 400, not a silent default
+        try:
+            _http_get(monitor.port,
+                      "/jobs/cluster-scope/traces?scope=bogus")
+            raise AssertionError("expected HTTP 400")
+        except urllib.error.HTTPError as e:
+            assert e.code == 400
+    finally:
+        monitor.stop()
+
+
 def test_minicluster_latency_markers_smoke():
     """LatencyMarker flow populates latency.* histograms under the
     MiniCluster executor too (cached histogram path: key_by breaks the
