@@ -986,6 +986,106 @@ def bench_columnar_chain(n_events=1 << 17, n_keys=256, window_ms=1000,
     }
 
 
+def bench_state_chain(n_events=1 << 17, n_keys=64, window_ms=16000,
+                      chunk=8192):
+    """Keyed window state ingest: the identical tumbling event-time
+    sum on the identical backend, (A) fed whole RecordBatches through
+    `WindowOperator.process_batch` -> `backend.add_batch` against (B)
+    fed per-record through `process_element` -> per-row state.add.
+    Watermark cadence is identical (one per chunk), both sides' window
+    output must match a numpy reference, and A must take the columnar
+    path for every row — the delta is exactly the per-row state tax.
+    Headline = the TPU backend pair; the heap pair rides in extras.
+    The config is ingest-dominated (2k rows per (key, window) group):
+    window FIRES still walk a per-(key, window) timer + state.get on
+    both sides, so fire-heavy configs measure that shared path, not
+    the ingest tax this bench exists to isolate."""
+    from flink_tpu.core.functions import as_key_selector
+    from flink_tpu.core.state import AggregatingStateDescriptor
+    from flink_tpu.ops.device_agg import SumAggregate
+    from flink_tpu.streaming.elements import RecordBatch, StreamRecord
+    from flink_tpu.streaming.harness import OneInputStreamOperatorTestHarness
+    from flink_tpu.streaming.window_operator import WindowOperator
+    from flink_tpu.streaming.windowing import TumblingEventTimeWindows
+
+    rng = np.random.default_rng(31)
+    keys64 = rng.integers(0, n_keys, n_events).astype(np.int64)
+    vals64 = rng.integers(0, 100, n_events).astype(np.int64)
+    ts64 = np.arange(n_events, dtype=np.int64)
+    vals_f = vals64.astype(np.float64)
+    records = [StreamRecord((int(k), float(v)), int(t))
+               for k, v, t in zip(keys64, vals64, ts64)]
+    # numpy reference (exact: small ints sum exactly in float32)
+    wstart = ts64 - ts64 % window_ms
+    ref = {}
+    for k, w, v in zip(keys64.tolist(), wstart.tolist(), vals64.tolist()):
+        ref[(k, w)] = ref.get((k, w), 0) + v
+    expected = sorted((k, w, float(s)) for (k, w), s in ref.items())
+
+    class _KVSum(SumAggregate):
+        def __init__(self):
+            super().__init__(np.float32)
+
+        def extract_value(self, value):
+            return value[1] if isinstance(value, tuple) else value
+
+    def one_pass(backend, batched):
+        op = WindowOperator(
+            TumblingEventTimeWindows.of(window_ms),
+            AggregatingStateDescriptor("bench-sum", _KVSum()),
+            window_function=lambda k, w, vs: [(k, w.start, float(v))
+                                              for v in vs])
+        h = OneInputStreamOperatorTestHarness(
+            op, key_selector=as_key_selector(0), state_backend=backend)
+        h.open()
+        t0 = time.perf_counter()
+        if batched:
+            for i in range(0, n_events, chunk):
+                h.process_batch(RecordBatch(
+                    {"f0": keys64[i:i + chunk], "f1": vals_f[i:i + chunk]},
+                    ts=ts64[i:i + chunk]))
+                h.process_watermark(int(ts64[min(i + chunk, n_events) - 1]))
+        else:
+            for i, r in enumerate(records):
+                h.process_element(r)
+                if (i + 1) % chunk == 0 or i == n_events - 1:
+                    h.process_watermark(r.timestamp)
+        h.process_watermark(1 << 60)
+        elapsed = time.perf_counter() - t0
+        got = sorted((int(k), int(w), float(v))
+                     for k, w, v in h.extract_output_values())
+        assert got == expected, \
+            f"{backend} {'batched' if batched else 'per-row'} window " \
+            f"state diverged ({len(got)} vs {len(expected)} emissions)"
+        if batched:
+            assert op.boxed_fallbacks == 0 and op.columnar_rows == n_events, \
+                (op.boxed_fallbacks, op.columnar_fallback_reason)
+        return n_events / elapsed
+
+    rates = {}
+    for backend in ("tpu", "heap"):
+        one_pass(backend, True)    # warm: device tables, jit, dispatch
+        one_pass(backend, False)
+        batch_rate = row_rate = 0.0
+        for _rep in range(3):
+            row_rate = max(row_rate, one_pass(backend, False))
+            batch_rate = max(batch_rate, one_pass(backend, True))
+        rates[backend] = (batch_rate, row_rate)
+        log(f"[bench] state_chain[{backend}]: batch "
+            f"{batch_rate/1e6:.2f} M ev/s, per-row {row_rate/1e6:.2f} "
+            f"M ev/s, ratio {batch_rate/row_rate:.2f}x")
+    batch_rate, row_rate = rates["tpu"]
+    assert batch_rate >= 2.0 * row_rate, \
+        f"batched state ingest only {batch_rate/row_rate:.2f}x over " \
+        f"per-row on the tpu backend (acceptance floor is 2x)"
+    return batch_rate, row_rate, {
+        "heap_batch_events_per_sec": round(rates["heap"][0]),
+        "heap_row_events_per_sec": round(rates["heap"][1]),
+        "heap_vs_row": round(rates["heap"][0] / rates["heap"][1], 2),
+        "window_emissions": len(expected),
+    }
+
+
 def chaos_smoke() -> int:
     """One seeded chaos run per executor: injected storage failures,
     lost checkpoint acks, and a task crash must leave the output
@@ -1048,6 +1148,7 @@ def main():
         ("sql_join", bench_sql_join),
         ("shuffle", bench_shuffle),
         ("columnar_chain", bench_columnar_chain),
+        ("state_chain", bench_state_chain),
     ]
     # diagnostics: runnable by name, excluded from the default suite
     # (they document measured LIMITS, not headline configs)

@@ -62,6 +62,15 @@ def operator_batch_report(op) -> Tuple[str, str]:
     if isinstance(op, TwoInputStreamOperator):
         return BOXED, "two-input operator (per-input key contexts)"
 
+    # operators with a process_batch override may still demote
+    # themselves structurally (merging assigner, custom trigger,
+    # evictor on the window operator) — they know the reason AOT
+    elig = getattr(type(op), "_batch_eligibility", None)
+    if elig is not None:
+        reason = elig(op)
+        if reason:
+            return BOXED, reason
+
     # structural consumers declare themselves via a process_batch
     # override — anything still on the StreamOperator default boxes
     from flink_tpu.streaming.operators import StreamOperator

@@ -287,8 +287,33 @@ def _top_rows(job, detail, metrics, prev, dt_s):
     return rows
 
 
+def _top_state_footer(metrics) -> str:
+    """One-line keyed-state picture from the process-wide `state.*`
+    gauges, or "" when the server predates them."""
+    if not any(k.startswith("state.") for k in metrics):
+        return ""
+
+    def g(key, default=0):
+        v = metrics.get("state." + key)
+        return v if isinstance(v, (int, float)) else default
+
+    line = (f"state: batch rows {g('batchRows'):,.0f}, "
+            f"row-fallback {g('rowFallbackRows'):,.0f}")
+    if g("flushBatches"):
+        line += (f"; flush mean {g('flushSizeMean'):,.0f} "
+                 f"max {g('flushSizeMax'):,.0f}")
+    if g("device.states"):
+        line += (f"; device slots {g('device.slotsInUse'):,.0f}"
+                 f"/{g('device.capacity'):,.0f}, "
+                 f"spilled {g('device.spilledEntries'):,.0f}, "
+                 f"evictions {g('device.evictions'):,.0f}, "
+                 f"promotions {g('device.promotions'):,.0f}, "
+                 f"pending {g('device.pendingDepth'):,.0f}")
+    return line
+
+
 def _top_render(job, status, rows, checkpoints, alerts,
-                bottleneck=None) -> str:
+                bottleneck=None, state_line="") -> str:
     def fmt(v, spec="{:.0f}", dash="-"):
         return dash if v is None else spec.format(v)
 
@@ -328,6 +353,8 @@ def _top_render(job, status, rows, checkpoints, alerts,
     firing = alerts.get("rules_firing") or []
     lines.append(f"alerts: {alerts.get('total', 0)} total"
                  + (f"; FIRING: {', '.join(firing)}" if firing else ""))
+    if state_line:
+        lines.append(state_line)
     if bn_vid is not None:
         ups = ", ".join(f"{u.get('name')} ({u.get('ratio', 0) * 100:.0f}%)"
                         for u in bn.get("backpressured_upstreams") or [])
@@ -373,6 +400,12 @@ def _top(rest) -> int:
             q = urllib.parse.quote(job, safe="")
             detail = _top_fetch(base, f"/jobs/{q}/detail")
             metrics = _top_fetch(base, f"/jobs/{q}/metrics")
+            # state.* gauges are process-wide, not job-scoped: the
+            # footer reads them off the full registry dump
+            try:
+                full_dump = _top_fetch(base, "/metrics")
+            except OSError:
+                full_dump = metrics
             checkpoints = _top_fetch(base, f"/jobs/{q}/checkpoints")
             alerts = _top_fetch(base, f"/jobs/{q}/alerts")
             try:
@@ -388,7 +421,8 @@ def _top(rest) -> int:
             dt = (now - prev_t) if prev_t is not None else 0.0
             rows = _top_rows(job, detail, metrics, prev_metrics, dt)
             out = _top_render(job, detail.get("status"), rows,
-                              checkpoints, alerts, bottleneck)
+                              checkpoints, alerts, bottleneck,
+                              state_line=_top_state_footer(full_dump))
             if args.once:
                 print(out)
                 return 0

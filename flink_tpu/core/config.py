@@ -271,6 +271,27 @@ class TpuOptions:
     DONATE_BUFFERS = ConfigOptions.key("tpu.state.donate-buffers").default_value(True)
 
 
+class StateBackendOptions:
+    """Keyed-state backend tuning under the `state.backend.*` prefix —
+    the keys `state.loader.load_state_backend` reads off a
+    Configuration (it rejects non-positive values and unknown backend
+    names with the accepted list)."""
+
+    TPU_MAX_DEVICE_SLOTS = ConfigOptions.key(
+        "state.backend.tpu.max-device-slots").int_type().no_default_value(
+        ).with_description(
+        "Per-state HBM slot budget for the TPU backend; beyond it the "
+        "LRU-coldest slots spill to host RAM and are promoted back on "
+        "access. Unset = uncapped (grow-doubling device tables).")
+    TPU_MICROBATCH_SIZE = ConfigOptions.key(
+        "state.backend.tpu.microbatch-size").int_type().no_default_value(
+        ).with_description(
+        "Pending-ring flush threshold for the TPU backend's device "
+        "scatter/gather: state writes buffer on host and flush to the "
+        "device in one fused scatter once this many rows are pending. "
+        "Unset = the backend's built-in default (16384).")
+
+
 class MetricOptions:
     REPORTERS_LIST = ConfigOptions.key("metrics.reporters").string_type().no_default_value()
     SCOPE_DELIMITER = ConfigOptions.key("metrics.scope.delimiter").string_type().default_value(".")

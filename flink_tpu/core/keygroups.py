@@ -89,6 +89,28 @@ def splitmix64_np(x: np.ndarray) -> np.ndarray:
     return x ^ (x >> np.uint64(31))
 
 
+def stable_hashes_np(keys) -> np.ndarray:
+    """64-bit stable hash per key, EXACTLY matching ``stable_hash64`` —
+    the scalar routing/assignment path.  All-int key columns vectorize
+    fully (splitmix64 over an int64 array is the same masked arithmetic
+    as the scalar hash); anything else hashes per key in Python with
+    only the downstream murmur+index math vectorized.  NOTE: the 2-D
+    tuple combine in ``native.vectorized.hash_keys_np`` intentionally
+    differs from ``stable_hash64(tuple)`` and must never be used for
+    routing or key-group assignment — keyed state would land on the
+    wrong subtask."""
+    n = len(keys)
+    for k in keys:
+        if type(k) is not int:
+            return np.fromiter((stable_hash64(k) for k in keys),
+                               np.uint64, n)
+    try:
+        arr = np.array(keys, np.int64)
+    except OverflowError:
+        return np.fromiter((stable_hash64(k) for k in keys), np.uint64, n)
+    return splitmix64_np(arr)
+
+
 def assign_to_key_group(key: Any, max_parallelism: int) -> int:
     """key → key group (ref: KeyGroupRangeAssignment.java:58-70:
     ``murmurHash(key.hashCode()) % maxParallelism``)."""

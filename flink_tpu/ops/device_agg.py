@@ -63,6 +63,17 @@ class DeviceAggregateFunction(AggregateFunction):
         so the device batch carries plain numerics."""
         return value
 
+    def extract_column(self, values):
+        """Vectorized twin of extract_value over a whole value column
+        (ndarray, or tuple of ndarrays for multi-column records).
+        Returns the numeric column to aggregate, or None when this
+        aggregate needs per-row extraction (the caller then boxes).
+        Default: the identity — valid exactly when extract_value is
+        still the base identity."""
+        if type(self).extract_value is DeviceAggregateFunction.extract_value:
+            return values
+        return None
+
     def compress_value_hash(self, vh_hi: np.ndarray, vh_lo: np.ndarray):
         """Optionally shrink the per-record value-hash lanes on the
         host before transfer (e.g. HLL needs only register + rank, 3
@@ -123,6 +134,34 @@ class DeviceAggregateFunction(AggregateFunction):
         """state[dst] ⊕= state[src] — session-window namespace merging
         (device twin of AggregateFunction.merge)."""
         raise NotImplementedError(f"{type(self).__name__} does not support merging")
+
+    def merge_rows(
+        self, state: Dict[str, jnp.ndarray], dst: jnp.ndarray, src: jnp.ndarray
+    ) -> Dict[str, jnp.ndarray]:
+        """state[dst] ⊕= state[src] for pairwise (dst, src) rows with
+        UNIQUE dst — the ``jit(vmap(merge))`` batch-merge kernel: gather
+        both row sets, vmap a single-pair merge (merge_slots over a
+        2-row stacked state) across them, scatter back with one
+        .at[dst].set.  Repeated dst entries would race under .set; the
+        backend's batch-merge driver rounds multi-source merges so each
+        dispatch is repeat-free (merge_slots stays the repeat-tolerant
+        scalar path)."""
+        specs = self.state_specs()
+
+        def pair_merge(rows_a, rows_b):
+            stacked = {k: jnp.stack([rows_a[k], rows_b[k]]) for k in rows_a}
+            merged = self.merge_slots(stacked,
+                                      jnp.zeros(1, jnp.int32),
+                                      jnp.ones(1, jnp.int32))
+            return {k: v[0] for k, v in merged.items()}
+
+        rows_a = {k: state[k][dst] for k in specs}
+        rows_b = {k: state[k][src] for k in specs}
+        merged = jax.vmap(pair_merge)(rows_a, rows_b)
+        out = dict(state)
+        for k in specs:
+            out[k] = out[k].at[dst].set(merged[k])
+        return out
 
     def clear_slots(self, state: Dict[str, jnp.ndarray], slots: jnp.ndarray) -> Dict[str, jnp.ndarray]:
         out = dict(state)

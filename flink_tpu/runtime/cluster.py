@@ -77,7 +77,11 @@ from flink_tpu.runtime.backpressure import (
     observe_threaded_source,
     read_vertex_stats,
 )
-from flink_tpu.runtime.metrics import MetricRegistry, register_network_gauges
+from flink_tpu.runtime.metrics import (
+    MetricRegistry,
+    register_network_gauges,
+    register_state_gauges,
+)
 from flink_tpu.runtime.netchannel import DataClient, DataServer
 from flink_tpu.runtime.rpc import (
     RpcEndpoint,
@@ -1241,6 +1245,7 @@ class TaskExecutor(RpcEndpoint):
             self.metrics, data_server=data_server,
             data_clients=lambda: [a.data_client
                                   for a in list(self._attempts.values())])
+        register_state_gauges(self.metrics)
         self._blob_cache: Dict[str, bytes] = {}
         #: local recovery (ref: TaskLocalStateStore/TaskStateManager):
         #: the last TWO acked snapshots per task (cid -> pickled) —

@@ -69,6 +69,7 @@ from flink_tpu.runtime.metrics import (
     TaskIOMetricGroup,
     register_checkpoint_gauges,
     register_faulttolerance_gauges,
+    register_state_gauges,
 )
 from flink_tpu.runtime.tracing import (
     get_tracer,
@@ -1226,6 +1227,7 @@ class LocalExecutor:
         self.pts = processing_time_service or TestProcessingTimeService()
         self.channel_capacity = channel_capacity
         self.metrics = metric_registry or MetricRegistry()
+        register_state_gauges(self.metrics)
         self.latency_interval_ms = latency_interval_ms
         #: "full" | "region" (ref: FailoverStrategyLoader /
         #: jobmanager.execution.failover-strategy)

@@ -563,3 +563,39 @@ def register_network_gauges(metrics: MetricRegistry,
                 merged.update(client.bytes_in_by_channel())
             return merged
         g.gauge("bytesInPerChannel", _bytes_in_per_channel)
+
+
+def register_state_gauges(metrics: MetricRegistry) -> None:
+    """Publish the `state.*` gauge surface for a process: batch-ingest
+    vs row-fallback row counts from `state.stats.STATE_STATS`, device
+    micro-batch flush sizes, columnar-vs-row snapshot traffic, and the
+    aggregate device-tier picture (slots in use, capacity, evictions,
+    host-spill promotions, pending-ring depth) over every live
+    `DeviceAggregatingState`.  Registered under the registry root —
+    the state tier is process-wide, like the data plane."""
+    from flink_tpu.state.stats import STATE_STATS, device_state_summary
+
+    s = STATE_STATS
+    g = metrics.root.add_group("state")
+    g.gauge("batchRows", lambda: s.batch_rows)
+    g.gauge("rowFallbackRows", lambda: s.row_fallback_rows)
+    g.gauge("batchCalls", lambda: s.batch_calls)
+    g.gauge("rowFallbackCalls", lambda: s.row_fallback_calls)
+    g.gauge("flushBatches", lambda: s.flush_batches)
+    g.gauge("flushRows", lambda: s.flush_rows)
+    g.gauge("flushSizeMean", lambda: s.flush_size_mean())
+    g.gauge("flushSizeMax", lambda: s.flush_size_max())
+    g.gauge("snapshotColumns", lambda: s.snapshot_columns)
+    g.gauge("snapshotRows", lambda: s.snapshot_rows)
+
+    def _dev(field):
+        return device_state_summary().get(field, 0)
+
+    d = g.add_group("device")
+    d.gauge("states", lambda: _dev("states"))
+    d.gauge("slotsInUse", lambda: _dev("slots_in_use"))
+    d.gauge("capacity", lambda: _dev("capacity"))
+    d.gauge("spilledEntries", lambda: _dev("spilled_entries"))
+    d.gauge("evictions", lambda: _dev("evictions"))
+    d.gauge("promotions", lambda: _dev("promotions"))
+    d.gauge("pendingDepth", lambda: _dev("pending_depth"))

@@ -68,6 +68,7 @@ from flink_tpu.runtime.metrics import (
     MetricRegistry,
     register_checkpoint_gauges,
     register_faulttolerance_gauges,
+    register_state_gauges,
 )
 from flink_tpu.runtime.tracing import get_tracer
 from flink_tpu.streaming.elements import LatencyMarker
@@ -240,6 +241,7 @@ class MiniCluster:
         self.shared_pts = processing_time_service  # None → per-TM services
         self.channel_capacity = channel_capacity
         self.metrics = metric_registry or MetricRegistry()
+        register_state_gauges(self.metrics)
         self.latency_interval_ms = latency_interval_ms
         #: metrics time-series journal cadence (None = disabled)
         self.sample_interval_ms = sample_interval_ms

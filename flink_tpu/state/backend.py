@@ -20,9 +20,13 @@ from __future__ import annotations
 import abc
 from typing import Any, Dict, Iterable, Optional
 
+import numpy as np
+
 from flink_tpu.core.keygroups import (
     KeyGroupRange,
+    assign_key_groups_np,
     assign_to_key_group,
+    stable_hashes_np,
 )
 from flink_tpu.core.state import (
     AggregatingStateDescriptor,
@@ -202,6 +206,62 @@ class KeyedStateBackend(abc.ABC):
     def create_map_state(self, descriptor: MapStateDescriptor):
         ...
 
+    # ---- batched ingest (the paper's core thesis: whole sub-batches
+    # of (key, namespace, value) rows enter keyed state in one call,
+    # key-group assignment done in ONE vectorized hash pass instead of
+    # per-row setCurrentKey) ------------------------------------------
+    def assign_key_groups_batch(self, keys) -> np.ndarray:
+        """Vectorized key → key-group for a whole column of keys.
+        Bit-identical to per-row ``assign_to_key_group`` (the splitmix64
+        parity path shared with the batched router's split_batch)."""
+        return assign_key_groups_np(stable_hashes_np(keys),
+                                    self.max_parallelism)
+
+    def add_batch(self, state, keys, namespace, values,
+                  namespaces=None, pre_extracted: bool = False) -> str:
+        """Append a whole column of values into `state`, one row per
+        (keys[i], namespace-or-namespaces[i], values[i]).
+
+        Dispatches to the state object's native ``add_batch`` when it
+        has one (device SoA scatter on the TPU backend, grouped
+        in-order fold on the heap column table); otherwise falls back
+        to the exact per-row path (set_current_key +
+        set_current_namespace + state.add) so opaque-object states keep
+        bit-identical semantics.  Returns the path taken ("batch" or
+        "rows") so callers/benches can assert zero boxed fallbacks.
+
+        Leaves the backend's current key/namespace context undefined —
+        callers in a row context must re-establish it.
+        """
+        from flink_tpu.state.stats import STATE_STATS
+        n = len(keys)
+        native = getattr(state, "add_batch", None)
+        if native is not None:
+            if pre_extracted:
+                # caller already ran the aggregate's extract_value over
+                # the whole column (device states only — heap states
+                # don't take the kwarg)
+                native(keys, namespace, values, namespaces=namespaces,
+                       pre_extracted=True)
+            else:
+                native(keys, namespace, values, namespaces=namespaces)
+            STATE_STATS.batch_calls += 1
+            STATE_STATS.batch_rows += n
+            return "batch"
+        if namespaces is None:
+            state.set_current_namespace(namespace)
+            for i in range(n):
+                self.set_current_key(keys[i])
+                state.add(values[i])
+        else:
+            for i in range(n):
+                self.set_current_key(keys[i])
+                state.set_current_namespace(namespaces[i])
+                state.add(values[i])
+        STATE_STATS.row_fallback_calls += 1
+        STATE_STATS.row_fallback_rows += n
+        return "rows"
+
     # ---- introspection ----------------------------------------------
     @abc.abstractmethod
     def get_keys(self, state_name: str, namespace) -> Iterable[Any]:
@@ -300,6 +360,31 @@ class KeyedStateBackend(abc.ABC):
 
     def dispose(self) -> None:
         self._states.clear()
+
+
+def encode_obj_column(values) -> tuple:
+    """Encode a python value column through the wire codec's "col" tier
+    (int64/float64/str/tuple columns, PR 5) — ``("pickle", list)`` when
+    the column is not strictly typed.  Snapshot chunks carry these so
+    key columns and namespace columns serialize without boxing."""
+    values = list(values)
+    if values:
+        try:
+            from flink_tpu.runtime.netchannel import _encode_value_column
+            col = _encode_value_column(values)
+        except (OverflowError, ValueError):
+            col = None
+        if col is not None:
+            return col
+    return ("pickle", values)
+
+
+def decode_obj_column(col, n: int) -> list:
+    """Inverse of encode_obj_column."""
+    if col[0] == "pickle":
+        return list(col[1])
+    from flink_tpu.runtime.netchannel import _decode_value_column
+    return _decode_value_column(col, n)
 
 
 def migrate_table_values(table, descriptor, serializer,

@@ -36,10 +36,23 @@ def load_state_backend(
     if isinstance(config_or_name, Configuration):
         name = config_or_name.get_string(STATE_BACKEND_KEY, "heap")
         # HBM budget: beyond it, cold device slots spill to host RAM
-        cap = config_or_name.get_integer(
-            "state.backend.tpu.max-device-slots", 0)
-        if cap and "max_device_slots" not in kwargs:
-            kwargs["max_device_slots"] = cap
+        if config_or_name.contains("state.backend.tpu.max-device-slots"):
+            cap = config_or_name.get_integer(
+                "state.backend.tpu.max-device-slots")
+            if cap is None or cap <= 0:
+                raise ValueError(
+                    "state.backend.tpu.max-device-slots must be > 0 "
+                    f"(got {cap}); omit it for an uncapped device tier")
+            kwargs.setdefault("max_device_slots", cap)
+        # device scatter/gather micro-batch (pending-ring flush size)
+        if config_or_name.contains("state.backend.tpu.microbatch-size"):
+            mb = config_or_name.get_integer(
+                "state.backend.tpu.microbatch-size")
+            if mb is None or mb <= 0:
+                raise ValueError(
+                    "state.backend.tpu.microbatch-size must be > 0 "
+                    f"(got {mb}); omit it for the built-in default")
+            kwargs.setdefault("microbatch", mb)
     elif config_or_name is None:
         name = "heap"
     else:

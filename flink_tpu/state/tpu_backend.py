@@ -61,7 +61,9 @@ from flink_tpu.state.heap_backend import (
     HeapReducingState,
     HeapValueState,
     StateTable,
+    split_column_by_key_group,
 )
+from flink_tpu.state.stats import STATE_STATS, register_device_state
 
 DEFAULT_INITIAL_CAPACITY = 4096
 DEFAULT_MICROBATCH = 16384
@@ -129,6 +131,10 @@ class DeviceAggregatingState(AggregatingState):
                                    for k in st},
             donate_argnums=0)
         self._jit_merge = jax.jit(self.agg.merge_slots, donate_argnums=0)
+        #: the jit(vmap(merge)) pairwise kernel — unique-dst dispatches
+        #: only (merge_namespaces_batch rounds multi-source merges)
+        self._jit_merge_rows = jax.jit(self.agg.merge_rows,
+                                       donate_argnums=0)
         self._jit_clear = jax.jit(self.agg.clear_slots, donate_argnums=0)
         self._jit_result = jax.jit(self.agg.result)
         # queryable-state reads come from foreign threads; every
@@ -138,6 +144,7 @@ class DeviceAggregatingState(AggregatingState):
         # owner thread's swap sites take it; cost is one uncontended
         # acquire per micro-batch)
         self._device_lock = threading.RLock()
+        register_device_state(self)
 
     def _update_fn(self, state, slots, values, hi, lo, mask):
         return self.agg.update(state, slots, values, hi, lo, mask)
@@ -261,12 +268,14 @@ class DeviceAggregatingState(AggregatingState):
             self._flush()
 
     def add_batch(self, keys: Iterable[Any], namespace, values,
-                  namespaces=None) -> None:
+                  namespaces=None, pre_extracted: bool = False) -> None:
         """Vectorized write: one slot lookup loop, no per-record method
         dispatch.  `namespace` is ONE namespace shared by the whole
         batch (a window tuple is a single namespace); pass a parallel
         sequence via `namespaces=` to override per record.  `values` is
-        a sequence/ndarray parallel to keys."""
+        a sequence/ndarray parallel to keys; `pre_extracted=True` means
+        the caller already ran extract_value/extract_column over it (a
+        numeric column straight off a RecordBatch)."""
         keys = list(keys)
         if self.max_device_slots is not None \
                 and len(keys) > self.microbatch:
@@ -280,7 +289,8 @@ class DeviceAggregatingState(AggregatingState):
                     keys[sl], namespace,
                     values[sl] if values is not None else None,
                     namespaces=None if namespaces is None
-                    else namespaces[sl])
+                    else namespaces[sl],
+                    pre_extracted=pre_extracted)
             return
         slot_for = self._slot_for
         if namespaces is None:
@@ -291,8 +301,9 @@ class DeviceAggregatingState(AggregatingState):
         extract = self.agg.extract_value
         # overridden on the class or per-instance (an instance-attached
         # plain function has no __func__)
-        if getattr(extract, "__func__",
-                   None) is not DeviceAggregateFunction.extract_value:
+        if not pre_extracted and getattr(
+                extract, "__func__",
+                None) is not DeviceAggregateFunction.extract_value:
             values = [extract(v) for v in values]
         if self.agg.needs_value:
             self._pending_values.extend(values)
@@ -334,6 +345,7 @@ class DeviceAggregatingState(AggregatingState):
             lo = np.zeros(padded, np.uint32)
         self.device_state = self._jit_update(
             self.device_state, slots, values, hi, lo, mask)
+        STATE_STATS.note_flush(n)
         for s_ in self._pending_slots:
             self._slot_flushed[s_] = 1
         self._pending_slots.clear()
@@ -503,6 +515,63 @@ class DeviceAggregatingState(AggregatingState):
                 self._slot_flushed[s_] = 0
         self._free.extend(src_slots)
 
+    def merge_namespaces_batch(self, merges) -> None:
+        """Batched session merge: `merges` is a list of
+        (key, target_namespace, [source_namespaces]).  One flush up
+        front, then the whole merge set runs in ROUNDS through the
+        jit(vmap(agg.merge)) pairwise kernel — round r folds each
+        target's r-th live source, so every dispatch has UNIQUE
+        destination slots (distinct merges own distinct (key, target)
+        slots) — and one clear frees every source slot at the end.
+        Observable state after this call is identical to running
+        merge_namespaces per (key, target)."""
+        self._flush()
+        plans = []  # (dst_slot, [src_slots])
+        for key, target, sources in merges:
+            for src in sources:
+                if (key, src) in self.host_tier:
+                    self._promote((key, src))
+            if (key, target) in self.host_tier:
+                self._promote((key, target))
+            live = []
+            for src in sources:
+                s = self.slot_index.get((key, src))
+                if s is not None:
+                    self._clock += 1
+                    self._access_stamp[s] = self._clock
+                    live.append((src, s))
+            if not live:
+                continue
+            dst = self._slot_for(key, target)
+            srcs = []
+            for src, s in live:
+                del self.slot_index[(key, src)]
+                if s != dst:
+                    srcs.append(s)
+                    self.slot_meta[s] = None
+            if srcs:
+                plans.append((dst, srcs))
+        if not plans:
+            return
+        rounds = max(len(srcs) for _, srcs in plans)
+        all_srcs: List[int] = []
+        with self._device_lock:
+            for r in range(rounds):
+                dsts = [dst for dst, srcs in plans if len(srcs) > r]
+                srcs = [srcs[r] for _, srcs in plans if len(srcs) > r]
+                self.device_state = self._jit_merge_rows(
+                    self.device_state,
+                    jnp.asarray(np.array(dsts, np.int32)),
+                    jnp.asarray(np.array(srcs, np.int32)))
+                all_srcs.extend(srcs)
+            self.device_state = self._jit_clear(
+                self.device_state, jnp.asarray(np.array(all_srcs, np.int32)))
+            for dst, _ in plans:
+                self._slot_flushed[dst] = 1
+            for s_ in all_srcs:
+                self._slot_flushed[s_] = 0
+        self._free.extend(all_srcs)
+
     # ---- snapshot ---------------------------------------------------
     def snapshot_entries(self) -> Dict[int, List[Tuple[Any, Any, Dict[str, np.ndarray]]]]:
         """Per key group: [(key, namespace, {component: row})]."""
@@ -553,6 +622,78 @@ class DeviceAggregatingState(AggregatingState):
             self.device_state = new_state
             for s_ in slots:
                 self._slot_flushed[s_] = 1
+
+    def snapshot_columns(self) -> Dict[int, Tuple[list, list, Dict[str, np.ndarray]]]:
+        """Columnar snapshot: per key group, (keys, namespaces,
+        {component: stacked rows}) — ONE host transfer per component,
+        ONE fancy-index gather, and the key-group split done in one
+        vectorized hash pass (replaces snapshot_entries' per-row dict
+        building + per-row assign_to_key_group)."""
+        self._flush()
+        keys: List[Any] = []
+        nss: List[Any] = []
+        slots: List[int] = []
+        for (key, namespace), slot in self.slot_index.items():
+            keys.append(key)
+            nss.append(namespace)
+            slots.append(slot)
+        host = {name: np.asarray(arr)
+                for name, arr in self.device_state.items()}
+        idx = np.array(slots, np.int32)
+        comps = {name: arr[idx] for name, arr in host.items()}
+        if self.host_tier:
+            spilled = list(self.host_tier.items())
+            for (key, namespace), _ in spilled:
+                keys.append(key)
+                nss.append(namespace)
+            spill_cols = {name: np.stack([row[name] for _, row in spilled])
+                          for name in host}
+            comps = {name: np.concatenate([comps[name], spill_cols[name]])
+                     for name in host}
+        out: Dict[int, Tuple[list, list, Dict[str, np.ndarray]]] = {}
+        mp = self._backend.max_parallelism
+        for kg, sel in split_column_by_key_group(keys, mp):
+            out[kg] = ([keys[i] for i in sel], [nss[i] for i in sel],
+                       {name: arr[sel] for name, arr in comps.items()})
+        return out
+
+    def restore_columns(self, keys: list, namespaces: list,
+                        comps: Dict[str, np.ndarray]) -> None:
+        """Columnar restore: one slot-resolve loop, ONE device upload
+        per component (no per-row dict boxing)."""
+        n = len(keys)
+        if n == 0:
+            return
+        needed = len(self.slot_index) + n
+        if self.max_device_slots is not None \
+                and needed > self.max_device_slots:
+            # beyond the device budget: the overflow restores straight
+            # into the host tier (promoted lazily on first access)
+            budget = max(self.max_device_slots - len(self.slot_index), 0)
+            for i in range(budget, n):
+                self.host_tier[(keys[i], namespaces[i])] = {
+                    name: np.asarray(arr[i]) for name, arr in comps.items()}
+            keys = keys[:budget]
+            namespaces = namespaces[:budget]
+            comps = {name: arr[:budget] for name, arr in comps.items()}
+            n = budget
+            if n == 0:
+                return
+            needed = len(self.slot_index) + n
+        if needed > self.capacity - len(self._pending_slots):
+            self._grow(max(self.capacity * 2, _round_up_pow2(needed)))
+        slots = np.empty(n, np.int32)
+        for i in range(n):
+            slots[i] = self._slot_for(keys[i], namespaces[i])
+        idx = jnp.asarray(slots)
+        with self._device_lock:
+            new_state = dict(self.device_state)
+            for name, arr in comps.items():
+                new_state[name] = new_state[name].at[idx].set(
+                    jnp.asarray(np.ascontiguousarray(arr)))
+            self.device_state = new_state
+            for s_ in slots:
+                self._slot_flushed[int(s_)] = 1
 
     def active_entries(self) -> Iterable[Tuple[Any, Any]]:
         yield from self.slot_index.keys()
@@ -632,26 +773,81 @@ class TpuKeyedStateBackend(KeyedStateBackend):
 
     # ---- snapshot / restore -----------------------------------------
     def snapshot(self) -> KeyedStateSnapshot:
-        per_kg: Dict[int, dict] = defaultdict(lambda: {"host": [], "device": {}})
+        """v2 columnar chunk format: device states serialize as ONE
+        gather + one column per component per key group (key and
+        namespace columns through the wire codec), host-table entries
+        stay per-row."""
+        from flink_tpu.state.backend import encode_obj_column
+        per_kg_rows: Dict[int, list] = defaultdict(list)
+        per_kg_cols: Dict[int, Dict[str, list]] = defaultdict(dict)
         for name, table in self._tables.items():
             for namespace, key, value in table.entries():
                 kg = assign_to_key_group(key, self.max_parallelism)
-                per_kg[kg]["host"].append((name, namespace, key, value))
+                per_kg_rows[kg].append((name, namespace, key, value))
+                STATE_STATS.snapshot_rows += 1
         for name, dstate in self._device_states.items():
-            for kg, entries in dstate.snapshot_entries().items():
-                per_kg[kg]["device"][name] = entries
+            for kg, (keys, nss, comps) in dstate.snapshot_columns().items():
+                per_kg_cols[kg].setdefault(name, []).append({
+                    "keys": encode_obj_column(keys),
+                    "ns": ("col", encode_obj_column(nss)),
+                    "comps": comps,
+                    "kind": "acc",
+                })
+                STATE_STATS.snapshot_columns += len(keys)
+        chunks = {}
+        for kg in set(per_kg_rows) | set(per_kg_cols):
+            chunks[kg] = pickle.dumps(
+                {"v": 2, "rows": per_kg_rows.get(kg, []),
+                 "cols": per_kg_cols.get(kg, {})},
+                protocol=pickle.HIGHEST_PROTOCOL)
         return KeyedStateSnapshot(
-            {kg: pickle.dumps(chunk, protocol=pickle.HIGHEST_PROTOCOL)
-             for kg, chunk in per_kg.items()},
+            chunks,
             meta={"backend": self.name,
                   "serializers": self.serializer_config_snapshots()},
         )
+
+    def _restore_norm_rows(self, rows, pending_device) -> None:
+        """Per-row entries: values in the scalar-twin accumulator
+        format (dict of per-component arrays, see
+        DeviceAggregateFunction.create_accumulator) whose state is
+        device-resident here normalize to device rows; everything else
+        goes to host tables."""
+        for name, namespace, key, value in rows:
+            dstate = self._device_states.get(name)
+            if dstate is not None and isinstance(value, dict):
+                specs = dstate.agg.state_specs()
+                row = {n: np.asarray(value[n]).reshape(specs[n].shape)
+                       for n in specs}
+                pending_device[name].append((key, namespace, row))
+            else:
+                self._table(name).put(key, namespace, value)
+
+    def _restore_v2_cols(self, cols: dict, pending_device,
+                         pending_cols) -> None:
+        from flink_tpu.state.backend import decode_obj_column
+        for name, blocks in cols.items():
+            for block in blocks:
+                comps = block["comps"]
+                n = len(next(iter(comps.values()))) if comps else 0
+                keys = decode_obj_column(block["keys"], n)
+                ns_field = block["ns"]
+                namespaces = ([ns_field[1]] * n if ns_field[0] == "const"
+                              else decode_obj_column(ns_field[1], n))
+                if block["kind"] == "scalar":
+                    # heap column block: plain scalar values
+                    table = self._table(name)
+                    vals = comps["value"]
+                    for k, ns, v in zip(keys, namespaces, vals):
+                        table.put(k, ns, v.item())
+                    continue
+                pending_cols.setdefault(name, []).append(
+                    (keys, namespaces, comps))
 
     def restore(self, snapshots) -> None:
         self.check_serializer_compatibility(snapshots)
         # clear in place: bound state objects hold table references
         for table in self._tables.values():
-            table.by_namespace.clear()
+            table.clear_all()
         for dstate in self._device_states.values():
             # reset device state in place (descriptor bindings survive);
             # pending micro-batches are pre-failure writes — drop them,
@@ -660,36 +856,47 @@ class TpuKeyedStateBackend(KeyedStateBackend):
             dstate.slot_index.clear()
             dstate.slot_meta = [None] * dstate.capacity
             dstate._free = list(range(dstate.capacity - 1, -1, -1))
+            dstate._slot_flushed = bytearray(dstate.capacity)
+            dstate.host_tier.clear()
             dstate._pending_slots.clear()
             dstate._pending_values.clear()
             dstate._pending_hi.clear()
             dstate._pending_lo.clear()
         pending_device: Dict[str, list] = defaultdict(list)
+        pending_cols: Dict[str, list] = {}
         for snap in snapshots:
             for kg, blob in snap.blobs():
                 if not self.key_group_range.contains(kg):
                     continue
                 chunk = pickle.loads(blob)
                 if isinstance(chunk, list):
-                    # chunk written by the heap backend: entries whose
-                    # state is device-resident here carry the scalar-twin
-                    # accumulator format (dict of per-component arrays,
-                    # see DeviceAggregateFunction.create_accumulator) —
-                    # normalize to device rows; the rest go to host tables
-                    for name, namespace, key, value in chunk:
-                        dstate = self._device_states.get(name)
-                        if dstate is not None and isinstance(value, dict):
-                            specs = dstate.agg.state_specs()
-                            row = {n: np.asarray(value[n]).reshape(specs[n].shape)
-                                   for n in specs}
-                            pending_device[name].append((key, namespace, row))
-                        else:
-                            self._table(name).put(key, namespace, value)
+                    # chunk written by the legacy heap backend
+                    self._restore_norm_rows(chunk, pending_device)
+                    continue
+                if chunk.get("v") == 2:
+                    self._restore_norm_rows(chunk["rows"], pending_device)
+                    self._restore_v2_cols(chunk["cols"], pending_device,
+                                          pending_cols)
                     continue
                 for name, namespace, key, value in chunk["host"]:
                     self._table(name).put(key, namespace, value)
                 for name, entries in chunk["device"].items():
                     pending_device[name].extend(entries)
+        for name, blocks in pending_cols.items():
+            dstate = self._device_states.get(name)
+            if dstate is not None:
+                for keys, namespaces, comps in blocks:
+                    dstate.restore_columns(keys, namespaces, comps)
+            else:
+                # descriptor not bound yet: park per-row accumulator
+                # dicts in a host table; create_aggregating_state's
+                # migration lifts them onto the device at bind time
+                table = self._table(name)
+                for keys, namespaces, comps in blocks:
+                    for i in range(len(keys)):
+                        row = {c: np.array(arr[i])
+                               for c, arr in comps.items()}
+                        table.put(keys[i], namespaces[i], row)
         for name, entries in pending_device.items():
             dstate = self._device_states.get(name)
             if dstate is not None:

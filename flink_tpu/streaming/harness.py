@@ -74,6 +74,10 @@ class OneInputStreamOperatorTestHarness:
         self.operator.set_key_context(record)
         self.operator.process_element(record)
 
+    def process_batch(self, batch) -> None:
+        """Feed a RecordBatch to the operator's columnar path."""
+        self.operator.process_batch(batch)
+
     def process_watermark(self, timestamp) -> None:
         wm = timestamp if isinstance(timestamp, Watermark) else Watermark(timestamp)
         self.operator.process_watermark(wm)

@@ -25,28 +25,15 @@ from flink_tpu.core.keygroups import (
     compute_operator_index_for_key_group,
     splitmix64_np,
     stable_hash64,
+    stable_hashes_np,
 )
 
 
 def _routing_hashes(keys: list) -> np.ndarray:
     """64-bit stable hash per key, EXACTLY matching `stable_hash64` —
-    the scalar routing path.  All-int key columns vectorize fully
-    (splitmix64 over an int64 array is the same masked arithmetic as
-    the scalar hash); anything else hashes per key in Python with only
-    the murmur+index math vectorized downstream.  NOTE: the 2-D tuple
-    combine in `native.vectorized.hash_keys_np` intentionally differs
-    from `stable_hash64(tuple)` and must never be used here — keyed
-    state would land on the wrong subtask."""
-    n = len(keys)
-    for k in keys:
-        if type(k) is not int:
-            return np.fromiter((stable_hash64(k) for k in keys),
-                               np.uint64, n)
-    try:
-        arr = np.array(keys, np.int64)
-    except OverflowError:
-        return np.fromiter((stable_hash64(k) for k in keys), np.uint64, n)
-    return splitmix64_np(arr)
+    shared with key-group assignment in core.keygroups so routing and
+    state bucketing can never disagree."""
+    return stable_hashes_np(keys)
 
 
 class StreamPartitioner(abc.ABC):

@@ -219,6 +219,24 @@ class InternalTimerService:
         heapq.heappush(self._event_heap, (timestamp, self._seq, key, namespace))
         self._seq += 1
 
+    def register_event_time_timers_bulk(self, namespace, timestamp: int,
+                                        keys) -> None:
+        """Register the same (namespace, timestamp) timer for MANY keys
+        without touching the backend's current-key context — the
+        batched window path registers one trigger/cleanup timer per
+        distinct key in a sub-batch.  Semantics per key are identical
+        to register_event_time_timer."""
+        push = heapq.heappush
+        heap = self._event_heap
+        seen = self._event_set
+        for key in keys:
+            entry = (timestamp, key, namespace)
+            if entry in seen:
+                continue
+            seen.add(entry)
+            push(heap, (timestamp, self._seq, key, namespace))
+            self._seq += 1
+
     def delete_event_time_timer(self, namespace, timestamp: int) -> None:
         # lazy deletion: remove from the set; heap entries are skipped
         self._event_set.discard((timestamp, self._backend.current_key, namespace))

@@ -20,6 +20,8 @@ from __future__ import annotations
 import abc
 from typing import Any, Iterable, List, Optional, Tuple
 
+import numpy as np
+
 from flink_tpu.core.state import (
     AggregatingStateDescriptor,
     ListStateDescriptor,
@@ -35,9 +37,11 @@ from flink_tpu.streaming.operators import (
     TimestampedCollector,
 )
 from flink_tpu.streaming.windowing import (
+    SlidingEventTimeWindows,
     Trigger,
     TriggerContext,
     TriggerResult,
+    TumblingEventTimeWindows,
     WindowAssigner,
 )
 
@@ -307,11 +311,13 @@ class WindowOperator(AbstractUdfStreamOperator):
     # ---- lifecycle --------------------------------------------------
     def open(self):
         super().open()
-        # structural fallback, known AOT: triggers and per-(key,
-        # window) namespaced state are inherently per-row — batches
-        # reaching this operator box (the columnar.ratio gauge and
-        # linter FT184 surface this reason)
-        self.columnar_fallback_reason = "per-row window/trigger state"
+        # structural demotions, known AOT: merging assigners, custom
+        # triggers and evictors are inherently per-row; plain
+        # tumbling/sliding event-time windows with their default
+        # trigger take the vectorized process_batch path (the
+        # columnar.ratio gauge and linter FT184 surface the reason)
+        self._batch_demote_reason = self._batch_eligibility()
+        self.columnar_fallback_reason = self._batch_demote_reason
         self._emit_batch_hist = None
         if self.metrics is not None:
             # eager so monitoring sees the zero (ref: the counter is
@@ -364,6 +370,189 @@ class WindowOperator(AbstractUdfStreamOperator):
                 self.num_late_records_dropped += 1
                 if self.metrics is not None:
                     self.metrics.counter("numLateRecordsDropped").inc()
+
+    # ---- batch path -------------------------------------------------
+    def _batch_eligibility(self) -> Optional[str]:
+        """Structural reason this operator must take the per-row path,
+        or None when process_batch can vectorize.  Called at open();
+        uses only constructor state."""
+        if self.assigner.is_merging():
+            return "merging window assigner is per-row"
+        if not isinstance(self.assigner,
+                          (TumblingEventTimeWindows, SlidingEventTimeWindows)):
+            return (f"no vectorized assignment for "
+                    f"{type(self.assigner).__name__}")
+        if type(self.trigger) is not type(self.assigner.get_default_trigger()):
+            return (f"custom trigger {type(self.trigger).__name__} "
+                    f"is per-row")
+        return None
+
+    def _batch_keys(self, batch, values) -> list:
+        """Key column for a batch as a python list — bit-identical to
+        what set_key_context would have extracted per row (same idiom
+        as the generic engine's _batch_keys)."""
+        from flink_tpu.core.functions import _FieldKeySelector
+        sel = self.key_selector
+        if isinstance(sel, _FieldKeySelector) \
+                and type(sel._field) is int and not batch.is_scalar:
+            col = batch.cols.get(f"f{sel._field}")
+            if col is not None:
+                return np.asarray(col).tolist()
+        return [sel.get_key(v) for v in values]
+
+    def process_batch(self, batch) -> None:
+        """Columnar ingest: assign tumbling/sliding panes for the whole
+        batch in numpy, group rows by (pane start), and feed each
+        sub-batch into the backend's add_batch — one vectorized state
+        write per (window, batch) instead of one per row.
+
+        Exactness: the watermark is FIXED for the whole batch, so a
+        window either fires immediately for ALL its in-batch rows
+        (max_timestamp <= watermark, the allowed-lateness grace path)
+        or for NONE of them.  Fire-now rows are replayed through the
+        scalar per-element path in row order — their incremental
+        emissions are part of the operator's contract — while all
+        CONTINUE panes (the overwhelming majority) go through the
+        column path, which only accumulates state and registers
+        dedup'd timers and therefore commutes with the replay."""
+        n = len(batch)
+        if n == 0:
+            return
+        reason = self._batch_demote_reason
+        if reason is None and (
+                batch.ts is None
+                or (batch.ts_mask is not None and not batch.ts_mask.all())):
+            reason = "rows without event timestamps"
+        if reason is None and self.key_selector is None:
+            reason = "no key selector bound"
+        if reason is not None:
+            self._note_boxed(n, reason)
+            for record in batch.to_records():
+                self.set_key_context(record)
+                self.process_element(record)
+            return
+        self._process_batch_vectorized(batch, n)
+        self._note_columnar(n)
+
+    def _process_batch_vectorized(self, batch, n: int) -> None:
+        ts = np.asarray(batch.ts, np.int64)
+        values = batch.row_values()
+        keys = self._batch_keys(batch, values)
+        wm = self.timer_service.current_watermark
+        assigner = self.assigner
+        size = assigner.size
+        slide = getattr(assigner, "slide", size)
+        offset = assigner.offset
+        lateness = self.allowed_lateness
+        state = self.window_state
+        backend = self.keyed_backend
+        # value column for device states: the aggregate's extract is
+        # identity, so the raw column feeds the scatter directly
+        vcol = None
+        agg = getattr(state, "agg", None)
+        if agg is not None and hasattr(agg, "extract_column"):
+            c = agg.extract_column(batch.value_arrays())
+            if isinstance(c, np.ndarray) and c.ndim == 1 and len(c) == n:
+                vcol = c
+        last_start = ts - ((ts - offset) % slide)
+        npanes = -(-size // slide)  # ceil; 1 for tumbling
+        assigned = np.zeros(n, bool)
+        immediate = np.zeros(n, bool)
+        idx_parts = []
+        start_parts = []
+        for p in range(npanes):
+            starts = last_start - p * slide
+            maxts = starts + (size - 1)
+            live = starts > (ts - size)
+            window_late = (maxts + lateness) <= wm
+            ok = live & ~window_late
+            if not ok.any():
+                continue
+            assigned |= ok
+            fire_now = ok & (maxts <= wm)
+            immediate |= fire_now
+            vi = np.nonzero(ok & ~fire_now)[0]
+            if vi.size:
+                idx_parts.append(vi)
+                start_parts.append(starts[vi])
+        if idx_parts:
+            all_idx = np.concatenate(idx_parts)
+            all_starts = np.concatenate(start_parts)
+            # group by window; WITHIN a window restore row order —
+            # different rows reach the same sliding window at different
+            # pane indexes, and both the state fold order and
+            # same-timestamp timer order must match the scalar path's
+            # row-major traversal
+            order = np.lexsort((all_idx, all_starts))
+            sidx = all_idx[order]
+            sstarts = all_starts[order]
+            bounds = np.nonzero(np.diff(sstarts))[0] + 1
+            lo = 0
+            for hi in [*bounds.tolist(), len(sidx)]:
+                gidx = sidx[lo:hi]
+                start = int(sstarts[lo])
+                lo = hi
+                ns = (start, start + size)
+                gkeys = [keys[i] for i in gidx]
+                if vcol is not None:
+                    backend.add_batch(state, gkeys, ns, vcol[gidx],
+                                      pre_extracted=True)
+                else:
+                    backend.add_batch(state, gkeys, ns,
+                                      [values[i] for i in gidx])
+                # first-occurrence order, NOT a set: same-timestamp
+                # timers fire in registration order, and the scalar
+                # path registers them in row order
+                dkeys = dict.fromkeys(gkeys)
+                maxt = start + size - 1
+                # trigger timer (what EventTimeTrigger.on_element
+                # registers on CONTINUE) + GC timer; the dedup set
+                # makes re-registration free
+                self.timer_service.register_event_time_timers_bulk(
+                    ns, maxt, dkeys)
+                cleanup = maxt + lateness
+                if cleanup < MAX_TIMESTAMP:
+                    self.timer_service.register_event_time_timers_bulk(
+                        ns, cleanup, dkeys)
+        if immediate.any():
+            tlist = ts.tolist()
+            for i in np.nonzero(immediate)[0]:
+                backend.set_current_key(keys[i])
+                self._replay_immediate(values[i], tlist[i], wm)
+        dropped = ~assigned & ~immediate & ((ts + lateness) <= wm)
+        if dropped.any():
+            if self.late_data_tag is not None:
+                tlist = ts.tolist()
+                for i in np.nonzero(dropped)[0]:
+                    self.output.collect_side(
+                        self.late_data_tag,
+                        StreamRecord(values[i], tlist[i]))
+            else:
+                cnt = int(dropped.sum())
+                self.num_late_records_dropped += cnt
+                if self.metrics is not None:
+                    self.metrics.counter("numLateRecordsDropped").inc(cnt)
+
+    def _replay_immediate(self, value, timestamp: int, wm: int) -> None:
+        """Scalar replay for a row with >= 1 window already past the
+        watermark: only those windows run here (add + trigger + emit,
+        exactly process_element's per-window body); CONTINUE windows
+        were vector-ingested."""
+        record = StreamRecord(value, timestamp)
+        for window in self.assigner.assign_windows(
+                value, timestamp, self.assigner_ctx):
+            if window.max_timestamp() > wm:
+                continue  # handled by the column path
+            if self._is_window_late(window):
+                continue
+            ns = self._namespace_of(window)
+            self.window_state.set_current_namespace(ns)
+            self.window_state.add(self._state_value(record))
+            self.trigger_ctx.window = window
+            result = self.trigger.on_element(
+                value, timestamp, window, self.trigger_ctx)
+            self._react(result, window)
+            self._register_cleanup_timer(window)
 
     def _process_merging(self, record, windows, skipped):
         from flink_tpu.state.backend import VOID_NAMESPACE
@@ -607,6 +796,9 @@ class EvictingWindowOperator(WindowOperator):
         if pre_aggregator is not None:
             self._internal_fn = _InternalWindowFunction(
                 window_function, single_value=True)
+
+    def _batch_eligibility(self) -> Optional[str]:
+        return "evictor retains raw per-row elements"
 
     def _state_value(self, record: StreamRecord):
         # store (timestamp, value) so time-based eviction works; the

@@ -10,6 +10,9 @@
 #   5. columnar gate — the boxed-vs-columnar differential suite, then
 #      a real-TCP shuffle smoke with the wire codec pinned ON and OFF
 #      (identical delivered streams required)
+#   6. state gate — the keyed-state differential suite, then the
+#      heap-vs-tpu batched-ingest smoke with a mid-stream restore and
+#      the codec pinned on/off (bit-equal outputs required)
 #
 # Stages keep running after a failure so one report covers
 # everything; rc is non-zero if ANY stage failed.
@@ -21,28 +24,34 @@ cd "$(dirname "$0")/.."
 export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
 rc=0
 
-echo "== stage 1/5: repo lint =="
+echo "== stage 1/6: repo lint =="
 scripts/lint_repo.sh || rc=1
 
 echo
-echo "== stage 2/5: strict graph lint over examples/ =="
+echo "== stage 2/6: strict graph lint over examples/ =="
 python -m flink_tpu lint --strict examples/ || rc=1
 
 echo
-echo "== stage 3/5: tier-1 test suite =="
+echo "== stage 3/6: tier-1 test suite =="
 python -m pytest tests/ -q -m 'not slow' \
     --continue-on-collection-errors \
     -p no:cacheprovider -p no:xdist -p no:randomly || rc=1
 
 echo
-echo "== stage 4/5: observability smoke =="
+echo "== stage 4/6: observability smoke =="
 python scripts/observability_smoke.py || rc=1
 
 echo
-echo "== stage 5/5: columnar differential + shuffle codec smoke =="
+echo "== stage 5/6: columnar differential + shuffle codec smoke =="
 python -m pytest tests/test_columnar_pipeline.py -q \
     -p no:cacheprovider -p no:xdist -p no:randomly || rc=1
 python scripts/columnar_smoke.py || rc=1
+
+echo
+echo "== stage 6/6: state differential + batched-ingest smoke =="
+python -m pytest tests/test_state_batch.py -q \
+    -p no:cacheprovider -p no:xdist -p no:randomly || rc=1
+python scripts/state_smoke.py || rc=1
 
 echo
 if [ "$rc" -eq 0 ]; then

@@ -1,0 +1,155 @@
+"""CI smoke for the keyed-state tier (scripts/ci_check.sh stage 6).
+
+Runs the same windowed aggregation — batched ingest plus a mid-stream
+snapshot/restore — on the heap and TPU backends, with the column wire
+codec available and with it pinned OFF (snapshot key columns degrade
+to the pickle tier), and requires every pass to reproduce the per-row
+scalar reference exactly: values AND timestamps, in emission order,
+with zero boxed fallbacks on the batch side.  A smoke, not a
+benchmark: small event count, correctness asserts only.
+
+Exit code 0 = clean.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import numpy as np
+
+N_CHUNKS = 6
+CHUNK = 256
+N_KEYS = 11
+
+
+def make_operator():
+    from flink_tpu.core.state import AggregatingStateDescriptor
+    from flink_tpu.ops.device_agg import SumAggregate
+    from flink_tpu.streaming.window_operator import WindowOperator
+    from flink_tpu.streaming.windowing import TumblingEventTimeWindows
+
+    class _KVSum(SumAggregate):
+        def __init__(self):
+            super().__init__(np.float32)
+
+        def extract_value(self, value):
+            return value[1] if isinstance(value, tuple) else value
+
+    def fn(key, window, elements):
+        for v in elements:
+            yield (key, float(v), window.start)
+
+    return WindowOperator(
+        TumblingEventTimeWindows.of(1000),
+        AggregatingStateDescriptor("smoke-sum", _KVSum()),
+        window_function=fn)
+
+
+def chunk_arrays(chunk, rng):
+    keys = rng.integers(0, N_KEYS, CHUNK)
+    vals = rng.integers(0, 100, CHUNK).astype(np.float64)
+    ts = rng.integers(chunk * 1000, chunk * 1000 + 2000,
+                      CHUNK).astype(np.int64)
+    return keys, vals, ts
+
+
+def run_pass(backend, batched, snapshot_at=None):
+    """Drive the job; `snapshot_at` = chunk index after which the
+    harness is snapshotted and restored into a FRESH one (same
+    backend) — the crash/restore the state tier must survive."""
+    from flink_tpu.streaming.elements import RecordBatch
+    from flink_tpu.streaming.harness import OneInputStreamOperatorTestHarness
+
+    def fresh():
+        h = OneInputStreamOperatorTestHarness(
+            make_operator(), key_selector=lambda x: x[0],
+            state_backend=backend)
+        h.open()
+        return h
+
+    h = fresh()
+    rng = np.random.default_rng(1234)
+    out = []
+    for chunk in range(N_CHUNKS):
+        keys, vals, ts = chunk_arrays(chunk, rng)
+        if batched:
+            h.process_batch(RecordBatch({"f0": keys, "f1": vals}, ts=ts))
+        else:
+            batch = RecordBatch({"f0": keys, "f1": vals}, ts=ts)
+            for r in batch.to_records():
+                h.process_element(r)
+        h.process_watermark(chunk * 1000 + 500)
+        out.extend((r.value, r.timestamp) for r in h.get_output())
+        h.clear_output()
+        if snapshot_at == chunk:
+            snap = h.snapshot()
+            h = fresh()
+            h.initialize_state(snap)
+    h.process_watermark(10 ** 13)
+    out.extend((r.value, r.timestamp) for r in h.get_output())
+    if batched:
+        op = h.operator
+        assert op.boxed_fallbacks == 0, \
+            f"batch pass hit {op.boxed_fallbacks} boxed fallbacks " \
+            f"({op.columnar_fallback_reason})"
+    return out
+
+
+def main():
+    from flink_tpu.runtime import netchannel
+    from flink_tpu.state.stats import STATE_STATS
+
+    # two scalar references: plain, and with the same mid-stream
+    # restore the batch passes take (a restore rebuilds the timer heap,
+    # so same-timestamp fire order is only comparable restore-to-restore)
+    reference = run_pass("heap", batched=False)
+    reference_r = run_pass("heap", batched=False, snapshot_at=2)
+    assert reference and sorted(reference) == sorted(reference_r)
+
+    for backend in ("heap", "tpu"):
+        batch_rows_before = STATE_STATS.batch_rows
+        cols_before = STATE_STATS.snapshot_columns
+        rows_before = STATE_STATS.snapshot_rows
+        out = run_pass(backend, batched=True)
+        assert out == reference, \
+            f"{backend} batch pass diverged from the scalar reference"
+        out = run_pass(backend, batched=True, snapshot_at=2)
+        assert out == reference_r, \
+            f"{backend} batch pass diverged across snapshot/restore"
+        assert STATE_STATS.batch_rows > batch_rows_before, \
+            f"{backend} pass never used the add_batch path"
+        if backend == "tpu":
+            # device states snapshot as ONE gather per component
+            assert STATE_STATS.snapshot_columns > cols_before, \
+                "tpu snapshot never went columnar"
+        else:
+            # float32 accumulators are boxed on the heap (only exact
+            # python int/float columns stay typed there)
+            assert STATE_STATS.snapshot_rows > rows_before, \
+                "heap snapshot carried no state"
+
+    # codec pinned OFF: snapshot key columns must degrade to the
+    # pickle tier and STILL restore bit-equal
+    def _refuse(values):
+        raise ValueError("wire codec pinned off for state smoke")
+
+    saved = netchannel._encode_value_column
+    netchannel._encode_value_column = _refuse
+    try:
+        for backend in ("heap", "tpu"):
+            out = run_pass(backend, batched=True, snapshot_at=2)
+            assert out == reference_r, \
+                f"{backend} pass diverged with the codec pinned off"
+    finally:
+        netchannel._encode_value_column = saved
+
+    print(f"state_smoke: OK — {N_CHUNKS * CHUNK} events, "
+          f"{len(reference)} window emissions, heap+tpu x codec on/off "
+          f"all bit-equal to the scalar reference across restore")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
