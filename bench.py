@@ -1118,6 +1118,15 @@ def main():
         argv = [a for a in argv if a != "--trace"]
         from flink_tpu.runtime import tracing
         tracing.get_tracer().enabled = True
+    # --device-ledger: enable the device telemetry plane for the whole
+    # run and ship its payload (per-tag transfer ledger, per-kernel
+    # attribution, exchange phase breakdown, fire/flush counters) into
+    # bench_report.json under "device_ledger"
+    device_ledger = "--device-ledger" in argv
+    if device_ledger:
+        argv = [a for a in argv if a != "--device-ledger"]
+        from flink_tpu.runtime.device_stats import get_telemetry
+        get_telemetry().enable()
     # --chaos-smoke: one seeded chaos case per executor (the
     # tests/test_chaos.py harness), exits non-zero if exactly-once
     # breaks — a quick fault-tolerance gate without the full suite
@@ -1210,6 +1219,24 @@ def main():
             f"bench_trace_cluster.json"
             + (f"; {tracer.dropped} events dropped at the ring limit"
                if tracer.dropped else ""))
+
+    if device_ledger:
+        from flink_tpu.runtime.device_stats import get_telemetry
+        ledger = get_telemetry().payload()
+        results["device_ledger"] = ledger
+        tot, ctr = ledger["totals"], ledger["counters"]
+        log(f"[bench] device ledger: h2d {tot['h2d']['bytes']:,} B / "
+            f"{tot['h2d']['total_ms']:.1f} ms, "
+            f"d2h {tot['d2h']['bytes']:,} B / "
+            f"{tot['d2h']['total_ms']:.1f} ms; "
+            f"flushes {ctr['flushes']:,}, fire reads "
+            f"{ctr['fire_reads']:,}, fire/flush "
+            f"{ctr['fire_flush_ratio']:.2f}")
+        for tag, ph in (ledger.get("exchange_phases") or {}).items():
+            log(f"[bench]   exchange {tag}: rounds={ph['rounds']} "
+                f"pack={ph['pack_ms']:.1f}ms h2d={ph['h2d_ms']:.1f}ms "
+                f"collective={ph['collective_ms']:.1f}ms "
+                f"d2h={ph['d2h_ms']:.1f}ms")
 
     with open("bench_report.json", "w") as f:
         json.dump(results, f, indent=2)

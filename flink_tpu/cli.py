@@ -312,8 +312,53 @@ def _top_state_footer(metrics) -> str:
     return line
 
 
+def _fmt_bytes(n) -> str:
+    n = float(n)
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if abs(n) < 1024 or unit == "GiB":
+            return (f"{n:,.0f} {unit}" if unit == "B"
+                    else f"{n:,.1f} {unit}")
+        n /= 1024
+    return f"{n:,.1f} GiB"
+
+
+def _top_device_footer(metrics, prev=None, dt=0.0) -> str:
+    """One-line device-telemetry picture from the process-wide
+    `device.*` gauges: HBM used/capacity, transfer B/s, flushes/s and
+    the fire-flush ratio.  "" when the telemetry plane is disabled or
+    the server predates it."""
+    if not metrics.get("device.enabled"):
+        return ""
+
+    def g(key, default=0):
+        v = metrics.get("device." + key)
+        return v if isinstance(v, (int, float)) else default
+
+    def rate(key):
+        if not prev or not dt:
+            return None
+        pv = (prev or {}).get("device." + key)
+        if not isinstance(pv, (int, float)):
+            return None
+        return max(0.0, (g(key) - pv) / dt)
+
+    line = f"device: HBM {_fmt_bytes(g('hbm.bytesInUse'))}"
+    if g("hbm.bytesLimit"):
+        line += f"/{_fmt_bytes(g('hbm.bytesLimit'))}"
+    h2d, d2h = rate("h2d.bytes"), rate("d2h.bytes")
+    line += ("; h2d " + (f"{_fmt_bytes(h2d)}/s" if h2d is not None
+                         else _fmt_bytes(g("h2d.bytes")) + " total"))
+    line += ("; d2h " + (f"{_fmt_bytes(d2h)}/s" if d2h is not None
+                         else _fmt_bytes(g("d2h.bytes")) + " total"))
+    fl = rate("flushes")
+    line += ("; flushes " + (f"{fl:,.1f}/s" if fl is not None
+                             else f"{g('flushes'):,.0f}"))
+    line += f"; fire/flush {g('fireFlushRatio'):,.2f}"
+    return line
+
+
 def _top_render(job, status, rows, checkpoints, alerts,
-                bottleneck=None, state_line="") -> str:
+                bottleneck=None, state_line="", device_line="") -> str:
     def fmt(v, spec="{:.0f}", dash="-"):
         return dash if v is None else spec.format(v)
 
@@ -355,6 +400,8 @@ def _top_render(job, status, rows, checkpoints, alerts,
                  + (f"; FIRING: {', '.join(firing)}" if firing else ""))
     if state_line:
         lines.append(state_line)
+    if device_line:
+        lines.append(device_line)
     if bn_vid is not None:
         ups = ", ".join(f"{u.get('name')} ({u.get('ratio', 0) * 100:.0f}%)"
                         for u in bn.get("backpressured_upstreams") or [])
@@ -389,6 +436,7 @@ def _top(rest) -> int:
         base = "http://" + base
 
     prev_metrics: dict = {}
+    prev_full: dict = {}
     prev_t = None
     try:
         while True:
@@ -415,20 +463,22 @@ def _top(rest) -> int:
             now = time.monotonic()
             if args.once and prev_t is None:
                 # rates need two samples: take a quick second one
-                prev_metrics, prev_t = metrics, now
+                prev_metrics, prev_full, prev_t = metrics, full_dump, now
                 time.sleep(min(args.interval, 0.5))
                 continue
             dt = (now - prev_t) if prev_t is not None else 0.0
             rows = _top_rows(job, detail, metrics, prev_metrics, dt)
             out = _top_render(job, detail.get("status"), rows,
                               checkpoints, alerts, bottleneck,
-                              state_line=_top_state_footer(full_dump))
+                              state_line=_top_state_footer(full_dump),
+                              device_line=_top_device_footer(
+                                  full_dump, prev_full, dt))
             if args.once:
                 print(out)
                 return 0
             # full-redraw refresh (clear + home), like watch(1)
             print("\x1b[2J\x1b[H" + out, flush=True)
-            prev_metrics, prev_t = metrics, now
+            prev_metrics, prev_full, prev_t = metrics, full_dump, now
             time.sleep(args.interval)
     except KeyboardInterrupt:
         return 0

@@ -30,6 +30,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from flink_tpu.core.functions import AggregateFunction
+from flink_tpu.runtime.tracing import traced_jit
 
 
 class StateSpec(NamedTuple):
@@ -188,15 +189,19 @@ class DeviceAggregateFunction(AggregateFunction):
                 jax.jit(lambda x: x, **kw)  # probe support
             except TypeError:  # pragma: no cover — very old jax
                 kw = {}
+            agg_name = type(self).__name__
             jits = {
-                "add": jax.jit(lambda st, v, hi, lo: self.update(
+                "add": traced_jit(lambda st, v, hi, lo: self.update(
                     st, jnp.zeros(1, jnp.int32), v, hi, lo,
-                    jnp.ones(1, bool)), **kw),
-                "result": jax.jit(lambda st: self.result(
-                    st, jnp.zeros(1, jnp.int32)), **kw),
-                "merge": jax.jit(lambda st: self.merge_slots(
+                    jnp.ones(1, bool)),
+                    name=f"agg.{agg_name}.add", **kw),
+                "result": traced_jit(lambda st: self.result(
+                    st, jnp.zeros(1, jnp.int32)),
+                    name=f"agg.{agg_name}.result", **kw),
+                "merge": traced_jit(lambda st: self.merge_slots(
                     st, jnp.array([0], jnp.int32),
-                    jnp.array([1], jnp.int32)), **kw),
+                    jnp.array([1], jnp.int32)),
+                    name=f"agg.{agg_name}.merge", **kw),
             }
             self._scalar_jit_cache = jits
         return jits

@@ -23,7 +23,6 @@ multi-chip path runs as ONE compiled SPMD program per micro-batch.
 
 from __future__ import annotations
 
-from functools import partial
 from typing import NamedTuple, Tuple
 
 import jax
@@ -31,6 +30,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from flink_tpu.ops.hashing import fmix32
+from flink_tpu.runtime.tracing import traced_jit
 
 
 class DeviceHashTable(NamedTuple):
@@ -127,8 +127,9 @@ def insert_or_lookup_impl(
     return final.table, final.slots, ok
 
 
-insert_or_lookup = partial(jax.jit, static_argnames=("max_probes",),
-                           donate_argnums=0)(insert_or_lookup_impl)
+insert_or_lookup = traced_jit(
+    insert_or_lookup_impl, name="table.insert_or_lookup",
+    static_argnames=("max_probes",), donate_argnums=0)
 
 
 def insert_or_lookup_regions_impl(
@@ -203,8 +204,7 @@ def insert_or_lookup_regions_impl(
     return final.table, final.slots, ok
 
 
-@partial(jax.jit, donate_argnums=0)
-def clear_entries(table: DeviceHashTable, slots: jnp.ndarray) -> DeviceHashTable:
+def _clear_entries_impl(table: DeviceHashTable, slots: jnp.ndarray) -> DeviceHashTable:
     """Free table positions (window fired).  Linear probing requires
     tombstone-free deletion in general; here windows clear their WHOLE
     shard (separate tables per window), so full clears are the common
@@ -215,6 +215,10 @@ def clear_entries(table: DeviceHashTable, slots: jnp.ndarray) -> DeviceHashTable
         key_lo=table.key_lo,
         occupied=table.occupied.at[slots].set(False),
     )
+
+
+clear_entries = traced_jit(_clear_entries_impl, name="table.clear",
+                           donate_argnums=0)
 
 
 def lookup_np(table: DeviceHashTable, h64: np.ndarray, max_probes: int = 64):

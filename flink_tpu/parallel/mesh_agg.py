@@ -22,6 +22,7 @@ which owns the hash → original-key mapping for its shard.
 
 from __future__ import annotations
 
+import time
 from functools import partial
 from typing import Dict, NamedTuple, Tuple
 
@@ -41,6 +42,9 @@ from flink_tpu.ops.device_table import (
     make_table,
 )
 from flink_tpu.ops.hashing import fmix32
+from flink_tpu.runtime.device_stats import TELEMETRY
+
+_perf_ns = time.perf_counter_ns
 
 
 def _target_shard(h_lo: jnp.ndarray, max_parallelism: int, n_shards: int) -> jnp.ndarray:
@@ -178,9 +182,30 @@ class MeshWindowAggregation:
 
     def step(self, h_hi, h_lo, values, vh_hi, vh_lo, mask) -> None:
         """Process one global batch (length divisible by n_shards)."""
-        self.state, overflow = self._step(
-            self.state, h_hi, h_lo, values, vh_hi, vh_lo, mask)
-        ov = int(np.asarray(overflow).sum())
+        if TELEMETRY.enabled:
+            # the exchange here is fused into one XLA program, so the
+            # pack/H2D legs are not separable: the dispatch is billed
+            # as the collective phase, the overflow readback as D2H
+            sent = sum(int(getattr(a, "nbytes", 0))
+                       for a in (h_hi, h_lo, values, vh_hi, vh_lo, mask))
+            t0 = _perf_ns()
+            self.state, overflow = self._step(
+                self.state, h_hi, h_lo, values, vh_hi, vh_lo, mask)
+            t1 = _perf_ns()
+            overflow_np = np.asarray(overflow)
+            t2 = _perf_ns()
+            TELEMETRY.record_transfer("h2d", sent, t0, t1,
+                                      tag="mesh.step")
+            TELEMETRY.record_transfer("d2h", overflow_np.nbytes, t1, t2,
+                                      tag="mesh.step")
+            TELEMETRY.record_exchange_round(
+                "mesh.agg", 0.0, 0.0, (t1 - t0) / 1e6,
+                (t2 - t1) / 1e6, sent)
+            ov = int(overflow_np.sum())
+        else:
+            self.state, overflow = self._step(
+                self.state, h_hi, h_lo, values, vh_hi, vh_lo, mask)
+            ov = int(np.asarray(overflow).sum())
         if ov:
             self.overflowed += ov
             if not self.allow_overflow:
@@ -192,6 +217,22 @@ class MeshWindowAggregation:
     def fire(self):
         """Close the window: returns (key_hi, key_lo, results, occupied)
         host arrays concatenated over shards, and resets state."""
+        if TELEMETRY.enabled:
+            t0 = _perf_ns()
+            self.state, (hi, lo, res, occ) = self._fire(self.state)
+            hi_np, lo_np = np.asarray(hi), np.asarray(lo)
+            res_np, occ_np = np.asarray(res), np.asarray(occ)
+            t1 = _perf_ns()
+            TELEMETRY.record_transfer(
+                "d2h",
+                hi_np.nbytes + lo_np.nbytes + res_np.nbytes
+                + occ_np.nbytes,
+                t0, t1, tag="mesh.fire")
+            TELEMETRY.note_fire_read()
+            return (hi_np.reshape(-1), lo_np.reshape(-1),
+                    res_np.reshape(res_np.shape[0] * res_np.shape[1],
+                                   *res_np.shape[2:]),
+                    occ_np.reshape(-1))
         self.state, (hi, lo, res, occ) = self._fire(self.state)
         return (np.asarray(hi).reshape(-1), np.asarray(lo).reshape(-1),
                 np.asarray(res).reshape(res.shape[0] * res.shape[1], *res.shape[2:]),

@@ -35,12 +35,16 @@ Design notes:
 
 from __future__ import annotations
 
+import time
 from typing import Any, List, Optional
 
 import numpy as np
 
 from flink_tpu.ops.device_agg import DeviceAggregateFunction
 from flink_tpu.ops.sketches import CountMinSketchAggregate
+from flink_tpu.runtime.device_stats import TELEMETRY
+
+_perf_ns = time.perf_counter_ns
 
 
 def _split_u64(a: np.ndarray):
@@ -237,6 +241,8 @@ class _MeshShardedLogEngine:
         (data-parallel split of the batch)."""
         S, cap = self.n_shards, self.bucket_cap
         m = len(lanes) // S
+        telem = TELEMETRY.enabled
+        t0 = _perf_ns() if telem else 0
         bucks = self._buck_buf
         counts = np.zeros((S, S), np.int32)
         overflow = []           # (target, rows) beyond the bucket cap
@@ -260,9 +266,37 @@ class _MeshShardedLogEngine:
                 counts[s, t] = c
                 if n_t > c:
                     overflow.append((t, rows[c:]))
-        recv, rcounts = self._exchange(bucks, counts)
-        recv = np.asarray(recv)
-        rcounts = np.asarray(rcounts)
+        if telem:
+            # phase-split round: an explicit sharded device_put
+            # separates the H2D leg from the collective so the ledger
+            # attributes fabric time and staging time independently
+            import jax
+            from jax.sharding import NamedSharding, PartitionSpec
+            t1 = _perf_ns()
+            sharding = NamedSharding(self.mesh, PartitionSpec(self.axis))
+            d_bucks = jax.device_put(bucks, sharding)
+            d_counts = jax.device_put(counts, sharding)
+            jax.block_until_ready((d_bucks, d_counts))
+            t2 = _perf_ns()
+            recv, rcounts = self._exchange(d_bucks, d_counts)
+            jax.block_until_ready((recv, rcounts))
+            t3 = _perf_ns()
+            recv = np.asarray(recv)
+            rcounts = np.asarray(rcounts)
+            t4 = _perf_ns()
+            sent = bucks.nbytes + counts.nbytes
+            TELEMETRY.record_transfer("h2d", sent, t1, t2,
+                                      tag="mesh.exchange")
+            TELEMETRY.record_transfer(
+                "d2h", recv.nbytes + rcounts.nbytes, t3, t4,
+                tag="mesh.exchange")
+            TELEMETRY.record_exchange_round(
+                "mesh.log", (t1 - t0) / 1e6, (t2 - t1) / 1e6,
+                (t3 - t2) / 1e6, (t4 - t3) / 1e6, sent)
+        else:
+            recv, rcounts = self._exchange(bucks, counts)
+            recv = np.asarray(recv)
+            rcounts = np.asarray(rcounts)
         for j in range(S):
             parts = [recv[j, s, :rcounts[j, s]]
                      for s in range(S) if rcounts[j, s]]

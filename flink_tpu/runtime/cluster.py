@@ -77,6 +77,7 @@ from flink_tpu.runtime.backpressure import (
     observe_threaded_source,
     read_vertex_stats,
 )
+from flink_tpu.runtime.device_stats import register_device_gauges
 from flink_tpu.runtime.metrics import (
     MetricRegistry,
     register_network_gauges,
@@ -1246,6 +1247,7 @@ class TaskExecutor(RpcEndpoint):
             data_clients=lambda: [a.data_client
                                   for a in list(self._attempts.values())])
         register_state_gauges(self.metrics)
+        register_device_gauges(self.metrics)
         self._blob_cache: Dict[str, bytes] = {}
         #: local recovery (ref: TaskLocalStateStore/TaskStateManager):
         #: the last TWO acked snapshots per task (cid -> pickled) —

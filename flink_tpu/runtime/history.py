@@ -16,7 +16,8 @@ over HTTP).  Here:
 - `HistoryServer` scans one or more archive directories, caches the
   summaries, and serves `/jobs`, `/jobs/<id>`, `/overview` plus the
   per-job sub-routes `/metrics`, `/metrics/history`, `/checkpoints`,
-  `/alerts`, `/traces` (`?scope=cluster` replays the archived merged
+  `/alerts`, `/device` (the archived device-telemetry ledger),
+  `/traces` (`?scope=cluster` replays the archived merged
   cluster trace), `/bottleneck`, `/exceptions` over a threaded HTTP
   server —
   the same route shapes (and error bodies) as the live WebMonitor
@@ -75,6 +76,15 @@ def build_archive_summary(job_name: str, state: str,
             coordinator, checkpoints_base)
     if exceptions:
         summary["exceptions"] = list(exceptions)
+    try:
+        from flink_tpu.runtime.device_stats import get_telemetry
+        telemetry = get_telemetry()
+        if telemetry.enabled:
+            # the `/jobs/<n>/device` ledger, frozen at archive time —
+            # includes the link-probe measurement under "link"
+            summary["device"] = telemetry.payload()
+    except Exception:  # noqa: BLE001 — telemetry must never block archiving
+        pass
     if upstreams is not None:
         # vertex -> upstream vertices: the bottleneck route replays
         # localization over the archived metrics snapshot
@@ -262,6 +272,14 @@ class HistoryServer:
             job = self._find(jobs, path[len("/jobs/"):-len("/alerts")])
             return job.get("alerts") or {
                 "alerts": [], "total": 0, "rules_firing": []}
+        if path.startswith("/jobs/") and path.endswith("/device"):
+            job = self._find(jobs, path[len("/jobs/"):-len("/device")])
+            device = job.get("device")
+            if device is None:
+                # same shape as a live monitor with telemetry off
+                from flink_tpu.runtime.device_stats import DeviceTelemetry
+                device = DeviceTelemetry().payload()
+            return device
         if path.startswith("/jobs/") and path.endswith("/metrics"):
             job = self._find(jobs, path[len("/jobs/"):-len("/metrics")])
             metrics = job.get("metrics") or {}

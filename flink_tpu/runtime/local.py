@@ -63,6 +63,7 @@ from flink_tpu.runtime.failover import (
     compute_pipelined_regions,
     region_of,
 )
+from flink_tpu.runtime.device_stats import register_device_gauges
 from flink_tpu.runtime.metrics import (
     LatencyStats,
     MetricRegistry,
@@ -1228,6 +1229,7 @@ class LocalExecutor:
         self.channel_capacity = channel_capacity
         self.metrics = metric_registry or MetricRegistry()
         register_state_gauges(self.metrics)
+        register_device_gauges(self.metrics)
         self.latency_interval_ms = latency_interval_ms
         #: "full" | "region" (ref: FailoverStrategyLoader /
         #: jobmanager.execution.failover-strategy)

@@ -64,6 +64,7 @@ from flink_tpu.runtime.backpressure import (
     observe_subtask,
     observe_threaded_source,
 )
+from flink_tpu.runtime.device_stats import register_device_gauges
 from flink_tpu.runtime.metrics import (
     MetricRegistry,
     register_checkpoint_gauges,
@@ -242,6 +243,7 @@ class MiniCluster:
         self.channel_capacity = channel_capacity
         self.metrics = metric_registry or MetricRegistry()
         register_state_gauges(self.metrics)
+        register_device_gauges(self.metrics)
         self.latency_interval_ms = latency_interval_ms
         #: metrics time-series journal cadence (None = disabled)
         self.sample_interval_ms = sample_interval_ms

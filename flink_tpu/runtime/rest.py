@@ -7,6 +7,8 @@ clients: `/jobs` (status per tracked job), `/jobs/<name>/metrics`
 query: `?metric=<glob>&since=<wall ms>&buckets=<n>` with min/max/avg/
 p95 rollups), `/jobs/<name>/checkpoints` (full stats history +
 summary percentiles), `/jobs/<name>/alerts` (health events),
+`/jobs/<name>/device` (device telemetry ledger: transfers, HBM,
+per-kernel attribution — runtime/device_stats.py),
 `/metrics` (full dump), `/metrics/prometheus` (text exposition via
 PrometheusTextReporter).  JSON out, stdlib only.  Errors are JSON
 bodies: unknown routes/jobs are 404, malformed query params 400.
@@ -353,6 +355,15 @@ class WebMonitor:
             if job not in self.jobs:
                 raise KeyError(path)
             return self._job_alerts(self.jobs[job]), "application/json"
+        if path.startswith("/jobs/") and path.endswith("/device"):
+            job = urllib.parse.unquote(
+                path[len("/jobs/"):-len("/device")])
+            if job not in self.jobs:
+                raise KeyError(path)
+            # the ledger is process-global (like the tracer): one
+            # device plane per host, surfaced while the job is tracked
+            from flink_tpu.runtime.device_stats import get_telemetry
+            return get_telemetry().payload(), "application/json"
         if path.startswith("/jobs/") and path.endswith("/metrics"):
             job = urllib.parse.unquote(
                 path[len("/jobs/"):-len("/metrics")])

@@ -248,6 +248,13 @@ class HealthEvaluator:
         `bottleneck_consecutive` consecutive evaluations — a stable
         localization, not a transient blip (the autoscaler's scale-up
         target signal).
+      * ``transfer-tax`` — the device ledger's D2H-fire-reads per
+        fired-window ratio (deltas of ``device.fireReads`` over
+        ``device.windowsFired``) stayed above
+        `transfer_tax_threshold` for `transfer_tax_consecutive`
+        consecutive sample intervals: the job is paying a per-result
+        device readback tax (docs/state.md's fire-path caveat) instead
+        of amortizing fires over batched reads.
     """
 
     def __init__(self, journal: MetricsJournal,
@@ -258,6 +265,8 @@ class HealthEvaluator:
                  coordinator_supplier: Optional[Callable[[], Any]] = None,
                  bottleneck_supplier: Optional[Callable[[], Any]] = None,
                  bottleneck_consecutive: int = 5,
+                 transfer_tax_threshold: float = 4.0,
+                 transfer_tax_consecutive: int = 5,
                  max_alerts: int = 256,
                  wall_clock: Callable[[], float] = None):
         self.journal = journal
@@ -268,6 +277,8 @@ class HealthEvaluator:
         self.coordinator_supplier = coordinator_supplier
         self.bottleneck_supplier = bottleneck_supplier
         self.bottleneck_consecutive = max(2, bottleneck_consecutive)
+        self.transfer_tax_threshold = transfer_tax_threshold
+        self.transfer_tax_consecutive = max(2, transfer_tax_consecutive)
         self.max_alerts = max_alerts
         self._wall = wall_clock or (lambda: _time.time() * 1000.0)
         self._lock = threading.Lock()
@@ -319,6 +330,7 @@ class HealthEvaluator:
         self._eval_watermark_lag()
         self._eval_checkpoint_budget()
         self._eval_bottleneck()
+        self._eval_transfer_tax()
 
     def _tail(self, key: str, n: int) -> List[float]:
         samples = self.journal.series(key)
@@ -364,6 +376,30 @@ class HealthEvaluator:
             p95 > budget,
             f"completed-checkpoint duration p95 {p95:.1f} ms exceeds "
             f"budget {budget:.1f} ms", p95)
+
+    def _eval_transfer_tax(self) -> None:
+        thr = self.transfer_tax_threshold
+        if thr is None:
+            return
+        k = self.transfer_tax_consecutive
+        # both are cumulative counters: the rule runs on per-interval
+        # deltas, so k firing intervals need k+1 samples of each
+        reads = self._tail("device.fireReads", k + 1)
+        fired = self._tail("device.windowsFired", k + 1)
+        firing = False
+        value = None
+        if len(reads) == k + 1 and len(fired) == k + 1:
+            d_reads = [b - a for a, b in zip(reads, reads[1:])]
+            d_fired = [b - a for a, b in zip(fired, fired[1:])]
+            ratios = [dr / df for dr, df in zip(d_reads, d_fired)
+                      if df > 0]
+            firing = len(ratios) == k and all(r > thr for r in ratios)
+            value = ratios[-1] if ratios else None
+        self._episode(
+            "transfer-tax", "device.fireReads", firing,
+            f"sustained device readback tax: > {thr} D2H fire reads "
+            f"per fired window for {k} consecutive sample intervals "
+            "(see docs/state.md, per-key fire path)", value)
 
     def _eval_bottleneck(self) -> None:
         if self.bottleneck_supplier is None:

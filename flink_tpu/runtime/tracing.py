@@ -562,12 +562,18 @@ def reset_kernel_stats() -> None:
 # ---------------------------------------------------------------------
 
 class _JitStat:
-    __slots__ = ("recompiles", "compile_time_ms", "cache_hits")
+    __slots__ = ("recompiles", "compile_time_ms", "cache_hits",
+                 "last_shape_sig", "shape_sigs")
 
     def __init__(self):
         self.recompiles = 0
         self.compile_time_ms = 0.0
         self.cache_hits = 0
+        #: arg-shape signature of the most recent recompile + the set
+        #: of distinct signatures seen — shape-churn retraces become
+        #: diagnosable instead of just counted
+        self.last_shape_sig = ""
+        self.shape_sigs: set = set()
 
 
 _jit_stats: Dict[str, _JitStat] = {}
@@ -582,14 +588,37 @@ def _jit_entry(name: str) -> _JitStat:
         return stat
 
 
+def _shape_signature(args, kwargs) -> str:
+    """Compact per-leaf ``dtype[shape]`` signature of a call's
+    arguments — the thing that changed when a jit retraced."""
+    def leaf_sig(x):
+        shape = getattr(x, "shape", None)
+        dtype = getattr(x, "dtype", None)
+        if shape is not None and dtype is not None:
+            return f"{dtype}[{','.join(map(str, shape))}]"
+        return type(x).__name__
+
+    try:
+        import jax
+        leaves = jax.tree_util.tree_leaves((args, kwargs))
+    except Exception:  # noqa: BLE001
+        leaves = list(args)
+    return "(" + ", ".join(leaf_sig(x) for x in leaves) + ")"
+
+
 def traced_jit(fn, name: Optional[str] = None, **jit_kwargs):
     """``jax.jit`` with compile-event accounting.  Each call compares
     the jitted callable's ``_cache_size()`` before/after: growth means
     the call traced+compiled (count it, with wall time — compilation
     dominates the call so attributing the whole call is a fine
-    estimate); no growth is a cache hit.  Falls back to plain timing
-    when the private API is absent."""
+    estimate, plus the triggering arg-shape signature); no growth is a
+    cache hit.  Falls back to plain timing when the private API is
+    absent.  When the device telemetry plane is enabled every dispatch
+    additionally accumulates wall time and bytes in/out per kernel
+    name (``runtime/device_stats.py``)."""
     import jax
+
+    from flink_tpu.runtime.device_stats import TELEMETRY, tree_nbytes
 
     jitted = jax.jit(fn, **jit_kwargs)
     label = name or getattr(fn, "__name__", None) or "jit_fn"
@@ -598,21 +627,36 @@ def traced_jit(fn, name: Optional[str] = None, **jit_kwargs):
 
     def wrapper(*args, **kwargs):
         if cache_size is None:
-            return jitted(*args, **kwargs)
+            if not TELEMETRY.enabled:
+                return jitted(*args, **kwargs)
+            t0 = _perf_ns()
+            out = jitted(*args, **kwargs)
+            TELEMETRY.record_kernel_dispatch(
+                label, (_perf_ns() - t0) / 1e6,
+                tree_nbytes((args, kwargs)), tree_nbytes(out))
+            return out
         before = cache_size()
         t0 = _perf_ns()
         out = jitted(*args, **kwargs)
         if cache_size() > before:
             ms = (_perf_ns() - t0) / 1e6
+            sig = _shape_signature(args, kwargs)
             with _LOCK:
                 stat.recompiles += 1
                 stat.compile_time_ms += ms
+                stat.last_shape_sig = sig
+                stat.shape_sigs.add(sig)
             tracer = _tracer
             if tracer.enabled:
                 tracer.record_instant("jit.compile." + label,
-                                      compile_ms=round(ms, 3))
+                                      compile_ms=round(ms, 3),
+                                      arg_shapes=sig)
         else:
             stat.cache_hits += 1
+        if TELEMETRY.enabled:
+            TELEMETRY.record_kernel_dispatch(
+                label, (_perf_ns() - t0) / 1e6,
+                tree_nbytes((args, kwargs)), tree_nbytes(out))
         return out
 
     wrapper.__name__ = "traced_" + label.replace(".", "_")
@@ -642,6 +686,8 @@ def jit_stats() -> Dict[str, dict]:
                 "recompiles": st.recompiles,
                 "compile_time_ms": st.compile_time_ms,
                 "cache_hits": st.cache_hits,
+                "shape_variants": len(st.shape_sigs),
+                "last_shape_sig": st.last_shape_sig,
             }
     return out
 
@@ -694,6 +740,8 @@ def _add_jit_gauges(group, name: str, stat: _JitStat) -> None:
     g.gauge("recompiles", lambda s=stat: s.recompiles)
     g.gauge("compileTimeMs", lambda s=stat: s.compile_time_ms)
     g.gauge("cacheHits", lambda s=stat: s.cache_hits)
+    g.gauge("shapeVariants", lambda s=stat: len(s.shape_sigs))
+    g.gauge("lastArgShapes", lambda s=stat: s.last_shape_sig)
 
 
 def register_runtime_profile_gauges(registry) -> None:

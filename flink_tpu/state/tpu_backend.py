@@ -26,6 +26,7 @@ from __future__ import annotations
 
 import pickle
 import threading
+import time
 from collections import defaultdict
 from typing import Any, Dict, Iterable, List, Optional, Tuple
 
@@ -63,7 +64,10 @@ from flink_tpu.state.heap_backend import (
     StateTable,
     split_column_by_key_group,
 )
+from flink_tpu.runtime.device_stats import TELEMETRY
 from flink_tpu.state.stats import STATE_STATS, register_device_state
+
+_perf_ns = time.perf_counter_ns
 
 DEFAULT_INITIAL_CAPACITY = 4096
 DEFAULT_MICROBATCH = 16384
@@ -201,8 +205,16 @@ class DeviceAggregatingState(AggregatingState):
         candidates.sort()
         victims = [s for _, s in candidates[:n]]
         idx = np.array(victims, np.int32)
-        host_rows = {name: np.asarray(arr[jnp.asarray(idx)])
-                     for name, arr in self.device_state.items()}
+        if TELEMETRY.enabled:
+            t0 = _perf_ns()
+            host_rows = {name: np.asarray(arr[jnp.asarray(idx)])
+                         for name, arr in self.device_state.items()}
+            TELEMETRY.record_transfer(
+                "d2h", sum(a.nbytes for a in host_rows.values()),
+                t0, _perf_ns(), "state.evict")
+        else:
+            host_rows = {name: np.asarray(arr[jnp.asarray(idx)])
+                         for name, arr in self.device_state.items()}
         for i, s in enumerate(victims):
             entry = self.slot_meta[s]
             self.host_tier[entry] = {name: host_rows[name][i]
@@ -228,9 +240,19 @@ class DeviceAggregatingState(AggregatingState):
         slot = self._free.pop()
         row = self.host_tier[entry]
         with self._device_lock:
-            self.device_state = self._jit_upload(
-                self.device_state, jnp.int32(slot),
-                {name: jnp.asarray(val) for name, val in row.items()})
+            if TELEMETRY.enabled:
+                t0 = _perf_ns()
+                self.device_state = self._jit_upload(
+                    self.device_state, jnp.int32(slot),
+                    {name: jnp.asarray(val) for name, val in row.items()})
+                TELEMETRY.record_transfer(
+                    "h2d",
+                    sum(getattr(v, "nbytes", 0) for v in row.values()),
+                    t0, _perf_ns(), "state.promote")
+            else:
+                self.device_state = self._jit_upload(
+                    self.device_state, jnp.int32(slot),
+                    {name: jnp.asarray(val) for name, val in row.items()})
             del self.host_tier[entry]
             self.slot_index[entry] = slot
             self._slot_flushed[slot] = 1
@@ -343,8 +365,19 @@ class DeviceAggregatingState(AggregatingState):
         else:
             hi = np.zeros(padded, np.uint32)
             lo = np.zeros(padded, np.uint32)
-        self.device_state = self._jit_update(
-            self.device_state, slots, values, hi, lo, mask)
+        if TELEMETRY.enabled:
+            t0 = _perf_ns()
+            self.device_state = self._jit_update(
+                self.device_state, slots, values, hi, lo, mask)
+            TELEMETRY.record_transfer(
+                "h2d",
+                slots.nbytes + mask.nbytes + values.nbytes
+                + hi.nbytes + lo.nbytes,
+                t0, _perf_ns(), "state.flush")
+            TELEMETRY.note_flush(n)
+        else:
+            self.device_state = self._jit_update(
+                self.device_state, slots, values, hi, lo, mask)
         STATE_STATS.note_flush(n)
         for s_ in self._pending_slots:
             self._slot_flushed[s_] = 1
@@ -360,8 +393,18 @@ class DeviceAggregatingState(AggregatingState):
         if slot is None:
             return None
         self._flush()
-        out = np.asarray(self._jit_result(
-            self.device_state, jnp.asarray(np.array([slot], np.int32))))[0]
+        if TELEMETRY.enabled:
+            t0 = _perf_ns()
+            res = np.asarray(self._jit_result(
+                self.device_state, jnp.asarray(np.array([slot], np.int32))))
+            TELEMETRY.record_transfer("d2h", res.nbytes, t0, _perf_ns(),
+                                      "state.fire")
+            TELEMETRY.note_fire_read()
+            out = res[0]
+        else:
+            out = np.asarray(self._jit_result(
+                self.device_state,
+                jnp.asarray(np.array([slot], np.int32))))[0]
         return out.item() if np.ndim(out) == 0 else out
 
     def get_batch(self, keys, namespace, namespaces=None) -> Tuple[np.ndarray, np.ndarray]:
@@ -392,8 +435,16 @@ class DeviceAggregatingState(AggregatingState):
             found.append(s is not None)
             slots.append(s if s is not None else 0)
         self._flush()
-        res = np.asarray(self._jit_result(
-            self.device_state, jnp.asarray(np.array(slots, np.int32))))
+        if TELEMETRY.enabled:
+            t0 = _perf_ns()
+            res = np.asarray(self._jit_result(
+                self.device_state, jnp.asarray(np.array(slots, np.int32))))
+            TELEMETRY.record_transfer("d2h", res.nbytes, t0, _perf_ns(),
+                                      "state.fire")
+            TELEMETRY.note_fire_read()
+        else:
+            res = np.asarray(self._jit_result(
+                self.device_state, jnp.asarray(np.array(slots, np.int32))))
         return res, np.array(found, bool)
 
     def query_by_key(self, key, namespace):
@@ -576,7 +627,16 @@ class DeviceAggregatingState(AggregatingState):
     def snapshot_entries(self) -> Dict[int, List[Tuple[Any, Any, Dict[str, np.ndarray]]]]:
         """Per key group: [(key, namespace, {component: row})]."""
         self._flush()
-        host = {name: np.asarray(arr) for name, arr in self.device_state.items()}
+        if TELEMETRY.enabled:
+            t0 = _perf_ns()
+            host = {name: np.asarray(arr)
+                    for name, arr in self.device_state.items()}
+            TELEMETRY.record_transfer(
+                "d2h", sum(a.nbytes for a in host.values()),
+                t0, _perf_ns(), "state.snapshot")
+        else:
+            host = {name: np.asarray(arr)
+                    for name, arr in self.device_state.items()}
         per_kg: Dict[int, List[Tuple[Any, Any, Dict[str, np.ndarray]]]] = defaultdict(list)
         mp = self._backend.max_parallelism
         for (key, namespace), slot in self.slot_index.items():
@@ -637,8 +697,16 @@ class DeviceAggregatingState(AggregatingState):
             keys.append(key)
             nss.append(namespace)
             slots.append(slot)
-        host = {name: np.asarray(arr)
-                for name, arr in self.device_state.items()}
+        if TELEMETRY.enabled:
+            t0 = _perf_ns()
+            host = {name: np.asarray(arr)
+                    for name, arr in self.device_state.items()}
+            TELEMETRY.record_transfer(
+                "d2h", sum(a.nbytes for a in host.values()),
+                t0, _perf_ns(), "state.snapshot")
+        else:
+            host = {name: np.asarray(arr)
+                    for name, arr in self.device_state.items()}
         idx = np.array(slots, np.int32)
         comps = {name: arr[idx] for name, arr in host.items()}
         if self.host_tier:

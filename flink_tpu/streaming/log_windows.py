@@ -48,11 +48,15 @@ the multi-chip path (parallel/mesh_windows.py).
 
 from __future__ import annotations
 
+import time
 from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
 import flink_tpu.native as nat
+from flink_tpu.runtime.device_stats import TELEMETRY
+
+_perf_ns = time.perf_counter_ns
 from flink_tpu.ops.device_agg import DeviceAggregateFunction, SumAggregate
 from flink_tpu.ops.hashing import split_hash64_np
 from flink_tpu.ops.sketches import (
@@ -270,10 +274,25 @@ class _HllMode:
         # backend (measured 902 ms vs 14 ms for 20 MB — BENCH_NOTES
         # round 4); the put also starts the H2D before dispatch
         dev = jax.devices()[0]
-        out = np.asarray(self._jit_finish(jax.device_put(ranks_p, dev),
-                                          jax.device_put(ends_p, dev),
-                                          np.int32(n_cells),
-                                          np.int32(n_keys)))
+        if TELEMETRY.enabled:
+            t0 = _perf_ns()
+            d_ranks = jax.device_put(ranks_p, dev)
+            d_ends = jax.device_put(ends_p, dev)
+            TELEMETRY.record_transfer(
+                "h2d", ranks_p.nbytes + ends_p.nbytes, t0, _perf_ns(),
+                "log.finish")
+            t1 = _perf_ns()
+            out = np.asarray(self._jit_finish(d_ranks, d_ends,
+                                              np.int32(n_cells),
+                                              np.int32(n_keys)))
+            TELEMETRY.record_transfer("d2h", out.nbytes, t1, _perf_ns(),
+                                      "log.finish")
+            TELEMETRY.note_fire_read()
+        else:
+            out = np.asarray(self._jit_finish(jax.device_put(ranks_p, dev),
+                                              jax.device_put(ends_p, dev),
+                                              np.int32(n_cells),
+                                              np.int32(n_keys)))
         return out[:n_keys].astype(np.float64)
 
 
@@ -498,6 +517,8 @@ class LogStructuredTumblingWindows:
                 continue
             keys, cols = log.concat()
             fired += self._fire_window(keys, cols, start, start + self.size)
+        if TELEMETRY.enabled:
+            TELEMETRY.note_windows_fired(fired)
         return fired
 
     def _fire_window(self, keys, cols, start: int, end: int) -> int:
@@ -829,6 +850,8 @@ class LogStructuredSlidingWindows(LogStructuredTumblingWindows):
             if P + self.window_size - 1 > watermark:
                 break
             del self.windows[P]
+        if TELEMETRY.enabled:
+            TELEMETRY.note_windows_fired(fired)
         return fired
 
 

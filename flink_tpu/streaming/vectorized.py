@@ -25,6 +25,7 @@ stay on the scalar operator (they merge, SURVEY.md §7 hard parts).
 
 from __future__ import annotations
 
+import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import jax
@@ -34,7 +35,10 @@ import numpy as np
 from flink_tpu.core.keygroups import splitmix64_np, stable_hash64
 from flink_tpu.ops.device_agg import DeviceAggregateFunction
 from flink_tpu.ops.hashing import split_hash64_np
+from flink_tpu.runtime.device_stats import TELEMETRY
 from flink_tpu.runtime.tracing import traced_jit
+
+_perf_ns = time.perf_counter_ns
 
 
 def hash_keys_np(keys) -> np.ndarray:
@@ -547,8 +551,17 @@ class VectorizedTumblingWindows:
         else:
             hi = np.zeros(1, np.uint32)
             lo = np.zeros(1, np.uint32)
-        self.state = self._jit_update(self.state, slots, values, hi, lo,
-                                      np.int32(n))
+        if TELEMETRY.enabled:
+            t0 = _perf_ns()
+            self.state = self._jit_update(self.state, slots, values, hi,
+                                          lo, np.int32(n))
+            TELEMETRY.record_transfer(
+                "h2d", slots.nbytes + values.nbytes + hi.nbytes + lo.nbytes,
+                t0, _perf_ns(), "window.flush")
+            TELEMETRY.note_flush(n)
+        else:
+            self.state = self._jit_update(self.state, slots, values, hi,
+                                          lo, np.int32(n))
         self._p_slots.clear()
         self._p_values.clear()
         self._p_hi.clear()
@@ -590,6 +603,8 @@ class VectorizedTumblingWindows:
                 else:
                     self._clear_tiled(slots)
                 self.arena.release(slots)
+        if TELEMETRY.enabled:
+            TELEMETRY.note_windows_fired(fired)
         return fired
 
     def _emit_fire(self, keys, slots: np.ndarray, start: int, end: int,
@@ -613,7 +628,15 @@ class VectorizedTumblingWindows:
             # one fused reduce over the whole state (no slice
             # materialization), one D2H of the per-slot results,
             # host-side fancy index into fire order
-            results = np.asarray(self._jit_result_all(self.state))[slots]
+            if TELEMETRY.enabled:
+                t0 = _perf_ns()
+                res_all = np.asarray(self._jit_result_all(self.state))
+                TELEMETRY.record_transfer("d2h", res_all.nbytes,
+                                          t0, _perf_ns(), "window.fire")
+                TELEMETRY.note_fire_read()
+                results = res_all[slots]
+            else:
+                results = np.asarray(self._jit_result_all(self.state))[slots]
         if self.emit_arrays:
             self.fired.append((keys,
                                results if full
@@ -664,7 +687,15 @@ class VectorizedTumblingWindows:
             # overlap device compute on the async dispatch queue
             futures.append((self._fire_tile_future(chunk, tile),
                             len(chunk)))
-        outs = [np.asarray(f)[:ln] for f, ln in futures]
+        if TELEMETRY.enabled and futures:
+            t0 = _perf_ns()
+            outs = [np.asarray(f)[:ln] for f, ln in futures]
+            TELEMETRY.record_transfer(
+                "d2h", sum(o.nbytes for o in outs), t0, _perf_ns(),
+                "window.fire")
+            TELEMETRY.note_fire_read(len(futures))
+        else:
+            outs = [np.asarray(f)[:ln] for f, ln in futures]
         return np.concatenate(outs).tolist() if outs else []
 
     def _gather_tiled_np(self, slots: np.ndarray) -> np.ndarray:
@@ -675,6 +706,14 @@ class VectorizedTumblingWindows:
             chunk = slots[i:i + tile]
             futures.append((self._fire_tile_future(chunk, tile),
                             len(chunk)))
+        if TELEMETRY.enabled and futures:
+            t0 = _perf_ns()
+            outs = [np.asarray(f)[:ln] for f, ln in futures]
+            TELEMETRY.record_transfer(
+                "d2h", sum(o.nbytes for o in outs), t0, _perf_ns(),
+                "window.fire")
+            TELEMETRY.note_fire_read(len(futures))
+            return np.concatenate(outs)
         return np.concatenate([np.asarray(f)[:ln] for f, ln in futures])
 
     def _clear_tiled(self, slots: np.ndarray) -> None:
@@ -844,6 +883,8 @@ class VectorizedSlidingWindows(_ScratchMergeMixin, VectorizedTumblingWindows):
             self._clear_tiled(union_slots)
             self.arena.release(union_slots)
         self._prune_panes(watermark)
+        if TELEMETRY.enabled:
+            TELEMETRY.note_windows_fired(fired)
         return fired
 
     def _prune_panes(self, watermark: int) -> None:
@@ -922,8 +963,16 @@ def _tumbling_snapshot(self) -> dict:
     the checkpoint, SURVEY §5 checkpoint row); host-side indexes ride
     along as plain arrays."""
     self.flush()
+    if TELEMETRY.enabled:
+        t0 = _perf_ns()
+        host_state = {k: np.asarray(v) for k, v in self.state.items()}
+        TELEMETRY.record_transfer(
+            "d2h", sum(a.nbytes for a in host_state.values()),
+            t0, _perf_ns(), "window.snapshot")
+    else:
+        host_state = {k: np.asarray(v) for k, v in self.state.items()}
     return {
-        "state": {k: np.asarray(v) for k, v in self.state.items()},
+        "state": host_state,
         "capacity": self.capacity,
         "arena": _snapshot_arena(self.arena),
         "watermark": self.watermark,

@@ -32,6 +32,7 @@ from __future__ import annotations
 
 import bisect
 import heapq
+import time
 
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
@@ -41,7 +42,10 @@ import numpy as np
 
 from flink_tpu.ops.device_agg import DeviceAggregateFunction
 from flink_tpu.ops.hashing import split_hash64_np
+from flink_tpu.runtime.device_stats import TELEMETRY
 from flink_tpu.runtime.tracing import traced_jit
+
+_perf_ns = time.perf_counter_ns
 from flink_tpu.streaming.vectorized import (
     _ScratchMergeMixin,
     _SlotArena,
@@ -189,8 +193,18 @@ class VectorizedSessionWindows(_ScratchMergeMixin):
         else:
             hi_p = np.zeros(1, np.uint32)
             lo_p = np.zeros(1, np.uint32)
-        self.state = self._jit_update(self.state, slots_p, vals_p, hi_p,
-                                      lo_p, np.int32(len(rs)))
+        if TELEMETRY.enabled:
+            t0 = _perf_ns()
+            self.state = self._jit_update(self.state, slots_p, vals_p,
+                                          hi_p, lo_p, np.int32(len(rs)))
+            TELEMETRY.record_transfer(
+                "h2d",
+                slots_p.nbytes + vals_p.nbytes + hi_p.nbytes + lo_p.nbytes,
+                t0, _perf_ns(), "session.flush")
+            TELEMETRY.note_flush(len(rs))
+        else:
+            self.state = self._jit_update(self.state, slots_p, vals_p,
+                                          hi_p, lo_p, np.int32(len(rs)))
 
         # 4. merge batch-sessions into the live table (host work is per
         # session, device merges batched)
@@ -270,8 +284,16 @@ class VectorizedSessionWindows(_ScratchMergeMixin):
             return 0
         arr = np.asarray(fire_slots, np.int32)
         padded = pad_pow2(arr, arr[0])
-        results = np.asarray(self._jit_result(self.state,
-                                              jnp.asarray(padded)))[:len(arr)]
+        if TELEMETRY.enabled:
+            t0 = _perf_ns()
+            results = np.asarray(self._jit_result(
+                self.state, jnp.asarray(padded)))[:len(arr)]
+            TELEMETRY.record_transfer("d2h", results.nbytes,
+                                      t0, _perf_ns(), "session.fire")
+            TELEMETRY.note_fire_read()
+        else:
+            results = np.asarray(self._jit_result(
+                self.state, jnp.asarray(padded)))[:len(arr)]
         for (key, start, end), res in zip(fire_meta, results):
             if self.emit is not None:
                 self.emit(key, res, start, end)
@@ -279,6 +301,8 @@ class VectorizedSessionWindows(_ScratchMergeMixin):
                 self.emitted.append((key, res, start, end))
             fired += 1
         self._clear_release(fire_slots)
+        if TELEMETRY.enabled:
+            TELEMETRY.note_windows_fired(fired)
         return fired
 
     def block_until_ready(self) -> None:

@@ -29,6 +29,7 @@ from flink_tpu.core.state import (
     StateDescriptor,
     ValueStateDescriptor,
 )
+from flink_tpu.runtime.device_stats import TELEMETRY
 from flink_tpu.runtime.tracing import get_tracer
 from flink_tpu.streaming.elements import MAX_TIMESTAMP, StreamRecord
 from flink_tpu.streaming.operators import (
@@ -690,6 +691,10 @@ class WindowOperator(AbstractUdfStreamOperator):
         if self._emit_batch_hist is not None:
             self._emit_batch_hist.update(
                 len(contents) if hasattr(contents, "__len__") else 1)
+        if TELEMETRY.enabled:
+            # per-key timer fire: one emitted (key, window) result —
+            # the denominator of the device ledger's transfer-tax ratio
+            TELEMETRY.note_windows_fired(1)
         tracer = get_tracer()
         if tracer.enabled:
             with tracer.span("window.fire"):
