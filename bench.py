@@ -1127,6 +1127,14 @@ def main():
         argv = [a for a in argv if a != "--device-ledger"]
         from flink_tpu.runtime.device_stats import get_telemetry
         get_telemetry().enable()
+    # --flame: attach the sampling profiler for the whole run and ship
+    # the folded collapsed-stack profile (per-vertex tries, on/off-CPU
+    # split) into bench_report.json under "flame"
+    flame = "--flame" in argv
+    if flame:
+        argv = [a for a in argv if a != "--flame"]
+        from flink_tpu.runtime.profiler import get_profiler
+        get_profiler().enable()
     # --chaos-smoke: one seeded chaos case per executor (the
     # tests/test_chaos.py harness), exits non-zero if exactly-once
     # breaks — a quick fault-tolerance gate without the full suite
@@ -1173,6 +1181,15 @@ def main():
         if only and name != only:
             continue
         log(f"[bench] running {name} ...")
+        if flame:
+            # benchmarks drive kernels from this thread directly (no
+            # executor loop to stamp scopes), so attribute the whole
+            # pattern to a synthetic vertex — the folded profile then
+            # reads `<pattern>;frames...`
+            import types as _types
+            from flink_tpu.runtime.profiler import get_profiler
+            get_profiler().set_scope(_types.SimpleNamespace(
+                profiler_scope=("bench", f"0_{name}", 0)))
         t0 = time.perf_counter()
         try:
             out = fn()
@@ -1237,6 +1254,26 @@ def main():
                 f"pack={ph['pack_ms']:.1f}ms h2d={ph['h2d_ms']:.1f}ms "
                 f"collective={ph['collective_ms']:.1f}ms "
                 f"d2h={ph['d2h_ms']:.1f}ms")
+
+    if flame:
+        from flink_tpu.runtime.profiler import collapsed_lines, get_profiler
+        profiler = get_profiler()
+        profiler.disable()
+        export = profiler.export()
+        folded = collapsed_lines(export)
+        results["flame"] = {
+            "hz": export["hz"],
+            "samples": export["samples"],
+            "dropped": export["dropped"],
+            "folded": folded,
+        }
+        log(f"[bench] flame: {export['samples']['total']} samples "
+            f"({export['samples']['on_cpu']} on-CPU / "
+            f"{export['samples']['off_cpu']} off-CPU / "
+            f"{export['samples']['backpressured']} backpressured), "
+            f"{len(folded)} folded stacks"
+            + (f"; {export['dropped']} samples truncated at the node "
+               f"cap" if export["dropped"] else ""))
 
     with open("bench_report.json", "w") as f:
         json.dump(results, f, indent=2)

@@ -201,7 +201,10 @@ def _lint(rest) -> int:
 def _profile(rest) -> int:
     """Run a job script with the tracer attached; on exit write the
     Chrome trace-event file (load in Perfetto / chrome://tracing) and
-    print the per-span and per-kernel summaries to stderr."""
+    print the per-span and per-kernel summaries to stderr.  With
+    --flame the sampling profiler rides along and the folded
+    collapsed-stack profile (flamegraph.pl / speedscope input) is
+    written too."""
     out = "trace.json"
     if "--trace-out" in rest:
         i = rest.index("--trace-out")
@@ -210,18 +213,60 @@ def _profile(rest) -> int:
             return 2
         out = rest[i + 1]
         rest = rest[:i] + rest[i + 2:]
+    flame = "--flame" in rest
+    if flame:
+        rest = [a for a in rest if a != "--flame"]
+    flame_out = "profile.folded"
+    if "--flame-out" in rest:
+        i = rest.index("--flame-out")
+        if i + 1 >= len(rest):
+            print("--flame-out needs a path", file=sys.stderr)
+            return 2
+        flame_out = rest[i + 1]
+        rest = rest[:i] + rest[i + 2:]
+        flame = True
+    flame_hz = 50.0
+    if "--flame-hz" in rest:
+        i = rest.index("--flame-hz")
+        if i + 1 >= len(rest):
+            print("--flame-hz needs a number", file=sys.stderr)
+            return 2
+        try:
+            flame_hz = float(rest[i + 1])
+        except ValueError:
+            print(f"--flame-hz wants a number, got {rest[i + 1]!r}",
+                  file=sys.stderr)
+            return 2
+        rest = rest[:i] + rest[i + 2:]
+        flame = True
     if not rest:
         print("usage: flink_tpu profile <script.py> [args...] "
-              "[--trace-out trace.json]", file=sys.stderr)
+              "[--trace-out trace.json] [--flame] "
+              "[--flame-out profile.folded] [--flame-hz 50]",
+              file=sys.stderr)
         return 2
 
     from flink_tpu.runtime import tracing
     tracer = tracing.get_tracer()
     tracer.enabled = True
+    profiler = None
+    if flame:
+        from flink_tpu.runtime.profiler import get_profiler
+        profiler = get_profiler()
+        profiler.enable(hz=flame_hz)
     sys.argv = rest
     try:
         runpy.run_path(rest[0], run_name="__main__")
     finally:
+        if profiler is not None:
+            profiler.disable()
+            from flink_tpu.runtime.profiler import collapsed_lines
+            folded = collapsed_lines(profiler.export())
+            with open(flame_out, "w") as f:
+                f.write("\n".join(folded) + ("\n" if folded else ""))
+            print(f"-- flame: {sum(profiler.samples)} samples, "
+                  f"{len(folded)} stacks -> {flame_out}",
+                  file=sys.stderr)
         n = tracer.write_chrome_trace(out)
         print(f"-- trace: {n} events -> {out}", file=sys.stderr)
         stats = sorted(tracer.stats().items(),
@@ -246,10 +291,55 @@ def _top_fetch(base, path):
         return _json.loads(resp.read().decode())
 
 
-def _top_rows(job, detail, metrics, prev, dt_s):
+def _top_hot_frames(flame) -> dict:
+    """vertex id -> hottest frame label from a `/flamegraph` payload
+    (max self-samples anywhere in that vertex's subtree); {} when the
+    profiler is off or the server predates the route."""
+    out = {}
+    tree = (flame or {}).get("tree") or {}
+    for child in tree.get("children") or []:
+        try:
+            vid = int(str(child.get("name", "")).split("_", 1)[0])
+        except ValueError:
+            continue
+        from flink_tpu.runtime.profiler import hottest_frame
+        best = hottest_frame(child)
+        if best is not None:
+            out[vid] = best[0]
+    return out
+
+
+def _top_latency_footer(job, metrics) -> str:
+    """One-line end-to-end latency picture from the job's `latency.*`
+    histograms (p50/p95/p99 ms per source→operator pair, worst
+    subtask), or "" when no latency markers flow."""
+    prefix = f"{job}.latency.source_"
+    pairs = {}
+    for k, v in metrics.items():
+        if not k.startswith(prefix) or not isinstance(v, dict):
+            continue
+        if not v.get("count"):
+            continue
+        src, sep, op = k[len(prefix):].partition(".operator_")
+        if not sep:
+            continue
+        src_op = src.rsplit("_", 1)[0]  # strip the subtask index
+        worst = pairs.setdefault((src_op, op), [0.0, 0.0, 0.0])
+        for i, q in enumerate(("p50", "p95", "p99")):
+            val = v.get(q)
+            if isinstance(val, (int, float)):
+                worst[i] = max(worst[i], float(val))
+    if not pairs:
+        return ""
+    parts = [f"{src}→{op} {w[0]:.1f}/{w[1]:.1f}/{w[2]:.1f}"
+             for (src, op), w in sorted(pairs.items())]
+    return "latency ms (p50/p95/p99): " + "; ".join(parts)
+
+
+def _top_rows(job, detail, metrics, prev, dt_s, hot=None):
     """One table row per vertex: records/s (Δ numRecordsOut across the
     vertex's subtasks between refreshes), worst backpressure, max
-    watermarkLag."""
+    watermarkLag, hottest sampled frame."""
     rows = []
     for v in detail.get("vertices") or []:
         prefix = f"{job}.{v['id']}_"
@@ -283,6 +373,7 @@ def _top_rows(job, detail, metrics, prev, dt_s):
             "watermark_lag_ms": max(lags) if lags else None,
             "columnar_ratio": min(col_ratios) if col_ratios else None,
             "columnar_boxed": col_boxed,
+            "hot": (hot or {}).get(v["id"]),
         })
     return rows
 
@@ -358,7 +449,8 @@ def _top_device_footer(metrics, prev=None, dt=0.0) -> str:
 
 
 def _top_render(job, status, rows, checkpoints, alerts,
-                bottleneck=None, state_line="", device_line="") -> str:
+                bottleneck=None, state_line="", device_line="",
+                latency_line="") -> str:
     def fmt(v, spec="{:.0f}", dash="-"):
         return dash if v is None else spec.format(v)
 
@@ -367,7 +459,7 @@ def _top_render(job, status, rows, checkpoints, alerts,
     lines = [f"job: {job}  [{status}]",
              f"{'id':>4}  {'vertex':<36} {'par':>3}  {'rec/s':>10}  "
              f"{'backpressure':<18} {'wmLag ms':>10} {'col%':>6} "
-             f"{'boxed':>6} {'BOTTLENECK':<10}"]
+             f"{'boxed':>6} {'BOTTLENECK':<10} {'HOT':<28}"]
     for r in rows:
         bp = "-"
         if r["bp_ratio"] is not None:
@@ -382,7 +474,8 @@ def _top_render(job, status, rows, checkpoints, alerts,
             f"{fmt(r['parallelism'], '{:d}'):>3}  "
             f"{fmt(r['records_per_s'], '{:,.0f}'):>10}  {bp:<18} "
             f"{fmt(r['watermark_lag_ms'], '{:,.0f}'):>10} {col:>6} "
-            f"{fmt(r.get('columnar_boxed'), '{:,.0f}'):>6} {marker:<10}")
+            f"{fmt(r.get('columnar_boxed'), '{:,.0f}'):>6} {marker:<10} "
+            f"{(r.get('hot') or '-')[:28]:<28}")
     counts = checkpoints.get("counts") or {}
     last = None
     for c in checkpoints.get("history") or []:
@@ -402,6 +495,8 @@ def _top_render(job, status, rows, checkpoints, alerts,
         lines.append(state_line)
     if device_line:
         lines.append(device_line)
+    if latency_line:
+        lines.append(latency_line)
     if bn_vid is not None:
         ups = ", ".join(f"{u.get('name')} ({u.get('ratio', 0) * 100:.0f}%)"
                         for u in bn.get("backpressured_upstreams") or [])
@@ -460,6 +555,10 @@ def _top(rest) -> int:
                 bottleneck = _top_fetch(base, f"/jobs/{q}/bottleneck")
             except OSError:  # pre-bottleneck server: footer reads "none"
                 bottleneck = None
+            try:
+                flame = _top_fetch(base, f"/jobs/{q}/flamegraph")
+            except OSError:  # pre-profiler server: HOT column reads "-"
+                flame = None
             now = time.monotonic()
             if args.once and prev_t is None:
                 # rates need two samples: take a quick second one
@@ -467,12 +566,15 @@ def _top(rest) -> int:
                 time.sleep(min(args.interval, 0.5))
                 continue
             dt = (now - prev_t) if prev_t is not None else 0.0
-            rows = _top_rows(job, detail, metrics, prev_metrics, dt)
+            rows = _top_rows(job, detail, metrics, prev_metrics, dt,
+                             hot=_top_hot_frames(flame))
             out = _top_render(job, detail.get("status"), rows,
                               checkpoints, alerts, bottleneck,
                               state_line=_top_state_footer(full_dump),
                               device_line=_top_device_footer(
-                                  full_dump, prev_full, dt))
+                                  full_dump, prev_full, dt),
+                              latency_line=_top_latency_footer(
+                                  job, metrics))
             if args.once:
                 print(out)
                 return 0

@@ -47,17 +47,21 @@ def sample_backpressure(subtasks_by_vertex: Dict[int, List],
     """`subtasks_by_vertex` is the executor's live map (vertex_id ->
     [SubtaskInstance]).  Returns per-vertex ratios + levels (the
     OperatorBackPressureStats shape)."""
+    from flink_tpu.runtime.profiler import sample_windowed
     counts: Dict[int, List[int]] = {
         vid: [0] * len(sts) for vid, sts in subtasks_by_vertex.items()}
-    for s in range(num_samples):
+
+    def probe(_s: int) -> None:
         for vid, sts in subtasks_by_vertex.items():
             for i, st in enumerate(sts):
                 # reading queue lengths cross-thread is safe (len on
                 # deques); a torn read only perturbs one sample
                 if not st.router.has_capacity():
                     counts[vid][i] += 1
-        if s < num_samples - 1:
-            _time.sleep(delay_s)
+
+    # the profiler owns the tree's one windowed-sampling core; this
+    # sampler only supplies the capacity-predicate probe
+    sample_windowed(probe, num_samples, delay_s)
     out: Dict[int, dict] = {}
     for vid, per_subtask in counts.items():
         ratios = [c / num_samples for c in per_subtask]
@@ -155,7 +159,7 @@ class TimeAccounting:
     construction (the invariant the tests pin)."""
 
     __slots__ = ("busy_ns", "idle_ns", "backpressured_ns", "_last_ns",
-                 "_win_start_ns", "_win", "_rates")
+                 "_win_start_ns", "_win", "_rates", "last_class")
 
     #: refresh the windowed rate gauges at most this often (~5 Hz)
     WINDOW_NS = 200_000_000
@@ -168,6 +172,11 @@ class TimeAccounting:
         self._win_start_ns: Optional[int] = None
         self._win = [0, 0, 0]
         self._rates = (0.0, 0.0, 0.0)
+        #: the class of the most recent observation in the sampling
+        #: profiler's encoding (0 on-CPU/busy, 1 off-CPU/idle,
+        #: 2 backpressured) — read cross-thread by the profiler to
+        #: classify stack samples; None until the first interval
+        self.last_class: Optional[int] = None
 
     def observe(self, made_progress: bool, blocked: bool,
                 now_ns: Optional[int] = None) -> None:
@@ -183,12 +192,15 @@ class TimeAccounting:
         if made_progress:
             self.busy_ns += dt
             self._win[0] += dt
+            self.last_class = 0
         elif blocked:
             self.backpressured_ns += dt
             self._win[2] += dt
+            self.last_class = 2
         else:
             self.idle_ns += dt
             self._win[1] += dt
+            self.last_class = 1
         span = now - self._win_start_ns
         if span >= self.WINDOW_NS:
             # ns-in-bucket / ns-elapsed × 1000 ⇒ ms per second; the

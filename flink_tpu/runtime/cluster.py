@@ -78,6 +78,12 @@ from flink_tpu.runtime.backpressure import (
     read_vertex_stats,
 )
 from flink_tpu.runtime.device_stats import register_device_gauges
+from flink_tpu.runtime.profiler import (
+    empty_export,
+    get_profiler,
+    merge_export,
+    register_profiler_gauges,
+)
 from flink_tpu.runtime.metrics import (
     MetricRegistry,
     register_network_gauges,
@@ -376,7 +382,8 @@ class Dispatcher(RpcEndpoint):
                     exceptions=master.exception_history,
                     upstreams=master.upstreams,
                     trace_buffers=master.trace_buffers,
-                    trace_offsets=master.clock_offsets))
+                    trace_offsets=master.clock_offsets,
+                    profile=master.profile))
 
     def request_job_status(self, job_id: str) -> dict:
         master = self._masters.get(job_id)
@@ -445,7 +452,7 @@ class JobMaster(RpcEndpoint):
 
     RPC_METHODS = ("acknowledge_checkpoint", "decline_checkpoint",
                    "update_task_execution_state", "fetch_restore_state",
-                   "report_metrics", "report_trace")
+                   "report_metrics", "report_trace", "report_profile")
 
     def __init__(self, job_id: str, blob_key: str, graph_blob: bytes,
                  job_config: dict, rpc_service: RpcService):
@@ -481,6 +488,14 @@ class JobMaster(RpcEndpoint):
         #: (report_trace); drained into trace_buffers by the driver's
         #: supervise loop — the cross-process leg of the merged trace
         self._trace_queue: deque = deque()
+        #: profiler trie increments shipped by TaskExecutors
+        #: (report_profile); drained into the merged per-vertex
+        #: ``profile`` export by the driver's supervise loop — the
+        #: cross-process leg of the flame-graph plane
+        self._profile_queue: deque = deque()
+        #: merged flame-graph export (profiler.merge_export over every
+        #: shipped increment); None until the first increment lands
+        self.profile: Optional[dict] = None
         #: lane -> {"events": [...], "anchor": {...}} accumulated
         #: across the job's life (one logical process lane per TM)
         self.trace_buffers: Dict[str, dict] = {}
@@ -579,6 +594,12 @@ class JobMaster(RpcEndpoint):
         batch (events newer than its cursor + its clock anchor); the
         supervise loop folds it into the per-lane merged-trace store."""
         self._trace_queue.append((attempt, lane, payload))
+
+    def report_profile(self, attempt: int, payload: dict) -> None:
+        """A TaskExecutor shipped a flame-graph trie increment (the
+        profiler's delta export); the supervise loop merges it per
+        vertex into the master's accumulated profile."""
+        self._profile_queue.append((attempt, payload))
 
     def locate_bottleneck(self) -> Optional[dict]:
         """Downstream-first walk over the last shipped metrics dump:
@@ -916,6 +937,15 @@ class JobMaster(RpcEndpoint):
                 buf["events"].extend(payload.get("events") or [])
                 del buf["events"][:-8192]  # bounded per lane
 
+        def drain_profiles():
+            while self._profile_queue:
+                att, payload = self._profile_queue.popleft()
+                if att != attempt:
+                    continue
+                if self.profile is None:
+                    self.profile = empty_export()
+                merge_export(self.profile, payload)
+
         def poll_statuses() -> List[dict]:
             statuses = []
             for entry in tm_entries:
@@ -936,6 +966,7 @@ class JobMaster(RpcEndpoint):
                 drain_acks()
                 drain_metrics()
                 drain_traces()
+                drain_profiles()
                 if coordinator is not None:
                     coordinator.maybe_trigger()
                 now = _time.monotonic()
@@ -970,6 +1001,7 @@ class JobMaster(RpcEndpoint):
         drain_acks()
         drain_metrics()
         drain_traces()
+        drain_profiles()
 
         # ---- end-of-job phases: workers stopped, endpoint-threaded --
         for entry in tm_entries:
@@ -1084,6 +1116,9 @@ class _JobAttempt:
         #: tracer ring-buffer shipping cursor (events newer than this
         #: seq ship with the next report_metrics tick)
         self._trace_seq = 0
+        #: the job name scopes this attempt's profiler delta exports
+        #: (the process-wide profiler may hold other jobs' tries)
+        self.job_name: Optional[str] = None
 
     def assign(self, st: SubtaskInstance) -> None:
         self.subtasks.append(st)
@@ -1110,6 +1145,7 @@ class _JobAttempt:
             # spans from this worker thread group under one pid lane in
             # the merged cluster trace
             get_tracer().set_lane(self.lane)
+            profiler = get_profiler()
             while not self._stop.is_set():
                 if self._pause.is_set():
                     self._paused.set()
@@ -1122,6 +1158,8 @@ class _JobAttempt:
                         st.notify_checkpoint_complete(cid)
                 for s in self.coop_sources:
                     if not s.finished:
+                        if profiler.enabled:
+                            profiler.set_scope(s)
                         n = s.source_step(self.SOURCE_BATCH)
                         progress += n
                         observe_subtask(s, n > 0)
@@ -1138,6 +1176,8 @@ class _JobAttempt:
                         finally:
                             s.emission_lock.release()
                 for st in self.non_sources:
+                    if profiler.enabled:
+                        profiler.set_scope(st)
                     n = st.step(self.STEP_BUDGET)
                     progress += n
                     observe_subtask(st, n > 0)
@@ -1173,6 +1213,15 @@ class _JobAttempt:
                                     self._trace_seq = payload["seq"]
                                     self.jm_gateway.tell.report_trace(
                                         self.attempt, self.lane, payload)
+                            except Exception:  # noqa: BLE001
+                                pass
+                        if profiler.enabled:
+                            try:  # ship trie increments (same cadence)
+                                inc = profiler.export(job=self.job_name,
+                                                      delta=True)
+                                if inc["jobs"]:
+                                    self.jm_gateway.tell.report_profile(
+                                        self.attempt, inc)
                             except Exception:  # noqa: BLE001
                                 pass
                 if not progress:
@@ -1248,6 +1297,7 @@ class TaskExecutor(RpcEndpoint):
                                   for a in list(self._attempts.values())])
         register_state_gauges(self.metrics)
         register_device_gauges(self.metrics)
+        register_profiler_gauges(self.metrics)
         self._blob_cache: Dict[str, bytes] = {}
         #: local recovery (ref: TaskLocalStateStore/TaskStateManager):
         #: the last TWO acked snapshots per task (cid -> pickled) —
@@ -1308,17 +1358,22 @@ class TaskExecutor(RpcEndpoint):
         att.jm_gateway = self._rpc.connect(tdd["jm_address"], tdd["jm_name"])
         att.sample_interval_ms = tdd.get("sample_interval_ms")
         att.metrics_registry = self.metrics
+        att.job_name = job_graph.job_name
         mine: Set[Tuple[int, int]] = {tuple(a) for a in tdd["assignments"]}
         job_group = self.metrics.job_group(job_graph.job_name)
         for vid, vertex in job_graph.vertices.items():
             vgroup = job_group.add_group(f"{vid}_{vertex.name}")
             for i in range(vertex.parallelism):
                 if (vid, i) in mine:
-                    att.assign(SubtaskInstance(
+                    st = SubtaskInstance(
                         vertex, i, tdd["state_backend"],
                         tdd["max_parallelism"], att.pts,
                         tdd["channel_capacity"],
-                        metrics_group=vgroup.add_group(str(i))))
+                        metrics_group=vgroup.add_group(str(i)))
+                    # flame-graph attribution, stamped at deploy time
+                    st.profiler_scope = (job_graph.job_name,
+                                         f"{vid}_{vertex.name}", i)
+                    att.assign(st)
         self._wire(att, job_graph, tdd, mine)
 
         for st in att.subtasks:

@@ -45,7 +45,8 @@ def build_archive_summary(job_name: str, state: str,
                           journal=None, evaluator=None,
                           coordinator=None, checkpoints_base: int = 0,
                           exceptions=None, upstreams=None,
-                          trace_buffers=None, trace_offsets=None) -> dict:
+                          trace_buffers=None, trace_offsets=None,
+                          profile=None) -> dict:
     """Assemble the post-mortem REST bundle for one finished job (ref:
     FsJobArchivist.archiveJob collecting every JsonArchivist's
     responses).  Every field mirrors what the live WebMonitor serves
@@ -84,6 +85,19 @@ def build_archive_summary(job_name: str, state: str,
             # includes the link-probe measurement under "link"
             summary["device"] = telemetry.payload()
     except Exception:  # noqa: BLE001 — telemetry must never block archiving
+        pass
+    try:
+        from flink_tpu.runtime.profiler import get_profiler
+        if profile is not None:
+            # cluster: the JobMaster's merged increment store
+            summary["profile"] = profile
+        elif get_profiler().enabled:
+            # in-process executors: freeze the process-wide tries for
+            # this job — the `/jobs/<n>/flamegraph` twin rebuilds the
+            # d3 tree from this with the same builder the live route
+            # uses, so the payloads are identical
+            summary["profile"] = get_profiler().export(job=job_name)
+    except Exception:  # noqa: BLE001 — profiling must never block archiving
         pass
     if upstreams is not None:
         # vertex -> upstream vertices: the bottleneck route replays
@@ -235,6 +249,7 @@ class HistoryServer:
         from flink_tpu.runtime.rest import (
             BadRequest,
             parse_bottleneck_params,
+            parse_flamegraph_params,
             parse_history_params,
         )
         split = urllib.parse.urlsplit(raw_path)
@@ -280,6 +295,18 @@ class HistoryServer:
                 from flink_tpu.runtime.device_stats import DeviceTelemetry
                 device = DeviceTelemetry().payload()
             return device
+        if path.startswith("/jobs/") and path.endswith("/flamegraph"):
+            job = self._find(jobs, path[len("/jobs/"):-len("/flamegraph")])
+            vertex, mode = parse_flamegraph_params(query)
+            from flink_tpu.runtime.profiler import flamegraph_payload
+            name = job.get("job_name") or ""
+            # same builder as the live route: a frozen export in, the
+            # identical d3 payload out (disabled-shape export when the
+            # job archived without a profile)
+            export = job.get("profile") or {"enabled": False,
+                                            "jobs": {}}
+            return flamegraph_payload(export, name, vertex=vertex,
+                                      mode=mode)
         if path.startswith("/jobs/") and path.endswith("/metrics"):
             job = self._find(jobs, path[len("/jobs/"):-len("/metrics")])
             metrics = job.get("metrics") or {}

@@ -64,6 +64,7 @@ from flink_tpu.runtime.failover import (
     region_of,
 )
 from flink_tpu.runtime.device_stats import register_device_gauges
+from flink_tpu.runtime.profiler import get_profiler, register_profiler_gauges
 from flink_tpu.runtime.metrics import (
     LatencyStats,
     MetricRegistry,
@@ -659,6 +660,9 @@ class SubtaskInstance:
 
         def target():
             try:
+                # static profiler attribution for this thread: every
+                # stack sampled here belongs to this source subtask
+                get_profiler().set_scope(self)
                 ctx = self.head.make_context(
                     output=_LockedSourceOutput(self))
                 ctx._checkpoint_lock = self.emission_lock
@@ -1230,6 +1234,7 @@ class LocalExecutor:
         self.metrics = metric_registry or MetricRegistry()
         register_state_gauges(self.metrics)
         register_device_gauges(self.metrics)
+        register_profiler_gauges(self.metrics)
         self.latency_interval_ms = latency_interval_ms
         #: "full" | "region" (ref: FailoverStrategyLoader /
         #: jobmanager.execution.failover-strategy)
@@ -1500,6 +1505,7 @@ class LocalExecutor:
               journal=None, evaluator=None):
         pts = self.pts
         pts_poll = getattr(pts, "fire_due", None)
+        profiler = get_profiler()
         last_latency_emit = _time.monotonic()
         while True:
             if client.cancel_requested:
@@ -1529,6 +1535,8 @@ class LocalExecutor:
             # 1. sources
             for s in coop_sources:
                 if not s.finished:
+                    if profiler.enabled:
+                        profiler.set_scope(s)
                     try:
                         n = s.source_step(self.SOURCE_BATCH)
                     except Exception as e:  # noqa: BLE001
@@ -1554,6 +1562,8 @@ class LocalExecutor:
 
             # 2. operators
             for st in non_sources:
+                if profiler.enabled:
+                    profiler.set_scope(st)
                 try:
                     n = st.step(self.STEP_BUDGET)
                 except Exception as e:  # noqa: BLE001
@@ -1950,6 +1960,11 @@ def build_and_wire_subtasks(job_graph: JobGraph, state_backend: str,
                             latency_stats=latency_stats)
             for i in range(vertex.parallelism)
         ]
+        # stamp attribution for the sampling profiler once at wiring
+        # time — the sampler never derives scope on the hot path
+        for i, st in enumerate(subtasks[vid]):
+            st.profiler_scope = (job_graph.job_name,
+                                 f"{vid}_{vertex.name}", i)
         register_backpressure_gauges(vertex_group, subtasks[vid])
     for edge in job_graph.edges:
         ups = subtasks[edge.source_vertex_id]

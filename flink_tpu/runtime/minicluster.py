@@ -65,6 +65,7 @@ from flink_tpu.runtime.backpressure import (
     observe_threaded_source,
 )
 from flink_tpu.runtime.device_stats import register_device_gauges
+from flink_tpu.runtime.profiler import get_profiler, register_profiler_gauges
 from flink_tpu.runtime.metrics import (
     MetricRegistry,
     register_checkpoint_gauges,
@@ -151,6 +152,7 @@ class TaskManagerRunner:
             # logical process lane: this worker thread's spans group
             # under one pid in the merged cluster trace
             get_tracer().set_lane(f"tm-{self.tm_id}")
+            profiler = get_profiler()
             pts_poll = getattr(self.pts, "fire_due", None)
             while not self._stop.is_set():
                 if self._pause.is_set():
@@ -180,6 +182,8 @@ class TaskManagerRunner:
                                 s.head.output.emit_latency_marker(marker)
                 for s in self.coop_sources:
                     if not s.finished:
+                        if profiler.enabled:
+                            profiler.set_scope(s)
                         n = s.source_step(self.SOURCE_BATCH)
                         progress += n
                         observe_subtask(s, n > 0)
@@ -196,6 +200,8 @@ class TaskManagerRunner:
                         finally:
                             s.emission_lock.release()
                 for st in self.non_sources:
+                    if profiler.enabled:
+                        profiler.set_scope(st)
                     n = st.step(self.STEP_BUDGET)
                     progress += n
                     observe_subtask(st, n > 0)
@@ -244,6 +250,7 @@ class MiniCluster:
         self.metrics = metric_registry or MetricRegistry()
         register_state_gauges(self.metrics)
         register_device_gauges(self.metrics)
+        register_profiler_gauges(self.metrics)
         self.latency_interval_ms = latency_interval_ms
         #: metrics time-series journal cadence (None = disabled)
         self.sample_interval_ms = sample_interval_ms

@@ -85,6 +85,23 @@ def parse_bottleneck_params(query: Dict[str, list]) -> tuple:
                 f"{query['ratio_threshold'][0]!r}") from None
     return busy, ratio
 
+
+def parse_flamegraph_params(query: Dict[str, list]) -> tuple:
+    """Validate `/flamegraph` query params into (vertex, mode); raises
+    BadRequest on garbage.  Shared by the live WebMonitor and the
+    HistoryServer so the two routes cannot diverge."""
+    from flink_tpu.runtime.profiler import MODES
+    vertex = None
+    if "vertex" in query:
+        vertex = query["vertex"][0]
+        if not vertex:
+            raise BadRequest("empty 'vertex' filter")
+    mode = query.get("mode", ["full"])[0]
+    if mode not in MODES:
+        raise BadRequest(
+            f"unknown 'mode' (want one of {'|'.join(MODES)}): {mode!r}")
+    return vertex, mode
+
 #: the dashboard (ref: flink-runtime-web/web-dashboard — scaled to one
 #: dependency-free page over the JSON routes below).  Status colors
 #: always pair with a glyph + label (never color alone); all text
@@ -364,6 +381,22 @@ class WebMonitor:
             # device plane per host, surfaced while the job is tracked
             from flink_tpu.runtime.device_stats import get_telemetry
             return get_telemetry().payload(), "application/json"
+        if path.startswith("/jobs/") and path.endswith("/flamegraph"):
+            job = urllib.parse.unquote(
+                path[len("/jobs/"):-len("/flamegraph")])
+            if job not in self.jobs:
+                raise KeyError(path)
+            vertex, mode = parse_flamegraph_params(query)
+            # the profiler is process-global (like the tracer); the
+            # d3 tree is built by the same function the HistoryServer
+            # twin uses, from the same export shape that archives
+            from flink_tpu.runtime.profiler import (
+                flamegraph_payload,
+                get_profiler,
+            )
+            return (flamegraph_payload(get_profiler().export(job=job),
+                                       job, vertex=vertex, mode=mode),
+                    "application/json")
         if path.startswith("/jobs/") and path.endswith("/metrics"):
             job = urllib.parse.unquote(
                 path[len("/jobs/"):-len("/metrics")])

@@ -1,7 +1,7 @@
 #!/usr/bin/env python
 """CI observability smoke (ci_check.sh stage 4).
 
-Four short end-to-end checks over the observability plane:
+Five short end-to-end checks over the observability plane:
 
 1. a MiniCluster job with metric sampling + checkpointing on: the live
    `/jobs/<name>/metrics/history` route must fill with samples and the
@@ -18,7 +18,11 @@ Four short end-to-end checks over the observability plane:
 4. a windowed job on the TPU state backend with device telemetry on:
    the live `/jobs/<name>/device` route must report non-zero flush,
    H2D-transfer and fire-read counters and the `device.*` gauges must
-   appear in the `/metrics` dump (works under JAX_PLATFORMS=cpu).
+   appear in the `/metrics` dump (works under JAX_PLATFORMS=cpu);
+5. a MiniCluster job with the sampling profiler enabled at 50 Hz: the
+   live `/jobs/<name>/flamegraph` route must serve a non-empty
+   per-vertex d3 tree with nonzero samples, and all three modes
+   (full / on_cpu / off_cpu) must be well-formed.
 
 Exits 0 on success, 1 with a reason on the first failed check.
 """
@@ -261,6 +265,54 @@ def main():
     finally:
         telemetry.disable()
         telemetry.reset()
+
+    # ---- 5. sampling profiler: live flamegraph route fills ----------
+    from flink_tpu.runtime.profiler import get_profiler
+
+    profiler = get_profiler()
+    profiler.enable(hz=50)
+    try:
+        env = StreamExecutionEnvironment()
+        env.set_parallelism(2)
+        env.use_mini_cluster(2)
+        (env.add_source(Slowish(n=2500, delay=0.001))
+            .key_by(lambda v: v % 4)
+            .map(lambda v: sum(range(200)) and v)
+            .add_sink(CollectSink()))
+        client = env.execute_async("smoke-flame")
+        monitor = WebMonitor(env.get_metric_registry()).start()
+        try:
+            monitor.track_job("smoke-flame", client)
+            flame = None
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline:
+                flame = _get(monitor.port, "/jobs/smoke-flame/flamegraph")
+                if (flame.get("samples", {}).get("total", 0) > 0
+                        and flame["tree"]["children"]):
+                    break
+                time.sleep(0.05)
+            check(flame and flame.get("enabled")
+                  and flame["samples"]["total"] > 0,
+                  f"live flamegraph route holds samples "
+                  f"({(flame or {}).get('samples')})")
+            check(bool(flame["tree"]["children"]),
+                  f"flamegraph tree has per-vertex children "
+                  f"({[c['name'] for c in flame['tree']['children']]})")
+            for mode in ("full", "on_cpu", "off_cpu"):
+                body = _get(monitor.port,
+                            f"/jobs/smoke-flame/flamegraph?mode={mode}")
+                ok_shape = (body.get("mode") == mode
+                            and isinstance(body.get("tree"), dict)
+                            and {"name", "value", "children"}
+                            <= set(body["tree"]))
+                check(ok_shape, f"flamegraph mode={mode} is well-formed "
+                                f"(value={body.get('tree', {}).get('value')})")
+            client.wait(timeout=60)
+        finally:
+            monitor.stop()
+    finally:
+        profiler.disable()
+        profiler.reset()
 
     print("observability smoke: PASSED")
     return 0

@@ -841,3 +841,80 @@ def test_cli_top_once(capsys):
         monitor.stop()
     # unreachable endpoint: clean error, not a traceback
     assert cli_main(["top", "http://127.0.0.1:1", "--once"]) == 1
+
+
+# ---------------------------------------------------------------------
+# latency histograms: journal series + `flink_tpu top` footer
+# ---------------------------------------------------------------------
+
+def test_latency_percentiles_reach_journal():
+    """`latency.*` histogram percentiles flatten into the journal like
+    any other dict-valued metric — the end-to-end latency picture
+    survives into `/metrics/history` and the archive."""
+    env = StreamExecutionEnvironment()
+    env.set_latency_tracking_interval(0)  # every executor loop pass
+    env.config.set("metrics.sample.interval.ms", 2)
+    (env.add_source(_Slowish(n=3000, delay=0.0))
+        .key_by(lambda v: v % 2)  # marker crosses an edge
+        .map(lambda v: v + 1)
+        .add_sink(CollectSink()))
+    client = env.execute_async("lat-journal-job")
+    client.wait(timeout=120)
+
+    journal = client.executor_state["journal"]
+    p99_keys = journal.keys("lat-journal-job.latency.*.p99")
+    assert p99_keys, journal.keys("*")[:20]
+    assert all(".latency.source_" in k for k in p99_keys)
+    for k in p99_keys:
+        assert journal.latest(k) >= 0.0
+    # the full percentile set flattens alongside
+    base = p99_keys[0][:-len(".p99")]
+    for q in ("p50", "p95", "count"):
+        assert journal.latest(f"{base}.{q}") is not None
+
+
+def test_top_latency_footer_picks_worst_subtask():
+    from flink_tpu.cli import _top_latency_footer
+    metrics = {
+        "j.latency.source_src_0.operator_op": {
+            "count": 5, "p50": 1.0, "p95": 2.0, "p99": 3.0},
+        "j.latency.source_src_1.operator_op": {
+            "count": 5, "p50": 2.5, "p95": 1.0, "p99": 2.0},
+        # empty histogram: no markers seen yet -> skipped
+        "j.latency.source_src_0.operator_other": {"count": 0},
+        "j.numRecordsOut": 7,
+    }
+    line = _top_latency_footer("j", metrics)
+    # per-quantile max across subtasks of the same source operator
+    assert line == "latency ms (p50/p95/p99): src→op 2.5/2.0/3.0"
+    assert _top_latency_footer("j", {"j.numRecordsOut": 7}) == ""
+
+
+def test_top_hot_frames_and_hot_column_render():
+    from flink_tpu.cli import _top_hot_frames, _top_render
+    from flink_tpu.runtime.profiler import (
+        ON_CPU,
+        flamegraph_payload,
+        get_profiler,
+    )
+    p = get_profiler()
+    p.reset()
+    try:
+        p.ingest("j", "1_map", 0, ["a.py:f", "b.py:g"], ON_CPU)
+        p.ingest("j", "1_map", 0, ["a.py:f", "b.py:g"], ON_CPU)
+        flame = flamegraph_payload(p.export(job="j"), "j")
+    finally:
+        p.reset()
+    hot = _top_hot_frames(flame)
+    assert hot == {1: "b.py:g"}
+    assert _top_hot_frames(None) == {}
+    rows = [{"id": 1, "name": "map", "parallelism": 2,
+             "records_per_s": 10.0, "bp_ratio": None, "bp_level": None,
+             "watermark_lag_ms": None, "columnar_ratio": None,
+             "columnar_boxed": None, "hot": hot.get(1)}]
+    out = _top_render("j", "RUNNING", rows, {}, {},
+                      latency_line="latency ms (p50/p95/p99): s→o "
+                                   "1.0/2.0/3.0")
+    assert "HOT" in out
+    assert "b.py:g" in out
+    assert "latency ms (p50/p95/p99)" in out

@@ -1,0 +1,519 @@
+"""Sampling profiler & flame-graph plane: collapsed-stack folding,
+on/off-CPU classification, bounded tries, the `/jobs/<n>/flamegraph`
+route on the live monitor and the HistoryServer, and the cluster
+increment-shipping merge (ref: runtime/profiler.py — FLIP-165's
+JobVertexThreadInfoTracker / VertexFlameGraphFactory rebuilt)."""
+
+import json
+import threading
+import time
+import types
+import urllib.error
+import urllib.request
+
+import pytest
+
+from flink_tpu.runtime.backpressure import TimeAccounting
+from flink_tpu.runtime.history import FsJobArchivist, HistoryServer
+from flink_tpu.runtime.metrics import MetricRegistry
+from flink_tpu.runtime.profiler import (
+    BACKPRESSURED,
+    OFF_CPU,
+    ON_CPU,
+    SamplingProfiler,
+    classify_subtask,
+    collapsed_lines,
+    empty_export,
+    flamegraph_payload,
+    fold_stack,
+    get_profiler,
+    hottest_frame,
+    merge_export,
+    register_profiler_gauges,
+    sample_windowed,
+)
+from flink_tpu.runtime.rest import WebMonitor
+from flink_tpu.streaming.datastream import StreamExecutionEnvironment
+from flink_tpu.streaming.sources import CollectSink, SourceFunction
+
+
+def _get(port, path):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=5) as r:
+        return json.loads(r.read().decode())
+
+
+def _get_error(port, path):
+    try:
+        _get(port, path)
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read().decode())
+    raise AssertionError(f"expected HTTP error for {path}")
+
+
+@pytest.fixture(autouse=True)
+def _clean_profiler():
+    """The profiler is a process-global singleton — every test starts
+    and leaves it disabled + empty so suites can run in any order."""
+    p = get_profiler()
+    p.disable()
+    p.reset()
+    yield
+    p.disable()
+    p.reset()
+
+
+# ---------------------------------------------------------------------
+# disabled path: one attribute check, nothing else
+# ---------------------------------------------------------------------
+
+def test_disabled_guard_is_near_free():
+    """The hot-path contract: with the profiler off, the per-step cost
+    is ONE attribute read (same bound style as the device ledger's
+    guard test)."""
+    p = get_profiler()
+    assert p.enabled is False
+    n = 200_000
+    best = None
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for _ in range(n):
+            if p.enabled:
+                raise AssertionError("must stay disabled")
+        dt = time.perf_counter() - t0
+        best = dt if best is None else min(best, dt)
+    assert best / n < 1e-6, f"guard cost {best / n * 1e9:.0f} ns"
+
+
+# ---------------------------------------------------------------------
+# folding + classification units (fake frames, fake subtasks)
+# ---------------------------------------------------------------------
+
+class _Code:
+    def __init__(self, filename, name):
+        self.co_filename = filename
+        self.co_name = name
+
+
+class _Frame:
+    def __init__(self, filename, name, back=None):
+        self.f_code = _Code(filename, name)
+        self.f_back = back
+
+
+class _Router:
+    def __init__(self, has_capacity=True):
+        self._cap = has_capacity
+        self.last_blocked_mono = 0.0
+
+    def has_capacity(self):
+        return self._cap
+
+
+def test_fold_stack_root_first():
+    leaf = _Frame("/pkg/mod.py", "inner",
+                  back=_Frame("/pkg/mid.py", "middle",
+                              back=_Frame("/app/top.py", "outer")))
+    assert fold_stack(leaf) == ["top.py:outer", "mid.py:middle",
+                                "mod.py:inner"]
+
+
+def test_fold_stack_depth_cap():
+    frame = None
+    for i in range(300):
+        frame = _Frame("/x/f.py", f"fn{i}", back=frame)
+    folded = fold_stack(frame, limit=64)
+    assert len(folded) == 64
+    # the leaf-most frames are kept (the hot detail)
+    assert folded[-1] == "f.py:fn299"
+
+
+def _subtask(last_class=None, blocked=False):
+    acct = TimeAccounting()
+    acct.last_class = last_class
+    return types.SimpleNamespace(
+        router=_Router(has_capacity=not blocked),
+        time_accounting=acct)
+
+
+def test_classify_live_block_wins():
+    assert classify_subtask(_subtask(last_class=0, blocked=True)) \
+        == BACKPRESSURED
+
+
+def test_classify_from_time_accounting():
+    assert classify_subtask(_subtask(last_class=0)) == ON_CPU
+    assert classify_subtask(_subtask(last_class=1)) == OFF_CPU
+    assert classify_subtask(_subtask(last_class=2)) == BACKPRESSURED
+    # unknown state reads as on-CPU (the thread was caught running)
+    assert classify_subtask(_subtask(last_class=None)) == ON_CPU
+    assert classify_subtask(types.SimpleNamespace()) == ON_CPU
+
+
+def test_time_accounting_tracks_last_class():
+    acct = TimeAccounting()
+    assert acct.last_class is None
+    acct.observe(True, False, now_ns=1_000)
+    assert acct.last_class is None  # first interval only anchors
+    acct.observe(True, False, now_ns=2_000)
+    assert acct.last_class == 0
+    acct.observe(False, True, now_ns=3_000)
+    assert acct.last_class == 2
+    acct.observe(False, False, now_ns=4_000)
+    assert acct.last_class == 1
+
+
+def test_sample_windowed_is_the_window_core():
+    seen = []
+    n = sample_windowed(seen.append, num_samples=5, delay_s=0.0)
+    assert n == 5 and seen == [0, 1, 2, 3, 4]
+
+
+# ---------------------------------------------------------------------
+# trie folding, modes, caps
+# ---------------------------------------------------------------------
+
+def test_mode_filtering():
+    p = get_profiler()
+    p.ingest("j", "1_map", 0, ["a.py:f", "b.py:g"], ON_CPU)
+    p.ingest("j", "1_map", 0, ["a.py:f", "b.py:g"], ON_CPU)
+    p.ingest("j", "1_map", 0, ["a.py:f", "c.py:h"], OFF_CPU)
+    p.ingest("j", "1_map", 1, ["a.py:f"], BACKPRESSURED)
+    exp = p.export(job="j")
+
+    full = flamegraph_payload(exp, "j", mode="full")
+    assert full["tree"]["value"] == 4
+    assert full["samples"] == {"total": 4, "on_cpu": 2, "off_cpu": 1,
+                               "backpressured": 1}
+
+    on = flamegraph_payload(exp, "j", mode="on_cpu")
+    assert on["tree"]["value"] == 2
+    # the off-CPU-only branch is pruned from the on-CPU tree
+    vtx = on["tree"]["children"][0]
+    frames = {c["name"] for c in vtx["children"][0]["children"]}
+    assert frames == {"b.py:g"}
+
+    off = flamegraph_payload(exp, "j", mode="off_cpu")
+    assert off["tree"]["value"] == 2  # OFF_CPU + BACKPRESSURED
+    # the per-class split is reported regardless of mode
+    assert off["samples"]["total"] == 4
+
+
+def test_vertex_filter_and_subtask_counts():
+    p = get_profiler()
+    p.ingest("j", "1_map", 0, ["a.py:f"], ON_CPU)
+    p.ingest("j", "2_sink", 0, ["a.py:f"], OFF_CPU)
+    exp = p.export(job="j")
+    by_label = flamegraph_payload(exp, "j", vertex="2_sink")
+    by_id = flamegraph_payload(exp, "j", vertex="2")
+    by_name = flamegraph_payload(exp, "j", vertex="sink")
+    assert (by_label["tree"]["value"] == by_id["tree"]["value"]
+            == by_name["tree"]["value"] == 1)
+    assert by_id["samples"] == {"total": 1, "on_cpu": 0, "off_cpu": 1,
+                                "backpressured": 0}
+    assert exp["jobs"]["j"]["1_map"]["subtasks"] == {"0": [1, 0, 0]}
+
+
+def test_trie_cap_and_dropped_counter():
+    p = get_profiler()
+    p.max_nodes = 8
+    for i in range(40):
+        p.ingest("j", "0_v", 0, [f"m{i}.py:a", f"m{i}.py:b"], ON_CPU)
+    assert p._node_count <= 8
+    assert p.dropped > 0
+    exp = p.export(job="j")
+    assert exp["dropped"] == p.dropped
+    # every sample is still counted — truncated, never lost
+    assert exp["samples"]["total"] == 40
+    assert flamegraph_payload(exp, "j")["tree"]["value"] == 40
+
+
+def test_collapsed_lines_and_hottest_frame():
+    p = get_profiler()
+    p.ingest("j", "1_map", 0, ["a.py:f", "b.py:g"], ON_CPU)
+    p.ingest("j", "1_map", 0, ["a.py:f", "b.py:g"], ON_CPU)
+    p.ingest("j", "1_map", 0, ["a.py:f"], OFF_CPU)
+    lines = collapsed_lines(p.export(job="j"))
+    assert "1_map;a.py:f;b.py:g 2" in lines
+    assert "1_map;a.py:f 1" in lines
+    tree = flamegraph_payload(p.export(job="j"), "j")["tree"]
+    assert hottest_frame(tree) == ("b.py:g", 2)
+
+
+# ---------------------------------------------------------------------
+# live sampling of a registered thread
+# ---------------------------------------------------------------------
+
+def test_sampler_attributes_registered_thread():
+    p = get_profiler()
+    st = types.SimpleNamespace(profiler_scope=("live-job", "0_src", 0),
+                               router=None, time_accounting=None)
+    stop = threading.Event()
+
+    def busy():
+        p.set_scope(st)
+        while not stop.is_set():
+            time.sleep(0.001)
+
+    t = threading.Thread(target=busy, daemon=True)
+    t.start()
+    p.enable(hz=200)
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline and sum(p.samples) == 0:
+        time.sleep(0.01)
+    stop.set()
+    t.join()
+    p.disable()
+    payload = flamegraph_payload(p.export(job="live-job"), "live-job")
+    assert payload["samples"]["total"] > 0
+    assert payload["tree"]["children"][0]["name"] == "0_src"
+    # the dead thread's scope registration is pruned by the sampler
+    p.enable(hz=200)
+    time.sleep(0.05)
+    p.disable()
+    assert t.ident not in p._scopes
+
+
+# ---------------------------------------------------------------------
+# delta export + cluster merge
+# ---------------------------------------------------------------------
+
+def test_delta_export_and_merge_reconstructs_full_tree():
+    p = get_profiler()
+    dst = empty_export()
+    p.ingest("j", "1_map", 0, ["a.py:f", "b.py:g"], ON_CPU)
+    merge_export(dst, p.export(job="j", delta=True))
+    p.ingest("j", "1_map", 0, ["a.py:f", "b.py:g"], OFF_CPU)
+    p.ingest("j", "1_map", 1, ["a.py:f"], BACKPRESSURED)
+    merge_export(dst, p.export(job="j", delta=True))
+    # nothing new: the delta is empty and merging it is a no-op
+    inc = p.export(job="j", delta=True)
+    assert inc["jobs"] == {} and inc["samples"]["total"] == 0
+    merge_export(dst, inc)
+
+    full = flamegraph_payload(p.export(job="j"), "j")
+    merged = flamegraph_payload(dst, "j")
+    assert merged["tree"] == full["tree"]
+    assert merged["samples"] == full["samples"]
+    assert dst["jobs"]["j"]["1_map"]["subtasks"] == {
+        "0": [1, 1, 0], "1": [0, 0, 1]}
+
+
+def test_report_profile_rpc_merges_on_jobmaster():
+    """Unit-level increment shipping: report_profile enqueues, the
+    supervise drain merges per vertex (exercised here through the same
+    merge the drain calls)."""
+    from flink_tpu.runtime.cluster import JobMaster
+    assert "report_profile" in JobMaster.RPC_METHODS
+    p = get_profiler()
+    p.ingest("j", "1_map", 0, ["a.py:f"], ON_CPU)
+    inc1 = p.export(job="j", delta=True)
+    p.ingest("j", "1_map", 0, ["a.py:f"], ON_CPU)
+    inc2 = p.export(job="j", delta=True)
+    store = empty_export()
+    merge_export(store, inc1)
+    merge_export(store, inc2)
+    assert store["jobs"]["j"]["1_map"]["root"][
+        "children"]["a.py:f"]["counts"] == [2, 0, 0]
+    assert store["samples"]["total"] == 2
+
+
+# ---------------------------------------------------------------------
+# REST routes: live 404/400, gauges
+# ---------------------------------------------------------------------
+
+class _FakeClient:
+    executor_state = None
+
+    def job_status(self):
+        return {"state": "RUNNING"}
+
+
+def test_flamegraph_route_errors_and_disabled_shape():
+    registry = MetricRegistry()
+    monitor = WebMonitor(registry).start()
+    try:
+        monitor.track_job("real-job", _FakeClient())
+        assert _get_error(monitor.port, "/jobs/nope/flamegraph")[0] == 404
+        code, body = _get_error(
+            monitor.port, "/jobs/real-job/flamegraph?mode=sideways")
+        assert code == 400 and "mode" in body["error"]
+        code, _ = _get_error(
+            monitor.port, "/jobs/real-job/flamegraph?vertex=")
+        assert code == 400
+        body = _get(monitor.port, "/jobs/real-job/flamegraph")
+        assert body["enabled"] is False
+        assert body["samples"]["total"] == 0
+        assert body["tree"] == {"name": "real-job", "value": 0,
+                                "self": 0, "children": []}
+    finally:
+        monitor.stop()
+
+
+def test_profiler_gauges_registered_and_journaled():
+    registry = MetricRegistry()
+    register_profiler_gauges(registry)
+    dump = registry.dump()
+    assert dump["profiler.enabled"] == 0
+    assert dump["profiler.samples"] == 0.0
+    p = get_profiler()
+    p.ingest("j", "1_map", 0, ["a.py:f"], ON_CPU)
+    p.ingest("j", "1_map", 0, ["a.py:f"], BACKPRESSURED)
+    dump = registry.dump()
+    assert dump["profiler.samples"] == 2.0
+    assert dump["profiler.on_cpu"] == 1.0
+    assert dump["profiler.backpressured"] == 1.0
+    assert dump["profiler.dropped"] == 0.0
+
+
+# ---------------------------------------------------------------------
+# end-to-end: MiniCluster live route + HistoryServer twin parity
+# ---------------------------------------------------------------------
+
+class _Slowish(SourceFunction):
+    def __init__(self, n, delay):
+        self.n = n
+        self.delay = delay
+        self._running = True
+
+    def run(self, ctx):
+        for i in range(self.n):
+            if not self._running:
+                return
+            ctx.collect(i)
+            if self.delay:
+                time.sleep(self.delay)
+
+    def cancel(self):
+        self._running = False
+
+
+def _wait_for_archive(directory, timeout=15.0):
+    import os
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if os.path.isdir(directory) and any(
+                not f.endswith(".part") for f in os.listdir(directory)):
+            return
+        time.sleep(0.05)
+    raise AssertionError(f"no archive appeared in {directory}")
+
+
+def test_live_and_history_flamegraph_payload_parity(tmp_path):
+    """The acceptance invariant: enabled at sampling rate, the live
+    `/flamegraph` route serves a non-empty tree for a MiniCluster job
+    and the HistoryServer serves the identical frozen payload after
+    archive (same builder, same export)."""
+    archive = str(tmp_path / "archive")
+    p = get_profiler()
+    p.enable(hz=100)
+    env = StreamExecutionEnvironment()
+    env.set_parallelism(2)
+    env.use_mini_cluster(2)
+    env.config.set("history.archive.dir", archive)
+    (env.add_source(_Slowish(n=4000, delay=0.0005))
+        .key_by(lambda v: v % 4)
+        .map(lambda v: sum(range(150)) and v)
+        .add_sink(CollectSink()))
+    client = env.execute_async("flame-job")
+    monitor = WebMonitor(env.get_metric_registry()).start()
+    try:
+        monitor.track_job("flame-job", client)
+        live_running = None
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            live_running = _get(monitor.port, "/jobs/flame-job/flamegraph")
+            if (live_running["samples"]["total"] > 0
+                    and live_running["tree"]["children"]):
+                break
+            time.sleep(0.02)
+        assert live_running["enabled"] is True
+        assert live_running["samples"]["total"] > 0, \
+            "no samples while the job ran"
+        client.wait(timeout=120)
+        _wait_for_archive(archive)
+        live = _get(monitor.port, "/jobs/flame-job/flamegraph")
+        live_on = _get(monitor.port,
+                       "/jobs/flame-job/flamegraph?mode=on_cpu")
+    finally:
+        monitor.stop()
+    # the enabled-at-50Hz acceptance: a non-empty on/off-CPU split
+    assert live["samples"]["total"] > 0
+    assert live["samples"]["on_cpu"] + live["samples"]["off_cpu"] \
+        + live["samples"]["backpressured"] == live["samples"]["total"]
+    assert live["tree"]["children"], "per-vertex subtrees expected"
+
+    hs = HistoryServer([archive]).start()
+    try:
+        arch = _get(hs.port, "/jobs/flame-job/flamegraph")
+        assert arch == live, "archived payload must be identical"
+        arch_on = _get(hs.port, "/jobs/flame-job/flamegraph?mode=on_cpu")
+        assert arch_on == live_on
+        # shared validator: the twin 400s the same way
+        code, _ = _get_error(hs.port,
+                             "/jobs/flame-job/flamegraph?mode=nope")
+        assert code == 400
+        assert _get_error(hs.port, "/jobs/nope/flamegraph")[0] == 404
+    finally:
+        hs.stop()
+
+
+def test_history_flamegraph_disabled_shape_without_archive_field(
+        tmp_path):
+    FsJobArchivist.archive(str(tmp_path), "job-1", {
+        "job_name": "old-job", "state": "FINISHED"})
+    hs = HistoryServer([str(tmp_path)]).start()
+    try:
+        body = _get(hs.port, "/jobs/old-job/flamegraph")
+        assert body["enabled"] is False
+        assert body["samples"]["total"] == 0
+        assert body["tree"]["children"] == []
+    finally:
+        hs.stop()
+
+
+# ---------------------------------------------------------------------
+# cluster mode: TaskExecutors ship trie increments to the JobMaster
+# ---------------------------------------------------------------------
+
+def test_cluster_profile_shipping_and_merged_archive(tmp_path):
+    """With the profiler on, workers ship trie increments alongside
+    the report_metrics cadence; the JobMaster merges them per vertex
+    and the Dispatcher freezes the merged export into the archive the
+    HistoryServer twin serves."""
+    from flink_tpu.runtime.cluster import (
+        JobManagerProcess,
+        TaskManagerProcess,
+    )
+    archive = str(tmp_path / "archive")
+    jm = JobManagerProcess(archive_dir=archive)
+    tms = [TaskManagerProcess(jm_address=jm.address, num_slots=2)
+           for _ in range(2)]
+    p = get_profiler()
+    p.enable(hz=250)
+    try:
+        env = StreamExecutionEnvironment()
+        env.set_parallelism(2)
+        env.config.set("metrics.sample.interval.ms", 10)
+        env.use_remote_cluster(jm.address)
+        (env.from_collection(range(20000))
+            .key_by(lambda v: v % 4)
+            .map(lambda v: sum(range(100)) and v)
+            .add_sink(CollectSink()))
+        env.execute("cluster-flame-job")
+
+        _wait_for_archive(archive)
+        hs = HistoryServer([archive]).start()
+        try:
+            body = _get(hs.port, "/jobs/cluster-flame-job/flamegraph")
+            assert body["samples"]["total"] > 0, \
+                "workers should have shipped trie increments"
+            assert body["tree"]["children"]
+            labels = {c["name"] for c in body["tree"]["children"]}
+            assert any("_" in lbl for lbl in labels), labels
+        finally:
+            hs.stop()
+    finally:
+        for tm in tms:
+            tm.stop()
+        jm.stop()
