@@ -80,28 +80,51 @@ def operator_batch_report(op) -> Tuple[str, str]:
     return BOXED, f"no batch kernel on {type(op).__name__}"
 
 
+def operator_decided_by(op) -> str:
+    """Who decided this operator's column-kernel path so far:
+    ``"static"`` (type-flow verdict, probe-free), ``"probe"``
+    (first-batch probe), ``"pending"`` (kernel-eligible but no batch
+    seen yet; "static" when the typeflow stamp guarantees the probe
+    will be skipped), or ``""`` for operators without a kernel path."""
+    from flink_tpu.streaming.operators import _ColumnKernelMixin
+    if not isinstance(op, _ColumnKernelMixin):
+        return ""
+    decided = getattr(op, "columnar_decided_by", None)
+    if decided:
+        return decided
+    if getattr(op, "_static_kernel", False):
+        return "static"
+    mode, _ = operator_batch_report(op)
+    return "pending" if mode == KERNEL else ""
+
+
 def chain_report(operators: List) -> dict:
     """Columnar eligibility of one operator chain (head first):
-    ``{"modes": [(name, mode, reason)...], "eligible": bool,
-    "first_blocker": name | None, "prefix_len": int}``.
+    ``{"modes": [(name, mode, reason)...], "decided_by": [...],
+    "eligible": bool, "first_blocker": name | None,
+    "prefix_len": int}``.
 
     ``eligible`` means the HEAD consumes batches (so a batch-mode
     subscription pays off at all); ``prefix_len`` counts how many
     operators a batch survives before the first boxed hop reboxes it;
-    ``first_blocker`` names that hop."""
+    ``first_blocker`` names that hop.  ``decided_by`` parallels
+    ``modes``: per-operator :func:`operator_decided_by`."""
     modes = []
+    decided_by = []
     first_blocker: Optional[str] = None
     prefix = 0
     for op in operators:
         mode, reason = operator_batch_report(op)
         name = type(op).__name__
         modes.append((name, mode, reason))
+        decided_by.append(operator_decided_by(op))
         if mode == BOXED and first_blocker is None:
             first_blocker = name
         elif first_blocker is None:
             prefix += 1
     return {
         "modes": modes,
+        "decided_by": decided_by,
         "eligible": bool(modes) and modes[0][1] != BOXED,
         "first_blocker": first_blocker,
         "prefix_len": prefix,

@@ -53,6 +53,11 @@ CODES: Dict[str, tuple] = {
     "FT182": (INFO, "aggregate proven liftable; runtime probe will be skipped"),
     "FT183": (WARNING, "impure map/filter/reduce function"),
     "FT184": (INFO, "columnar batch eligibility of an operator chain"),
+    # --- column type flow (pass 3) ----------------------------------
+    "FT185": (WARNING, "exchange edge conclusively demotes to the pickle wire tier"),
+    "FT186": (WARNING, "dtype-overflow hazard in a lifted kernel"),
+    "FT187": (WARNING, "predicted device state footprint exceeds the slot budget"),
+    "FT188": (WARNING, "schema conflict at a union/merge point"),
     # --- pre-flight construction / linter self-errors ---------------
     "FT190": (ERROR, "operator factory raised during pre-flight construction"),
     "FT199": (INFO, "linter check skipped (internal error)"),

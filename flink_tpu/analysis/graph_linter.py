@@ -33,16 +33,27 @@ from flink_tpu.analysis.liftability import (
 log = logging.getLogger("flink_tpu.lint")
 
 
-def lint_graph(graph, config=None, env=None) -> Diagnostics:
-    """Run all pre-flight checks over a StreamGraph."""
-    return _GraphLinter(graph, config=config, env=env).run()
+def lint_graph(graph, config=None, env=None,
+               types: bool = False) -> Diagnostics:
+    """Run all pre-flight checks over a StreamGraph.
+
+    With ``types=True`` the column type-flow prover (pass 3,
+    :mod:`~flink_tpu.analysis.typeflow`) also runs: its FT185–FT188
+    findings land in the returned report, and the full
+    :class:`~flink_tpu.analysis.typeflow.TypeflowReport` is attached
+    as ``report.typeflow`` for callers that want the per-edge schema
+    dump or to feed verdicts into the runtime."""
+    return _GraphLinter(graph, config=config, env=env,
+                        types=types).run()
 
 
 class _GraphLinter:
-    def __init__(self, graph, config=None, env=None):
+    def __init__(self, graph, config=None, env=None, types=False):
         self.graph = graph
         self.config = config
         self.env = env
+        self.types = types
+        self.typeflow = None
         self.report = Diagnostics(
             job_name=getattr(graph, "job_name", None))
         #: node_id -> operator instance (from the node's factory), or
@@ -91,6 +102,7 @@ class _GraphLinter:
             self._check_unbounded_state,
             self._check_timestamps,
             self._check_liftability,
+            self._check_typeflow,
             self._check_columnar,
         )
         for check in checks:
@@ -484,6 +496,23 @@ class _GraphLinter:
                              "recovery re-processes records after the "
                              "last checkpoint")
 
+    def _check_typeflow(self):
+        """Pass 3 (opt-in via ``types=True`` / lint.types.mode): run
+        the whole-graph column type-flow prover, fold its FT185–FT188
+        findings into this report, and keep the full
+        :class:`~flink_tpu.analysis.typeflow.TypeflowReport` around
+        as ``report.typeflow`` (per-edge schemas for the CLI's
+        ``--json`` dump, FT184 enrichment below, and
+        :func:`~flink_tpu.analysis.typeflow.apply_static`)."""
+        if not self.types:
+            return
+        from flink_tpu.analysis.typeflow import analyze_graph
+        tf = analyze_graph(self.graph, config=self.config,
+                           ops=self.ops)
+        self.typeflow = tf
+        self.report.typeflow = tf
+        self.report.extend(tf.diagnostics)
+
     def _check_columnar(self):
         """FT184: per-chain columnar eligibility (informational).
 
@@ -526,11 +555,23 @@ class _GraphLinter:
             elif rep["eligible"]:
                 blocker_i = rep["prefix_len"]
                 _, _, reason = rep["modes"][blocker_i]
+                edge_info = ""
+                if self.typeflow is not None and blocker_i > 0:
+                    # name the exact edge/dtype the batch dies on:
+                    # the schema leaving the last columnar operator
+                    prev = chain_nodes[blocker_i - 1]
+                    schema = self.typeflow.node_schemas.get(prev.id)
+                    if schema is not None and schema.conclusive:
+                        edge_info = (
+                            f" — boxing the edge '{prev.name}' -> "
+                            f"'{chain_nodes[blocker_i].name}' carrying "
+                            f"{schema.describe()}")
                 self._diag(
                     "FT184",
                     f"chain [{names}] rides columns for "
                     f"{rep['prefix_len']} of {len(ops)} operators, then "
-                    f"boxes at '{chain_nodes[blocker_i].name}': {reason}",
+                    f"boxes at '{chain_nodes[blocker_i].name}': "
+                    f"{reason}{edge_info}",
                     node=chain_nodes[blocker_i],
                     hint="operators past the first boxing point pay "
                          "per-record StreamRecord costs")

@@ -81,9 +81,14 @@ class ScriptLintResult:
         return total
 
 
-def lint_script(path: str, argv: Optional[List[str]] = None
-                ) -> ScriptLintResult:
-    """Capture-and-validate run of one job script (see module doc)."""
+def lint_script(path: str, argv: Optional[List[str]] = None,
+                types: bool = False) -> ScriptLintResult:
+    """Capture-and-validate run of one job script (see module doc).
+
+    With ``types=True`` (``flink_tpu lint --types``) the column
+    type-flow prover also runs per environment: FT185–FT188 findings
+    join each report and the per-edge schema dump rides along as
+    ``report.typeflow`` (surfaced by the CLI's ``--json``)."""
     from flink_tpu.streaming.datastream import StreamExecutionEnvironment
 
     captured: List[Any] = []
@@ -126,7 +131,7 @@ def lint_script(path: str, argv: Optional[List[str]] = None
         if not env.graph.nodes:
             continue  # constructed but never populated
         try:
-            report = env.validate()
+            report = env.validate(types=types)
         except Exception as e:  # noqa: BLE001
             report = Diagnostics(job_name=env.graph.job_name)
             report.add("FT199", f"validation crashed: {e!r}")

@@ -114,7 +114,7 @@ def _lint(rest) -> int:
     import json as _json
     import os
 
-    strict = json_out = check_imports = False
+    strict = json_out = check_imports = types = False
     args = []
     for a in rest:
         if a == "--strict":
@@ -123,10 +123,12 @@ def _lint(rest) -> int:
             json_out = True
         elif a == "--check-imports":
             check_imports = True
+        elif a == "--types":
+            types = True
         else:
             args.append(a)
     if not args:
-        print("usage: flink_tpu lint [--strict] [--json] "
+        print("usage: flink_tpu lint [--strict] [--json] [--types] "
               "[--check-imports] <script.py|dir> [script args...]",
               file=sys.stderr)
         return 2
@@ -153,18 +155,25 @@ def _lint(rest) -> int:
             # the linted script's own prints must not corrupt the
             # machine-readable payload on stdout
             with contextlib.redirect_stdout(sys.stderr):
-                res = lint_script(script, script_args)
+                res = lint_script(script, script_args, types=types)
         else:
-            res = lint_script(script, script_args)
+            res = lint_script(script, script_args, types=types)
         c = res.counts()
         total_errors += c["error"]
         total_warnings += c["warning"]
         if json_out:
+            jobs = []
+            for _, report in res.reports:
+                j = report.to_dict()
+                tf = getattr(report, "typeflow", None)
+                if tf is not None:
+                    j["typeflow"] = tf.to_dict()
+                jobs.append(j)
             payload.append({
                 "script": script,
                 "script_error": (repr(res.script_error)
                                  if res.script_error else None),
-                "jobs": [r.to_dict() for _, r in res.reports],
+                "jobs": jobs,
             })
             continue
         print(f"== {script}")
@@ -175,6 +184,16 @@ def _lint(rest) -> int:
             print("   (no topology captured)")
         for _, report in res.reports:
             print("   " + report.render().replace("\n", "\n   "))
+            tf = getattr(report, "typeflow", None)
+            if tf is not None:
+                s = tf.summary()
+                print(f"   typeflow: {s['edges_conclusive']}/"
+                      f"{s['edges_total']} edges conclusive, "
+                      f"{s['kernels_proven']}/{s['kernels_total']} "
+                      f"kernels proven probe-free, "
+                      f"{s['pickle_edges']} pickle-tier exchange "
+                      f"edge(s), predicted state "
+                      f"{s['predicted_state_bytes']} B")
 
     imports_rc = 0
     if check_imports:
@@ -448,9 +467,46 @@ def _top_device_footer(metrics, prev=None, dt=0.0) -> str:
     return line
 
 
+def _top_typeflow_footer(job, metrics) -> str:
+    """One-line type-flow picture: the AOT `typeflow.*` summary
+    gauges plus the live probe-free story from the per-operator
+    `columnar.decided_by` / `columnar.probes` gauges.  "" when the
+    prover never ran and no kernel has decided yet."""
+    def g(key):
+        v = metrics.get(f"{job}.typeflow.{key}")
+        return v if isinstance(v, (int, float)) else None
+
+    static = probed = 0
+    probes = 0.0
+    for k, v in metrics.items():
+        if not k.startswith(f"{job}."):
+            continue
+        if k.endswith(".columnar.decided_by"):
+            if v == "static":
+                static += 1
+            elif v == "probe":
+                probed += 1
+        elif k.endswith(".columnar.probes") \
+                and isinstance(v, (int, float)):
+            probes += v
+    if g("edges_total") is None and not (static or probed or probes):
+        return ""
+    parts = []
+    if g("edges_total") is not None:
+        parts.append(f"{g('edges_conclusive') or 0:,.0f}/"
+                     f"{g('edges_total'):,.0f} edges conclusive")
+        parts.append(f"{g('kernels_proven') or 0:,.0f}/"
+                     f"{g('kernels_total') or 0:,.0f} kernels proven")
+        if g("pickle_edges"):
+            parts.append(f"{g('pickle_edges'):,.0f} pickle edge(s)")
+    parts.append(f"kernels decided static {static} / probe {probed}, "
+                 f"probes run {probes:,.0f}")
+    return "typeflow: " + ", ".join(parts)
+
+
 def _top_render(job, status, rows, checkpoints, alerts,
                 bottleneck=None, state_line="", device_line="",
-                latency_line="") -> str:
+                latency_line="", typeflow_line="") -> str:
     def fmt(v, spec="{:.0f}", dash="-"):
         return dash if v is None else spec.format(v)
 
@@ -497,6 +553,8 @@ def _top_render(job, status, rows, checkpoints, alerts,
         lines.append(device_line)
     if latency_line:
         lines.append(latency_line)
+    if typeflow_line:
+        lines.append(typeflow_line)
     if bn_vid is not None:
         ups = ", ".join(f"{u.get('name')} ({u.get('ratio', 0) * 100:.0f}%)"
                         for u in bn.get("backpressured_upstreams") or [])
@@ -574,6 +632,8 @@ def _top(rest) -> int:
                               device_line=_top_device_footer(
                                   full_dump, prev_full, dt),
                               latency_line=_top_latency_footer(
+                                  job, metrics),
+                              typeflow_line=_top_typeflow_footer(
                                   job, metrics))
             if args.once:
                 print(out)

@@ -292,6 +292,44 @@ class StateBackendOptions:
         "Unset = the backend's built-in default (16384).")
 
 
+class LintOptions:
+    """Pre-flight static-analysis gates read by ``execute()``
+    (docs/static_analysis.md).  Both modes accept the same vocabulary
+    — ``off`` | ``warn`` | ``strict`` — validated by
+    :func:`lint_mode_of` (unknown values raise with the accepted
+    list, like the state-backend loader)."""
+
+    MODE = ConfigOptions.key("lint.mode").string_type().default_value(
+        "warn").with_description(
+        "Pre-flight graph lint at execute(): off = skip, warn = log "
+        "errors/warnings and run anyway, strict = raise "
+        "JobValidationError on any ERROR diagnostic.")
+    TYPES_MODE = ConfigOptions.key(
+        "lint.types.mode").string_type().default_value(
+        "off").with_description(
+        "Column type-flow prover (pass 3) at execute(): off = skip, "
+        "warn = run it, log FT185-FT188 findings, and feed conclusive "
+        "verdicts into the runtime (probe-free kernels, codec hints, "
+        "state pre-sizing), strict = additionally raise "
+        "JobValidationError when any FT185-FT188 finding fires.")
+
+
+#: the only values the lint gates accept
+LINT_MODES = ("off", "warn", "strict")
+
+
+def lint_mode_of(config, option) -> str:
+    """Read + validate one lint gate off a Configuration.  Unknown
+    values are a configuration bug: fail with the accepted names
+    instead of silently skipping a gate someone meant to arm."""
+    mode = str(config.get(option)).lower().strip()
+    if mode not in LINT_MODES:
+        raise ValueError(
+            f"unknown {option.key} value {mode!r}; expected one of "
+            f"{sorted(LINT_MODES)}")
+    return mode
+
+
 class MetricOptions:
     REPORTERS_LIST = ConfigOptions.key("metrics.reporters").string_type().no_default_value()
     SCOPE_DELIMITER = ConfigOptions.key("metrics.scope.delimiter").string_type().default_value(".")

@@ -89,6 +89,7 @@ from flink_tpu.runtime.metrics import (
     register_network_gauges,
     register_state_gauges,
 )
+from flink_tpu.runtime import netchannel
 from flink_tpu.runtime.netchannel import DataClient, DataServer
 from flink_tpu.runtime.rpc import (
     RpcEndpoint,
@@ -1464,6 +1465,12 @@ class TaskExecutor(RpcEndpoint):
             n_up = job_graph.vertices[edge.source_vertex_id].parallelism
             n_down = job_graph.vertices[edge.target_vertex_id].parallelism
             feedback = getattr(edge, "is_feedback", False)
+            # type-flow codec prediction: a conclusive tier lets the
+            # wire encoder skip the per-frame columnar probe for this
+            # edge (netchannel.PREDICTED_TIERS, keyed like ChannelKey)
+            netchannel.note_predicted_tier(
+                att.job_id, edge_idx,
+                getattr(edge, "predicted_codec_tier", None))
             for i in range(n_up):
                 if edge.partitioner.is_pointwise:
                     targets = pointwise_targets(i, n_up, n_down)

@@ -513,6 +513,24 @@ def register_lint_gauges(metrics: MetricRegistry, job_name: str,
         codes.gauge(code, lambda n=n: n)
 
 
+def register_typeflow_gauges(metrics: MetricRegistry, job_name: str,
+                             typeflow) -> None:
+    """Publish the `typeflow.*` surface from a
+    :class:`flink_tpu.analysis.typeflow.TypeflowReport`: how much of
+    the graph the prover settled AOT — conclusive edges, proven
+    (probe-free) kernels, conclusively pickle-tier exchange edges, and
+    the predicted device-state footprint.  Values are frozen at
+    submit time (the report is AOT by construction); the live
+    ``columnar.decided_by`` / ``columnar.probes`` operator gauges tell
+    the runtime half of the story."""
+    summary = typeflow.summary()
+    g = metrics.job_group(job_name).add_group("typeflow")
+    for key in ("edges_total", "edges_conclusive", "kernels_total",
+                "kernels_proven", "pickle_edges",
+                "predicted_state_bytes"):
+        g.gauge(key, lambda v=summary[key]: v)
+
+
 def register_network_gauges(metrics: MetricRegistry,
                             data_server=None,
                             data_clients=None) -> None:
@@ -536,6 +554,7 @@ def register_network_gauges(metrics: MetricRegistry,
     g.gauge("decodedColumnar", lambda: stats.decoded_col)
     g.gauge("decodedPickle", lambda: stats.decoded_pickle)
     g.gauge("framesSplit", lambda: stats.frames_split)
+    g.gauge("predictedSkips", lambda: stats.predicted_skips)
 
     def _hstats(h, field):
         s = h.get_statistics()

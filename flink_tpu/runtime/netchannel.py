@@ -101,7 +101,7 @@ class NetStats:
     __slots__ = ("frames_out", "frames_in", "bytes_out", "bytes_in",
                  "frames_col", "frames_pickle", "decoded_col",
                  "decoded_pickle", "decoded_batch", "frames_split",
-                 "frame_bytes", "frame_elements")
+                 "predicted_skips", "frame_bytes", "frame_elements")
 
     def __init__(self):
         self.reset()
@@ -122,6 +122,9 @@ class NetStats:
         self.decoded_batch = 0
         #: continuation splits forced by SPLIT_FRAME_BYTES
         self.frames_split = 0
+        #: frames whose columnar encode attempt was skipped because
+        #: the type-flow prover predicted the pickle tier AOT
+        self.predicted_skips = 0
         #: sliding-window distributions of outbound frames
         self.frame_bytes = Histogram(window=1024)
         self.frame_elements = Histogram(window=1024)
@@ -138,6 +141,7 @@ class NetStats:
             "decodedPickle": self.decoded_pickle,
             "decodedBatch": self.decoded_batch,
             "framesSplit": self.frames_split,
+            "predictedSkips": self.predicted_skips,
             "frameBytesMean": fb.mean if fb.count else 0.0,
             "frameBytesP99": fb.quantile(0.99) if fb.count else 0.0,
             "frameElementsMean": fe.mean if fe.count else 0.0,
@@ -145,6 +149,22 @@ class NetStats:
 
 
 NET_STATS = NetStats()
+
+#: (job_id, edge_id) -> wire tier ("col" | "pickle") the type-flow
+#: prover predicted for that exchange edge.  Registered at wiring time
+#: (cluster._wire) from JobEdge.predicted_codec_tier; a conclusive
+#: "pickle" prediction lets the encoder skip the doomed per-column
+#: probe on every frame of that edge.  Purely a speed hint: the frame
+#: format is identical to an organic pickle fallback.
+PREDICTED_TIERS: Dict[tuple, str] = {}
+
+
+def note_predicted_tier(job_id, edge_id: int, tier) -> None:
+    """Record (or clear, tier=None) one edge's predicted codec tier."""
+    if tier in ("col", "pickle"):
+        PREDICTED_TIERS[(job_id, edge_id)] = tier
+    else:
+        PREDICTED_TIERS.pop((job_id, edge_id), None)
 
 
 # ---------------------------------------------------------------------
@@ -236,13 +256,21 @@ def _encode_timestamps(ts: list):
             np.array([0 if t is None else t for t in ts], np.int64))
 
 
-def encode_elements(batch: list):
+def encode_elements(batch: list, hint: Optional[str] = None):
     """Wire record encoding (ref: SpanningRecordSerializer — the typed
     per-record codecs of the reference's data plane).  Pure
     StreamRecord batches of primitives — ints, floats, strings, and
     tuples thereof — take the COLUMNAR path: one numpy buffer per
     column instead of N pickled objects.  Everything else rides
-    per-batch pickle, the universal Python codec."""
+    per-batch pickle, the universal Python codec.
+
+    ``hint="pickle"`` (a conclusive type-flow verdict for this edge)
+    skips the columnar encode attempt outright — same frame bytes as
+    the organic fallback, minus the per-column probing."""
+    if hint == "pickle" and batch:
+        NET_STATS.predicted_skips += 1
+        NET_STATS.frames_pickle += 1
+        return ("pickle", batch)
     enc = _encode_elements(batch)
     if enc[0] == "col":
         NET_STATS.frames_col += 1
@@ -509,7 +537,8 @@ def _frame_budget(queue_len: int, credit_left: int) -> int:
 def _data_frame(key: ChannelKey, batch: list, more: bool,
                 tc: Optional[dict] = None) -> dict:
     frame = {"kind": "data", "channel": key,
-             "elements": encode_elements(batch)}
+             "elements": encode_elements(
+                 batch, hint=PREDICTED_TIERS.get((key[0], key[2])))}
     if more:
         # continuation marker: this frame is a split slice of one
         # credited batch and the consumer must NOT debit credit for it

@@ -398,18 +398,22 @@ class StreamExecutionEnvironment:
         return self._last_executor
 
     # ---- pre-flight validation --------------------------------------
-    def validate(self, strict: bool = False):
+    def validate(self, strict: bool = False, types: bool = False):
         """Run the pre-flight static analysis (graph linter + UDF
         liftability) over the current topology WITHOUT executing it.
 
         Returns a :class:`flink_tpu.analysis.Diagnostics` report; with
         ``strict=True`` raises
         :class:`flink_tpu.analysis.JobValidationError` when the report
-        contains any ERROR diagnostic.  See docs/static_analysis.md
-        for the code catalog.
+        contains any ERROR diagnostic.  With ``types=True`` the column
+        type-flow prover (pass 3) also runs: FT185–FT188 findings land
+        in the report and the per-edge schema dump is attached as
+        ``report.typeflow``.  See docs/static_analysis.md for the code
+        catalog.
         """
         from flink_tpu.analysis import JobValidationError, lint_graph
-        report = lint_graph(self.graph, config=self.config, env=self)
+        report = lint_graph(self.graph, config=self.config, env=self,
+                            types=types)
         self._last_validation = report
         if strict and report.has_errors():
             raise JobValidationError(report)
@@ -418,12 +422,34 @@ class StreamExecutionEnvironment:
     def _preflight(self, job_name: str):
         """execute()-time lint gate, controlled by the ``lint.mode``
         config key: ``off`` skips it, ``warn`` (default) logs errors
-        and warnings, ``strict`` raises on any ERROR diagnostic."""
-        mode = self.config.get_string("lint.mode", "warn").lower()
-        if mode == "off":
+        and warnings, ``strict`` raises on any ERROR diagnostic.
+
+        ``lint.types.mode`` (default ``off``) arms the column
+        type-flow prover the same way: ``warn`` runs it, logs its
+        FT185–FT188 findings, and feeds conclusive verdicts into the
+        runtime (probe-free map/filter kernels, per-edge codec hints,
+        device-state pre-sizing); ``strict`` additionally raises when
+        any FT185–FT188 finding fires."""
+        from flink_tpu.core.config import LintOptions, lint_mode_of
+        mode = lint_mode_of(self.config, LintOptions.MODE)
+        tmode = lint_mode_of(self.config, LintOptions.TYPES_MODE)
+        if mode == "off" and tmode == "off":
             return None
         self.graph.job_name = job_name
-        report = self.validate(strict=(mode == "strict"))
+        report = self.validate(strict=(mode == "strict"),
+                               types=(tmode != "off"))
+        typeflow = getattr(report, "typeflow", None)
+        self._last_typeflow = typeflow
+        if typeflow is not None:
+            from flink_tpu.analysis.typeflow import apply_static
+            apply_static(self.graph, typeflow)
+            if tmode == "strict":
+                findings = [d for d in report
+                            if d.code in ("FT185", "FT186", "FT187",
+                                          "FT188")]
+                if findings:
+                    from flink_tpu.analysis import JobValidationError
+                    raise JobValidationError(report)
         if len(report):
             report.log()
         return report
@@ -439,6 +465,17 @@ class StreamExecutionEnvironment:
             register_lint_gauges(registry, self.graph.job_name, report)
         except Exception:
             pass  # metrics are best-effort; never block submission
+        typeflow = getattr(report, "typeflow", None)
+        if typeflow is None:
+            return
+        try:
+            from flink_tpu.runtime.metrics import (
+                register_typeflow_gauges,
+            )
+            register_typeflow_gauges(registry, self.graph.job_name,
+                                     typeflow)
+        except Exception:
+            pass
 
     def execute(self, job_name: str = "job"):
         """(ref: execute :1508) — runs on the local executor."""

@@ -67,6 +67,10 @@ class StreamEdge:
         #: iteration back edge (DataStream.iterate): excluded from EOS
         #: and barrier propagation and from chaining
         self.is_feedback = False
+        #: wire-codec tier the type-flow prover predicted for this
+        #: edge's elements ("col" | "pickle"), or None when the
+        #: schema was inconclusive (netchannel decides at runtime)
+        self.predicted_codec_tier = None
 
     def __repr__(self):
         return (f"StreamEdge({self.source_id}->{self.target_id} "
@@ -148,6 +152,8 @@ class JobEdge:
         #: which node inside the source chain emits this edge
         self.source_node_id = source_node_id
         self.is_feedback = is_feedback
+        #: carried over from the StreamEdge by create_job_graph
+        self.predicted_codec_tier = None
 
 
 class JobGraph:
@@ -269,8 +275,11 @@ def create_job_graph(stream_graph: StreamGraph) -> JobGraph:
     for e in stream_graph.edges:
         if id(e) in chained_edge_ids:
             continue
-        jg.edges.append(JobEdge(
+        je = JobEdge(
             node_to_vertex[e.source_id], node_to_vertex[e.target_id],
             e.partitioner, e.type_number, e.side_output_tag,
-            source_node_id=e.source_id, is_feedback=e.is_feedback))
+            source_node_id=e.source_id, is_feedback=e.is_feedback)
+        je.predicted_codec_tier = getattr(e, "predicted_codec_tier",
+                                          None)
+        jg.edges.append(je)
     return jg

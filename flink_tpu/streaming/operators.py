@@ -12,6 +12,7 @@ task layer (ref: OperatorChain.java).
 from __future__ import annotations
 
 import abc
+import logging
 import threading
 import time as _time_mod
 from typing import List, Optional, TypeVar
@@ -38,6 +39,30 @@ from flink_tpu.streaming.timers import (
 
 IN = TypeVar("IN")
 OUT = TypeVar("OUT")
+
+log = logging.getLogger("flink_tpu.operators")
+
+
+class _KernelStats:
+    """Process-wide first-batch probe accounting for the map/filter
+    column kernels.  The differential typeflow suite asserts
+    ``probes == 0`` for statically proven chains."""
+
+    __slots__ = ("probes", "static_skips")
+
+    def __init__(self):
+        self.reset()
+
+    def reset(self):
+        self.probes = 0
+        self.static_skips = 0
+
+
+KERNEL_STATS = _KernelStats()
+
+#: (operator class name, reason prefix) pairs already warned about —
+#: the boxed fallback is once-per-class noise, not per-instance spam
+_FALLBACK_WARNED = set()
 
 
 class OutputTag:
@@ -152,6 +177,10 @@ class StreamOperator(abc.ABC):
         self.boxed_fallbacks: int = 0
         self.columnar_fallback_reason: Optional[str] = None
         self._boxed_fallbacks_counter = None
+        # who decided the column-kernel path: "static" (typeflow
+        # verdict, probe skipped) or "probe" (first-batch probe)
+        self.columnar_decided_by: Optional[str] = None
+        self.kernel_probes: int = 0
 
     # ---- wiring -----------------------------------------------------
     def setup(self, output: Output,
@@ -191,6 +220,9 @@ class StreamOperator(abc.ABC):
         col.gauge("ratio", self._columnar_ratio)
         col.gauge("fallback_reason",
                   lambda: self.columnar_fallback_reason or "")
+        col.gauge("decided_by",
+                  lambda: self.columnar_decided_by or "")
+        col.gauge("probes", lambda: self.kernel_probes)
         self._boxed_fallbacks_counter = col.counter("boxed_fallbacks")
         self._boxed_fallbacks_counter.count = self.boxed_fallbacks
 
@@ -518,12 +550,24 @@ def _udf_liftable(user_function, attr: str):
 class _ColumnKernelMixin:
     """Shared decide/probe/fallback state machine for StreamMap and
     StreamFilter.  `_batch_kernel` is None (undecided), True (riding
-    columns, probe passed), or False (locked onto the boxed path)."""
+    columns, probe passed or statically proven), or False (locked onto
+    the boxed path).
+
+    ``_static_kernel`` is stamped by the type-flow prover
+    (:func:`flink_tpu.analysis.typeflow.apply_static`) when the whole
+    dtype flow of the kernel was proven AOT — the first-batch probe is
+    skipped and ``decided_by`` records "static".  The output-shape
+    validation in ``_emit_kernel_result`` stays armed either way, so a
+    runtime mismatch still demotes boxed with a recorded reason."""
 
     _batch_kernel = None
     _KERNEL_ATTR = ""
+    _static_kernel = False
+    _typeflow_verdict = None
 
     def _decide_kernel(self) -> bool:
+        if self._static_kernel:
+            return True
         ok, reason = _udf_liftable(self.user_function, self._KERNEL_ATTR)
         if not ok:
             self._batch_kernel = False
@@ -533,6 +577,16 @@ class _ColumnKernelMixin:
     def _kernel_fallback(self, batch, reason: str):
         self._batch_kernel = False
         self.columnar_fallback_reason = reason
+        self.columnar_decided_by = None
+        key = (type(self).__name__, reason.split(":")[0])
+        if key not in _FALLBACK_WARNED:
+            _FALLBACK_WARNED.add(key)
+            verdict = self._typeflow_verdict
+            log.warning(
+                "%s '%s' falls back to the boxed path: %s%s",
+                type(self).__name__, self.operator_id, reason,
+                f" (typeflow verdict was: {verdict})" if verdict
+                else "")
         StreamOperator.process_batch(self, batch)
 
     def process_batch(self, batch):
@@ -551,14 +605,26 @@ class _ColumnKernelMixin:
             self._kernel_fallback(batch, f"kernel raised {e!r}")
             return
         if decided is None:
-            # first surviving batch: validate the vectorized result
-            # against the scalar UDF on the edge rows (LIFTABLE UDFs
-            # are proven pure, so replaying rows is safe)
-            err = self._probe(batch, fn, out, n)
-            if err is not None:
-                self._kernel_fallback(batch, err)
-                return
-            self._batch_kernel = True
+            if self._static_kernel:
+                # the type-flow prover certified the dtype flow AOT:
+                # no probe (the emit-side shape validation still
+                # demotes on any runtime divergence)
+                self._batch_kernel = True
+                self.columnar_decided_by = "static"
+                KERNEL_STATS.static_skips += 1
+            else:
+                # first surviving batch: validate the vectorized
+                # result against the scalar UDF on the edge rows
+                # (LIFTABLE UDFs are proven pure, so replaying rows
+                # is safe)
+                self.kernel_probes += 1
+                KERNEL_STATS.probes += 1
+                err = self._probe(batch, fn, out, n)
+                if err is not None:
+                    self._kernel_fallback(batch, err)
+                    return
+                self._batch_kernel = True
+                self.columnar_decided_by = "probe"
         self._emit_kernel_result(batch, out, n)
 
 

@@ -3,14 +3,16 @@
 #   1. repo-wide pre-flight lint (scripts/lint_repo.sh: graph lint +
 #      UDF liftability over examples/, unused-import sweep)
 #   2. strict graph lint — warnings promoted to failures
-#   3. the tier-1 test suite (everything not marked slow)
-#   4. observability smoke — a short MiniCluster job with metric
+#   3. strict TYPED lint — the column type-flow prover over the same
+#      examples (FT185-FT188 seeded findings fail the gate)
+#   4. the tier-1 test suite (everything not marked slow)
+#   5. observability smoke — a short MiniCluster job with metric
 #      sampling (history + checkpoints routes must fill) and a seeded
 #      backpressure job that must fire exactly one health alert
-#   5. columnar gate — the boxed-vs-columnar differential suite, then
+#   6. columnar gate — the boxed-vs-columnar differential suite, then
 #      a real-TCP shuffle smoke with the wire codec pinned ON and OFF
 #      (identical delivered streams required)
-#   6. state gate — the keyed-state differential suite, then the
+#   7. state gate — the keyed-state differential suite, then the
 #      heap-vs-tpu batched-ingest smoke with a mid-stream restore and
 #      the codec pinned on/off (bit-equal outputs required)
 #
@@ -24,31 +26,35 @@ cd "$(dirname "$0")/.."
 export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
 rc=0
 
-echo "== stage 1/6: repo lint =="
+echo "== stage 1/7: repo lint =="
 scripts/lint_repo.sh || rc=1
 
 echo
-echo "== stage 2/6: strict graph lint over examples/ =="
+echo "== stage 2/7: strict graph lint over examples/ =="
 python -m flink_tpu lint --strict examples/ || rc=1
 
 echo
-echo "== stage 3/6: tier-1 test suite =="
+echo "== stage 3/7: type-flow lint over examples/ =="
+python -m flink_tpu lint --types --strict examples/ || rc=1
+
+echo
+echo "== stage 4/7: tier-1 test suite =="
 python -m pytest tests/ -q -m 'not slow' \
     --continue-on-collection-errors \
     -p no:cacheprovider -p no:xdist -p no:randomly || rc=1
 
 echo
-echo "== stage 4/6: observability smoke =="
+echo "== stage 5/7: observability smoke =="
 python scripts/observability_smoke.py || rc=1
 
 echo
-echo "== stage 5/6: columnar differential + shuffle codec smoke =="
+echo "== stage 6/7: columnar differential + shuffle codec smoke =="
 python -m pytest tests/test_columnar_pipeline.py -q \
     -p no:cacheprovider -p no:xdist -p no:randomly || rc=1
 python scripts/columnar_smoke.py || rc=1
 
 echo
-echo "== stage 6/6: state differential + batched-ingest smoke =="
+echo "== stage 7/7: state differential + batched-ingest smoke =="
 python -m pytest tests/test_state_batch.py -q \
     -p no:cacheprovider -p no:xdist -p no:randomly || rc=1
 python scripts/state_smoke.py || rc=1

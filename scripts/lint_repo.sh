@@ -6,6 +6,9 @@
 #      ERROR diagnostic.
 #   2. the built-in unused-import checker over the flink_tpu package
 #      (pyflakes-lite; the container has no pyflakes).
+#   3. FT-code registry integrity: the diagnostics catalog must have
+#      no duplicate codes, and every FTxxx code emitted anywhere in
+#      flink_tpu/analysis must be catalogued.
 #
 # Usage: scripts/lint_repo.sh  (from the repo root; rc 0 = clean)
 set -uo pipefail
@@ -27,6 +30,48 @@ for f in findings:
     print(f.render())
 print(f"{len(findings)} unused import(s)")
 sys.exit(1 if findings else 0)
+EOF
+
+echo
+echo "== checking the FT diagnostic-code registry =="
+python - <<'EOF' || rc=1
+import ast, pathlib, re, sys
+
+bad = 0
+
+# 1. no duplicate keys in the CODES dict literal (a later duplicate
+#    would silently shadow the earlier severity/description)
+src = pathlib.Path("flink_tpu/analysis/diagnostics.py").read_text()
+tree = ast.parse(src)
+literal_keys = []
+for node in ast.walk(tree):
+    targets = []
+    if isinstance(node, ast.Assign):
+        targets = node.targets
+    elif isinstance(node, ast.AnnAssign) and node.value is not None:
+        targets = [node.target]
+    if any(isinstance(t, ast.Name) and t.id == "CODES"
+           for t in targets) and isinstance(node.value, ast.Dict):
+        literal_keys = [k.value for k in node.value.keys
+                        if isinstance(k, ast.Constant)]
+dupes = sorted({k for k in literal_keys
+                if literal_keys.count(k) > 1})
+if dupes:
+    print(f"duplicate CODES entries: {dupes}")
+    bad = 1
+
+# 2. every FTxxx code referenced by the analysis sources is catalogued
+from flink_tpu.analysis.diagnostics import CODES
+emitted = set()
+for path in pathlib.Path("flink_tpu/analysis").glob("*.py"):
+    emitted |= set(re.findall(r'"(FT\d{3})"', path.read_text()))
+unknown = sorted(emitted - set(CODES))
+if unknown:
+    print(f"codes emitted but not in the CODES catalog: {unknown}")
+    bad = 1
+print(f"{len(literal_keys)} catalogued code(s), "
+      f"{len(emitted)} referenced in analysis sources")
+sys.exit(bad)
 EOF
 
 exit $rc

@@ -1,0 +1,722 @@
+"""Whole-graph column type-flow prover (flink_tpu/analysis/typeflow):
+schema inference, the dtype abstract interpreter, FT185-FT188 seeding,
+and the differential contract against the runtime first-batch probe —
+the prover must never issue a conclusive verdict the runtime
+contradicts, and statically proven chains must run with ZERO probes
+and byte-identical output."""
+
+import json
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+from flink_tpu.analysis.typeflow import (
+    analyze_graph,
+    apply_static,
+    codec_tier,
+)
+from flink_tpu.core.config import (
+    Configuration,
+    LINT_MODES,
+    LintOptions,
+    lint_mode_of,
+)
+from flink_tpu.streaming import operators as op_mod
+from flink_tpu.streaming.columnar import (
+    VectorizedCollectionSource,
+    batch_from_records,
+)
+from flink_tpu.streaming.datastream import StreamExecutionEnvironment
+from flink_tpu.streaming.elements import StreamRecord
+from flink_tpu.streaming.sources import CollectSink
+from flink_tpu.streaming.windowing import Time
+from flink_tpu.ops.device_agg import SumAggregate
+
+
+def _env(conf=None):
+    return StreamExecutionEnvironment(conf)
+
+
+def _analyze(env):
+    return analyze_graph(env.graph, config=env.config)
+
+
+def _node_id(env, name):
+    ids = [nid for nid, n in env.graph.nodes.items() if n.name == name]
+    assert ids, f"no node named {name}"
+    return ids[0]
+
+
+class TupleSum(SumAggregate):
+    def __init__(self):
+        super().__init__(np.float32)
+
+    def extract_value(self, value):
+        return value[1]
+
+
+# ---------------------------------------------------------------------
+# source schema inference
+# ---------------------------------------------------------------------
+
+def test_vectorized_source_schema_is_exact():
+    env = _env()
+    env.add_source(VectorizedCollectionSource([3, 1, 7])) \
+       .add_sink(CollectSink())
+    tf = _analyze(env)
+    schema = tf.node_schemas[_node_id(env, "source")]
+    assert schema.conclusive and schema.scalar
+    (c,) = schema.cols
+    assert c.token == "i8" and (c.lo, c.hi) == (1.0, 7.0)
+
+
+def test_from_collection_schemas():
+    env = _env()
+    env.from_collection([0.5, 1.5]).add_sink(CollectSink())
+    env.from_collection(["a", "bb"]).add_sink(CollectSink())
+    env.from_collection([(1, 2.0), (3, 4.0)]).add_sink(CollectSink())
+    tf = _analyze(env)
+    by_name = {env.graph.nodes[nid].name: s
+               for nid, s in tf.node_schemas.items()}
+    srcs = [s for nid, s in tf.node_schemas.items()
+            if env.graph.nodes[nid].name == "from_collection"]
+    tokens = sorted(s.tokens() for s in srcs)
+    assert tokens == [("f8",), ("i8", "f8"), ("str",)]
+    assert all(s.conclusive for s in srcs)
+    assert by_name  # schemas exist for every node
+
+
+def test_unbounded_source_is_inconclusive():
+    env = _env()
+    env.socket_text_stream("localhost", 9999).add_sink(CollectSink())
+    tf = _analyze(env)
+    schema = tf.node_schemas[_node_id(env, "socket_source")]
+    assert not schema.conclusive
+
+
+def test_codec_tier_vocabulary():
+    env = _env()
+    env.add_source(VectorizedCollectionSource([1, 2])) \
+       .map(lambda x: np.float32(x)).add_sink(CollectSink())
+    tf = _analyze(env)
+    schema = tf.node_schemas[_node_id(env, "map")]
+    assert schema.conclusive and schema.tokens() == ("f4",)
+    tier, blocker = codec_tier(schema)
+    assert (tier, blocker) == ("pickle", "f4")
+    src = tf.node_schemas[_node_id(env, "source")]
+    assert codec_tier(src) == ("col", "")
+
+
+# ---------------------------------------------------------------------
+# kernel dtype inference
+# ---------------------------------------------------------------------
+
+def _kernel_of(fn, values, op="map"):
+    env = _env()
+    ds = env.add_source(VectorizedCollectionSource(list(values)))
+    ds = ds.map(fn) if op == "map" else ds.filter(fn)
+    ds.add_sink(CollectSink())
+    tf = _analyze(env)
+    return tf.kernels[_node_id(env, op)]
+
+
+def test_int_arithmetic_stays_i8():
+    v = _kernel_of(lambda x: x * 2 + 1, range(100))
+    assert v.proven and v.out_schema.tokens() == ("i8",)
+    (c,) = v.out_schema.cols
+    assert (c.lo, c.hi) == (1.0, 199.0)
+
+
+def test_truediv_promotes_to_f8():
+    v = _kernel_of(lambda x: x / 2, range(10))
+    assert v.proven and v.out_schema.tokens() == ("f8",)
+
+
+def test_tuple_output_schema():
+    v = _kernel_of(lambda x: (x, x + 0.5), range(10))
+    assert v.proven
+    assert v.out_schema.tokens() == ("i8", "f8")
+    assert not v.out_schema.scalar
+
+
+def test_float32_preserved_through_ufunc():
+    v = _kernel_of(lambda x: np.sqrt(np.float32(x)) * 2, range(10))
+    assert v.proven and v.out_schema.tokens() == ("f4",)
+
+
+def test_filter_predicate_proves_bool():
+    v = _kernel_of(lambda x: x > 10, range(100), op="filter")
+    assert v.proven
+    # filters never change values: out schema is the in schema
+    assert v.out_schema.tokens() == ("i8",)
+
+
+def test_branchy_udf_is_not_proven():
+    v = _kernel_of(lambda x: x * 2 if x % 2 else x - 1, range(10))
+    assert not v.proven
+
+
+def test_opaque_call_is_not_proven():
+    d = {"k": 1}
+    v = _kernel_of(lambda x: d.get("k", x), range(10))
+    assert not v.proven
+
+
+def test_tuple_field_access():
+    env = _env()
+    vals = [(i, float(i) * 0.5) for i in range(20)]
+    env.add_source(VectorizedCollectionSource(vals)) \
+       .map(lambda t: t[1] * 2).add_sink(CollectSink())
+    tf = _analyze(env)
+    v = tf.kernels[_node_id(env, "map")]
+    assert v.proven and v.out_schema.tokens() == ("f8",)
+
+
+def test_inconclusive_input_blocks_kernel_proof():
+    env = _env()
+    env.socket_text_stream("localhost", 9999) \
+       .map(lambda x: x).add_sink(CollectSink())
+    tf = _analyze(env)
+    v = tf.kernels[_node_id(env, "map")]
+    assert not v.proven and "inconclusive" in v.note
+
+
+# ---------------------------------------------------------------------
+# soundness differential: prover vs first-batch probe (the zoo)
+# ---------------------------------------------------------------------
+
+# (fn, values) spanning proven kernels, probe-demoted kernels, and
+# raise-demoted kernels.  The contract under test: the prover NEVER
+# proves a kernel the runtime probe would demote.
+_ZOO = [
+    (lambda v: v * 3 + 1, list(range(50))),
+    (lambda v: v / 4, list(range(50))),
+    (lambda v: (v, v * 2.0), list(range(30))),
+    (lambda t: (t[0], t[1] * 2.0), [(i, float(i)) for i in range(30)]),
+    # data-dependent branch: probe never runs (liftability demotes)
+    (lambda v: v * 2 if v % 2 else v - 1, list(range(40))),
+    # int64 wraparound the probe catches: interval escapes int64
+    (lambda v: v << 70, list(range(1, 20))),
+    # kernel raises on arrays (array index into a constant tuple)
+    (lambda v: (10, 20, 30)[v], [i % 3 for i in range(30)]),
+]
+
+
+def _probe_decision(fn, values):
+    """Run the real operator machinery on one batch; returns
+    (demoted, rows) with rows the flattened output."""
+    from flink_tpu.core.functions import _LambdaMap
+    from flink_tpu.streaming.operators import StreamMap
+
+    class _Cap:
+        def __init__(self):
+            self.elements = []
+
+        def collect(self, r):
+            self.elements.append((r.value, r.timestamp))
+
+        def collect_batch(self, b):
+            self.elements.extend(zip(b.row_values(), b.timestamps()))
+
+        def emit_watermark(self, w):
+            pass
+
+    op = StreamMap(_LambdaMap(fn))
+    out = _Cap()
+    op.setup(out)
+    op.open()
+    op.process_batch(batch_from_records(list(values),
+                                        list(range(len(values)))))
+    return op._batch_kernel is False, out.elements
+
+
+@pytest.mark.parametrize("idx", range(len(_ZOO)))
+def test_prover_never_eligible_where_probe_demotes(idx):
+    fn, values = _ZOO[idx]
+    verdict = _kernel_of(fn, values)
+    demoted, rows = _probe_decision(fn, values)
+    if demoted:
+        assert not verdict.proven, (
+            f"prover claimed a kernel the probe demotes: {verdict}")
+    # either way the operator output matches the scalar ground truth
+    want = [(fn(v), t) for t, v in enumerate(values)]
+    assert rows == want
+
+
+def test_proven_kernel_output_matches_boxed_path():
+    """Byte-identical results: statically proven kernel vs the
+    per-record boxed execution of the same UDF."""
+    from flink_tpu.core.functions import _LambdaMap
+    from flink_tpu.streaming.operators import StreamMap
+    for fn, values in _ZOO[:4]:
+        verdict = _kernel_of(fn, values)
+        assert verdict.proven
+
+        class _Cap:
+            def __init__(self):
+                self.rows = []
+
+            def collect(self, r):
+                self.rows.append((r.value, r.timestamp))
+
+            def collect_batch(self, b):
+                self.rows.extend(zip(b.row_values(), b.timestamps()))
+
+            def emit_watermark(self, w):
+                pass
+
+        ts = list(range(len(values)))
+        op = StreamMap(_LambdaMap(fn))
+        op._static_kernel = True        # what apply_static stamps
+        cap = _Cap()
+        op.setup(cap)
+        op.open()
+        op.process_batch(batch_from_records(list(values), ts))
+        assert op.columnar_decided_by == "static"
+        assert op.kernel_probes == 0
+        boxed_op = StreamMap(_LambdaMap(fn))
+        boxed = _Cap()
+        boxed_op.setup(boxed)
+        boxed_op.open()
+        for v, t in zip(values, ts):
+            boxed_op.process_element(StreamRecord(v, t))
+        assert cap.rows == boxed.rows
+
+
+def test_static_stamp_still_demotes_on_runtime_mismatch():
+    """The emit-side shape validation stays armed for statically
+    stamped kernels: a wrong stamp demotes boxed, never corrupts."""
+    from flink_tpu.core.functions import _LambdaMap
+    from flink_tpu.streaming.operators import StreamMap
+
+    class _Cap:
+        def __init__(self):
+            self.rows = []
+
+        def collect(self, r):
+            self.rows.append((r.value, r.timestamp))
+
+        def collect_batch(self, b):
+            self.rows.extend(zip(b.row_values(), b.timestamps()))
+
+        def emit_watermark(self, w):
+            pass
+
+    fn = lambda v: {"k": v}  # noqa: E731 — not a column shape
+    op = StreamMap(_LambdaMap(fn))
+    op._static_kernel = True  # deliberately wrong stamp
+    cap = _Cap()
+    op.setup(cap)
+    op.open()
+    values, ts = list(range(5)), list(range(5))
+    op.process_batch(batch_from_records(values, ts))
+    assert op._batch_kernel is False
+    assert op.columnar_decided_by is None
+    assert cap.rows == [({"k": v}, t) for v, t in zip(values, ts)]
+
+
+# ---------------------------------------------------------------------
+# probe-free end-to-end execution
+# ---------------------------------------------------------------------
+
+def _chain_env(types_mode):
+    conf = Configuration()
+    if types_mode:
+        conf.set("lint.types.mode", types_mode)
+    env = _env(conf)
+    env.set_parallelism(1)
+    sink = CollectSink()
+    env.add_source(VectorizedCollectionSource(list(range(1, 101)))) \
+       .map(lambda x: x * 2).filter(lambda x: x > 10) \
+       .map(lambda x: (x, x + 0.5)).add_sink(sink)
+    return env, sink
+
+
+def test_statically_proven_chain_runs_probe_free():
+    op_mod.KERNEL_STATS.reset()
+    env, sink = _chain_env("warn")
+    env.execute("typeflow-static")
+    static_out = list(sink.values)
+    assert op_mod.KERNEL_STATS.probes == 0
+    assert op_mod.KERNEL_STATS.static_skips >= 3
+
+    op_mod.KERNEL_STATS.reset()
+    env2, sink2 = _chain_env(None)
+    env2.execute("typeflow-probed")
+    assert op_mod.KERNEL_STATS.probes >= 3
+    assert op_mod.KERNEL_STATS.static_skips == 0
+    assert static_out == list(sink2.values)
+
+
+def test_apply_static_counts_and_idempotence():
+    env, _ = _chain_env(None)
+    tf = _analyze(env)
+    applied = apply_static(env.graph, tf)
+    assert applied["kernels_proven"] == 3
+    # re-applying replaces the factory wrap instead of stacking
+    applied2 = apply_static(env.graph, tf)
+    assert applied2 == applied
+    for node in env.graph.nodes.values():
+        f = node.operator_factory
+        orig = getattr(f, "_typeflow_orig", None)
+        if orig is not None:
+            assert not hasattr(orig, "_typeflow_orig")
+
+
+def test_decided_by_surfaces():
+    from flink_tpu.analysis.columnar_eligibility import (
+        chain_report,
+        operator_decided_by,
+    )
+    env, _ = _chain_env(None)
+    tf = _analyze(env)
+    apply_static(env.graph, tf)
+    ops = [n.operator_factory() for n in env.graph.nodes.values()]
+    decided = [operator_decided_by(op) for op in ops]
+    assert decided.count("static") == 3
+    rep = chain_report(ops)
+    assert len(rep["decided_by"]) == len(rep["modes"])
+    assert rep["decided_by"].count("static") == 3
+
+
+# ---------------------------------------------------------------------
+# seeded FT185-FT188
+# ---------------------------------------------------------------------
+
+def test_ft185_pickle_tier_exchange_edge():
+    env = _env()
+    env.add_source(VectorizedCollectionSource([1, 2, 3, 4])) \
+       .map(lambda x: x > 2).rebalance().add_sink(CollectSink())
+    tf = _analyze(env)
+    (d,) = tf.diagnostics.by_code("FT185")
+    assert d.severity == "warning"
+    assert "bool" in d.message and "map" in d.message
+    # forward edges with the same schema do NOT fire
+    env2 = _env()
+    env2.add_source(VectorizedCollectionSource([1, 2, 3, 4])) \
+        .map(lambda x: x > 2).add_sink(CollectSink())
+    assert not _analyze(env2).diagnostics.by_code("FT185")
+
+
+def test_ft186_int64_overflow_hazard():
+    env = _env()
+    vals = list(range(2 ** 29, 2 ** 30, 2 ** 20))
+    env.add_source(VectorizedCollectionSource(vals)) \
+       .map(lambda x: x << 40).add_sink(CollectSink())
+    tf = _analyze(env)
+    (d,) = tf.diagnostics.by_code("FT186")
+    assert d.severity == "warning"
+    # the hazardous kernel keeps its probe: NOT proven
+    assert not tf.kernels[_node_id(env, "map")].proven
+    # same shift on values that cannot escape int64: no hazard
+    env2 = _env()
+    env2.add_source(VectorizedCollectionSource([1, 2, 3])) \
+        .map(lambda x: x << 40).add_sink(CollectSink())
+    tf2 = _analyze(env2)
+    assert not tf2.diagnostics.by_code("FT186")
+    assert tf2.kernels[_node_id(env2, "map")].proven
+
+
+def test_ft187_state_footprint_over_budget():
+    conf = Configuration()
+    conf.set("state.backend.tpu.max-device-slots", 16)
+    env = _env(conf)
+    recs = [((k, 1.0), k) for k in range(64)]
+    (env.from_collection(recs, timestamped=True)
+        .key_by(lambda t: t[0])
+        .time_window(Time.seconds(1))
+        .aggregate(TupleSum())
+        .add_sink(CollectSink()))
+    tf = _analyze(env)
+    (d,) = tf.diagnostics.by_code("FT187")
+    assert d.severity == "warning"
+    assert "64" in d.message and "16" in d.message
+    (fp,) = tf.footprints.values()
+    assert fp.slots == 64 and fp.over_budget
+    # within budget: estimate recorded, no finding
+    conf2 = Configuration()
+    conf2.set("state.backend.tpu.max-device-slots", 128)
+    env2 = _env(conf2)
+    (env2.from_collection(recs, timestamped=True)
+         .key_by(lambda t: t[0])
+         .time_window(Time.seconds(1))
+         .aggregate(TupleSum())
+         .add_sink(CollectSink()))
+    tf2 = _analyze(env2)
+    assert not tf2.diagnostics.by_code("FT187")
+    (fp2,) = tf2.footprints.values()
+    assert fp2.slots == 64 and not fp2.over_budget
+
+
+def test_ft187_presizes_engine_capacity():
+    env = _env()
+    recs = [((k, 1.0), k) for k in range(300)]
+    (env.from_collection(recs, timestamped=True)
+        .key_by(lambda t: t[0])
+        .time_window(Time.seconds(1))
+        .aggregate(TupleSum())
+        .add_sink(CollectSink()))
+    tf = _analyze(env)
+    apply_static(env.graph, tf)
+    nid = _node_id(env, "window_aggregate")
+    op = env.graph.nodes[nid].operator_factory()
+    assert op._predicted_slots == 300
+    assert op.initial_capacity >= 512  # next pow2 over 300
+
+
+def test_ft188_union_schema_conflict():
+    env = _env()
+    a = env.add_source(VectorizedCollectionSource([1, 2, 3]))
+    b = env.add_source(VectorizedCollectionSource(["a", "b"]))
+    a.union(b).add_sink(CollectSink())
+    tf = _analyze(env)
+    (d,) = tf.diagnostics.by_code("FT188")
+    assert d.severity == "warning"
+    assert "i8" in d.message and "str" in d.message
+    # agreeing branches merge cleanly with unioned bounds
+    env2 = _env()
+    a2 = env2.add_source(VectorizedCollectionSource([1, 2]))
+    b2 = env2.add_source(VectorizedCollectionSource([10, 20]))
+    u = a2.union(b2)
+    u.add_sink(CollectSink())
+    tf2 = _analyze(env2)
+    assert not tf2.diagnostics.by_code("FT188")
+    schema = tf2.node_schemas[u.node.id]
+    assert schema.conclusive
+    (c,) = schema.cols
+    assert (c.lo, c.hi) == (1.0, 20.0)
+
+
+def test_every_typeflow_code_is_catalogued():
+    from flink_tpu.analysis import CODES
+    for code in ("FT185", "FT186", "FT187", "FT188"):
+        assert code in CODES
+        assert CODES[code][0] == "warning"
+
+
+# ---------------------------------------------------------------------
+# netchannel codec hint
+# ---------------------------------------------------------------------
+
+def test_encode_hint_skips_columnar_attempt():
+    from flink_tpu.runtime import netchannel
+    records = [StreamRecord({"k": i}, i) for i in range(4)]
+    netchannel.NET_STATS.reset()
+    organic = netchannel.encode_elements(list(records))
+    hinted = netchannel.encode_elements(list(records), hint="pickle")
+    assert hinted[0] == "pickle" and organic[0] == "pickle"
+    decoded_h = netchannel.decode_elements(hinted)
+    decoded_o = netchannel.decode_elements(organic)
+    assert [(r.value, r.timestamp) for r in decoded_h] == \
+        [(r.value, r.timestamp) for r in decoded_o]
+    assert netchannel.NET_STATS.predicted_skips == 1
+    snap = netchannel.NET_STATS.snapshot()
+    assert snap["predictedSkips"] == 1
+
+
+def test_predicted_tier_table_only_keeps_known_tiers():
+    from flink_tpu.runtime import netchannel
+    netchannel.note_predicted_tier("j", 0, "pickle")
+    assert netchannel.PREDICTED_TIERS[("j", 0)] == "pickle"
+    netchannel.note_predicted_tier("j", 0, None)
+    assert ("j", 0) not in netchannel.PREDICTED_TIERS
+    netchannel.note_predicted_tier("j", 1, "weird")
+    assert ("j", 1) not in netchannel.PREDICTED_TIERS
+
+
+def test_predicted_tier_lands_on_job_edge():
+    env = _env()
+    env.add_source(VectorizedCollectionSource([1, 2, 3, 4])) \
+       .map(lambda x: x > 2).rebalance().add_sink(CollectSink())
+    tf = _analyze(env)
+    applied = apply_static(env.graph, tf)
+    assert applied["edges_predicted"] == 1
+    jg = env.get_job_graph()
+    tiers = [e.predicted_codec_tier for e in jg.edges]
+    assert "pickle" in tiers
+
+
+# ---------------------------------------------------------------------
+# config gate + validate()/execute() wiring
+# ---------------------------------------------------------------------
+
+def test_lint_types_mode_accepted_names():
+    conf = Configuration()
+    assert lint_mode_of(conf, LintOptions.TYPES_MODE) == "off"
+    assert lint_mode_of(conf, LintOptions.MODE) == "warn"
+    for mode in LINT_MODES:
+        conf.set("lint.types.mode", mode)
+        assert lint_mode_of(conf, LintOptions.TYPES_MODE) == mode
+    conf.set("lint.types.mode", "bogus")
+    with pytest.raises(ValueError) as ei:
+        lint_mode_of(conf, LintOptions.TYPES_MODE)
+    assert "lint.types.mode" in str(ei.value)
+    assert "off" in str(ei.value) and "strict" in str(ei.value)
+
+
+def test_unknown_types_mode_fails_execute():
+    conf = Configuration()
+    conf.set("lint.types.mode", "aggressive")
+    env = _env(conf)
+    env.from_collection([1, 2]).map(lambda x: x).add_sink(CollectSink())
+    with pytest.raises(ValueError):
+        env.execute("bad-mode")
+
+
+def test_types_strict_raises_on_seeded_finding():
+    from flink_tpu.analysis import JobValidationError
+    conf = Configuration()
+    conf.set("lint.types.mode", "strict")
+    env = _env(conf)
+    env.add_source(VectorizedCollectionSource([1, 2, 3, 4])) \
+       .map(lambda x: x > 2).rebalance().add_sink(CollectSink())
+    with pytest.raises(JobValidationError) as ei:
+        env.execute("strict-types")
+    assert "FT185" in ei.value.report.codes()
+
+
+def test_types_warn_executes_and_keeps_report():
+    conf = Configuration()
+    conf.set("lint.types.mode", "warn")
+    env = _env(conf)
+    sink = CollectSink()
+    env.add_source(VectorizedCollectionSource([1, 2, 3, 4])) \
+       .map(lambda x: x > 2).rebalance().add_sink(sink)
+    env.execute("warn-types")
+    assert sorted(sink.values) == [False, False, True, True]
+    assert env._last_typeflow is not None
+    assert "FT185" in env._last_validation.codes()
+
+
+def test_config_docs_reflect_types_mode():
+    from flink_tpu.core.config_docs import generate_config_docs
+    md = generate_config_docs()
+    assert "lint.types.mode" in md and "lint.mode" in md
+
+
+def test_typeflow_metrics_registered():
+    conf = Configuration()
+    conf.set("lint.types.mode", "warn")
+    env = _env(conf)
+    sink = CollectSink()
+    env.add_source(VectorizedCollectionSource(list(range(20)))) \
+       .map(lambda x: x * 2).add_sink(sink)
+    env.execute("tf-metrics")
+    reg = env.get_metric_registry()
+    snap = reg.snapshot() if hasattr(reg, "snapshot") else reg.dump()
+    tf = {str(k): v for k, v in snap.items() if ".typeflow." in str(k)}
+    assert tf.get("tf-metrics.typeflow.kernels_proven") == 1
+    assert tf.get("tf-metrics.typeflow.edges_conclusive") == 2
+    decided = {str(k): v for k, v in snap.items()
+               if str(k).endswith(".columnar.decided_by")}
+    assert "static" in decided.values()
+
+
+# ---------------------------------------------------------------------
+# linter integration: lint_graph(types=), FT184 enrichment, validate()
+# ---------------------------------------------------------------------
+
+def test_lint_graph_types_opt_in():
+    from flink_tpu.analysis.graph_linter import lint_graph
+    env = _env()
+    env.add_source(VectorizedCollectionSource([1, 2, 3, 4])) \
+       .map(lambda x: x > 2).rebalance().add_sink(CollectSink())
+    plain = lint_graph(env.graph)
+    assert "FT185" not in plain.codes()
+    typed = lint_graph(env.graph, types=True)
+    assert "FT185" in typed.codes()
+    assert typed.typeflow is not None
+    assert typed.typeflow.summary()["pickle_edges"] == 1
+
+
+def test_ft184_names_the_boxing_edge_schema():
+    from flink_tpu.analysis.graph_linter import lint_graph
+    env = _env()
+    (env.add_source(VectorizedCollectionSource(list(range(10))))
+        .map(lambda v: v + 1)
+        .map(lambda v: v * 2 if v else v)   # first blocker
+        .add_sink(CollectSink()))
+    report = lint_graph(env.graph, types=True)
+    ft184 = [d for d in report.by_code("FT184")
+             if "boxes at" in d.message]
+    assert ft184
+    assert any("boxing the edge" in d.message and "i8" in d.message
+               for d in ft184)
+
+
+def test_script_lint_types(tmp_path):
+    from flink_tpu.analysis.script_lint import lint_script
+    p = tmp_path / "pickle_edge_job.py"
+    p.write_text(textwrap.dedent("""
+        from flink_tpu.streaming.columnar import VectorizedCollectionSource
+        from flink_tpu.streaming.datastream import StreamExecutionEnvironment
+        from flink_tpu.streaming.sources import CollectSink
+
+        env = StreamExecutionEnvironment()
+        env.add_source(VectorizedCollectionSource([1, 2, 3, 4])) \\
+           .map(lambda x: x > 2).rebalance().add_sink(CollectSink())
+        env.execute("pickle-edge-job")
+    """))
+    res = lint_script(str(p), types=True)
+    assert res.script_error is None
+    (name, report) = res.reports[0]
+    assert "FT185" in report.codes()
+    assert report.typeflow is not None
+    # without --types the same script is silent
+    res2 = lint_script(str(p))
+    assert "FT185" not in res2.reports[0][1].codes()
+
+
+def _run_cli(*args):
+    return subprocess.run(
+        [sys.executable, "-m", "flink_tpu", "lint", *args],
+        capture_output=True, text=True, timeout=120,
+        env={"PATH": "/usr/bin:/bin", "JAX_PLATFORMS": "cpu",
+             "PYTHONPATH": "."})
+
+
+@pytest.mark.slow
+def test_cli_lint_types_strict_flags_seeds(tmp_path):
+    p = tmp_path / "seeded_job.py"
+    p.write_text(textwrap.dedent("""
+        from flink_tpu.core.config import Configuration
+        from flink_tpu.streaming.columnar import VectorizedCollectionSource
+        from flink_tpu.streaming.datastream import StreamExecutionEnvironment
+        from flink_tpu.streaming.sources import CollectSink
+        from flink_tpu.streaming.windowing import Time
+        import numpy as np
+        from flink_tpu.ops.device_agg import SumAggregate
+
+        class TupleSum(SumAggregate):
+            def __init__(self):
+                super().__init__(np.float32)
+            def extract_value(self, value):
+                return value[1]
+
+        conf = Configuration()
+        conf.set("state.backend.tpu.max-device-slots", 16)
+        env = StreamExecutionEnvironment(conf)
+        env.add_source(VectorizedCollectionSource([1, 2, 3, 4])) \\
+           .map(lambda x: x > 2).rebalance().add_sink(CollectSink())
+        recs = [((k, 1.0), k) for k in range(64)]
+        (env.from_collection(recs, timestamped=True)
+            .key_by(lambda t: t[0])
+            .time_window(Time.seconds(1))
+            .aggregate(TupleSum())
+            .add_sink(CollectSink()))
+        env.execute("seeded-job")
+    """))
+    r = _run_cli("--types", "--strict", "--json", str(p))
+    assert r.returncode == 1, r.stderr
+    payload = json.loads(r.stdout[r.stdout.index("["):])
+    jobs = [j for entry in payload for j in entry["jobs"]]
+    codes = [d["code"] for j in jobs for d in j["diagnostics"]]
+    assert "FT185" in codes and "FT187" in codes
+    tf = jobs[0].get("typeflow")
+    assert tf and tf["summary"]["pickle_edges"] == 1
+    assert any(e["codec_tier"] == "pickle" for e in tf["edges"])
+    # the job never executed: lint captures, doesn't run
+    assert "seeded-job" in r.stdout or jobs
