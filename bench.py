@@ -1086,6 +1086,96 @@ def bench_state_chain(n_events=1 << 17, n_keys=64, window_ms=16000,
     }
 
 
+def bench_state_chain_fires(n_events=1 << 17, n_keys=256, window_ms=1000,
+                            chunk=8192):
+    """Fire-dominated twin of state_chain: 256 keys x 1s tumbling
+    windows over a 131s event span = ~34k window FIRES, with a
+    watermark per chunk so fires interleave with ingest.  Both sides
+    ingest through the identical columnar process_batch path — the A/B
+    toggle is `WindowOperator.batch_fires`: (A) the columnar timer
+    sweep + one-gather watermark fire against (B) the per-timer scalar
+    drain (one state.get / one D2H per fired (key, window) on the
+    device backend).  Both sides' emissions must match the numpy
+    reference, so the delta is exactly the per-fire tax.  Headline =
+    the TPU backend pair; the heap pair rides in extras."""
+    from flink_tpu.core.functions import as_key_selector
+    from flink_tpu.core.state import AggregatingStateDescriptor
+    from flink_tpu.ops.device_agg import SumAggregate
+    from flink_tpu.streaming.elements import RecordBatch
+    from flink_tpu.streaming.harness import OneInputStreamOperatorTestHarness
+    from flink_tpu.streaming.window_operator import WindowOperator
+    from flink_tpu.streaming.windowing import TumblingEventTimeWindows
+
+    rng = np.random.default_rng(37)
+    keys64 = rng.integers(0, n_keys, n_events).astype(np.int64)
+    vals64 = rng.integers(0, 100, n_events).astype(np.int64)
+    ts64 = np.arange(n_events, dtype=np.int64)
+    vals_f = vals64.astype(np.float64)
+    wstart = ts64 - ts64 % window_ms
+    ref = {}
+    for k, w, v in zip(keys64.tolist(), wstart.tolist(), vals64.tolist()):
+        ref[(k, w)] = ref.get((k, w), 0) + v
+    expected = sorted((k, w, float(s)) for (k, w), s in ref.items())
+
+    class _KVSum(SumAggregate):
+        def __init__(self):
+            super().__init__(np.float32)
+
+        def extract_value(self, value):
+            return value[1] if isinstance(value, tuple) else value
+
+    def one_pass(backend, batch_fires):
+        op = WindowOperator(
+            TumblingEventTimeWindows.of(window_ms),
+            AggregatingStateDescriptor("bench-fire-sum", _KVSum()),
+            window_function=lambda k, w, vs: [(k, w.start, float(v))
+                                              for v in vs])
+        op.batch_fires = batch_fires
+        h = OneInputStreamOperatorTestHarness(
+            op, key_selector=as_key_selector(0), state_backend=backend)
+        h.open()
+        t0 = time.perf_counter()
+        for i in range(0, n_events, chunk):
+            h.process_batch(RecordBatch(
+                {"f0": keys64[i:i + chunk], "f1": vals_f[i:i + chunk]},
+                ts=ts64[i:i + chunk]))
+            h.process_watermark(int(ts64[min(i + chunk, n_events) - 1]))
+        h.process_watermark(1 << 60)
+        elapsed = time.perf_counter() - t0
+        got = sorted((int(k), int(w), float(v))
+                     for k, w, v in h.extract_output_values())
+        assert got == expected, \
+            f"{backend} {'batched' if batch_fires else 'per-timer'} " \
+            f"fire path diverged ({len(got)} vs {len(expected)} windows)"
+        assert op.boxed_fallbacks == 0 and op.columnar_rows == n_events, \
+            (op.boxed_fallbacks, op.columnar_fallback_reason)
+        return len(expected) / elapsed
+
+    rates = {}
+    for backend in ("tpu", "heap"):
+        one_pass(backend, True)    # warm: device tables, jit, dispatch
+        one_pass(backend, False)
+        batch_rate = row_rate = 0.0
+        for _rep in range(3):
+            row_rate = max(row_rate, one_pass(backend, False))
+            batch_rate = max(batch_rate, one_pass(backend, True))
+        rates[backend] = (batch_rate, row_rate)
+        log(f"[bench] state_chain_fires[{backend}]: batch "
+            f"{batch_rate/1e3:.1f} k fires/s, per-timer "
+            f"{row_rate/1e3:.1f} k fires/s, ratio "
+            f"{batch_rate/row_rate:.2f}x")
+    batch_rate, row_rate = rates["tpu"]
+    assert batch_rate >= 2.0 * row_rate, \
+        f"batched window fires only {batch_rate/row_rate:.2f}x over " \
+        f"per-timer on the tpu backend (acceptance floor is 2x)"
+    return batch_rate, row_rate, {
+        "heap_batch_fires_per_sec": round(rates["heap"][0]),
+        "heap_row_fires_per_sec": round(rates["heap"][1]),
+        "heap_vs_row": round(rates["heap"][0] / rates["heap"][1], 2),
+        "window_fires": len(expected),
+    }
+
+
 def chaos_smoke() -> int:
     """One seeded chaos run per executor: injected storage failures,
     lost checkpoint acks, and a task crash must leave the output
@@ -1166,6 +1256,7 @@ def main():
         ("shuffle", bench_shuffle),
         ("columnar_chain", bench_columnar_chain),
         ("state_chain", bench_state_chain),
+        ("state_chain_fires", bench_state_chain_fires),
     ]
     # diagnostics: runnable by name, excluded from the default suite
     # (they document measured LIMITS, not headline configs)

@@ -463,6 +463,12 @@ def _top_device_footer(metrics, prev=None, dt=0.0) -> str:
     fl = rate("flushes")
     line += ("; flushes " + (f"{fl:,.1f}/s" if fl is not None
                              else f"{g('flushes'):,.0f}"))
+    # prefer the sample-delta rate (same horizon as the other /s
+    # figures); fall back to the telemetry plane's own ring gauge
+    wf = rate("windowsFired")
+    if wf is None:
+        wf = g("windowsFiredRate")
+    line += f"; fired {wf:,.1f}/s"
     line += f"; fire/flush {g('fireFlushRatio'):,.2f}"
     return line
 

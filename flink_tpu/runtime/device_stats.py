@@ -124,6 +124,10 @@ class DeviceTelemetry:
         self.flush_rows = 0
         self.fire_reads = 0
         self.windows_fired = 0
+        #: (monotonic seconds, cumulative windows_fired) samples, one
+        #: per note_windows_fired — bounded ring feeding the
+        #: windows-fired/s rate gauge
+        self._fired_ring: deque = deque(maxlen=64)
 
     # ---- lifecycle --------------------------------------------------
     def enable(self) -> None:
@@ -142,6 +146,7 @@ class DeviceTelemetry:
             self.flush_rows = 0
             self.fire_reads = 0
             self.windows_fired = 0
+            self._fired_ring.clear()
 
     # ---- recording (callers guard on .enabled) ----------------------
     def record_transfer(self, direction: str, nbytes: int,
@@ -226,6 +231,8 @@ class DeviceTelemetry:
         if n:
             with self._lock:
                 self.windows_fired += n
+                self._fired_ring.append(
+                    (time.monotonic(), self.windows_fired))
 
     # ---- aggregation ------------------------------------------------
     def direction_totals(self) -> Dict[str, Dict[str, float]]:
@@ -246,6 +253,28 @@ class DeviceTelemetry:
     def fire_flush_ratio(self) -> float:
         flushes = self.flushes
         return (self.fire_reads / flushes) if flushes else 0.0
+
+    def windows_fired_rate(self, horizon: float = 5.0) -> float:
+        """Windows fired per second over roughly the last ``horizon``
+        seconds: the cumulative count's slope against the oldest ring
+        sample still inside the horizon (or the oldest sample at all —
+        a sparse firer still gets a rate).  0.0 when fewer than two
+        samples or no time has passed — rate undefined, not infinite."""
+        now = time.monotonic()
+        with self._lock:
+            ring = list(self._fired_ring)
+        if len(ring) < 2:
+            return 0.0
+        base_t, base_c = ring[0]
+        for t, c in ring:
+            if now - t <= horizon:
+                break
+            base_t, base_c = t, c
+        latest_t, latest_c = ring[-1]
+        dt = latest_t - base_t
+        if dt <= 0.0 or latest_c <= base_c:
+            return 0.0
+        return (latest_c - base_c) / dt
 
     def hbm_snapshot(self) -> Dict[str, Any]:
         """Device-memory picture: runtime ``memory_stats()`` when the
@@ -347,6 +376,7 @@ class DeviceTelemetry:
                 "windows_fired": self.windows_fired,
             }
         counters["fire_flush_ratio"] = round(self.fire_flush_ratio(), 4)
+        counters["windows_fired_rate"] = round(self.windows_fired_rate(), 2)
         return {
             "enabled": self.enabled,
             "counters": counters,
@@ -381,6 +411,7 @@ def register_device_gauges(metrics) -> None:
     g.gauge("flushRows", lambda: t.flush_rows)
     g.gauge("fireReads", lambda: t.fire_reads)
     g.gauge("windowsFired", lambda: t.windows_fired)
+    g.gauge("windowsFiredRate", lambda: t.windows_fired_rate())
     g.gauge("fireFlushRatio", lambda: t.fire_flush_ratio())
 
     def _dir(direction, field):

@@ -262,6 +262,74 @@ class KeyedStateBackend(abc.ABC):
         STATE_STATS.row_fallback_rows += n
         return "rows"
 
+    def get_batch(self, state, keys, namespace, namespaces=None):
+        """Read a whole column of (keys[i], namespace-or-namespaces[i])
+        contents out of `state` — the batched twin of ``state.get()``,
+        the window FIRE path's one-gather read.
+
+        Returns ``(results, found, path)``: `results` indexes per row
+        (an ndarray for device states, a list for host states), `found`
+        is a bool mask (False rows have no state — the scalar get()'s
+        None), and `path` is ``"batch"`` or ``"rows"``.
+
+        Dispatches to the state object's native ``get_batch`` when it
+        has one (ONE flush + ONE fused gather + ONE D2H per component
+        on the TPU backend, direct column reads on the heap tables);
+        otherwise falls back to the exact per-row loop
+        (set_current_key + set_current_namespace + state.get) so
+        opaque-object states keep bit-identical semantics.
+
+        Leaves the backend's current key/namespace context undefined —
+        callers in a row context must re-establish it.
+        """
+        from flink_tpu.state.stats import STATE_STATS
+        n = len(keys)
+        native = getattr(state, "get_batch", None)
+        if native is not None:
+            results, found = native(keys, namespace, namespaces=namespaces)
+            STATE_STATS.batch_calls += 1
+            STATE_STATS.batch_rows += n
+            return results, found, "batch"
+        results = []
+        found = np.empty(n, bool)
+        if namespaces is None:
+            state.set_current_namespace(namespace)
+        for i in range(n):
+            self.set_current_key(keys[i])
+            if namespaces is not None:
+                state.set_current_namespace(namespaces[i])
+            v = state.get()
+            results.append(v)
+            found[i] = v is not None
+        STATE_STATS.row_fallback_calls += 1
+        STATE_STATS.row_fallback_rows += n
+        return results, found, "rows"
+
+    def clear_batch(self, state, keys, namespace, namespaces=None) -> str:
+        """Drop a whole column of (keys[i], namespace-or-namespaces[i])
+        slots from `state` — the batched twin of ``state.clear()``, the
+        fire path's one-call cleanup.  Returns the path taken ("batch"
+        or "rows"); fallback semantics per row are exactly
+        set_current_key + set_current_namespace + state.clear().
+
+        Leaves the backend's current key/namespace context undefined.
+        """
+        native = getattr(state, "clear_batch", None)
+        if native is not None:
+            native(keys, namespace, namespaces=namespaces)
+            return "batch"
+        if namespaces is None:
+            state.set_current_namespace(namespace)
+            for k in keys:
+                self.set_current_key(k)
+                state.clear()
+        else:
+            for i, k in enumerate(keys):
+                self.set_current_key(k)
+                state.set_current_namespace(namespaces[i])
+                state.clear()
+        return "rows"
+
     # ---- introspection ----------------------------------------------
     @abc.abstractmethod
     def get_keys(self, state_name: str, namespace) -> Iterable[Any]:

@@ -285,6 +285,11 @@ def split_column_by_key_group(keys, max_parallelism: int):
         start = end
 
 
+#: sentinel for "no namespace seen yet" in the batched read's
+#: last-block cache (None and () are both real namespaces)
+_NO_NAMESPACE = object()
+
+
 class _AbstractHeapState:
     def __init__(self, backend: "HeapKeyedStateBackend", descriptor: StateDescriptor,
                  table: StateTable):
@@ -302,6 +307,64 @@ class _AbstractHeapState:
 
     def clear(self) -> None:
         self._table.remove(self._key, self._namespace)
+
+    def clear_batch(self, keys, namespace, namespaces=None) -> None:
+        """Batched twin of clear(): one table.remove per row, no
+        backend key-context churn (the fire path's one-call cleanup)."""
+        remove = self._table.remove
+        if namespaces is None:
+            for k in keys:
+                remove(k, namespace)
+        else:
+            for i, k in enumerate(keys):
+                remove(k, namespaces[i])
+
+    def _get_rows_batch(self, keys, namespace, namespaces) -> list:
+        """Raw stored values for many (key, namespace) rows — COLUMN-
+        DIRECT when the table is a ColumnStateTable: one block fetch
+        per distinct namespace, values read straight out of the typed
+        numpy column (the identical .item() boxing scalar reads
+        perform).  Absent rows are None."""
+        n = len(keys)
+        out: list = [None] * n
+        blocks = getattr(self._table, "blocks", None)
+        if blocks is None:
+            get = self._table.get
+            if namespaces is None:
+                for i in range(n):
+                    out[i] = get(keys[i], namespace)
+            else:
+                for i in range(n):
+                    out[i] = get(keys[i], namespaces[i])
+            return out
+        if namespaces is None:
+            b = blocks.get(namespace)
+            if b is None:
+                return out
+            idx, boxed, vals = b.index, b.boxed, b.vals
+            for i in range(n):
+                slot = idx.get(keys[i])
+                if slot is not None:
+                    out[i] = (boxed[slot] if boxed is not None
+                              else vals[slot].item())
+            return out
+        # per-row namespaces arrive grouped-by-window from the timer
+        # sweep, so caching the last block makes this one dict fetch
+        # per distinct window, not per row
+        cur: Any = _NO_NAMESPACE
+        b = None
+        for i in range(n):
+            ns = namespaces[i]
+            if ns != cur:
+                cur = ns
+                b = blocks.get(ns)
+            if b is None:
+                continue
+            slot = b.index.get(keys[i])
+            if slot is not None:
+                out[i] = (b.boxed[slot] if b.boxed is not None
+                          else b.vals[slot].item())
+        return out
 
     @staticmethod
     def _group_rows(keys, namespace, namespaces):
@@ -374,6 +437,13 @@ class HeapListState(_AbstractHeapState, ListState):
             else:
                 cur.extend(rows)
 
+    def get_batch(self, keys, namespace, namespaces=None):
+        """Batched twin of get(): one table read per row, contents
+        copied exactly as get() does (empty lists read as absent)."""
+        rows = self._get_rows_batch(keys, namespace, namespaces)
+        found = np.fromiter((bool(v) for v in rows), bool, len(rows))
+        return [list(v) if v else None for v in rows], found
+
     def merge_namespaces(self, target, sources) -> None:
         """(ref: InternalMergingState#mergeNamespaces via
         HeapListState — concatenation)."""
@@ -411,6 +481,14 @@ class HeapReducingState(_AbstractHeapState, ReducingState):
                 v = values[i]
                 cur = v if cur is None else reduce(cur, v)
             self._table.put(k, ns, cur)
+
+    def get_batch(self, keys, namespace, namespaces=None):
+        """Batched twin of get(): direct column reads (the reduced
+        value IS the stored value), no key-context churn."""
+        rows = self._get_rows_batch(keys, namespace, namespaces)
+        found = np.fromiter((v is not None for v in rows), bool,
+                            len(rows))
+        return rows, found
 
     def merge_namespaces(self, target, sources) -> None:
         merged = self._table.get(self._key, target)
@@ -457,6 +535,16 @@ class HeapAggregatingState(_AbstractHeapState, AggregatingState):
                     acc = agg.create_accumulator()
                 acc = agg.add(values[i], acc)
             self._table.put(k, ns, acc)
+
+    def get_batch(self, keys, namespace, namespaces=None):
+        """Batched twin of get(): accumulators read column-direct,
+        finalized per row through agg.get_result in row order — the
+        exact scalar result for any aggregate function."""
+        accs = self._get_rows_batch(keys, namespace, namespaces)
+        get_result = self._agg.get_result
+        found = np.fromiter((a is not None for a in accs), bool,
+                            len(accs))
+        return [None if a is None else get_result(a) for a in accs], found
 
     def merge_namespaces(self, target, sources) -> None:
         merged = self._table.get(self._key, target)

@@ -189,9 +189,10 @@ class DeviceAggregatingState(AggregatingState):
         self._flush()
         # never evict recently touched slots: a batch mid-assembly
         # references up to `microbatch` freshly assigned slots (the
-        # chunked add_batch/get_batch bound), and a merge mid-flight
-        # re-stamps its sources just before allocating the target —
-        # the +16 margin covers the merge's source set
+        # chunked add_batch bound; get_batch never allocates), and a
+        # merge mid-flight re-stamps its sources just before
+        # allocating the target — the +16 margin covers the merge's
+        # source set
         protected = self._clock - (2 * self.microbatch + 16)
         candidates = [(self._access_stamp[s], s)
                       for s, meta in enumerate(self.slot_meta)
@@ -408,44 +409,82 @@ class DeviceAggregatingState(AggregatingState):
         return out.item() if np.ndim(out) == 0 else out
 
     def get_batch(self, keys, namespace, namespaces=None) -> Tuple[np.ndarray, np.ndarray]:
-        """Gather results for many (key, namespace) pairs in one device
-        call; returns (results, found_mask).  Namespace semantics as in
-        `add_batch`."""
+        """Gather results for many (key, namespace) pairs in ONE device
+        round-trip: one pending-ring flush, one fused jit gather, one
+        D2H per component — the batched window-fire read.  Spill-tier
+        rows are finalized from their host-resident accumulators
+        WITHOUT promotion (a fire is a read; lifting cold rows into
+        HBM per fired window would re-pay the per-row transfer tax
+        this path exists to amortize).  No slot allocation or eviction
+        can happen here, so no chunking is needed.  Returns
+        (results, found_mask); namespace semantics as in `add_batch`."""
         keys = list(keys)
-        if self.max_device_slots is not None \
-                and len(keys) > self.microbatch:
-            # chunked for the same eviction-window reason as add_batch:
-            # a promotion late in the loop must not evict a slot
-            # resolved earlier in the same chunk
-            outs, founds = [], []
-            for i in range(0, len(keys), self.microbatch):
-                sl = slice(i, i + self.microbatch)
-                r, f = self.get_batch(
-                    keys[sl], namespace,
-                    namespaces=None if namespaces is None
-                    else namespaces[sl])
-                outs.append(r)
-                founds.append(f)
-            return np.concatenate(outs), np.concatenate(founds)
-        slots = []
-        found = []
+        n = len(keys)
+        slot_index = self.slot_index
+        host_tier = self.host_tier
+        slots = np.zeros(n, np.int32)
+        found = np.zeros(n, bool)
+        spill_idx: List[int] = []
+        spill_rows: List[Dict[str, np.ndarray]] = []
         for i, k in enumerate(keys):
-            ns = namespace if namespaces is None else namespaces[i]
-            s = self._slot_for(k, ns, create=False)
-            found.append(s is not None)
-            slots.append(s if s is not None else 0)
-        self._flush()
+            entry = (k, namespace if namespaces is None else namespaces[i])
+            s = slot_index.get(entry)
+            if s is not None:
+                slots[i] = s
+                found[i] = True
+                # reads stamp the LRU clock exactly as scalar get()
+                self._clock += 1
+                self._access_stamp[s] = self._clock
+                continue
+            row = host_tier.get(entry)
+            if row is not None:
+                spill_idx.append(i)
+                spill_rows.append(row)
+                found[i] = True
+        self._flush()  # ONE flush for the whole sweep
         if TELEMETRY.enabled:
             t0 = _perf_ns()
             res = np.asarray(self._jit_result(
-                self.device_state, jnp.asarray(np.array(slots, np.int32))))
+                self.device_state, jnp.asarray(slots)))
             TELEMETRY.record_transfer("d2h", res.nbytes, t0, _perf_ns(),
                                       "state.fire")
             TELEMETRY.note_fire_read()
         else:
             res = np.asarray(self._jit_result(
-                self.device_state, jnp.asarray(np.array(slots, np.int32))))
-        return res, np.array(found, bool)
+                self.device_state, jnp.asarray(slots)))
+        if spill_idx:
+            res = np.array(res)  # the gather's output is read-only
+            res[spill_idx] = self._finalize_spilled(spill_rows)
+        return res, found
+
+    def _finalize_spilled(self, rows: List[Dict[str, np.ndarray]]) -> np.ndarray:
+        """Result extraction for spill-tier rows without promotion:
+        stack the host-resident accumulator rows into a pow2-padded
+        [m, ...] state and run the SAME jit result kernel over it —
+        bit-identical finalization (query_by_key's single-row idiom,
+        batched), zero HBM slot traffic."""
+        m = len(rows)
+        padded = _round_up_pow2(m)
+        state = {}
+        nbytes_in = 0
+        for name in self.device_state:
+            col = np.stack([r[name] for r in rows])
+            if padded != m:
+                pad = np.zeros((padded - m,) + col.shape[1:], col.dtype)
+                col = np.concatenate([col, pad])
+            nbytes_in += col.nbytes
+            state[name] = jnp.asarray(col)
+        idx = jnp.asarray(np.arange(padded, dtype=np.int32))
+        if TELEMETRY.enabled:
+            t0 = _perf_ns()
+            out = np.asarray(self._jit_result(state, idx))
+            TELEMETRY.record_transfer("h2d", nbytes_in, t0, t0,
+                                      "state.fire.spill")
+            TELEMETRY.record_transfer("d2h", out.nbytes, t0, _perf_ns(),
+                                      "state.fire.spill")
+        else:
+            out = np.asarray(self._jit_result(state, idx))
+        return out[:m]
 
     def query_by_key(self, key, namespace):
         """Queryable-state read from a FOREIGN thread (ref:

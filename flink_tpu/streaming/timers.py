@@ -276,6 +276,48 @@ class InternalTimerService:
             self._backend.set_current_key(key)
             self._triggerable.on_event_time(InternalTimer(ts, key, namespace))
 
+    def pop_due_event_time_timers(
+            self, watermark: int) -> Tuple[List[int], List[Any], List[Any]]:
+        """Bulk sweep: pop EVERY due event-time timer <= watermark and
+        return (timestamps, keys, namespaces) as parallel columns in
+        the exact per-row order advance_watermark would have fired
+        them (heap (timestamp, seq) order; lazily-deleted entries
+        skipped).  The watermark advances exactly as advance_watermark
+        does; FIRING is the caller's job.
+
+        Contract: only valid when the caller's timer callbacks would
+        not have registered NEW timers <= watermark mid-drain (the
+        batched window fire path qualifies: the default
+        EventTimeTrigger registers nothing from on_event_time) — a
+        timer registered during the sweep's processing fires on the
+        NEXT watermark instead of the current one."""
+        self.current_watermark = watermark
+        heap = self._event_heap
+        live = self._event_set
+        timestamps: List[int] = []
+        keys: List[Any] = []
+        namespaces: List[Any] = []
+        pop = heapq.heappop
+        while heap and heap[0][0] <= watermark:
+            ts, _, key, namespace = pop(heap)
+            entry = (ts, key, namespace)
+            if entry not in live:
+                continue  # deleted
+            live.remove(entry)
+            timestamps.append(ts)
+            keys.append(key)
+            namespaces.append(namespace)
+        return timestamps, keys, namespaces
+
+    def delete_event_time_timers_bulk(self, entries) -> None:
+        """Bulk lazy delete: `entries` yields (timestamp, key,
+        namespace) triples.  Semantics per entry are identical to
+        delete_event_time_timer (set removal; stale heap nodes are
+        skipped on pop) without touching the backend's current-key
+        context — the batched fire path drops every cleaned window's
+        trigger timer in one call."""
+        self._event_set.difference_update(entries)
+
     def _on_processing_time(self, fired_at: int) -> None:
         self._next_proc_registered = None
         now = self._pts.get_current_processing_time()

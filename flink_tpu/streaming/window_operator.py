@@ -18,6 +18,7 @@ the Evictor before/after the window function
 from __future__ import annotations
 
 import abc
+import contextlib
 from typing import Any, Iterable, List, Optional, Tuple
 
 import numpy as np
@@ -34,6 +35,7 @@ from flink_tpu.runtime.tracing import get_tracer
 from flink_tpu.streaming.elements import MAX_TIMESTAMP, StreamRecord
 from flink_tpu.streaming.operators import (
     AbstractUdfStreamOperator,
+    Output,
     OutputTag,
     TimestampedCollector,
 )
@@ -271,6 +273,32 @@ class _AssignerContext:
 
     def get_current_processing_time(self):
         return self._op.processing_time_service.get_current_processing_time()
+
+
+class _FireBufferOutput(Output):
+    """Captures the main-stream records emitted during ONE batched
+    fire sweep so they can be re-emitted as a single RecordBatch.
+    Watermarks, side outputs, and latency markers pass straight
+    through to the real output (a side tag has no ordering contract
+    against the main stream)."""
+
+    __slots__ = ("_inner", "records")
+
+    def __init__(self, inner: Output):
+        self._inner = inner
+        self.records: List[StreamRecord] = []
+
+    def collect(self, record: StreamRecord) -> None:
+        self.records.append(record)
+
+    def emit_watermark(self, watermark) -> None:
+        self._inner.emit_watermark(watermark)
+
+    def collect_side(self, tag: OutputTag, record: StreamRecord) -> None:
+        self._inner.collect_side(tag, record)
+
+    def emit_latency_marker(self, marker) -> None:
+        self._inner.emit_latency_marker(marker)
 
 
 class WindowOperator(AbstractUdfStreamOperator):
@@ -666,6 +694,151 @@ class WindowOperator(AbstractUdfStreamOperator):
             self._clear_all_state(window, merging)
         if merging is not None:
             merging.persist()
+
+    # ---- batched watermark fires ------------------------------------
+    #: kill switch / A-B toggle: False pins the per-timer scalar fire
+    #: path even for batch-eligible operators (the differential suite
+    #: and the bench A/B flip this)
+    batch_fires = True
+
+    def process_watermark(self, watermark) -> None:
+        """Watermark: the batch-eligible shape (tumbling/sliding
+        event-time windows with their default trigger — the same
+        structural test process_batch uses) takes the columnar fire
+        sweep; everything else (merging assigners, custom triggers,
+        evictors, processing-time assigners) keeps the per-timer drain
+        in advance_watermark."""
+        if (self.timer_service is None or not self.batch_fires
+                or getattr(self, "_batch_demote_reason", "unopened")
+                is not None):
+            super().process_watermark(watermark)
+            return
+        self.current_watermark = watermark.timestamp
+        self.on_watermark_batch(watermark.timestamp)
+        self.output.emit_watermark(watermark)
+
+    def on_watermark_batch(self, watermark: int) -> None:
+        """Columnar fire: ONE timer sweep → vectorized
+        EventTimeTrigger decision → ONE backend gather for every
+        firing (key, window) → in-pop-order emit (one RecordBatch when
+        the results columnarize) → ONE batch state clear + bulk
+        cleanup-timer delete.
+
+        Exactness vs the per-timer loop: the default EventTimeTrigger
+        neither writes state nor registers timers from on_event_time,
+        distinct (key, window) slots are independent, and within one
+        slot the fire timer (max_timestamp) pops before the cleanup
+        timer (max_timestamp + lateness) — with lateness 0 the two
+        dedup into ONE timer that fires then cleans — so gathering
+        every firing slot BEFORE the batch clear reads exactly what
+        the interleaved scalar drain read, in the same order.  The
+        differential suite (tests/test_fire_batch.py) pins the two
+        paths bit-equal."""
+        svc = self.timer_service
+        ts_col, key_col, ns_col = svc.pop_due_event_time_timers(watermark)
+        n = len(ts_col)
+        if n == 0:
+            return
+        lateness = self.allowed_lateness
+        tarr = np.fromiter(ts_col, np.int64, n)
+        maxts = np.fromiter((ns[1] for ns in ns_col), np.int64, n) - 1
+        # EventTimeTrigger.on_event_time: FIRE iff time == maxTimestamp
+        fire = tarr == maxts
+        if lateness == 0:
+            cleanup = fire  # fire and cleanup are the SAME dedup'd timer
+        else:
+            # a cleanup timer at/after MAX_TIMESTAMP is never
+            # registered, so int64 wraparound on an astronomical
+            # lateness yields False — exactly "no cleanup timer"
+            with np.errstate(over="ignore"):
+                cleanup = tarr == maxts + lateness
+        backend = self.keyed_backend
+        emitted = 0
+        fired_idx = np.nonzero(fire)[0]
+        if fired_idx.size:
+            rows = fired_idx.tolist()
+            contents_col, found_mask, _path = backend.get_batch(
+                self.window_state, [key_col[i] for i in rows], None,
+                namespaces=[ns_col[i] for i in rows])
+            emitted = self._emit_fired_columns(
+                rows, key_col, ns_col, contents_col, found_mask)
+        if TELEMETRY.enabled and emitted:
+            TELEMETRY.note_windows_fired(emitted)
+        cleanup_idx = np.nonzero(cleanup)[0]
+        if cleanup_idx.size:
+            rows = cleanup_idx.tolist()
+            backend.clear_batch(
+                self.window_state, [key_col[i] for i in rows], None,
+                namespaces=[ns_col[i] for i in rows])
+            if lateness:
+                # EventTimeTrigger.clear: drop the max_timestamp fire
+                # timer (with lateness 0 that timer IS the one just
+                # swept — nothing left to delete)
+                svc.delete_event_time_timers_bulk(
+                    (int(maxts[i]), key_col[i], ns_col[i]) for i in rows)
+            if isinstance(self._internal_fn.fn, ProcessWindowFunction):
+                wt = self.assigner.window_type()
+                for i in rows:
+                    backend.set_current_key(key_col[i])
+                    self._internal_fn.clear(
+                        key_col[i], wt.from_namespace(ns_col[i]), self)
+
+    def _emit_fired_columns(self, rows, key_col, ns_col, contents_col,
+                            found_mask) -> int:
+        """Run the window function over the gathered contents in pop
+        order, buffering the emissions; flush as ONE RecordBatch when
+        the rows columnarize (per-row records otherwise, same order).
+        Returns the number of windows that emitted — the scalar path's
+        windowsFired increments, applied in one note."""
+        wt = self.assigner.window_type()
+        backend = self.keyed_backend
+        hist = self._emit_batch_hist
+        # a device gather hands back an ndarray: unbox 0-d rows exactly
+        # as scalar get() does (`out.item() if np.ndim(out) == 0`);
+        # heap results are python objects and pass through untouched
+        unbox = isinstance(contents_col, np.ndarray)
+        buf = _FireBufferOutput(self.output)
+        collector = TimestampedCollector(buf)
+        tracer = get_tracer()
+        span = (tracer.span("window.fire.batch") if tracer.enabled
+                else contextlib.nullcontext())
+        fired = 0
+        with span:
+            for j, i in enumerate(rows):
+                if not found_mask[j]:
+                    continue
+                contents = contents_col[j]
+                if unbox:
+                    if np.ndim(contents) == 0:
+                        contents = contents.item()
+                elif contents is None:
+                    continue
+                window = wt.from_namespace(ns_col[i])
+                backend.set_current_key(key_col[i])
+                if hist is not None:
+                    hist.update(len(contents)
+                                if hasattr(contents, "__len__") else 1)
+                collector.set_absolute_timestamp(window.max_timestamp())
+                self._internal_fn.process(key_col[i], window, self,
+                                          contents, collector)
+                fired += 1
+        records = buf.records
+        if not records:
+            return fired
+        batch = None
+        if len(records) > 1:
+            from flink_tpu.streaming import columnar
+            if columnar.PIPELINE_ENABLED:
+                batch = columnar.batch_from_records(
+                    [r.value for r in records],
+                    [r.timestamp for r in records])
+        if batch is not None:
+            self.output.collect_batch(batch)
+        else:
+            collect = self.output.collect
+            for r in records:
+                collect(r)
+        return fired
 
     # ---- helpers ----------------------------------------------------
     def _react(self, result: int, window) -> None:

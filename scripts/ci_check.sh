@@ -12,9 +12,14 @@
 #   6. columnar gate — the boxed-vs-columnar differential suite, then
 #      a real-TCP shuffle smoke with the wire codec pinned ON and OFF
 #      (identical delivered streams required)
-#   7. state gate — the keyed-state differential suite, then the
-#      heap-vs-tpu batched-ingest smoke with a mid-stream restore and
-#      the codec pinned on/off (bit-equal outputs required)
+#   7. state gate — the keyed-state differential suite plus the
+#      batched-fire differential suite, then the heap-vs-tpu
+#      batched-ingest smoke with a mid-stream restore and the codec
+#      pinned on/off (bit-equal outputs required), including its
+#      fire-heavy leg (250 ms windows, columnar timer sweep vs the
+#      per-timer drain) which asserts device fire-read growth stays
+#      far below windows-fired growth — one gather per watermark
+#      sweep, not one per fired window
 #
 # Stages keep running after a failure so one report covers
 # everything; rc is non-zero if ANY stage failed.
@@ -54,8 +59,9 @@ python -m pytest tests/test_columnar_pipeline.py -q \
 python scripts/columnar_smoke.py || rc=1
 
 echo
-echo "== stage 7/7: state differential + batched-ingest smoke =="
-python -m pytest tests/test_state_batch.py -q \
+echo "== stage 7/7: state differential + batched-ingest/fire smoke =="
+python -m pytest tests/test_state_batch.py tests/test_fire_batch.py \
+    tests/test_timer_sweep.py -q \
     -p no:cacheprovider -p no:xdist -p no:randomly || rc=1
 python scripts/state_smoke.py || rc=1
 

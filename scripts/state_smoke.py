@@ -1,12 +1,17 @@
-"""CI smoke for the keyed-state tier (scripts/ci_check.sh stage 6).
+"""CI smoke for the keyed-state tier (scripts/ci_check.sh state gate).
 
 Runs the same windowed aggregation — batched ingest plus a mid-stream
 snapshot/restore — on the heap and TPU backends, with the column wire
 codec available and with it pinned OFF (snapshot key columns degrade
 to the pickle tier), and requires every pass to reproduce the per-row
 scalar reference exactly: values AND timestamps, in emission order,
-with zero boxed fallbacks on the batch side.  A smoke, not a
-benchmark: small event count, correctness asserts only.
+with zero boxed fallbacks on the batch side.  A fire-heavy leg
+(250 ms windows) repeats the exercise with the columnar watermark
+fire sweep toggled against the per-timer drain, across the same
+restore, and asserts the device backend's fire-read count stays far
+below its windows-fired count (one gather per sweep, not one per
+fired window).  A smoke, not a benchmark: small event count,
+correctness asserts only.
 
 Exit code 0 = clean.
 """
@@ -24,7 +29,7 @@ CHUNK = 256
 N_KEYS = 11
 
 
-def make_operator():
+def make_operator(window_ms=1000):
     from flink_tpu.core.state import AggregatingStateDescriptor
     from flink_tpu.ops.device_agg import SumAggregate
     from flink_tpu.streaming.window_operator import WindowOperator
@@ -42,7 +47,7 @@ def make_operator():
             yield (key, float(v), window.start)
 
     return WindowOperator(
-        TumblingEventTimeWindows.of(1000),
+        TumblingEventTimeWindows.of(window_ms),
         AggregatingStateDescriptor("smoke-sum", _KVSum()),
         window_function=fn)
 
@@ -97,6 +102,52 @@ def run_pass(backend, batched, snapshot_at=None):
     return out
 
 
+def run_fire_pass(backend, batch_fires, snapshot_at=None):
+    """Fire-heavy leg: 250 ms windows under the same keyed sum, so
+    every per-chunk watermark fires a spread of (key, window) slots
+    while later windows' timers are registered but NOT yet due — a
+    mid-stream snapshot must carry those swept-but-unfired timers.
+    `batch_fires` toggles the columnar sweep vs the per-timer scalar
+    drain; ingest is the identical batched path on both sides."""
+    from flink_tpu.streaming.elements import RecordBatch
+    from flink_tpu.streaming.harness import OneInputStreamOperatorTestHarness
+    from flink_tpu.streaming.window_operator import WindowOperator
+
+    def fresh():
+        op = make_operator(window_ms=250)
+        assert isinstance(op, WindowOperator)
+        op.batch_fires = batch_fires
+        h = OneInputStreamOperatorTestHarness(
+            op, key_selector=lambda x: x[0], state_backend=backend)
+        h.open()
+        return h
+
+    h = fresh()
+    rng = np.random.default_rng(4321)
+    out = []
+    for chunk in range(N_CHUNKS):
+        keys, vals, ts = chunk_arrays(chunk, rng)
+        h.process_batch(RecordBatch({"f0": keys, "f1": vals}, ts=ts))
+        h.process_watermark(chunk * 1000 + 500)
+        out.extend((r.value, r.timestamp) for r in h.get_output())
+        h.clear_output()
+        if snapshot_at == chunk:
+            timers_live = h.operator.timer_service.num_event_time_timers()
+            assert timers_live > 0, \
+                "fire leg expected undue timers pending at the snapshot"
+            snap = h.snapshot()
+            h = fresh()
+            h.initialize_state(snap)
+            restored = h.operator.timer_service.num_event_time_timers()
+            assert restored == timers_live, \
+                f"swept-but-unfired timers lost across restore " \
+                f"({restored} vs {timers_live})"
+    h.process_watermark(10 ** 13)
+    out.extend((r.value, r.timestamp) for r in h.get_output())
+    assert h.operator.boxed_fallbacks == 0
+    return out
+
+
 def main():
     from flink_tpu.runtime import netchannel
     from flink_tpu.state.stats import STATE_STATS
@@ -130,6 +181,38 @@ def main():
             assert STATE_STATS.snapshot_rows > rows_before, \
                 "heap snapshot carried no state"
 
+    # fire-heavy leg: 250 ms windows, columnar sweep vs per-timer
+    # drain, across the same mid-stream restore, both backends — the
+    # reference is the scalar drain on the heap backend
+    from flink_tpu.runtime.device_stats import TELEMETRY
+    fire_ref = run_fire_pass("heap", batch_fires=False)
+    fire_ref_r = run_fire_pass("heap", batch_fires=False, snapshot_at=2)
+    assert fire_ref and sorted(fire_ref) == sorted(fire_ref_r)
+    for backend in ("heap", "tpu"):
+        telemetry_was = TELEMETRY.enabled
+        if backend == "tpu":
+            TELEMETRY.enable()
+        fires_before = TELEMETRY.windows_fired
+        reads_before = TELEMETRY.fire_reads
+        try:
+            out = run_fire_pass(backend, batch_fires=True)
+            assert out == fire_ref, \
+                f"{backend} batched fire path diverged from the " \
+                f"per-timer reference"
+            out = run_fire_pass(backend, batch_fires=True, snapshot_at=2)
+            assert out == fire_ref_r, \
+                f"{backend} batched fire path diverged across restore"
+            if backend == "tpu":
+                # the whole point of the sweep: one gather per
+                # watermark, not one per fired window
+                fires = TELEMETRY.windows_fired - fires_before
+                reads = TELEMETRY.fire_reads - reads_before
+                assert fires >= 4 * max(reads, 1), \
+                    f"batched fires still read per-window " \
+                    f"({reads} gathers for {fires} fires)"
+        finally:
+            TELEMETRY.enabled = telemetry_was
+
     # codec pinned OFF: snapshot key columns must degrade to the
     # pickle tier and STILL restore bit-equal
     def _refuse(values):
@@ -146,7 +229,8 @@ def main():
         netchannel._encode_value_column = saved
 
     print(f"state_smoke: OK — {N_CHUNKS * CHUNK} events, "
-          f"{len(reference)} window emissions, heap+tpu x codec on/off "
+          f"{len(reference)} window emissions (+{len(fire_ref)} on the "
+          f"fire-heavy leg), heap+tpu x codec on/off x batched fires "
           f"all bit-equal to the scalar reference across restore")
     return 0
 

@@ -90,7 +90,8 @@ def test_disabled_path_records_nothing():
     assert p["enabled"] is False
     assert p["counters"] == {"flushes": 0, "flush_rows": 0,
                              "fire_reads": 0, "windows_fired": 0,
-                             "fire_flush_ratio": 0.0}
+                             "fire_flush_ratio": 0.0,
+                             "windows_fired_rate": 0.0}
     assert p["transfers"] == {} and p["kernels"] == {}
     assert p["exchange_phases"] == {}
     assert p["totals"]["h2d"]["bytes"] == 0

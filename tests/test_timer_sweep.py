@@ -1,0 +1,166 @@
+"""The bulk timer sweep (`pop_due_event_time_timers`) that feeds the
+batched window fire path: pop-order parity with `advance_watermark`,
+dedup, bulk registration/deletion seq contracts, and snapshot
+round-trips of a half-swept heap."""
+
+import pytest
+
+from flink_tpu.core.keygroups import KeyGroupRange
+from flink_tpu.streaming.timers import InternalTimerService
+
+
+class _FakeBackend:
+    def __init__(self, max_parallelism=128):
+        self.current_key = None
+        self.max_parallelism = max_parallelism
+        self.key_group_range = KeyGroupRange(0, max_parallelism - 1)
+
+    def set_current_key(self, key):
+        self.current_key = key
+
+
+class _Recorder:
+    """Triggerable that records (timestamp, key, namespace) fire order
+    plus the backend key context at fire time."""
+
+    def __init__(self, backend):
+        self.backend = backend
+        self.fired = []
+
+    def on_event_time(self, timer):
+        assert self.backend.current_key == timer.key
+        self.fired.append((timer.timestamp, timer.key, timer.namespace))
+
+    def on_processing_time(self, timer):
+        raise AssertionError("no processing-time timers in these tests")
+
+
+def _service():
+    backend = _FakeBackend()
+    rec = _Recorder(backend)
+    svc = InternalTimerService("t", backend, None, rec)
+    return svc, backend, rec
+
+
+def _register(svc, backend, entries):
+    for ts, key, ns in entries:
+        backend.set_current_key(key)
+        svc.register_event_time_timer(ns, ts)
+
+
+MIXED = [
+    (5, "a", (0, 5)),
+    (3, "b", (0, 3)),
+    (5, "b", (0, 5)),     # same ts as first — registration order decides
+    (9, "a", (4, 9)),
+    (3, "a", (0, 3)),
+    (7, "c", (2, 7)),
+    (12, "a", (7, 12)),   # beyond the sweep watermark
+    (12, "b", (7, 12)),
+]
+
+
+def test_sweep_matches_advance_watermark_order():
+    svc1, b1, rec = _service()
+    _register(svc1, b1, MIXED)
+    svc2, b2, _ = _service()
+    _register(svc2, b2, MIXED)
+
+    svc1.advance_watermark(9)
+    ts, keys, ns = svc2.pop_due_event_time_timers(9)
+
+    assert list(zip(ts, keys, ns)) == rec.fired
+    assert svc1.current_watermark == svc2.current_watermark == 9
+    # identical survivors: only the ts=12 timers
+    assert svc1._event_set == svc2._event_set
+    assert svc2.num_event_time_timers() == 2
+
+
+def test_sweep_skips_lazily_deleted_timers():
+    svc, backend, _ = _service()
+    _register(svc, backend, MIXED)
+    backend.set_current_key("b")
+    svc.delete_event_time_timer((0, 5), 5)
+    ts, keys, ns = svc.pop_due_event_time_timers(9)
+    assert (5, "b", (0, 5)) not in set(zip(ts, keys, ns))
+    assert len(ts) == 5
+
+
+def test_sweep_dedup_single_pop_per_entry():
+    svc, backend, _ = _service()
+    backend.set_current_key("k")
+    for _ in range(3):  # re-registration is a no-op
+        svc.register_event_time_timer((0, 4), 4)
+    ts, keys, ns = svc.pop_due_event_time_timers(10)
+    assert ts == [4] and keys == ["k"] and ns == [(0, 4)]
+    # the swept timer is gone: a second sweep finds nothing
+    assert svc.pop_due_event_time_timers(10) == ([], [], [])
+
+
+def test_bulk_registration_preserves_registration_order():
+    """Same-timestamp timers pop in bulk-registration (first
+    occurrence) order — the seq contract the batched window ingest
+    relies on for deterministic same-timestamp fire order."""
+    svc, backend, _ = _service()
+    svc.register_event_time_timers_bulk((0, 8), 8, ["x", "y", "x", "z"])
+    svc.register_event_time_timers_bulk((0, 8), 8, ["y", "w"])  # dups free
+    ts, keys, ns = svc.pop_due_event_time_timers(8)
+    assert keys == ["x", "y", "z", "w"]
+    assert ts == [8, 8, 8, 8]
+
+
+def test_bulk_delete_matches_scalar_delete():
+    svc, backend, _ = _service()
+    _register(svc, backend, MIXED)
+    svc.delete_event_time_timers_bulk([
+        (3, "b", (0, 3)), (7, "c", (2, 7)),
+        (99, "zz", (0, 99)),  # absent entry: no-op, same as discard
+    ])
+    ts, keys, ns = svc.pop_due_event_time_timers(9)
+    got = set(zip(ts, keys, ns))
+    assert (3, "b", (0, 3)) not in got
+    assert (7, "c", (2, 7)) not in got
+    assert len(ts) == 4
+
+
+def test_half_swept_heap_snapshot_round_trip():
+    """Snapshot after a partial sweep: popped timers must NOT revive,
+    undue timers must survive and fire in the same order as an
+    unsnapshotted service."""
+    svc, backend, rec = _service()
+    _register(svc, backend, MIXED)
+    svc.pop_due_event_time_timers(5)  # pops ts 3,3,5,5
+    snap = svc.snapshot()
+    assert snap["watermark"] == 5
+
+    svc2, b2, rec2 = _service()
+    svc2.restore([snap])
+    assert svc2.num_event_time_timers() == svc.num_event_time_timers() == 4
+
+    ts, keys, ns = svc.pop_due_event_time_timers(100)
+    ts2, keys2, ns2 = svc2.pop_due_event_time_timers(100)
+    assert sorted(zip(ts, keys, ns)) == sorted(zip(ts2, keys2, ns2))
+    # per-timestamp order: restore rebuilds seq from set iteration, so
+    # only the (timestamp) order is contractual across a restore —
+    # which both sides honor
+    assert ts == sorted(ts) and ts2 == sorted(ts2)
+
+
+def test_sweep_then_advance_watermark_interleave():
+    """A sweep and the scalar drain compose: timers registered after a
+    sweep fire normally through advance_watermark."""
+    svc, backend, rec = _service()
+    _register(svc, backend, MIXED[:4])
+    svc.pop_due_event_time_timers(5)
+    _register(svc, backend, [(6, "z", (0, 6))])
+    svc.advance_watermark(9)
+    assert rec.fired == [(6, "z", (0, 6)), (9, "a", (4, 9))]
+
+
+@pytest.mark.parametrize("watermark", [-1, 0, 2])
+def test_sweep_below_all_timers_is_empty(watermark):
+    svc, backend, _ = _service()
+    _register(svc, backend, MIXED)
+    before = svc.num_event_time_timers()
+    assert svc.pop_due_event_time_timers(watermark) == ([], [], [])
+    assert svc.num_event_time_timers() == before
