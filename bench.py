@@ -1062,6 +1062,11 @@ def bench_state_chain(n_events=1 << 17, n_keys=64, window_ms=16000,
                 (op.boxed_fallbacks, op.columnar_fallback_reason)
         return n_events / elapsed
 
+    # the A/B isolates the per-row state tax: the introspection plane
+    # must stay disabled so its ingest hooks cannot skew either side
+    from flink_tpu.state.introspect import INTROSPECTION
+    assert not INTROSPECTION.enabled, \
+        "state introspection must be off during the state_chain A/B"
     rates = {}
     for backend in ("tpu", "heap"):
         one_pass(backend, True)    # warm: device tables, jit, dispatch

@@ -15,6 +15,11 @@ bin/flink script).
                                    [--interval S]    backpressure, watermark
                                    [--once]          lag, checkpoints,
                                                      bottleneck)
+    python -m flink_tpu state inspect <dir>          offline checkpoint
+                                   [--checkpoint N]  inspector: per-state
+                                   [--top K]         per-key-group rows/bytes,
+                                   [--parallelism P] dtypes, heaviest keys,
+                                   [--json]          rescale preview
     python -m flink_tpu list --master H:P            list cluster jobs
     python -m flink_tpu cancel --master H:P <job>    cancel a running job
                                    [-s DIR]          ... with a savepoint
@@ -91,6 +96,8 @@ def main(argv=None) -> int:
         return _taskmanager(rest)
     if verb == "top":
         return _top(rest)
+    if verb == "state":
+        return _state(rest)
     if verb == "list":
         return _list(rest)
     if verb == "cancel":
@@ -100,8 +107,8 @@ def main(argv=None) -> int:
     if verb == "stop":
         return _stop(rest)
     print(f"unknown command {verb!r}; "
-          f"try: run | lint | profile | top | list | cancel | savepoint "
-          f"| stop | info | bench | jobmanager | taskmanager",
+          f"try: run | lint | profile | top | state | list | cancel "
+          f"| savepoint | stop | info | bench | jobmanager | taskmanager",
           file=sys.stderr)
     return 2
 
@@ -397,9 +404,12 @@ def _top_rows(job, detail, metrics, prev, dt_s, hot=None):
     return rows
 
 
-def _top_state_footer(metrics) -> str:
+def _top_state_footer(metrics, state=None) -> str:
     """One-line keyed-state picture from the process-wide `state.*`
-    gauges, or "" when the server predates them."""
+    gauges plus, when the introspection plane is on, the skew and
+    hot-key cells from the `/jobs/<n>/state` payload.  "" when the
+    server predates the gauges; the skew cells degrade away when
+    introspection is disabled or the server predates the route."""
     if not any(k.startswith("state.") for k in metrics):
         return ""
 
@@ -419,6 +429,22 @@ def _top_state_footer(metrics) -> str:
                  f"evictions {g('device.evictions'):,.0f}, "
                  f"promotions {g('device.promotions'):,.0f}, "
                  f"pending {g('device.pendingDepth'):,.0f}")
+    if isinstance(state, dict) and state.get("enabled"):
+        sk = state.get("skew") or {}
+        cell = f"; skew {sk.get('ratio', 0.0):,.2f}x"
+        verdict = sk.get("verdict")
+        if verdict and verdict not in ("idle",):
+            cell += f" ({verdict})"
+        hot_kg = sk.get("hot_key_group")
+        if isinstance(hot_kg, int) and hot_kg >= 0:
+            cell += f" kg {hot_kg}"
+        line += cell
+        hot = state.get("hot_keys") or []
+        if hot:
+            h = hot[0]
+            line += (f"; hot-key {h.get('key')} "
+                     f"{float(h.get('share', 0.0)) * 100:,.0f}%"
+                     f" of {h.get('state')}")
     return line
 
 
@@ -623,6 +649,10 @@ def _top(rest) -> int:
                 flame = _top_fetch(base, f"/jobs/{q}/flamegraph")
             except OSError:  # pre-profiler server: HOT column reads "-"
                 flame = None
+            try:
+                kstate = _top_fetch(base, f"/jobs/{q}/state")
+            except OSError:  # pre-introspection server: no skew cells
+                kstate = None
             now = time.monotonic()
             if args.once and prev_t is None:
                 # rates need two samples: take a quick second one
@@ -634,7 +664,8 @@ def _top(rest) -> int:
                              hot=_top_hot_frames(flame))
             out = _top_render(job, detail.get("status"), rows,
                               checkpoints, alerts, bottleneck,
-                              state_line=_top_state_footer(full_dump),
+                              state_line=_top_state_footer(full_dump,
+                                                           kstate),
                               device_line=_top_device_footer(
                                   full_dump, prev_full, dt),
                               latency_line=_top_latency_footer(
@@ -653,6 +684,88 @@ def _top(rest) -> int:
     except OSError as e:
         print(f"cannot reach {base}: {e}", file=sys.stderr)
         return 1
+
+
+def _state(rest) -> int:
+    """Offline keyed-state tools (ref: flink-state-processor-api's
+    read-only SavepointReader, as a terminal inspector).  `state
+    inspect <dir>` reads a completed checkpoint's v2 columnar snapshot
+    chunks straight off the filesystem — no running job — and prints
+    per-state per-key-group rows/bytes, the component dtype breakdown,
+    the heaviest keys, and (with --parallelism) a rescale preview."""
+    import argparse
+    import json as _json
+
+    ap = argparse.ArgumentParser(prog="flink_tpu state")
+    sub = ap.add_subparsers(dest="cmd")
+    ins = sub.add_parser("inspect",
+                         help="inspect a checkpoint directory offline")
+    ins.add_argument("directory", help="checkpoint directory (the one "
+                                       "holding chk-N subdirs/files)")
+    ins.add_argument("--checkpoint", type=int, default=None,
+                     help="checkpoint id (default: latest completed)")
+    ins.add_argument("--top", type=int, default=10,
+                     help="how many heaviest keys to list (default 10)")
+    ins.add_argument("--parallelism", type=int, default=None,
+                     help="preview per-subtask key-group load at this "
+                          "parallelism")
+    ins.add_argument("--json", action="store_true", dest="json_out",
+                     help="emit the raw report as JSON")
+    args = ap.parse_args(rest)
+    if args.cmd != "inspect":
+        ap.print_help(sys.stderr)
+        return 2
+
+    from flink_tpu.state.introspect import inspect_checkpoint
+    try:
+        report = inspect_checkpoint(args.directory,
+                                    checkpoint_id=args.checkpoint,
+                                    top=args.top,
+                                    parallelism=args.parallelism)
+    except (FileNotFoundError, ValueError) as e:
+        print(f"state inspect: {e}", file=sys.stderr)
+        return 1
+    if args.json_out:
+        print(_json.dumps(report, indent=2, default=str))
+        return 0
+
+    print(f"checkpoint chk-{report['checkpoint_id']} "
+          f"({report['directory']})")
+    backends = ", ".join(report.get("backends") or []) or "?"
+    print(f"backends: {backends}; "
+          f"max parallelism: {report.get('max_parallelism')}")
+    states = report.get("states") or {}
+    if not states:
+        print("(no keyed state in this checkpoint)")
+        return 0
+    for name, st in states.items():
+        kgs = st["key_groups"]
+        print(f"\nstate {name!r}: {st['rows']:,} rows, "
+              f"{_fmt_bytes(st['bytes'])} across {len(kgs)} key group(s)")
+        dt = ", ".join(f"{d} {_fmt_bytes(b)}"
+                       for d, b in st["dtypes"].items())
+        if dt:
+            print(f"  dtypes: {dt}")
+        print(f"  {'kg':>5}  {'rows':>10}  {'bytes':>12}  {'ns':>4}")
+        for kg, e in st["key_groups"].items():
+            print(f"  {kg:>5}  {e['rows']:>10,}  "
+                  f"{_fmt_bytes(e['bytes']):>12}  {e['namespaces']:>4}")
+    if report.get("top_keys"):
+        print(f"\nheaviest keys (top {args.top}):")
+        for k in report["top_keys"]:
+            print(f"  {k['state']:<24} {k['key']:<24} "
+                  f"{k['rows']:>8,} rows  {_fmt_bytes(k['bytes'])}")
+    rescale = report.get("rescale")
+    if rescale:
+        print(f"\nrescale preview at parallelism "
+              f"{rescale['parallelism']} "
+              f"(max {rescale['max_parallelism']}, "
+              f"imbalance {rescale['imbalance']:.2f}x):")
+        for s in rescale["subtasks"]:
+            lo, hi = s["key_group_range"]
+            print(f"  subtask {s['subtask']:>3}  kg [{lo:>4}, {hi:>4}]  "
+                  f"{s['rows']:>10,} rows  {_fmt_bytes(s['bytes'])}")
+    return 0
 
 
 def _client(master, secret=None, tls_dir=None):

@@ -88,6 +88,7 @@ from flink_tpu.runtime.metrics import (
     MetricRegistry,
     register_network_gauges,
     register_state_gauges,
+    register_state_introspection_gauges,
 )
 from flink_tpu.runtime import netchannel
 from flink_tpu.runtime.netchannel import DataClient, DataServer
@@ -1297,6 +1298,7 @@ class TaskExecutor(RpcEndpoint):
             data_clients=lambda: [a.data_client
                                   for a in list(self._attempts.values())])
         register_state_gauges(self.metrics)
+        register_state_introspection_gauges(self.metrics)
         register_device_gauges(self.metrics)
         register_profiler_gauges(self.metrics)
         self._blob_cache: Dict[str, bytes] = {}

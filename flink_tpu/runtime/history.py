@@ -17,6 +17,7 @@ over HTTP).  Here:
   summaries, and serves `/jobs`, `/jobs/<id>`, `/overview` plus the
   per-job sub-routes `/metrics`, `/metrics/history`, `/checkpoints`,
   `/alerts`, `/device` (the archived device-telemetry ledger),
+  `/state` (the archived keyed-state introspection ledger),
   `/traces` (`?scope=cluster` replays the archived merged
   cluster trace), `/bottleneck`, `/exceptions` over a threaded HTTP
   server —
@@ -85,6 +86,16 @@ def build_archive_summary(job_name: str, state: str,
             # includes the link-probe measurement under "link"
             summary["device"] = telemetry.payload()
     except Exception:  # noqa: BLE001 — telemetry must never block archiving
+        pass
+    try:
+        from flink_tpu.state.introspect import get_introspection
+        introspection = get_introspection()
+        if introspection.enabled:
+            # the `/jobs/<n>/state` keyed-state ledger, frozen at
+            # archive time ("keyed_state", not "state" — that field is
+            # already the job status string)
+            summary["keyed_state"] = introspection.payload()
+    except Exception:  # noqa: BLE001 — introspection must never block archiving
         pass
     try:
         from flink_tpu.runtime.profiler import get_profiler
@@ -251,6 +262,7 @@ class HistoryServer:
             parse_bottleneck_params,
             parse_flamegraph_params,
             parse_history_params,
+            parse_state_params,
         )
         split = urllib.parse.urlsplit(raw_path)
         path = split.path
@@ -307,6 +319,20 @@ class HistoryServer:
                                             "jobs": {}}
             return flamegraph_payload(export, name, vertex=vertex,
                                       mode=mode)
+        if path.startswith("/jobs/") and path.endswith("/state"):
+            job = self._find(jobs, path[len("/jobs/"):-len("/state")])
+            top = parse_state_params(query)
+            state = job.get("keyed_state")
+            if state is None:
+                # same shape as a live monitor with introspection off
+                from flink_tpu.state.introspect import StateIntrospection
+                return StateIntrospection().payload(top=top)
+            if top is not None:
+                # the archive froze the default top-10 list; `top` can
+                # only narrow it after the fact
+                state = dict(state)
+                state["hot_keys"] = list(state.get("hot_keys") or [])[:top]
+            return state
         if path.startswith("/jobs/") and path.endswith("/metrics"):
             job = self._find(jobs, path[len("/jobs/"):-len("/metrics")])
             metrics = job.get("metrics") or {}

@@ -72,6 +72,7 @@ from flink_tpu.runtime.metrics import (
     register_checkpoint_gauges,
     register_faulttolerance_gauges,
     register_state_gauges,
+    register_state_introspection_gauges,
 )
 from flink_tpu.runtime.tracing import (
     get_tracer,
@@ -1233,6 +1234,7 @@ class LocalExecutor:
         self.channel_capacity = channel_capacity
         self.metrics = metric_registry or MetricRegistry()
         register_state_gauges(self.metrics)
+        register_state_introspection_gauges(self.metrics)
         register_device_gauges(self.metrics)
         register_profiler_gauges(self.metrics)
         self.latency_interval_ms = latency_interval_ms

@@ -618,3 +618,35 @@ def register_state_gauges(metrics: MetricRegistry) -> None:
     d.gauge("evictions", lambda: _dev("evictions"))
     d.gauge("promotions", lambda: _dev("promotions"))
     d.gauge("pendingDepth", lambda: _dev("pending_depth"))
+
+    # per-state attribution of the batch/fallback split (the aggregate
+    # gauge names above are pinned; these are the drill-down)
+    ps = g.add_group("perState")
+    ps.gauge("batchRows", lambda: dict(s.per_state_batch_rows))
+    ps.gauge("batchCalls", lambda: dict(s.per_state_batch_calls))
+    ps.gauge("rowFallbackRows", lambda: dict(s.per_state_fallback_rows))
+    ps.gauge("rowFallbackCalls", lambda: dict(s.per_state_fallback_calls))
+
+
+def register_state_introspection_gauges(metrics: MetricRegistry) -> None:
+    """Publish the keyed-state introspection plane's gauge surface
+    under the same root `state` group (add_group dedups): skew ratio,
+    hottest key group, occupied key groups, top hot-key share and
+    hot-key count, plus the enabled flag.  All read the cheap
+    tracker-side summary — no accounting table walk per journal tick.
+    Zeros while the plane is disabled, so the `key-skew-sustained`
+    health rule stays quiet."""
+    from flink_tpu.state.introspect import get_introspection
+
+    t = get_introspection()
+    g = metrics.root.add_group("state")
+    g.gauge("introspectionEnabled", lambda: 1 if t.enabled else 0)
+
+    def _skew(field):
+        return t.skew_summary()[field]
+
+    g.gauge("keyGroupSkew", lambda: _skew("ratio"))
+    g.gauge("hotKeyGroup", lambda: _skew("hot_key_group"))
+    g.gauge("occupiedKeyGroups", lambda: _skew("occupied_key_groups"))
+    g.gauge("hotKeyShare", lambda: _skew("hot_key_share"))
+    g.gauge("hotKeys", lambda: _skew("hot_keys"))

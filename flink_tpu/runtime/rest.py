@@ -102,6 +102,24 @@ def parse_flamegraph_params(query: Dict[str, list]) -> tuple:
             f"unknown 'mode' (want one of {'|'.join(MODES)}): {mode!r}")
     return vertex, mode
 
+
+def parse_state_params(query: Dict[str, list]) -> Optional[int]:
+    """Validate `/jobs/<n>/state` query params into the hot-key list
+    cap `top`; raises BadRequest on garbage.  Shared by the live
+    WebMonitor and the HistoryServer so the two routes cannot
+    diverge."""
+    top = None
+    if "top" in query:
+        try:
+            top = int(query["top"][0])
+        except (ValueError, TypeError):
+            raise BadRequest(
+                f"malformed 'top' (want int): "
+                f"{query['top'][0]!r}") from None
+        if top <= 0:
+            raise BadRequest(f"'top' must be positive: {top}")
+    return top
+
 #: the dashboard (ref: flink-runtime-web/web-dashboard — scaled to one
 #: dependency-free page over the JSON routes below).  Status colors
 #: always pair with a glyph + label (never color alone); all text
@@ -381,6 +399,18 @@ class WebMonitor:
             # device plane per host, surfaced while the job is tracked
             from flink_tpu.runtime.device_stats import get_telemetry
             return get_telemetry().payload(), "application/json"
+        if path.startswith("/jobs/") and path.endswith("/state"):
+            job = urllib.parse.unquote(
+                path[len("/jobs/"):-len("/state")])
+            if job not in self.jobs:
+                raise KeyError(path)
+            top = parse_state_params(query)
+            # the introspection plane is process-global (like the
+            # device ledger): per-state per-key-group accounting, hot
+            # keys and the skew verdict, surfaced while the job is
+            # tracked; {"enabled": false, ...} while disabled
+            from flink_tpu.state.introspect import get_introspection
+            return get_introspection().payload(top=top), "application/json"
         if path.startswith("/jobs/") and path.endswith("/flamegraph"):
             job = urllib.parse.unquote(
                 path[len("/jobs/"):-len("/flamegraph")])

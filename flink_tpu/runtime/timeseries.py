@@ -255,6 +255,14 @@ class HealthEvaluator:
         consecutive sample intervals: the job is paying a per-result
         device readback tax (docs/state.md's fire-path caveat) instead
         of amortizing fires over batched reads.
+      * ``key-skew-sustained`` — the keyed-state introspection plane's
+        ``state.keyGroupSkew`` gauge (max/mean occupied key-group
+        load) stayed above `key_skew_threshold` for
+        `key_skew_consecutive` consecutive samples: one or a few hot
+        key groups carry the traffic.  The alert names the hottest key
+        group (``state.hotKeyGroup``) — the rescale/partitioning input
+        ROADMAP item 4 consumes.  Quiet while introspection is
+        disabled (the gauge reads 0).
     """
 
     def __init__(self, journal: MetricsJournal,
@@ -267,6 +275,8 @@ class HealthEvaluator:
                  bottleneck_consecutive: int = 5,
                  transfer_tax_threshold: float = 4.0,
                  transfer_tax_consecutive: int = 5,
+                 key_skew_threshold: float = 3.0,
+                 key_skew_consecutive: int = 3,
                  max_alerts: int = 256,
                  wall_clock: Callable[[], float] = None):
         self.journal = journal
@@ -279,6 +289,8 @@ class HealthEvaluator:
         self.bottleneck_consecutive = max(2, bottleneck_consecutive)
         self.transfer_tax_threshold = transfer_tax_threshold
         self.transfer_tax_consecutive = max(2, transfer_tax_consecutive)
+        self.key_skew_threshold = key_skew_threshold
+        self.key_skew_consecutive = max(2, key_skew_consecutive)
         self.max_alerts = max_alerts
         self._wall = wall_clock or (lambda: _time.time() * 1000.0)
         self._lock = threading.Lock()
@@ -331,6 +343,7 @@ class HealthEvaluator:
         self._eval_checkpoint_budget()
         self._eval_bottleneck()
         self._eval_transfer_tax()
+        self._eval_key_skew()
 
     def _tail(self, key: str, n: int) -> List[float]:
         samples = self.journal.series(key)
@@ -400,6 +413,22 @@ class HealthEvaluator:
             f"sustained device readback tax: > {thr} D2H fire reads "
             f"per fired window for {k} consecutive sample intervals "
             "(see docs/state.md, per-key fire path)", value)
+
+    def _eval_key_skew(self) -> None:
+        thr = self.key_skew_threshold
+        if thr is None:
+            return
+        k = self.key_skew_consecutive
+        tail = self._tail("state.keyGroupSkew", k)
+        firing = (len(tail) == k and all(v > thr for v in tail))
+        hot_kg = self.journal.latest("state.hotKeyGroup")
+        hot_kg = int(hot_kg) if hot_kg is not None and hot_kg >= 0 else None
+        self._episode(
+            "key-skew-sustained", "state.keyGroupSkew", firing,
+            f"keyed-state skew > {thr}x the mean occupied key-group "
+            f"load for {k} consecutive samples (hot key group "
+            f"{hot_kg}; see /jobs/<name>/state for the hot-key list)",
+            tail[-1] if tail else None)
 
     def _eval_bottleneck(self) -> None:
         if self.bottleneck_supplier is None:

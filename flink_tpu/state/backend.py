@@ -116,6 +116,10 @@ class KeyedStateBackend(abc.ABC):
         self.max_parallelism = max_parallelism
         self._current_key: Any = None
         self._current_key_group: int = -1
+        # introspection registry (WeakSet — unconditional and free;
+        # the plane only walks registered backends while enabled)
+        from flink_tpu.state.introspect import INTROSPECTION
+        INTROSPECTION.register_backend(self)
         #: name → bound state object (ref: keyValueStatesByName, :319)
         self._states: Dict[str, Any] = {}
         #: name → descriptor it was bound with (compatibility checks)
@@ -233,8 +237,12 @@ class KeyedStateBackend(abc.ABC):
         Leaves the backend's current key/namespace context undefined —
         callers in a row context must re-establish it.
         """
+        from flink_tpu.state.introspect import INTROSPECTION
         from flink_tpu.state.stats import STATE_STATS
         n = len(keys)
+        name = _state_name(state)
+        if INTROSPECTION.enabled:
+            INTROSPECTION.note_ingest(name, keys, self.max_parallelism)
         native = getattr(state, "add_batch", None)
         if native is not None:
             if pre_extracted:
@@ -245,8 +253,7 @@ class KeyedStateBackend(abc.ABC):
                        pre_extracted=True)
             else:
                 native(keys, namespace, values, namespaces=namespaces)
-            STATE_STATS.batch_calls += 1
-            STATE_STATS.batch_rows += n
+            STATE_STATS.note_batch(name, n)
             return "batch"
         if namespaces is None:
             state.set_current_namespace(namespace)
@@ -258,8 +265,7 @@ class KeyedStateBackend(abc.ABC):
                 self.set_current_key(keys[i])
                 state.set_current_namespace(namespaces[i])
                 state.add(values[i])
-        STATE_STATS.row_fallback_calls += 1
-        STATE_STATS.row_fallback_rows += n
+        STATE_STATS.note_fallback(name, n)
         return "rows"
 
     def get_batch(self, state, keys, namespace, namespaces=None):
@@ -284,11 +290,11 @@ class KeyedStateBackend(abc.ABC):
         """
         from flink_tpu.state.stats import STATE_STATS
         n = len(keys)
+        name = _state_name(state)
         native = getattr(state, "get_batch", None)
         if native is not None:
             results, found = native(keys, namespace, namespaces=namespaces)
-            STATE_STATS.batch_calls += 1
-            STATE_STATS.batch_rows += n
+            STATE_STATS.note_batch(name, n)
             return results, found, "batch"
         results = []
         found = np.empty(n, bool)
@@ -301,8 +307,7 @@ class KeyedStateBackend(abc.ABC):
             v = state.get()
             results.append(v)
             found[i] = v is not None
-        STATE_STATS.row_fallback_calls += 1
-        STATE_STATS.row_fallback_rows += n
+        STATE_STATS.note_fallback(name, n)
         return results, found, "rows"
 
     def clear_batch(self, state, keys, namespace, namespaces=None) -> str:
@@ -426,8 +431,29 @@ class KeyedStateBackend(abc.ABC):
         all old subtasks; chunks outside the range are skipped).
         Implementations call `check_serializer_compatibility` first."""
 
+    # ---- keyed-state introspection ----------------------------------
+    def accounting_breakdown(self) -> Dict[str, Dict[int, dict]]:
+        """Per-(state, key-group) accounting:
+        ``{state_name: {key_group: {"rows", "bytes", "namespaces"}}}``.
+        Bytes follow the snapshot's serialization exactly — component
+        ndarray nbytes for columnar rows, pickled length for boxed
+        rows — so live accounting, the archive payload and the offline
+        inspector always agree.  Backends with tables override."""
+        return {}
+
     def dispose(self) -> None:
+        # freeze accounting BEFORE subclasses clear their tables
+        # (subclass disposes call super().dispose() first), so a
+        # finished job's numbers survive into the archive payload
+        from flink_tpu.state.introspect import INTROSPECTION
+        if INTROSPECTION.enabled:
+            INTROSPECTION.note_dispose(self)
         self._states.clear()
+
+
+def _state_name(state) -> str:
+    d = getattr(state, "_descriptor", None)
+    return getattr(d, "name", "?") if d is not None else "?"
 
 
 def encode_obj_column(values) -> tuple:

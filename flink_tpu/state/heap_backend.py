@@ -665,6 +665,52 @@ class HeapKeyedStateBackend(KeyedStateBackend):
         t = self._tables.get(state_name)
         return list(t.keys(namespace)) if t else []
 
+    def accounting_breakdown(self) -> Dict[str, Dict[int, dict]]:
+        """Per-(state, key-group) rows/bytes/namespaces over the live
+        tables, via the SAME key-group split and bytes definition the
+        snapshot serializer uses: typed column segments count
+        rows × itemsize (== the chunk's value buffer nbytes), boxed and
+        plain-table rows count their standalone pickled length."""
+        from flink_tpu.state.introspect import pickled_len
+        out: Dict[str, Dict[int, dict]] = {}
+        mp = self.max_parallelism
+
+        def entry(per_kg, kg):
+            e = per_kg.get(kg)
+            if e is None:
+                e = per_kg[kg] = {"rows": 0, "bytes": 0, "_ns": set()}
+            return e
+
+        for name, table in self._tables.items():
+            per_kg = out.setdefault(name, {})
+            if isinstance(table, ColumnStateTable):
+                for namespace, bkeys, vals, boxed in table.column_blocks():
+                    if vals is None:
+                        for key, value in zip(bkeys, boxed):
+                            kg = assign_to_key_group(key, mp)
+                            e = entry(per_kg, kg)
+                            e["rows"] += 1
+                            e["bytes"] += pickled_len(value)
+                            e["_ns"].add(namespace)
+                        continue
+                    itemsize = vals.dtype.itemsize
+                    for kg, idx in split_column_by_key_group(bkeys, mp):
+                        e = entry(per_kg, kg)
+                        e["rows"] += len(idx)
+                        e["bytes"] += len(idx) * itemsize
+                        e["_ns"].add(namespace)
+            else:
+                for namespace, key, value in table.entries():
+                    kg = assign_to_key_group(key, mp)
+                    e = entry(per_kg, kg)
+                    e["rows"] += 1
+                    e["bytes"] += pickled_len(value)
+                    e["_ns"].add(namespace)
+        return {name: {kg: {"rows": e["rows"], "bytes": e["bytes"],
+                            "namespaces": len(e["_ns"])}
+                       for kg, e in per_kg.items()}
+                for name, per_kg in out.items()}
+
     def _migrate_state_values(self, descriptor, serializer,
                               restored_cfg) -> None:
         """Rewrite restored table values through the serializer's
@@ -725,6 +771,7 @@ class HeapKeyedStateBackend(KeyedStateBackend):
         return KeyedStateSnapshot(
             chunks,
             meta={"backend": self.name,
+                  "max_parallelism": self.max_parallelism,
                   "serializers": self.serializer_config_snapshots()},
         )
 

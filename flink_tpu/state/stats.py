@@ -22,6 +22,8 @@ class StateStats:
         "batch_rows", "row_fallback_rows", "batch_calls",
         "row_fallback_calls", "flush_batches", "flush_rows",
         "flush_sizes", "snapshot_columns", "snapshot_rows",
+        "per_state_batch_rows", "per_state_batch_calls",
+        "per_state_fallback_rows", "per_state_fallback_calls",
     )
 
     def __init__(self) -> None:
@@ -42,6 +44,33 @@ class StateStats:
         #: snapshot rows serialized as columns vs boxed per-row
         self.snapshot_columns = 0
         self.snapshot_rows = 0
+        #: the same batch/fallback split ATTRIBUTED by state name, so a
+        #: fallback is traceable to the state that caused it; the
+        #: aggregate counters above stay authoritative for the
+        #: established gauge names
+        self.per_state_batch_rows = {}
+        self.per_state_batch_calls = {}
+        self.per_state_fallback_rows = {}
+        self.per_state_fallback_calls = {}
+
+    def note_batch(self, name: str, n: int) -> None:
+        """One backend-native add_batch/get_batch call of `n` rows on
+        state `name` (aggregates + the per-state split in one call)."""
+        self.batch_calls += 1
+        self.batch_rows += n
+        self.per_state_batch_calls[name] = \
+            self.per_state_batch_calls.get(name, 0) + 1
+        self.per_state_batch_rows[name] = \
+            self.per_state_batch_rows.get(name, 0) + n
+
+    def note_fallback(self, name: str, n: int) -> None:
+        """One per-row fallback pass of `n` rows on state `name`."""
+        self.row_fallback_calls += 1
+        self.row_fallback_rows += n
+        self.per_state_fallback_calls[name] = \
+            self.per_state_fallback_calls.get(name, 0) + 1
+        self.per_state_fallback_rows[name] = \
+            self.per_state_fallback_rows.get(name, 0) + n
 
     def note_flush(self, n: int) -> None:
         self.flush_batches += 1

@@ -878,6 +878,56 @@ class TpuKeyedStateBackend(KeyedStateBackend):
         t = self._tables.get(state_name)
         return list(t.keys(namespace)) if t else []
 
+    def accounting_breakdown(self) -> Dict[str, Dict[int, dict]]:
+        """Per-(state, key-group) rows/bytes/namespaces: host tables
+        count standalone pickled lengths (the snapshot's per-row tier);
+        device states count active entries (HBM slots + host spill,
+        INCLUDING rows still pending in the micro-batch ring — the
+        slot index is updated at add time) at the per-row component
+        width from ``agg.state_specs()``, which equals the snapshot's
+        gathered column nbytes — no D2H transfer needed."""
+        from flink_tpu.core.keygroups import assign_key_groups_np, \
+            stable_hashes_np
+        from flink_tpu.state.introspect import pickled_len
+        out: Dict[str, Dict[int, dict]] = {}
+        mp = self.max_parallelism
+
+        def entry(per_kg, kg):
+            e = per_kg.get(kg)
+            if e is None:
+                e = per_kg[kg] = {"rows": 0, "bytes": 0, "_ns": set()}
+            return e
+
+        for name, table in self._tables.items():
+            per_kg = out.setdefault(name, {})
+            for namespace, key, value in table.entries():
+                kg = assign_to_key_group(key, mp)
+                e = entry(per_kg, kg)
+                e["rows"] += 1
+                e["bytes"] += pickled_len(value)
+                e["_ns"].add(namespace)
+        for name, dstate in self._device_states.items():
+            per_kg = out.setdefault(name, {})
+            specs = dstate.agg.state_specs()
+            row_bytes = sum(
+                int(np.prod(spec.shape, dtype=np.int64))
+                * np.dtype(spec.dtype).itemsize
+                for spec in specs.values())
+            entries = list(dstate.active_entries())
+            if not entries:
+                continue
+            keys = [k for k, _ns in entries]
+            kgs = assign_key_groups_np(stable_hashes_np(keys), mp)
+            for (key, namespace), kg in zip(entries, kgs):
+                e = entry(per_kg, int(kg))
+                e["rows"] += 1
+                e["bytes"] += row_bytes
+                e["_ns"].add(namespace)
+        return {name: {kg: {"rows": e["rows"], "bytes": e["bytes"],
+                            "namespaces": len(e["_ns"])}
+                       for kg, e in per_kg.items()}
+                for name, per_kg in out.items()}
+
     # ---- snapshot / restore -----------------------------------------
     def snapshot(self) -> KeyedStateSnapshot:
         """v2 columnar chunk format: device states serialize as ONE
@@ -910,6 +960,7 @@ class TpuKeyedStateBackend(KeyedStateBackend):
         return KeyedStateSnapshot(
             chunks,
             meta={"backend": self.name,
+                  "max_parallelism": self.max_parallelism,
                   "serializers": self.serializer_config_snapshots()},
         )
 

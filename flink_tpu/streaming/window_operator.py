@@ -32,6 +32,7 @@ from flink_tpu.core.state import (
 )
 from flink_tpu.runtime.device_stats import TELEMETRY
 from flink_tpu.runtime.tracing import get_tracer
+from flink_tpu.state.introspect import INTROSPECTION
 from flink_tpu.streaming.elements import MAX_TIMESTAMP, StreamRecord
 from flink_tpu.streaming.operators import (
     AbstractUdfStreamOperator,
@@ -387,6 +388,11 @@ class WindowOperator(AbstractUdfStreamOperator):
                 ns = self._namespace_of(window)
                 self.window_state.set_current_namespace(ns)
                 self.window_state.add(self._state_value(record))
+                if INTROSPECTION.enabled:
+                    INTROSPECTION.note_row(
+                        self.state_descriptor.name,
+                        self.keyed_backend.current_key,
+                        self.keyed_backend.max_parallelism)
                 self.trigger_ctx.window = window
                 result = self.trigger.on_element(
                     record.value, record.timestamp, window, self.trigger_ctx)
@@ -577,6 +583,11 @@ class WindowOperator(AbstractUdfStreamOperator):
             ns = self._namespace_of(window)
             self.window_state.set_current_namespace(ns)
             self.window_state.add(self._state_value(record))
+            if INTROSPECTION.enabled:
+                INTROSPECTION.note_row(
+                    self.state_descriptor.name,
+                    self.keyed_backend.current_key,
+                    self.keyed_backend.max_parallelism)
             self.trigger_ctx.window = window
             result = self.trigger.on_element(
                 value, timestamp, window, self.trigger_ctx)

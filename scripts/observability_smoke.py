@@ -1,7 +1,7 @@
 #!/usr/bin/env python
 """CI observability smoke (ci_check.sh stage 4).
 
-Five short end-to-end checks over the observability plane:
+Six short end-to-end checks over the observability plane:
 
 1. a MiniCluster job with metric sampling + checkpointing on: the live
    `/jobs/<name>/metrics/history` route must fill with samples and the
@@ -22,7 +22,13 @@ Five short end-to-end checks over the observability plane:
 5. a MiniCluster job with the sampling profiler enabled at 50 Hz: the
    live `/jobs/<name>/flamegraph` route must serve a non-empty
    per-vertex d3 tree with nonzero samples, and all three modes
-   (full / on_cpu / off_cpu) must be well-formed.
+   (full / on_cpu / off_cpu) must be well-formed;
+6. keyed-state introspection on: a uniformly-keyed windowed job must
+   stay `balanced` with ZERO `key-skew-sustained` alerts, then a
+   seeded-skew twin (one hot key carrying ~50% of traffic) polled via
+   the live `/jobs/<name>/state` route must turn `skewed`, surface the
+   hot key at the top of the hot-key list, and fire exactly ONE
+   `key-skew-sustained` alert naming the hot key group.
 
 Exits 0 on success, 1 with a reason on the first failed check.
 """
@@ -313,6 +319,79 @@ def main():
     finally:
         profiler.disable()
         profiler.reset()
+
+    # ---- 6. keyed-state introspection: skew alert fires once --------
+    from flink_tpu.state.introspect import get_introspection
+
+    introspection = get_introspection()
+    introspection.enable()
+    try:
+        def run_keyed(name, key_fn, n=4000):
+            env = StreamExecutionEnvironment()
+            records = [((key_fn(i), 1.0), i * 5) for i in range(n)]
+            sink = CollectSink()
+            (env.from_collection(records, timestamped=True)
+                .key_by(lambda e: e[0])
+                .window(TumblingEventTimeWindows.of(5000))
+                .disable_device_operator()
+                .aggregate(_FieldSum(), window_function=(
+                    lambda key, w, vals: [(key, w.start, float(vals[0]))]))
+                .add_sink(sink))
+            env.graph.job_name = name
+            executor = LocalExecutor(sample_interval_ms=2)
+            client = executor.execute_async(env.get_job_graph())
+            monitor = WebMonitor(executor.metrics).start()
+            state = None
+            try:
+                monitor.track_job(name, client)
+                deadline = time.monotonic() + 60
+                while time.monotonic() < deadline:
+                    state = _get(monitor.port, f"/jobs/{name}/state")
+                    if (state.get("skew") or {}).get("verdict") \
+                            not in (None, "idle", "disabled"):
+                        break
+                    time.sleep(0.05)
+                client.wait(timeout=120)
+                state = _get(monitor.port, f"/jobs/{name}/state")
+            finally:
+                monitor.stop()
+            evaluator = client.executor_state["health"]
+            alerts = [a for a in evaluator.snapshot_alerts()
+                      if a["rule"] == "key-skew-sustained"]
+            return state, alerts
+
+        state, alerts = run_keyed("smoke-uniform", lambda i: i % 64)
+        check(state.get("enabled") is True,
+              "live state route reports introspection enabled")
+        check(state["skew"]["verdict"] == "balanced",
+              f"uniform keys stay balanced "
+              f"(ratio {state['skew']['ratio']})")
+        check(len(alerts) == 0,
+              f"uniform job fired no key-skew alerts ({len(alerts)})")
+
+        introspection.reset()  # fresh trackers for the skewed twin
+        state, alerts = run_keyed(
+            "smoke-skew", lambda i: 0 if i % 2 == 0 else 1 + (i % 63))
+        check(state["skew"]["verdict"] == "skewed"
+              and state["skew"]["ratio"] > 3.0,
+              f"seeded hot key turns the verdict skewed "
+              f"(ratio {state['skew']['ratio']})")
+        hot = (state.get("hot_keys") or [{}])[0]
+        check("0" in str(hot.get("key"))
+              and float(hot.get("share", 0.0)) > 0.3,
+              f"hot-key list names the seeded key ({hot})")
+        check(state.get("accounting"),
+              "state route carries per-key-group accounting")
+        check(len(alerts) == 1,
+              f"seeded skew fired exactly one key-skew alert "
+              f"({len(alerts)})")
+        hot_kg = state["skew"]["hot_key_group"]
+        check(str(hot_kg) in alerts[0]["message"],
+              f"alert names the hot key group {hot_kg} "
+              f"({alerts[0]['message']!r})")
+    finally:
+        introspection.disable()
+        introspection.reset()
 
     print("observability smoke: PASSED")
     return 0

@@ -10,8 +10,12 @@ with zero boxed fallbacks on the batch side.  A fire-heavy leg
 fire sweep toggled against the per-timer drain, across the same
 restore, and asserts the device backend's fire-read count stays far
 below its windows-fired count (one gather per sweep, not one per
-fired window).  A smoke, not a benchmark: small event count,
-correctness asserts only.
+fired window).  A final leg writes a real checkpoint to disk with
+FsCheckpointStorage and re-reads it with the offline snapshot
+inspector (`flink_tpu state inspect`), requiring the offline per-state
+per-key-group rows/bytes to match the live backend's
+`accounting_breakdown()` EXACTLY.  A smoke, not a benchmark: small
+event count, correctness asserts only.
 
 Exit code 0 = clean.
 """
@@ -228,10 +232,67 @@ def main():
     finally:
         netchannel._encode_value_column = saved
 
+    # offline inspector leg: a real on-disk checkpoint, read back with
+    # no running job, must reproduce the live accounting exactly
+    import shutil
+    import tempfile
+
+    from flink_tpu.runtime.checkpoints import FsCheckpointStorage
+    from flink_tpu.state.introspect import inspect_checkpoint
+    from flink_tpu.streaming.elements import RecordBatch
+    from flink_tpu.streaming.harness import OneInputStreamOperatorTestHarness
+
+    for backend in ("heap", "tpu"):
+        h = OneInputStreamOperatorTestHarness(
+            make_operator(), key_selector=lambda x: x[0],
+            state_backend=backend)
+        h.open()
+        rng = np.random.default_rng(99)
+        for chunk in range(N_CHUNKS):
+            keys, vals, ts = chunk_arrays(chunk, rng)
+            h.process_batch(RecordBatch({"f0": keys, "f1": vals}, ts=ts))
+        live = h.operator.keyed_backend.accounting_breakdown()
+        assert live and any(per_kg for per_kg in live.values()), \
+            f"{backend} accounting breakdown is empty"
+        snap = h.snapshot()
+        tmp = tempfile.mkdtemp(prefix="state-smoke-chk-")
+        try:
+            storage = FsCheckpointStorage(tmp)
+            storage.persist(7, {"timestamp": 0}, {(0, 0): snap})
+            report = inspect_checkpoint(tmp, top=5, parallelism=4)
+            assert report["checkpoint_id"] == 7
+            for name, per_kg in live.items():
+                st = report["states"][name]
+                for kg, e in per_kg.items():
+                    got = st["key_groups"][kg]
+                    assert got["rows"] == e["rows"], \
+                        f"{backend} {name} kg {kg}: offline rows " \
+                        f"{got['rows']} != live {e['rows']}"
+                    assert got["bytes"] == e["bytes"], \
+                        f"{backend} {name} kg {kg}: offline bytes " \
+                        f"{got['bytes']} != live {e['bytes']}"
+                assert st["rows"] == sum(e["rows"]
+                                         for e in per_kg.values())
+                assert st["bytes"] == sum(e["bytes"]
+                                          for e in per_kg.values())
+            assert set(report["states"]) == set(live), \
+                f"{backend} inspector saw states " \
+                f"{sorted(report['states'])} vs live {sorted(live)}"
+            assert report["top_keys"], \
+                f"{backend} inspector produced no heaviest-key report"
+            total_rows = sum(st["rows"]
+                             for st in report["states"].values())
+            assert sum(s["rows"] for s in
+                       report["rescale"]["subtasks"]) == total_rows, \
+                f"{backend} rescale preview lost rows"
+        finally:
+            shutil.rmtree(tmp, ignore_errors=True)
+
     print(f"state_smoke: OK — {N_CHUNKS * CHUNK} events, "
           f"{len(reference)} window emissions (+{len(fire_ref)} on the "
           f"fire-heavy leg), heap+tpu x codec on/off x batched fires "
-          f"all bit-equal to the scalar reference across restore")
+          f"all bit-equal to the scalar reference across restore; "
+          f"offline inspector matches live accounting exactly")
     return 0
 
 
