@@ -986,6 +986,321 @@ def bench_columnar_chain(n_events=1 << 17, n_keys=256, window_ms=1000,
     }
 
 
+def bench_fused_chain(n_events=1 << 18, n_keys=256, window_ms=1000,
+                      chunk=1 << 16):
+    """Chain fusion A/B on the SAME columnar graph over real TCP:
+    batched source -> map x4 / filter x2 -> keyBy split -> wire ->
+    batch-mode decode -> tumbling-window sum, with (A) the six-stage
+    map/filter/hash/route prefix lowered into ONE jitted fused chain
+    program (streaming/chain_fusion.py) against (B) the identical
+    chain on per-operator column-kernel dispatch.  Interleaved in one
+    process, both sides asserted against a numpy reference, zero boxed
+    fallbacks and zero demotions required.  The timed leg is the
+    producer dispatch (batch push through the chain + channel fan-out
+    + flush); the TCP drain and the window fold are identical on both
+    sides and verified untimed — the delta is exactly the per-operator
+    dispatch + host-intermediate tax fusion removes.
+
+    Under --device-ledger the fused region must cross the host-device
+    boundary ONLY at the chain edges: every transfer recorded during
+    an A pass carries the `chain.boundary` tag (no intra-chain
+    H2D/D2H), and the program shows up in the kernel table under its
+    `chain.<head>-><tail>` label."""
+    from flink_tpu.core.functions import (
+        AggregateFunction,
+        _LambdaFilter,
+        _LambdaMap,
+        as_key_selector,
+    )
+    from flink_tpu.runtime.device_stats import TELEMETRY
+    from flink_tpu.runtime.local import _ChainedOutput, _RouterOutput
+    from flink_tpu.runtime.netchannel import DataClient, DataServer
+    from flink_tpu.streaming import chain_fusion
+    from flink_tpu.streaming.elements import (
+        MAX_TIMESTAMP,
+        RecordBatch,
+        Watermark,
+    )
+    from flink_tpu.streaming.generic_agg import GenericWindowOperator
+    from flink_tpu.streaming.operators import (
+        Output,
+        StreamFilter,
+        StreamMap,
+    )
+    from flink_tpu.streaming.partitioners import KeyGroupStreamPartitioner
+    from flink_tpu.streaming.windowing import TumblingEventTimeWindows
+
+    rng = np.random.default_rng(29)
+    keys64 = rng.integers(0, n_keys, n_events).astype(np.int64)
+    vals64 = rng.integers(0, 100, n_events).astype(np.int64)
+    ts64 = np.arange(n_events, dtype=np.int64)
+    # numpy reference for the whole pipeline (exact: int sums); mask
+    # conjunction commutes, so both filters apply to the full column
+    v2 = vals64 * 3 + 17
+    keep = (v2 % 7) != 0
+    v3 = v2 * 5 - 2
+    keep &= (v3 % 11) != 3
+    v4 = v3 // 2
+    wstart = ts64 - ts64 % window_ms
+    expected_rows = int(np.count_nonzero(keep))
+    ref = {}
+    for k, w, v in zip(keys64[keep].tolist(), wstart[keep].tolist(),
+                       v4[keep].tolist()):
+        ref[(k, w)] = ref.get((k, w), 0) + v
+    expected = sorted((k, w, s) for (k, w), s in ref.items())
+
+    class SumAgg(AggregateFunction):
+        def create_accumulator(self):
+            return 0
+
+        def add(self, value, acc):
+            return acc + value[1]
+
+        def get_result(self, acc):
+            return acc
+
+        def merge(self, a, b):
+            return a + b
+
+    class _ResultOut(Output):
+        def __init__(self):
+            self.values = []
+
+        def collect(self, record):
+            self.values.append(record.value)
+
+        def emit_watermark(self, watermark):
+            pass
+
+    class _ChainSink:
+        blocked = False
+        capacity = 1 << 30
+        queue = ()
+
+        def __init__(self):
+            self.rows = 0
+            self.head = None
+
+        def push(self, el):
+            if el.is_batch:
+                self.head.process_batch(el)
+                self.rows += len(el)
+            else:
+                self.head.process_element(el)
+                self.rows += 1
+
+        def push_batch(self, els):
+            for el in els:
+                self.push(el)
+
+    # the prefix under test: six liftable stages ending in the keyBy
+    # split — deep enough that per-operator dispatch pays six kernel
+    # hops, two compactions and a host partition per batch where the
+    # fused program pays one device program.  Operators (and the A
+    # side's compiled program) live across passes, exactly like a
+    # deployed subtask.
+    def build_chain(router):
+        ops = [
+            StreamMap(_LambdaMap(lambda t: (t[0], t[1] * 3))),
+            StreamMap(_LambdaMap(lambda t: (t[0], t[1] + 17))),
+            StreamFilter(_LambdaFilter(lambda t: t[1] % 7 != 0)),
+            StreamMap(_LambdaMap(lambda t: (t[0], t[1] * 5 - 2))),
+            StreamFilter(_LambdaFilter(lambda t: t[1] % 11 != 3)),
+            StreamMap(_LambdaMap(lambda t: (t[0], t[1] // 2))),
+        ]
+        ops[-1].setup(router)
+        for k in range(len(ops) - 2, -1, -1):
+            ops[k].setup(_ChainedOutput(ops[k + 1], router))
+        for op in ops:
+            op.open()
+        return ops
+
+    n_ch = 4
+    server = DataServer()
+    clients, sinks, routers, chains, progs = [], [], [], [], []
+    for tag in ("A", "B"):
+        client = DataClient()
+        side_sinks = [_ChainSink() for _ in range(n_ch)]
+        router = _RouterOutput()
+        outs = []
+        for c in range(n_ch):
+            key = (f"bench-fused-{tag}", 0, 1, c, 0)
+            outs.append(server.register_out_channel(key, capacity=1 << 20))
+            client.subscribe(server.address, key, side_sinks[c],
+                             capacity=1 << 20, columnar=True)
+        router.add_route(KeyGroupStreamPartitioner(as_key_selector(0), 128),
+                         outs)
+        ops = build_chain(router)
+        prog = None
+        if tag == "A":
+            prog = chain_fusion.compile_chain(ops, router=router)
+            assert prog is not None and prog.route_field == 0 \
+                and len(prog.kernel_ops) == len(ops), \
+                "the whole map/filter->keyBy prefix must compile"
+        clients.append(client)
+        sinks.append(side_sinks)
+        routers.append(router)
+        chains.append(ops)
+        progs.append(prog)
+
+    ledger_tags = set()
+    fused_batches = [0]
+    fused_passes = [0]
+
+    def one_pass(fused):
+        i_side = 0 if fused else 1
+        client, side = clients[i_side], sinks[i_side]
+        router, ops = routers[i_side], chains[i_side]
+        prog = progs[i_side]
+        results = []
+        for s in side:
+            gwo = GenericWindowOperator(
+                TumblingEventTimeWindows.of(window_ms), SumAgg(),
+                window_function=lambda k, w, rs: [(k, w.start, rs[0])])
+            out = _ResultOut()
+            gwo.setup(out, key_selector=as_key_selector(0))
+            gwo.open()
+            s.head = gwo
+            s.rows = 0
+            results.append(out)
+        pre_transfers = (set(TELEMETRY.payload()["transfers"])
+                         if fused and TELEMETRY.enabled else None)
+        # timed: the producer dispatch leg (chain kernels, hash +
+        # partition, channel fan-out, flush).  Drain + window fold are
+        # identical on both sides and verified below, untimed.
+        t0 = time.perf_counter()
+        for i in range(0, n_events, chunk):
+            batch = RecordBatch(
+                {"f0": keys64[i:i + chunk], "f1": vals64[i:i + chunk]},
+                ts64[i:i + chunk])
+            if fused and prog.wants(batch):
+                prog.run(batch)
+            else:
+                ops[0].process_batch(batch)
+        router.flush_records()
+        elapsed = time.perf_counter() - t0
+        server.wake()
+        while sum(s.rows for s in side) < expected_rows:
+            if client.error is not None:
+                raise client.error
+            client.replenish_credits()
+            time.sleep(0.0005)
+        for s in side:
+            s.head.process_watermark(Watermark(MAX_TIMESTAMP))
+        got = sorted((int(k), int(w), int(v))
+                     for out in results for k, w, v in out.values)
+        assert got == expected, \
+            f"{'fused' if fused else 'per-operator'} pipeline diverged " \
+            f"({len(got)} vs {len(expected)} windows)"
+        for op in ops:
+            assert op.boxed_fallbacks == 0, \
+                (type(op).__name__, op.columnar_fallback_reason)
+        if fused:
+            fused_passes[0] += 1
+            assert prog.active, \
+                f"fused chain demoted: {prog.demoted_reason}"
+            assert ops[0].fused_rows == n_events * fused_passes[0], \
+                "every batch must ride the fused program"
+            fused_batches[0] = n_events // chunk
+            if pre_transfers is not None:
+                new = set(TELEMETRY.payload()["transfers"]) - pre_transfers
+                tags = {t.split(".", 1)[1] for t in new}
+                ledger_tags.update(tags)
+                assert tags <= {"chain.boundary"}, \
+                    f"intra-chain host round-trips: {tags}"
+        return n_events / elapsed
+
+    try:
+        one_pass(True)    # warm: connections, probes, jit traces
+        one_pass(False)
+        fused_rate = perop_rate = 0.0
+        for _rep in range(5):
+            perop_rate = max(perop_rate, one_pass(False))
+            fused_rate = max(fused_rate, one_pass(True))
+    finally:
+        for client in clients:
+            client.stop()
+        server.stop()
+
+    # dispatch-only rail: the same six-stage chain into counting
+    # channels (no wire, no consumer) — isolates the per-operator
+    # dispatch + host-intermediate tax fusion removes from the shared
+    # TCP/serialize cost that dominates (and adds noise to) the
+    # end-to-end leg above
+    class _CountCh:
+        def __init__(self):
+            self.rows = 0
+
+        def push(self, el):
+            self.rows += len(el)
+
+    class _LocalRouter:
+        def __init__(self, channels):
+            self.routes = [(KeyGroupStreamPartitioner(
+                as_key_selector(0), 128), channels, None)]
+            self.records_out_counter = None
+
+        def flush_records(self):
+            pass
+
+        def collect_batch(self, batch):
+            for part, channels, _tag in self.routes:
+                for idx, sub in part.split_batch(batch, len(channels)):
+                    channels[idx].push(sub)
+
+    rails = {}
+    for fused in (True, False):
+        chans = [_CountCh() for _ in range(n_ch)]
+        router = _LocalRouter(chans)
+        ops = build_chain(router)
+        prog = (chain_fusion.compile_chain(ops, router=router)
+                if fused else None)
+        rails[fused] = (chans, ops, prog)
+
+    def dispatch_pass(fused):
+        chans, ops, prog = rails[fused]
+        for c in chans:
+            c.rows = 0
+        t0 = time.perf_counter()
+        for i in range(0, n_events, chunk):
+            batch = RecordBatch(
+                {"f0": keys64[i:i + chunk],
+                 "f1": vals64[i:i + chunk]}, ts64[i:i + chunk])
+            if fused and prog.wants(batch):
+                prog.run(batch)
+            else:
+                ops[0].process_batch(batch)
+        el = time.perf_counter() - t0
+        assert sum(c.rows for c in chans) == expected_rows
+        if fused:
+            assert prog.active, prog.demoted_reason
+        return n_events / el
+
+    dispatch_pass(True)   # warm probes / jit traces
+    dispatch_pass(False)
+    disp_fused = disp_perop = 0.0
+    for _rep in range(5):
+        disp_perop = max(disp_perop, dispatch_pass(False))
+        disp_fused = max(disp_fused, dispatch_pass(True))
+
+    extra = {
+        "rows_after_filter": expected_rows,
+        "fused_batches_per_pass": fused_batches[0],
+        "demotions": chain_fusion.FUSION_STATS.demotions,
+        "dispatch_only": {
+            "fused_events_per_sec": int(disp_fused),
+            "perop_events_per_sec": int(disp_perop),
+            "ratio": round(disp_fused / disp_perop, 2),
+        },
+    }
+    if TELEMETRY.enabled:
+        extra["fused_region_transfer_tags"] = sorted(ledger_tags)
+        kernels = TELEMETRY.payload()["kernels"]
+        extra["chain_kernel_labels"] = sorted(
+            k for k in kernels if k.startswith("chain."))
+    return fused_rate, perop_rate, extra
+
+
 def bench_state_chain(n_events=1 << 17, n_keys=64, window_ms=16000,
                       chunk=8192):
     """Keyed window state ingest: the identical tumbling event-time
@@ -1260,6 +1575,7 @@ def main():
         ("sql_join", bench_sql_join),
         ("shuffle", bench_shuffle),
         ("columnar_chain", bench_columnar_chain),
+        ("fused_chain", bench_fused_chain),
         ("state_chain", bench_state_chain),
         ("state_chain_fires", bench_state_chain_fires),
     ]

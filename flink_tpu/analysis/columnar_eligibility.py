@@ -83,13 +83,20 @@ def operator_batch_report(op) -> Tuple[str, str]:
 def operator_decided_by(op) -> str:
     """Who decided this operator's column-kernel path so far:
     ``"static"`` (type-flow verdict, probe-free), ``"probe"``
-    (first-batch probe), ``"pending"`` (kernel-eligible but no batch
-    seen yet; "static" when the typeflow stamp guarantees the probe
-    will be skipped), or ``""`` for operators without a kernel path."""
+    (first-batch probe), ``"fused"`` (the operator is a member of a
+    fused-chain program that ran at least one batch — see
+    streaming/chain_fusion.py), ``"pending"`` (kernel-eligible but no
+    batch seen yet; "static" when the typeflow stamp guarantees the
+    probe will be skipped), or ``""`` for operators without a kernel
+    path."""
     from flink_tpu.streaming.operators import _ColumnKernelMixin
+    # fused membership applies to ANY operator type (window operators
+    # ride fused chains without the mixin)
+    decided = getattr(op, "columnar_decided_by", None)
+    if decided == "fused" or getattr(op, "_fused_member", None) is not None:
+        return decided or "fused"
     if not isinstance(op, _ColumnKernelMixin):
         return ""
-    decided = getattr(op, "columnar_decided_by", None)
     if decided:
         return decided
     if getattr(op, "_static_kernel", False):
@@ -108,7 +115,12 @@ def chain_report(operators: List) -> dict:
     subscription pays off at all); ``prefix_len`` counts how many
     operators a batch survives before the first boxed hop reboxes it;
     ``first_blocker`` names that hop.  ``decided_by`` parallels
-    ``modes``: per-operator :func:`operator_decided_by`."""
+    ``modes``: per-operator :func:`operator_decided_by`.
+
+    ``fusion`` is the chain-fusion verdict on top: whether a prefix of
+    this chain lowers into ONE jitted columnar program
+    (streaming/chain_fusion.py), which operators ride it, and the
+    first operator that blocks fusion (with the reason)."""
     modes = []
     decided_by = []
     first_blocker: Optional[str] = None
@@ -122,12 +134,14 @@ def chain_report(operators: List) -> dict:
             first_blocker = name
         elif first_blocker is None:
             prefix += 1
+    from flink_tpu.streaming.chain_fusion import fusion_report
     return {
         "modes": modes,
         "decided_by": decided_by,
         "eligible": bool(modes) and modes[0][1] != BOXED,
         "first_blocker": first_blocker,
         "prefix_len": prefix,
+        "fusion": fusion_report(operators),
     }
 
 

@@ -546,11 +546,26 @@ class _GraphLinter:
                 continue  # factory errors already reported (FT190)
             rep = chain_report(ops)
             names = " -> ".join(c.name for c in chain_nodes)
+            fus = rep["fusion"]
+            if fus["fusable"]:
+                fused_note = (
+                    f"; fuses {len(fus['fused_ops'])} operators into one "
+                    f"jitted program"
+                    + (f", fusion stops at '{fus['first_blocker']}': "
+                       f"{fus['blocker_reason']}"
+                       if fus["first_blocker"] else ""))
+            else:
+                fused_note = (
+                    f"; no fusable run"
+                    + (f" — first fusion blocker '{fus['first_blocker']}': "
+                       f"{fus['blocker_reason']}"
+                       if fus["first_blocker"] else ""))
             if rep["eligible"] and rep["first_blocker"] is None:
                 self._diag(
                     "FT184",
                     f"chain [{names}] consumes columnar batches end to "
-                    f"end ({', '.join(f'{n}:{m}' for n, m, _ in rep['modes'])})",
+                    f"end ({', '.join(f'{n}:{m}' for n, m, _ in rep['modes'])})"
+                    f"{fused_note}",
                     node=node)
             elif rep["eligible"]:
                 blocker_i = rep["prefix_len"]
@@ -571,7 +586,7 @@ class _GraphLinter:
                     f"chain [{names}] rides columns for "
                     f"{rep['prefix_len']} of {len(ops)} operators, then "
                     f"boxes at '{chain_nodes[blocker_i].name}': "
-                    f"{reason}{edge_info}",
+                    f"{reason}{edge_info}{fused_note}",
                     node=chain_nodes[blocker_i],
                     hint="operators past the first boxing point pay "
                          "per-record StreamRecord costs")

@@ -390,6 +390,12 @@ def _top_rows(job, detail, metrics, prev, dt_s, hot=None):
                         if k.startswith(prefix)
                         and k.endswith(".columnar.boxed_fallbacks")
                         and isinstance(val, (int, float)))
+        # chain-fusion share: worst per-subtask fraction of rows that
+        # rode a fused chain program (None until a batch is seen)
+        fused_ratios = [val for k, val in metrics.items()
+                        if k.startswith(prefix)
+                        and k.endswith(".columnar.fused_ratio")
+                        and isinstance(val, (int, float))]
         bp = (detail.get("backpressure") or {}).get(str(v["id"])) or {}
         rows.append({
             "id": v["id"], "name": v["name"],
@@ -398,6 +404,7 @@ def _top_rows(job, detail, metrics, prev, dt_s, hot=None):
             "bp_ratio": bp.get("max_ratio"), "bp_level": bp.get("level"),
             "watermark_lag_ms": max(lags) if lags else None,
             "columnar_ratio": min(col_ratios) if col_ratios else None,
+            "fused_ratio": min(fused_ratios) if fused_ratios else None,
             "columnar_boxed": col_boxed,
             "hot": (hot or {}).get(v["id"]),
         })
@@ -508,7 +515,7 @@ def _top_typeflow_footer(job, metrics) -> str:
         v = metrics.get(f"{job}.typeflow.{key}")
         return v if isinstance(v, (int, float)) else None
 
-    static = probed = 0
+    static = probed = fused = 0
     probes = 0.0
     for k, v in metrics.items():
         if not k.startswith(f"{job}."):
@@ -518,10 +525,13 @@ def _top_typeflow_footer(job, metrics) -> str:
                 static += 1
             elif v == "probe":
                 probed += 1
+            elif v == "fused":
+                fused += 1
         elif k.endswith(".columnar.probes") \
                 and isinstance(v, (int, float)):
             probes += v
-    if g("edges_total") is None and not (static or probed or probes):
+    if g("edges_total") is None and not (static or probed or fused
+                                         or probes):
         return ""
     parts = []
     if g("edges_total") is not None:
@@ -531,8 +541,8 @@ def _top_typeflow_footer(job, metrics) -> str:
                      f"{g('kernels_total') or 0:,.0f} kernels proven")
         if g("pickle_edges"):
             parts.append(f"{g('pickle_edges'):,.0f} pickle edge(s)")
-    parts.append(f"kernels decided static {static} / probe {probed}, "
-                 f"probes run {probes:,.0f}")
+    parts.append(f"kernels decided static {static} / probe {probed} "
+                 f"/ fused {fused}, probes run {probes:,.0f}")
     return "typeflow: " + ", ".join(parts)
 
 
@@ -547,7 +557,7 @@ def _top_render(job, status, rows, checkpoints, alerts,
     lines = [f"job: {job}  [{status}]",
              f"{'id':>4}  {'vertex':<36} {'par':>3}  {'rec/s':>10}  "
              f"{'backpressure':<18} {'wmLag ms':>10} {'col%':>6} "
-             f"{'boxed':>6} {'BOTTLENECK':<10} {'HOT':<28}"]
+             f"{'fused%':>6} {'boxed':>6} {'BOTTLENECK':<10} {'HOT':<28}"]
     for r in rows:
         bp = "-"
         if r["bp_ratio"] is not None:
@@ -556,12 +566,15 @@ def _top_render(job, status, rows, checkpoints, alerts,
                 bp += f" ({r['bp_level']})"
         col = ("-" if r.get("columnar_ratio") is None
                else f"{r['columnar_ratio'] * 100:.0f}%")
+        fus = ("-" if r.get("fused_ratio") is None
+               else f"{r['fused_ratio'] * 100:.0f}%")
         marker = "<<<" if r["id"] == bn_vid else ""
         lines.append(
             f"{r['id']:>4}  {r['name'][:36]:<36} "
             f"{fmt(r['parallelism'], '{:d}'):>3}  "
             f"{fmt(r['records_per_s'], '{:,.0f}'):>10}  {bp:<18} "
             f"{fmt(r['watermark_lag_ms'], '{:,.0f}'):>10} {col:>6} "
+            f"{fus:>6} "
             f"{fmt(r.get('columnar_boxed'), '{:,.0f}'):>6} {marker:<10} "
             f"{(r.get('hot') or '-')[:28]:<28}")
     counts = checkpoints.get("counts") or {}

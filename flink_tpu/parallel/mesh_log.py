@@ -100,6 +100,66 @@ def _make_lane_exchange(mesh, axis: str):
         out_specs=(P(axis), P(axis))))
 
 
+def _make_packed_exchange(mesh, axis: str, cap: int):
+    """The counting-partition pack FUSED into the exchange program —
+    the `split_batch`-style fan-out run on device instead of the host
+    python loop in the legacy pack.
+
+    Each source shard's block arrives RAW (``lanes [1, m, K]`` plus a
+    per-row effective target ``tgt [1, m]``, masked rows = S): one
+    stable sort groups rows by target, a searchsorted rank caps each
+    bucket, a single scatter builds the ``[S, cap, K]`` send buckets
+    (slot ``S*cap`` is the garbage bin for overflow/masked rows), and
+    `lax.all_to_all` moves them — pack and collective in ONE compiled
+    step, and the H2D leg ships ``m*K`` lanes instead of the legacy
+    ``S*cap*K`` pre-padded buckets.
+
+    Loop-free by construction (sort + scatter + one collective): this
+    env has no shard_map replication rule for ``lax.while_loop``, so
+    nothing here may iterate on device.
+
+    Overflow discipline: the host pre-checks bucket counts with one
+    vectorized bincount and only takes this path when NO (source,
+    target) bucket overflows ``cap`` — the device program itself would
+    silently truncate (rows past ``cap`` land in the garbage bin), so
+    the guard keeps the fallback exact rather than best-effort."""
+    import jax
+    try:
+        from jax import shard_map
+    except ImportError:  # older jax
+        from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    import jax.numpy as jnp
+
+    S = mesh.shape[axis]
+
+    def local(lanes_blk, tgt_blk):
+        lanes, tgt = lanes_blk[0], tgt_blk[0]
+        m, k = lanes.shape
+        order = jnp.argsort(tgt, stable=True)
+        st = tgt[order]
+        rows = lanes[order]
+        first = jnp.searchsorted(st, st, side="left").astype(jnp.int32)
+        rank = jnp.arange(m, dtype=jnp.int32) - first
+        valid = (st < S) & (rank < cap)
+        slot = jnp.where(valid, st * cap + rank, S * cap)
+        bucks = jnp.zeros((S * cap + 1, k), jnp.uint32).at[slot].set(rows)
+        counts = jnp.minimum(
+            jnp.bincount(jnp.clip(st, 0, S), length=S + 1)[:S],
+            cap).astype(jnp.int32)
+        bucks = bucks[:S * cap].reshape(1, S, cap, k)
+        counts = counts.reshape(1, S)
+        ex = lambda x: jax.lax.all_to_all(  # noqa: E731
+            x, axis, split_axis=1, concat_axis=1)
+        return ex(bucks), ex(counts)
+
+    return jax.jit(shard_map(
+        local, mesh=mesh,
+        in_specs=(P(axis), P(axis)),
+        out_specs=(P(axis), P(axis))))
+
+
 class _MeshShardedLogEngine:
     """Generic wrapper: N per-shard log engines behind the all_to_all
     lane exchange.  Presents the standard engine interface
@@ -133,11 +193,23 @@ class _MeshShardedLogEngine:
         self.bucket_cap = min(
             m, max(1, int(bucket_factor * m / self.n_shards)))
         self._exchange = _make_lane_exchange(mesh, axis)
-        # reusable send buffer; rows beyond counts[s, t] are stale
-        # garbage that travels but is never read on the receive side
+        self._packed_exchange = _make_packed_exchange(
+            mesh, axis, self.bucket_cap)
+        # reusable send buffer for the host-pack fallback; rows beyond
+        # counts[s, t] are stale garbage that travels but is never
+        # read on the receive side
         self._buck_buf = np.zeros(
             (self.n_shards, self.n_shards, self.bucket_cap,
              self.n_lanes), np.uint32)
+        # row offsets for the one-bincount overflow precheck: source s
+        # contributes ids s*(S+1) + target, so one flat bincount yields
+        # the full [S, S+1] (source, target) count matrix
+        self._src_base = (np.arange(self.n_shards, dtype=np.int64)
+                          [:, None] * (self.n_shards + 1))
+        # in-flight (recv, rcounts) device arrays from the previous
+        # step on the overlapped (non-telemetry) path; delivered at the
+        # next step or at any drain point (flush / snapshot / fires)
+        self._inflight = None
         #: rows that overflowed a bucket and took the out-of-band path
         self.num_overflow_routed = 0
         self._keys_signed: Optional[bool] = None
@@ -215,44 +287,121 @@ class _MeshShardedLogEngine:
 
     def flush(self, grow_to: Optional[int] = None) -> None:
         """Exchange every pending row (the final partial step pads to
-        the compiled G with masked rows)."""
-        if self._p_n == 0:
-            return
-        lanes, tgt = self._concat_pending()
-        self._p_lanes, self._p_tgt, self._p_n = [], [], 0
-        G = self.step_batch
-        for off in range(0, len(lanes), G):
-            chunk_l, chunk_t = lanes[off:off + G], tgt[off:off + G]
-            n = len(chunk_l)
-            if n < G:
-                pad_l = np.zeros((G - n, self.n_lanes), np.uint32)
-                chunk_l = np.concatenate([chunk_l, pad_l])
-                chunk_t = np.concatenate(
-                    [chunk_t, np.zeros(G - n, np.int32)])
-            mask = np.zeros(G, bool)
-            mask[:n] = True
-            self._run_step(chunk_l, chunk_t, mask)
+        the compiled G with masked rows) and land any overlapped step
+        still in flight."""
+        if self._p_n:
+            lanes, tgt = self._concat_pending()
+            self._p_lanes, self._p_tgt, self._p_n = [], [], 0
+            G = self.step_batch
+            for off in range(0, len(lanes), G):
+                chunk_l, chunk_t = lanes[off:off + G], tgt[off:off + G]
+                n = len(chunk_l)
+                if n < G:
+                    pad_l = np.zeros((G - n, self.n_lanes), np.uint32)
+                    chunk_l = np.concatenate([chunk_l, pad_l])
+                    chunk_t = np.concatenate(
+                        [chunk_t, np.zeros(G - n, np.int32)])
+                mask = np.zeros(G, bool)
+                mask[:n] = True
+                self._run_step(chunk_l, chunk_t, mask)
+        self._drain_inflight()
 
     def _run_step(self, lanes: np.ndarray, tgt: np.ndarray,
                   mask: np.ndarray) -> None:
-        """One G-row exchange step: host counting-partition into
-        per-(source, target) buckets, device all_to_all, per-shard
-        delivery.  Each source slice models one ingest host's rows
-        (data-parallel split of the batch)."""
+        """One G-row exchange step.  Each source slice models one
+        ingest host's rows (data-parallel split of the batch).
+
+        Fast path (no bucket overflow, the common case by bucket_cap
+        construction): ship RAW lanes + targets and let the fused
+        device program pack AND exchange in one compiled step — the
+        host's only work is a single bincount precheck, and the H2D
+        payload is the m×K rows themselves rather than the padded
+        S×cap×K bucket buffer.  Overflowing steps fall back to the
+        host counting-partition pack (_run_step_hostpack), which
+        routes the beyond-cap tail out of band.
+
+        Without telemetry the fast path is double-buffered: the step's
+        device work is dispatched asynchronously and the PREVIOUS
+        step's results are converted/delivered while the fabric moves
+        this one, so collective time overlaps host delivery instead of
+        serializing with it (the all_to_all tax in BENCH_NOTES.md's
+        scaling table).  Rows still reach shard engines in step order
+        — every consumer of shard state drains the in-flight step
+        first (flush / advance_watermark / snapshot)."""
         S, cap = self.n_shards, self.bucket_cap
         m = len(lanes) // S
         telem = TELEMETRY.enabled
         t0 = _perf_ns() if telem else 0
+        tgt_eff = np.where(mask, tgt, S).astype(np.int32, copy=False)
+        te = tgt_eff.reshape(S, m)
+        counts_st = np.bincount(
+            (self._src_base + te).ravel(),
+            minlength=S * (S + 1)).reshape(S, S + 1)[:, :S]
+        if (counts_st > cap).any():
+            self._drain_inflight()
+            self._run_step_hostpack(lanes, te, t0)
+            return
+        lanes3 = np.ascontiguousarray(
+            lanes.reshape(S, m, self.n_lanes))
+        if telem:
+            # phase-split round: an explicit sharded device_put
+            # separates the H2D leg from the collective so the ledger
+            # attributes fabric time and staging time independently.
+            # pack_ms here is the host-side precheck/staging only —
+            # the pack itself rides inside the collective phase.
+            self._drain_inflight()
+            import jax
+            from jax.sharding import NamedSharding, PartitionSpec
+            t1 = _perf_ns()
+            sharding = NamedSharding(self.mesh, PartitionSpec(self.axis))
+            d_lanes = jax.device_put(lanes3, sharding)
+            d_tgt = jax.device_put(te, sharding)
+            jax.block_until_ready((d_lanes, d_tgt))
+            t2 = _perf_ns()
+            recv, rcounts = self._packed_exchange(d_lanes, d_tgt)
+            jax.block_until_ready((recv, rcounts))
+            t3 = _perf_ns()
+            recv = np.asarray(recv)
+            rcounts = np.asarray(rcounts)
+            t4 = _perf_ns()
+            sent = lanes3.nbytes + te.nbytes
+            TELEMETRY.record_transfer("h2d", sent, t1, t2,
+                                      tag="mesh.exchange")
+            TELEMETRY.record_transfer(
+                "d2h", recv.nbytes + rcounts.nbytes, t3, t4,
+                tag="mesh.exchange")
+            TELEMETRY.record_exchange_round(
+                "mesh.log", (t1 - t0) / 1e6, (t2 - t1) / 1e6,
+                (t3 - t2) / 1e6, (t4 - t3) / 1e6, sent)
+            self._deliver_recv(recv, rcounts)
+        else:
+            # launch this step before touching the previous one: the
+            # np.asarray below blocks on step k-1 while step k is
+            # already moving on the fabric
+            prev = self._inflight
+            self._inflight = self._packed_exchange(lanes3, te)
+            if prev is not None:
+                self._deliver_recv(np.asarray(prev[0]),
+                                   np.asarray(prev[1]))
+
+    def _run_step_hostpack(self, lanes: np.ndarray, te: np.ndarray,
+                           t0: int) -> None:
+        """Legacy host counting-partition pack for steps where some
+        (source, target) bucket overflows the cap: per-slice stable
+        sort, explicit bucket fill, pure all_to_all, with the
+        beyond-cap tail routed out of band."""
+        S, cap = self.n_shards, self.bucket_cap
+        m = te.shape[1]
+        telem = TELEMETRY.enabled
         bucks = self._buck_buf
         counts = np.zeros((S, S), np.int32)
         overflow = []           # (target, rows) beyond the bucket cap
         for s in range(S):
             sl = slice(s * m, (s + 1) * m)
-            sl_t, sl_m = tgt[sl], mask[sl]
+            tgt_eff = te[s]
             # one stable sort per slice groups rows by target (O(m log
             # m) independent of S; masked padding rows sort last as
             # virtual target S and never ship)
-            tgt_eff = np.where(sl_m, sl_t, S)
             order = np.argsort(tgt_eff, kind="stable")
             sl_sorted = lanes[sl][order]
             run_counts = np.bincount(tgt_eff, minlength=S + 1)
@@ -297,12 +446,7 @@ class _MeshShardedLogEngine:
             recv, rcounts = self._exchange(bucks, counts)
             recv = np.asarray(recv)
             rcounts = np.asarray(rcounts)
-        for j in range(S):
-            parts = [recv[j, s, :rcounts[j, s]]
-                     for s in range(S) if rcounts[j, s]]
-            if parts:
-                self._deliver(j, parts[0] if len(parts) == 1
-                              else np.concatenate(parts))
+        self._deliver_recv(recv, rcounts)
         # bucket-cap overflow: live rows the exchange could not fit.
         # This single-host runtime owns every shard engine, so they
         # route host-side; a multi-host runtime would re-send them on
@@ -310,6 +454,27 @@ class _MeshShardedLogEngine:
         for t, rows in overflow:
             self.num_overflow_routed += len(rows)
             self._deliver(int(t), rows)
+
+    def _deliver_recv(self, recv: np.ndarray,
+                      rcounts: np.ndarray) -> None:
+        S = self.n_shards
+        for j in range(S):
+            parts = [recv[j, s, :rcounts[j, s]]
+                     for s in range(S) if rcounts[j, s]]
+            if parts:
+                self._deliver(j, parts[0] if len(parts) == 1
+                              else np.concatenate(parts))
+
+    def _drain_inflight(self) -> None:
+        """Deliver the overlapped previous step, if any.  Called at
+        every point that observes shard-engine state (flush → fires,
+        snapshot) and before any out-of-order delivery path."""
+        inflight = self._inflight
+        if inflight is None:
+            return
+        self._inflight = None
+        self._deliver_recv(np.asarray(inflight[0]),
+                           np.asarray(inflight[1]))
 
     def _deliver(self, shard: int, rows: np.ndarray) -> None:
         keys_u64 = _join_u64(rows[:, 0], rows[:, 1])
@@ -360,6 +525,9 @@ class _MeshShardedLogEngine:
 
     # ---- checkpoint -------------------------------------------------
     def snapshot(self) -> dict:
+        # an overlapped step's rows are neither pending nor in any
+        # shard yet — land them first or the snapshot would lose them
+        self._drain_inflight()
         lanes, tgt = (self._concat_pending() if self._p_n
                       else (np.zeros((0, self.n_lanes), np.uint32),
                             np.zeros(0, np.int32)))
@@ -387,6 +555,8 @@ class _MeshShardedLogEngine:
                 f"{snap_mp}; this operator is configured "
                 f"{self.max_parallelism} — keys would route to "
                 "different shards than the ones holding their state")
+        # in-flight rows belong to the pre-restore stream: drop them
+        self._inflight = None
         self._keys_signed = snap["keys_signed"]
         self._p_lanes = ([snap["pending_lanes"]]
                          if len(snap["pending_lanes"]) else [])
@@ -397,7 +567,9 @@ class _MeshShardedLogEngine:
             sh.restore(s)
 
     def block_until_ready(self) -> None:
-        """Host-tier shard state is always materialized."""
+        """Land any overlapped exchange step; shard state itself is
+        host-resident and always materialized."""
+        self._drain_inflight()
 
 
 class MeshLogTumblingWindows(_MeshShardedLogEngine):

@@ -1379,6 +1379,10 @@ class TaskExecutor(RpcEndpoint):
                     att.assign(st)
         self._wire(att, job_graph, tdd, mine)
 
+        # open() AFTER _wire: fused chain programs compile at the end
+        # of open() and need the routes (channel fan-out is a jit-time
+        # constant).  Worker processes gate fusion through the
+        # FLINK_TPU_CHAIN_FUSION env var, which the launcher forwards.
         for st in att.subtasks:
             st.open()
         restore = tdd.get("restore")

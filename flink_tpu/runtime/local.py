@@ -150,9 +150,16 @@ class _ChainedOutput(Output):
         self.op.process_element(record)
 
     def collect_batch(self, batch):
-        # batches chain whole: the next operator's kernel (or its
-        # boxing fallback) decides, never this output
-        self.op.process_batch(batch)
+        # batches chain whole: a fused chain program anchored on the
+        # next operator takes the whole run in one jitted dispatch;
+        # otherwise the operator's kernel (or its boxing fallback)
+        # decides, never this output
+        op = self.op
+        fused = op._fused_chain
+        if fused is not None and fused.wants(batch):
+            fused.run(batch)
+            return
+        op.process_batch(batch)
 
     def emit_watermark(self, watermark):
         self.op.process_watermark(watermark)
@@ -607,6 +614,10 @@ class SubtaskInstance:
     def open(self):
         for op in self.operators:
             op.open()
+        # routes are wired before open() in every executor, so the
+        # fused-chain compiler sees the final channel fan-out
+        from flink_tpu.streaming.chain_fusion import try_fuse_subtask
+        try_fuse_subtask(self)
 
     def close(self):
         if self.closed:
@@ -941,7 +952,11 @@ class SubtaskInstance:
                         head.set_key_context2(record)
                     head.process_element2(record)
         else:
-            head.process_batch(batch)
+            fused = head._fused_chain
+            if fused is not None and fused.wants(batch):
+                fused.run(batch)
+            else:
+                head.process_batch(batch)
 
     def process_channel_watermark(self, input_index: int, channel_id: int,
                                   watermark: Watermark):

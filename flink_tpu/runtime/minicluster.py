@@ -241,7 +241,8 @@ class MiniCluster:
                  sample_interval_ms: Optional[int] = None,
                  metrics_history_size: int = 1024,
                  archive_dir: Optional[str] = None,
-                 columnar_pipeline: Optional[bool] = None):
+                 columnar_pipeline: Optional[bool] = None,
+                 chain_fusion: Optional[bool] = None):
         self.num_task_managers = num_task_managers
         self.state_backend = state_backend
         self.max_parallelism = max_parallelism
@@ -263,6 +264,11 @@ class MiniCluster:
         #: cluster runs (None = leave the global flag alone); the
         #: differential suite executes the same graph both ways
         self.columnar_pipeline = columnar_pipeline
+        #: force fused chain programs on/off the same way (None =
+        #: leave chain_fusion.FUSION_ENABLED alone); the fused-vs-
+        #: per-operator differential suite runs the same graph both
+        #: ways on one process
+        self.chain_fusion = chain_fusion
 
     # ---- public API -----------------------------------------------------
     def execute(self, job_graph: JobGraph) -> JobExecutionResult:
@@ -288,10 +294,14 @@ class MiniCluster:
         journal, evaluator = make_health_plane(
             self.metrics, self.sample_interval_ms,
             self.metrics_history_size, job_graph.job_name, client)
+        from flink_tpu.streaming import chain_fusion as _fusion
         from flink_tpu.streaming import columnar as _columnar
         saved_pipeline = _columnar.PIPELINE_ENABLED
         if self.columnar_pipeline is not None:
             _columnar.PIPELINE_ENABLED = self.columnar_pipeline
+        saved_fusion = _fusion.FUSION_ENABLED
+        if self.chain_fusion is not None:
+            _fusion.FUSION_ENABLED = self.chain_fusion
         try:
             while True:
                 try:
@@ -320,6 +330,8 @@ class MiniCluster:
         finally:
             if self.columnar_pipeline is not None:
                 _columnar.PIPELINE_ENABLED = saved_pipeline
+            if self.chain_fusion is not None:
+                _fusion.FUSION_ENABLED = saved_fusion
             archive_finished_job(self.archive_dir, self.metrics,
                                  job_graph, client, journal, evaluator)
 

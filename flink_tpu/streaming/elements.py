@@ -83,11 +83,11 @@ class RecordBatch(StreamElement):
     of writing columns in place.
     """
 
-    __slots__ = ("cols", "ts", "ts_mask")
+    __slots__ = ("cols", "ts", "ts_mask", "routing")
 
     is_batch = True
 
-    def __init__(self, cols, ts=None, ts_mask=None):
+    def __init__(self, cols, ts=None, ts_mask=None, routing=None):
         #: {name: np.ndarray} — all the same length
         self.cols = cols
         #: int64 ndarray of per-row event timestamps, or None
@@ -95,6 +95,13 @@ class RecordBatch(StreamElement):
         #: bool ndarray (True = row HAS a timestamp), or None when
         #: every row's validity equals ``ts is not None``
         self.ts_mask = ts_mask
+        #: optional uint64 ndarray of precomputed per-row routing
+        #: hashes (splitmix64 of the key column, exactly what
+        #: ``KeyGroupStreamPartitioner`` would compute).  Only a
+        #: producer that KNOWS the downstream key selector may set
+        #: this; ``take``/``with_cols`` deliberately drop it because
+        #: a gather or column rewrite invalidates row↔hash pairing.
+        self.routing = routing
 
     def __len__(self) -> int:
         return len(next(iter(self.cols.values()))) if self.cols else 0

@@ -157,6 +157,14 @@ class StreamOperator(abc.ABC):
     docs/internals/task_lifecycle.md): setup → open → process* →
     snapshot* → close → dispose."""
 
+    #: chain_fusion.FusedChainProgram anchored at this operator, or
+    #: None — a class attribute so the hot-path check in the task
+    #: layer is one attribute load with no per-instance cost
+    _fused_chain = None
+    #: the FusedChainProgram this operator is a MEMBER of (any
+    #: position in the chain, not just the anchor); cleared on demote
+    _fused_member = None
+
     def __init__(self):
         self.output: Optional[Output] = None
         self.keyed_backend: Optional[KeyedStateBackend] = None
@@ -178,9 +186,14 @@ class StreamOperator(abc.ABC):
         self.columnar_fallback_reason: Optional[str] = None
         self._boxed_fallbacks_counter = None
         # who decided the column-kernel path: "static" (typeflow
-        # verdict, probe skipped) or "probe" (first-batch probe)
+        # verdict, probe skipped), "probe" (first-batch probe) or
+        # "fused" (member of a chain_fusion program)
         self.columnar_decided_by: Optional[str] = None
         self.kernel_probes: int = 0
+        # rows this operator processed INSIDE a fused chain program
+        # (counted into columnar_rows too: fused is a strict subset
+        # of the columnar path)
+        self.fused_rows: int = 0
 
     # ---- wiring -----------------------------------------------------
     def setup(self, output: Output,
@@ -218,6 +231,7 @@ class StreamOperator(abc.ABC):
         group.gauge("watermarkLag", self._watermark_lag_ms)
         col = group.add_group("columnar")
         col.gauge("ratio", self._columnar_ratio)
+        col.gauge("fused_ratio", self._fused_ratio)
         col.gauge("fallback_reason",
                   lambda: self.columnar_fallback_reason or "")
         col.gauge("decided_by",
@@ -232,8 +246,21 @@ class StreamOperator(abc.ABC):
             return None  # never saw a batch: ratio undefined
         return self.columnar_rows / total
 
+    def _fused_ratio(self):
+        total = self.columnar_rows + self.boxed_rows
+        if total == 0:
+            return None  # never saw a batch: ratio undefined
+        return self.fused_rows / total
+
     def _note_columnar(self, n: int) -> None:
         self.columnar_rows += n
+
+    def _note_fused(self, n: int) -> None:
+        """Rows handled inside a fused chain program on this
+        operator's behalf — its own kernel never dispatched."""
+        self.fused_rows += n
+        self.columnar_rows += n
+        self.columnar_decided_by = "fused"
 
     def _note_boxed(self, n: int, reason: str) -> None:
         self.boxed_rows += n

@@ -245,13 +245,20 @@ class KeyGroupStreamPartitioner(StreamPartitioner):
         n = len(batch)
         if n == 0:
             return []
-        keys = self._vector_keys(batch, n)
-        if keys is not None:
-            hashes = splitmix64_np(keys)
+        pre = batch.routing
+        if pre is not None and pre.shape == (n,):
+            # a fused chain program already hashed the key column on
+            # device (same splitmix64 arithmetic, verified by its
+            # probe) — skip the host hash pass entirely
+            hashes = pre
         else:
-            get_key = self.key_selector.get_key
-            hashes = _routing_hashes(
-                [get_key(v) for v in batch.row_values()])
+            keys = self._vector_keys(batch, n)
+            if keys is not None:
+                hashes = splitmix64_np(keys)
+            else:
+                get_key = self.key_selector.get_key
+                hashes = _routing_hashes(
+                    [get_key(v) for v in batch.row_values()])
         idx = assign_operator_indexes_np(hashes, self.max_parallelism,
                                          num_channels)
         order = np.argsort(idx, kind="stable")

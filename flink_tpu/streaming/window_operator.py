@@ -469,7 +469,28 @@ class WindowOperator(AbstractUdfStreamOperator):
         self._process_batch_vectorized(batch, n)
         self._note_columnar(n)
 
-    def _process_batch_vectorized(self, batch, n: int) -> None:
+    def process_batch_fused(self, batch, last_start=None) -> None:
+        """Ingest a batch whose first-pane starts were already computed
+        on device inside a fused chain program (chain_fusion) —
+        identical to :meth:`process_batch` except the pane arithmetic
+        is skipped.  Every boxing guard stays armed: when one trips,
+        the precomputed column is simply dropped and the ordinary path
+        (vectorized or per-row) runs."""
+        n = len(batch)
+        if n == 0:
+            return
+        if (last_start is None
+                or self._batch_demote_reason is not None
+                or batch.ts is None
+                or (batch.ts_mask is not None and not batch.ts_mask.all())
+                or self.key_selector is None):
+            self.process_batch(batch)
+            return
+        self._process_batch_vectorized(batch, n, last_start=last_start)
+        self._note_fused(n)
+
+    def _process_batch_vectorized(self, batch, n: int,
+                                  last_start=None) -> None:
         ts = np.asarray(batch.ts, np.int64)
         values = batch.row_values()
         keys = self._batch_keys(batch, values)
@@ -489,7 +510,10 @@ class WindowOperator(AbstractUdfStreamOperator):
             c = agg.extract_column(batch.value_arrays())
             if isinstance(c, np.ndarray) and c.ndim == 1 and len(c) == n:
                 vcol = c
-        last_start = ts - ((ts - offset) % slide)
+        if last_start is None:
+            last_start = ts - ((ts - offset) % slide)
+        else:
+            last_start = np.asarray(last_start, np.int64)
         npanes = -(-size // slide)  # ceil; 1 for tumbling
         assigned = np.zeros(n, bool)
         immediate = np.zeros(n, bool)

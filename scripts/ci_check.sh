@@ -20,6 +20,10 @@
 #      per-timer drain) which asserts device fire-read growth stays
 #      far below windows-fired growth — one gather per watermark
 #      sweep, not one per fired window
+#   8. fusion gate — the fused-chain differential suite, then the
+#      fused-vs-per-operator smoke (bit-identical per-channel output,
+#      zero demotions, and a forced probe failure that must demote the
+#      chain with a reason while rows keep flowing)
 #
 # Stages keep running after a failure so one report covers
 # everything; rc is non-zero if ANY stage failed.
@@ -31,39 +35,45 @@ cd "$(dirname "$0")/.."
 export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
 rc=0
 
-echo "== stage 1/7: repo lint =="
+echo "== stage 1/8: repo lint =="
 scripts/lint_repo.sh || rc=1
 
 echo
-echo "== stage 2/7: strict graph lint over examples/ =="
+echo "== stage 2/8: strict graph lint over examples/ =="
 python -m flink_tpu lint --strict examples/ || rc=1
 
 echo
-echo "== stage 3/7: type-flow lint over examples/ =="
+echo "== stage 3/8: type-flow lint over examples/ =="
 python -m flink_tpu lint --types --strict examples/ || rc=1
 
 echo
-echo "== stage 4/7: tier-1 test suite =="
+echo "== stage 4/8: tier-1 test suite =="
 python -m pytest tests/ -q -m 'not slow' \
     --continue-on-collection-errors \
     -p no:cacheprovider -p no:xdist -p no:randomly || rc=1
 
 echo
-echo "== stage 5/7: observability smoke =="
+echo "== stage 5/8: observability smoke =="
 python scripts/observability_smoke.py || rc=1
 
 echo
-echo "== stage 6/7: columnar differential + shuffle codec smoke =="
+echo "== stage 6/8: columnar differential + shuffle codec smoke =="
 python -m pytest tests/test_columnar_pipeline.py -q \
     -p no:cacheprovider -p no:xdist -p no:randomly || rc=1
 python scripts/columnar_smoke.py || rc=1
 
 echo
-echo "== stage 7/7: state differential + batched-ingest/fire smoke =="
+echo "== stage 7/8: state differential + batched-ingest/fire smoke =="
 python -m pytest tests/test_state_batch.py tests/test_fire_batch.py \
     tests/test_timer_sweep.py -q \
     -p no:cacheprovider -p no:xdist -p no:randomly || rc=1
 python scripts/state_smoke.py || rc=1
+
+echo
+echo "== stage 8/8: fused-chain differential + fusion smoke =="
+python -m pytest tests/test_chain_fusion.py -q \
+    -p no:cacheprovider -p no:xdist -p no:randomly || rc=1
+python scripts/fusion_smoke.py || rc=1
 
 echo
 if [ "$rc" -eq 0 ]; then
